@@ -1,0 +1,199 @@
+"""Typed flag registry + INI-persisted user configuration.
+
+Reference parity: skyplane/config.py:11-370 (``_FLAG_TYPES``/``_DEFAULT_FLAGS``
+registry, INI persistence, ``get_flag``/``set_flag``). TPU-native additions:
+``compress`` accepts codec names (none/zstd/tpu/tpu_zstd/native_lz), plus
+``dedup`` / ``cdc_*`` / ``tpu_batch_*`` knobs controlling the accelerator data
+path.
+"""
+
+from __future__ import annotations
+
+import configparser
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from skyplane_tpu.exceptions import BadConfigException
+
+_FLAG_TYPES: Dict[str, type] = {
+    # data path
+    "compress": str,  # none | zstd | tpu | tpu_zstd | native_lz
+    "dedup": bool,  # content-defined-chunking dedup on the TPU path
+    "encrypt_e2e": bool,
+    "encrypt_socket_tls": bool,
+    "verify_checksums": bool,
+    "num_connections": int,
+    "max_instances": int,
+    "bbr": bool,
+    # chunking
+    "multipart_enabled": bool,
+    "multipart_min_threshold_mb": int,
+    "multipart_chunk_size_mb": int,
+    "multipart_max_chunks": int,
+    # TPU data-path
+    "tpu_batch_chunks": int,  # chunks per device batch
+    "tpu_block_bytes": int,  # block size for the block-suppress codec
+    "cdc_min_bytes": int,
+    "cdc_avg_bytes": int,
+    "cdc_max_bytes": int,
+    # provisioning
+    "aws_instance_class": str,
+    "azure_instance_class": str,
+    "gcp_instance_class": str,
+    "aws_use_spot_instances": bool,
+    "azure_use_spot_instances": bool,
+    "gcp_use_spot_instances": bool,
+    "gcp_use_premium_network": bool,
+    "autoshutdown_minutes": int,
+    # behavior
+    "native_cmd_enabled": bool,
+    "native_cmd_threshold_gb": int,
+    "usage_stats": bool,
+    "gateway_docker_image": str,
+}
+
+_DEFAULT_FLAGS: Dict[str, Any] = {
+    "compress": "tpu_zstd",
+    "dedup": True,
+    "encrypt_e2e": True,
+    "encrypt_socket_tls": True,
+    "verify_checksums": True,
+    "num_connections": 32,
+    "max_instances": 1,
+    "bbr": True,
+    "multipart_enabled": True,
+    "multipart_min_threshold_mb": 128,
+    "multipart_chunk_size_mb": 64,
+    "multipart_max_chunks": 9990,
+    "tpu_batch_chunks": 8,
+    "tpu_block_bytes": 512,
+    "cdc_min_bytes": 16 * 1024,
+    "cdc_avg_bytes": 64 * 1024,
+    "cdc_max_bytes": 256 * 1024,
+    "aws_instance_class": "m5.8xlarge",
+    "azure_instance_class": "Standard_D32_v5",
+    "gcp_instance_class": "n2-standard-32",
+    "aws_use_spot_instances": False,
+    "azure_use_spot_instances": False,
+    "gcp_use_spot_instances": False,
+    "gcp_use_premium_network": True,
+    "autoshutdown_minutes": 15,
+    "native_cmd_enabled": True,
+    "native_cmd_threshold_gb": 2,
+    "usage_stats": False,
+    "gateway_docker_image": "",
+}
+
+_AVAILABLE_CODECS = ("none", "zstd", "tpu", "tpu_zstd", "native_lz")
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    raise BadConfigException(f"{v!r} is not a valid boolean")
+
+
+@dataclass
+class SkyplaneConfig:
+    """User-level configuration persisted to an INI file."""
+
+    aws_enabled: bool = False
+    azure_enabled: bool = False
+    gcp_enabled: bool = False
+    azure_subscription_id: Optional[str] = None
+    azure_resource_group: Optional[str] = None
+    azure_umi_name: Optional[str] = None
+    gcp_project_id: Optional[str] = None
+    anon_clientid: Optional[str] = None
+    flags: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def default_config() -> "SkyplaneConfig":
+        return SkyplaneConfig()
+
+    @staticmethod
+    def load_config(path) -> "SkyplaneConfig":
+        path = Path(path)
+        config = configparser.ConfigParser()
+        config.read(path)
+        cfg = SkyplaneConfig()
+        if "aws" in config:
+            cfg.aws_enabled = _parse_bool(config.get("aws", "enabled", fallback="false"))
+        if "azure" in config:
+            cfg.azure_enabled = _parse_bool(config.get("azure", "enabled", fallback="false"))
+            cfg.azure_subscription_id = config.get("azure", "subscription_id", fallback=None)
+            cfg.azure_resource_group = config.get("azure", "resource_group", fallback=None)
+            cfg.azure_umi_name = config.get("azure", "umi_name", fallback=None)
+        if "gcp" in config:
+            cfg.gcp_enabled = _parse_bool(config.get("gcp", "enabled", fallback="false"))
+            cfg.gcp_project_id = config.get("gcp", "project_id", fallback=None)
+        if "client" in config:
+            cfg.anon_clientid = config.get("client", "anon_clientid", fallback=None)
+        if "flags" in config:
+            for key in config["flags"]:
+                if key in _FLAG_TYPES:
+                    cfg.flags[key] = SkyplaneConfig._coerce(key, config.get("flags", key))
+        return cfg
+
+    def to_config_file(self, path) -> None:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        config = configparser.ConfigParser()
+        config["aws"] = {"enabled": str(self.aws_enabled)}
+        config["azure"] = {"enabled": str(self.azure_enabled)}
+        if self.azure_subscription_id:
+            config["azure"]["subscription_id"] = self.azure_subscription_id
+        if self.azure_resource_group:
+            config["azure"]["resource_group"] = self.azure_resource_group
+        if self.azure_umi_name:
+            config["azure"]["umi_name"] = self.azure_umi_name
+        config["gcp"] = {"enabled": str(self.gcp_enabled)}
+        if self.gcp_project_id:
+            config["gcp"]["project_id"] = self.gcp_project_id
+        config["client"] = {}
+        if self.anon_clientid:
+            config["client"]["anon_clientid"] = self.anon_clientid
+        config["flags"] = {k: str(v) for k, v in self.flags.items()}
+        with path.open("w") as f:
+            config.write(f)
+
+    @staticmethod
+    def _coerce(name: str, value: Any) -> Any:
+        typ = _FLAG_TYPES[name]
+        if typ is bool:
+            coerced: Any = _parse_bool(value)
+        else:
+            try:
+                coerced = typ(value)
+            except (TypeError, ValueError) as e:
+                raise BadConfigException(f"flag {name}={value!r} is not a valid {typ.__name__}") from e
+        if name == "compress" and coerced not in _AVAILABLE_CODECS:
+            raise BadConfigException(f"compress must be one of {_AVAILABLE_CODECS}, got {coerced!r}")
+        return coerced
+
+    @staticmethod
+    def flag_names():
+        return sorted(_FLAG_TYPES)
+
+    def get_flag(self, name: str) -> Any:
+        if name not in _FLAG_TYPES:
+            raise BadConfigException(f"unknown flag: {name}")
+        if name in self.flags:
+            return self.flags[name]
+        return _DEFAULT_FLAGS[name]
+
+    def set_flag(self, name: str, value: Any) -> None:
+        if name not in _FLAG_TYPES:
+            raise BadConfigException(f"unknown flag: {name}")
+        self.flags[name] = self._coerce(name, value)
+
+    def check_config(self) -> None:
+        for name in self.flags:
+            if name not in _FLAG_TYPES:
+                raise BadConfigException(f"unknown flag persisted in config: {name}")

@@ -1,0 +1,54 @@
+"""Filesystem path constants + module-level config singleton.
+
+Reference parity: skyplane/config_paths.py:1-43. Paths live under
+``~/.skyplane_tpu`` (overridable via ``SKYPLANE_TPU_CONFIG_ROOT`` for tests).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+config_root = Path(os.environ.get("SKYPLANE_TPU_CONFIG_ROOT", "~/.skyplane_tpu")).expanduser()
+config_path = Path(os.environ.get("SKYPLANE_TPU_CONFIG", config_root / "config")).expanduser()
+
+aws_config_path = config_root / "aws_config"
+aws_quota_path = config_root / "aws_quota"
+azure_config_path = config_root / "azure_config"
+azure_quota_path = config_root / "azure_quota"
+gcp_config_path = config_root / "gcp_config"
+gcp_quota_path = config_root / "gcp_quota"
+
+key_root = config_root / "keys"
+tmp_log_dir = Path("/tmp/skyplane_tpu")
+
+host_uuid_path = config_root / "host_uuid"
+
+
+def _load_config():
+    from skyplane_tpu.config import SkyplaneConfig
+
+    if config_path.exists():
+        return SkyplaneConfig.load_config(config_path)
+    return SkyplaneConfig.default_config()
+
+
+class _LazyCloudConfig:
+    """Defer config file IO until first attribute access (keeps import cheap)."""
+
+    _inner = None
+
+    def _get(self):
+        if _LazyCloudConfig._inner is None:
+            _LazyCloudConfig._inner = _load_config()
+        return _LazyCloudConfig._inner
+
+    def __getattr__(self, name):
+        return getattr(self._get(), name)
+
+    def reload(self):
+        _LazyCloudConfig._inner = _load_config()
+        return _LazyCloudConfig._inner
+
+
+cloud_config = _LazyCloudConfig()

@@ -1,0 +1,102 @@
+"""Exception hierarchy for skyplane_tpu.
+
+Mirrors the capability surface of the reference exception module
+(reference: skyplane/exceptions.py:1-99) with a rich ``pretty_print_str`` on the
+base class, but is organized around the TPU-native data path (codec/dedup errors
+are first-class here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class SkyplaneTpuException(Exception):
+    """Base class for all framework errors."""
+
+    pretty_print_header = "SkyplaneTpu exception"
+
+    def pretty_print_str(self) -> str:
+        return f"[bold][red]{self.pretty_print_header}: {str(self)}[/red][/bold]"
+
+
+class GatewayException(SkyplaneTpuException):
+    """Raised when a remote gateway reports an error (reference: skyplane/exceptions.py Gateway)."""
+
+    pretty_print_header = "Gateway exception"
+
+    def __init__(self, message: str, gateway_id: Optional[str] = None, tracebacks: Optional[list] = None):
+        super().__init__(message)
+        self.gateway_id = gateway_id
+        self.tracebacks = tracebacks or []
+
+    def pretty_print_str(self) -> str:
+        out = f"[bold][red]{self.pretty_print_header}: {str(self)}[/red][/bold]"
+        for tb in self.tracebacks:
+            out += f"\n[red]{tb}[/red]"
+        return out
+
+
+class PermissionsException(SkyplaneTpuException):
+    pretty_print_header = "Permissions error"
+
+
+class MissingBucketException(SkyplaneTpuException):
+    pretty_print_header = "Bucket does not exist"
+
+
+class MissingObjectException(SkyplaneTpuException):
+    pretty_print_header = "Object does not exist"
+
+
+class ChecksumMismatchException(SkyplaneTpuException):
+    pretty_print_header = "Checksum mismatch"
+
+
+class DedupIntegrityException(SkyplaneTpuException):
+    """A dedup recipe referenced a fingerprint the receiver cannot resolve."""
+
+    pretty_print_header = "Dedup recipe integrity error"
+
+
+class CodecException(SkyplaneTpuException):
+    """Compression / decompression failure on the data path."""
+
+    pretty_print_header = "Codec error"
+
+
+class InsufficientVCPUException(SkyplaneTpuException):
+    pretty_print_header = "Insufficient vCPU quota"
+
+
+class GatewayContainerStartException(SkyplaneTpuException):
+    pretty_print_header = "Gateway failed to start"
+
+
+class TransferFailedException(SkyplaneTpuException):
+    pretty_print_header = "Transfer failed"
+
+    def __init__(self, message: str, failed_objects: Optional[list] = None):
+        super().__init__(message)
+        self.failed_objects = failed_objects or []
+
+    def pretty_print_str(self) -> str:
+        out = f"[bold][red]{self.pretty_print_header}: {str(self)}[/red][/bold]"
+        if self.failed_objects:
+            preview = ", ".join(str(o) for o in self.failed_objects[:16])
+            out += f"\n[red]Failed objects ({len(self.failed_objects)}): {preview}[/red]"
+        return out
+
+
+class NoSuchObjectException(SkyplaneTpuException):
+    pretty_print_header = "No such object"
+
+
+class BadConfigException(SkyplaneTpuException):
+    pretty_print_header = "Bad configuration"
+
+
+class MissingDependencyException(SkyplaneTpuException):
+    """An optional provider SDK is not installed in this environment."""
+
+    pretty_print_header = "Missing optional dependency"

@@ -1,0 +1,6 @@
+from skyplane_tpu.utils.fn import do_parallel, wait_for
+from skyplane_tpu.utils.retry import retry_backoff
+from skyplane_tpu.utils.timer import Timer
+from skyplane_tpu.utils.logger import logger
+
+__all__ = ["do_parallel", "wait_for", "retry_backoff", "Timer", "logger"]

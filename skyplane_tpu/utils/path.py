@@ -1,0 +1,54 @@
+"""URI parsing for transfer endpoints (reference: skyplane/utils/path.py:9-82).
+
+``parse_path("s3://bucket/key")`` -> ("s3", "bucket", "key"); local filesystem
+paths map to provider ``local`` with bucket "" and the full path as key.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Tuple
+
+from skyplane_tpu.exceptions import BadConfigException
+
+_SCHEMES = {
+    "s3": "s3",
+    "gs": "gs",
+    "gcs": "gs",
+    "azure": "azure",
+    "az": "azure",
+    "r2": "r2",
+    "cos": "cos",
+    "hdfs": "hdfs",
+    "local": "local",
+    "file": "local",
+}
+
+
+def parse_path(path: str) -> Tuple[str, str, str]:
+    """Return (provider, bucket, key_prefix) for a transfer endpoint URI."""
+    match = re.match(r"^([a-zA-Z0-9]+)://", path)
+    if match:
+        scheme = match.group(1).lower()
+        if scheme not in _SCHEMES:
+            raise BadConfigException(f"unknown URI scheme {scheme!r} in {path!r}")
+        provider = _SCHEMES[scheme]
+        rest = path[len(match.group(0)) :]
+        if provider == "local":
+            return "local", "", "/" + rest.lstrip("/")
+        if provider == "azure":
+            # azure://<storage_account>/<container>/<key>
+            parts = rest.split("/", 2)
+            if len(parts) < 2:
+                raise BadConfigException(f"azure path must be azure://account/container[/key]: {path!r}")
+            account, container = parts[0], parts[1]
+            key = parts[2] if len(parts) > 2 else ""
+            return "azure", f"{account}/{container}", key
+        parts = rest.split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        if not bucket:
+            raise BadConfigException(f"missing bucket in {path!r}")
+        return provider, bucket, key
+    # bare filesystem path
+    return "local", "", path

@@ -1,0 +1,33 @@
+"""Exponential-backoff retry (reference: skyplane/utils/retry.py:10-37)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from skyplane_tpu.utils.logger import logger
+
+R = TypeVar("R")
+
+
+def retry_backoff(
+    fn: Callable[[], R],
+    max_retries: int = 8,
+    initial_backoff: float = 0.1,
+    max_backoff: float = 8.0,
+    exception_class: Tuple[Type[BaseException], ...] = (Exception,),
+    log_errors: bool = True,
+) -> R:
+    backoff = initial_backoff
+    for attempt in range(max_retries):
+        try:
+            return fn()
+        except exception_class as e:
+            if attempt == max_retries - 1:
+                raise
+            if log_errors:
+                name = getattr(fn, "__name__", str(fn))
+                logger.fs.warning(f"retry_backoff: {name} failed (attempt {attempt + 1}/{max_retries}): {e}")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, max_backoff)
+    raise RuntimeError("unreachable")

@@ -1,0 +1,11 @@
+import os
+
+# Force CPU with 8 virtual devices BEFORE jax is imported anywhere, so sharding
+# tests exercise a multi-chip mesh without TPU hardware.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Keep test runs hermetic: never read the developer's real config file.
+os.environ.setdefault("SKYPLANE_TPU_CONFIG_ROOT", "/tmp/skyplane_tpu_test_config")

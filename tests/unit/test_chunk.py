@@ -1,0 +1,98 @@
+import socket
+import threading
+import uuid
+
+import pytest
+
+from skyplane_tpu.chunk import (
+    Chunk,
+    ChunkRequest,
+    ChunkState,
+    Codec,
+    WireProtocolHeader,
+    HEADER_LENGTH_BYTES,
+)
+from skyplane_tpu.exceptions import SkyplaneTpuException
+
+
+def make_header(**kw):
+    defaults = dict(
+        chunk_id=uuid.uuid4().hex,
+        data_len=123456,
+        raw_data_len=654321,
+        codec=int(Codec.TPU_BLOCK_ZSTD),
+        flags=0b101,
+        fingerprint="ab" * 16,
+        n_chunks_left_on_socket=7,
+    )
+    defaults.update(kw)
+    return WireProtocolHeader(**defaults)
+
+
+def test_header_roundtrip_bytes():
+    h = make_header()
+    data = h.to_bytes()
+    assert len(data) == HEADER_LENGTH_BYTES
+    h2 = WireProtocolHeader.from_bytes(data)
+    assert h2 == h
+    assert h2.is_compressed and h2.is_recipe and not h2.is_encrypted
+
+
+def test_header_rejects_bad_magic():
+    data = bytearray(make_header().to_bytes())
+    data[0] ^= 0xFF
+    with pytest.raises(SkyplaneTpuException):
+        WireProtocolHeader.from_bytes(bytes(data))
+
+
+def test_header_rejects_corruption():
+    data = bytearray(make_header().to_bytes())
+    data[30] ^= 0x01  # flip a bit in data_len
+    with pytest.raises(SkyplaneTpuException):
+        WireProtocolHeader.from_bytes(bytes(data))
+
+
+def test_header_socket_roundtrip():
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+    h = make_header()
+    received = {}
+
+    def serve():
+        conn, _ = server.accept()
+        received["header"] = WireProtocolHeader.from_socket(conn)
+        conn.close()
+
+    t = threading.Thread(target=serve)
+    t.start()
+    client = socket.create_connection(("127.0.0.1", port))
+    h.to_socket(client)
+    client.close()
+    t.join(timeout=5)
+    server.close()
+    assert received["header"] == h
+
+
+def test_chunk_to_wire_header_flags():
+    c = Chunk(src_key="a", dest_key="b", chunk_id=uuid.uuid4().hex, chunk_length_bytes=10, fingerprint="0f" * 16)
+    h = c.to_wire_header(
+        n_chunks_left_on_socket=3, wire_length=5, raw_wire_length=10, codec=Codec.ZSTD, is_compressed=True, is_encrypted=True
+    )
+    assert h.is_compressed and h.is_encrypted and not h.is_recipe
+    assert h.codec == int(Codec.ZSTD)
+    assert h.fingerprint == "0f" * 16
+    assert h.n_chunks_left_on_socket == 3
+
+
+def test_chunk_request_dict_roundtrip():
+    c = Chunk(src_key="k", dest_key="k2", chunk_id=uuid.uuid4().hex, chunk_length_bytes=42, part_number=2, upload_id="u")
+    req = ChunkRequest(chunk=c, src_region="aws:us-east-1", dst_region="gcp:us-central1-a", src_type="object_store")
+    req2 = ChunkRequest.from_dict(req.as_dict())
+    assert req2 == req
+
+
+def test_chunk_state_ordering():
+    assert ChunkState.registered < ChunkState.complete
+    assert ChunkState.from_str("COMPLETE") == ChunkState.complete

@@ -1,0 +1,51 @@
+import pytest
+
+from skyplane_tpu.config import SkyplaneConfig
+from skyplane_tpu.exceptions import BadConfigException
+
+
+def test_default_flags():
+    cfg = SkyplaneConfig.default_config()
+    assert cfg.get_flag("num_connections") == 32
+    assert cfg.get_flag("multipart_chunk_size_mb") == 64
+    assert cfg.get_flag("compress") == "tpu_zstd"
+    assert cfg.get_flag("dedup") is True
+
+
+def test_set_get_flag_coercion():
+    cfg = SkyplaneConfig.default_config()
+    cfg.set_flag("num_connections", "64")
+    assert cfg.get_flag("num_connections") == 64
+    cfg.set_flag("dedup", "false")
+    assert cfg.get_flag("dedup") is False
+
+
+def test_unknown_flag_raises():
+    cfg = SkyplaneConfig.default_config()
+    with pytest.raises(BadConfigException):
+        cfg.get_flag("nope")
+    with pytest.raises(BadConfigException):
+        cfg.set_flag("nope", 1)
+
+
+def test_bad_codec_rejected():
+    cfg = SkyplaneConfig.default_config()
+    with pytest.raises(BadConfigException):
+        cfg.set_flag("compress", "lzma")
+
+
+def test_ini_roundtrip(tmp_path):
+    cfg = SkyplaneConfig.default_config()
+    cfg.gcp_enabled = True
+    cfg.gcp_project_id = "proj-123"
+    cfg.set_flag("num_connections", 16)
+    cfg.set_flag("compress", "zstd")
+    p = tmp_path / "config"
+    cfg.to_config_file(p)
+    cfg2 = SkyplaneConfig.load_config(p)
+    assert cfg2.gcp_enabled is True
+    assert cfg2.gcp_project_id == "proj-123"
+    assert cfg2.get_flag("num_connections") == 16
+    assert cfg2.get_flag("compress") == "zstd"
+    # unset flags fall back to defaults
+    assert cfg2.get_flag("multipart_max_chunks") == 9990

@@ -1,0 +1,74 @@
+import time
+
+import pytest
+
+from skyplane_tpu.utils import do_parallel, retry_backoff, wait_for, Timer
+from skyplane_tpu.utils.path import parse_path
+from skyplane_tpu.exceptions import BadConfigException
+
+
+def test_do_parallel_results():
+    results = do_parallel(lambda x: x * 2, range(10), n=4)
+    assert sorted(results) == [(i, i * 2) for i in range(10)]
+
+
+def test_do_parallel_propagates_exception():
+    def f(x):
+        if x == 3:
+            raise ValueError("boom")
+        return x
+
+    with pytest.raises(ValueError):
+        do_parallel(f, range(5), n=2)
+
+
+def test_retry_backoff_eventually_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert retry_backoff(flaky, initial_backoff=0.001, log_errors=False) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_backoff_exhausts():
+    with pytest.raises(RuntimeError):
+        retry_backoff(lambda: (_ for _ in ()).throw(RuntimeError("always")), max_retries=2, initial_backoff=0.001, log_errors=False)
+
+
+def test_wait_for_timeout():
+    with pytest.raises(TimeoutError):
+        wait_for(lambda: False, timeout=0.05, interval=0.01)
+    wait_for(lambda: True, timeout=1)
+
+
+def test_timer():
+    with Timer() as t:
+        time.sleep(0.01)
+    assert t.elapsed >= 0.01
+
+
+@pytest.mark.parametrize(
+    "uri,expected",
+    [
+        ("s3://bucket/key/prefix", ("s3", "bucket", "key/prefix")),
+        ("gs://b/k", ("gs", "b", "k")),
+        ("gcs://b/", ("gs", "b", "")),
+        ("azure://acct/container/key", ("azure", "acct/container", "key")),
+        ("r2://accountid/bucket", ("r2", "accountid", "bucket")),
+        ("local:///tmp/x", ("local", "", "/tmp/x")),
+        ("/tmp/y", ("local", "", "/tmp/y")),
+        ("hdfs://namenode/path", ("hdfs", "namenode", "path")),
+    ],
+)
+def test_parse_path(uri, expected):
+    assert parse_path(uri) == expected
+
+
+def test_parse_path_bad_scheme():
+    with pytest.raises(BadConfigException):
+        parse_path("ftp://x/y")
