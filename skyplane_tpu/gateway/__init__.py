@@ -1,0 +1,13 @@
+"""Gateway data plane: per-VM daemon running operator DAGs over chunk queues.
+
+Reference parity: skyplane/gateway/ (SURVEY §2.2). Architectural differences
+from the reference:
+
+  * The compress/encrypt stage is the TPU data path (ops/), not CPU LZ4/NaCl
+    only — codecs are carried per-chunk in the wire header.
+  * The control API is a stdlib ThreadingHTTPServer (no Flask dependency on
+    gateway VMs).
+  * Workers are threads by default (the byte pump holds the GIL only in
+    socket/file syscalls and jax releases it during device compute);
+    ``n_processes`` semantics from the reference map to ``n_workers``.
+"""

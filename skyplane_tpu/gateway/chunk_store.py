@@ -1,0 +1,71 @@
+"""Disk-backed chunk staging + chunk state log.
+
+Reference parity: skyplane/gateway/chunk_store.py:14-109. Chunk payloads
+stage as ``<chunk_dir>/<chunk_id>.chunk``; chunk-state transitions are pushed
+onto a status queue the daemon API drains (reference: chunk_store.py:72-91).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+from skyplane_tpu.chunk import ChunkRequest, ChunkState
+from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+from skyplane_tpu.utils.logger import logger
+
+
+class ChunkStore:
+    def __init__(self, chunk_dir: str):
+        self.chunk_dir = Path(chunk_dir)
+        self.chunk_dir.mkdir(parents=True, exist_ok=True)
+        for stale in self.chunk_dir.glob("*.chunk"):
+            logger.fs.warning(f"removing stale chunk file {stale}")
+            stale.unlink()
+        # per-partition inbound queues (reference: chunk_store.py:44-49)
+        self.chunk_requests: Dict[str, GatewayQueue] = {}
+        self.chunk_status_queue: "queue.Queue[dict]" = queue.Queue()
+        self._lock = threading.Lock()
+
+    def add_partition(self, partition_id: str, inbound_queue: GatewayQueue) -> None:
+        if partition_id in self.chunk_requests:
+            raise ValueError(f"partition {partition_id} already registered")
+        self.chunk_requests[partition_id] = inbound_queue
+
+    def add_chunk_request(self, chunk_req: ChunkRequest, state: ChunkState = ChunkState.registered) -> None:
+        partition = chunk_req.chunk.partition_id
+        if partition not in self.chunk_requests:
+            raise ValueError(f"unknown partition {partition} (known: {list(self.chunk_requests)})")
+        self.log_chunk_state(chunk_req, state)
+        self.chunk_requests[partition].put(chunk_req)
+
+    def log_chunk_state(
+        self,
+        chunk_req: ChunkRequest,
+        new_status: ChunkState,
+        operator_handle: Optional[str] = None,
+        worker_id: Optional[int] = None,
+        metadata: Optional[dict] = None,
+    ) -> None:
+        record = {
+            "chunk_id": chunk_req.chunk.chunk_id,
+            "partition": chunk_req.chunk.partition_id,
+            "state": new_status.to_short_str(),
+            "time": time.time(),
+            "handle": operator_handle,
+            "worker_id": worker_id,
+        }
+        if metadata:
+            record.update(metadata)
+        self.chunk_status_queue.put(record)
+
+    def chunk_path(self, chunk_id: str) -> Path:
+        return self.chunk_dir / f"{chunk_id}.chunk"
+
+    def remaining_bytes(self) -> int:
+        return shutil.disk_usage(self.chunk_dir).free

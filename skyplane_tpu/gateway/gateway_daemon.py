@@ -1,0 +1,327 @@
+"""Per-VM gateway daemon: builds the operator DAG from a gateway program and
+pumps chunk state to the control API.
+
+Reference parity: skyplane/gateway/gateway_daemon.py:34-359 — program/info
+JSON loading, per-partition operator construction with mux queue wiring and
+terminal-operator counting, worker startup, and the chunk-status pump loop.
+
+Queue wiring rules (reference :126-308):
+  * roots of a partition's operator forest read from the partition inbound
+    queue (fed by POST /chunk_requests — either from the client or a remote
+    sender's pre-registration);
+  * ``mux_and`` children each get a replicated sub-queue (multicast);
+  * ``mux_or`` (or any multi-child parent) children compete on one shared
+    queue;
+  * leaf operators are *terminal*: a chunk is done at this gateway when every
+    terminal handle has processed it (explicit refcount in the API).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.gateway_daemon_api import GatewayDaemonAPI
+from skyplane_tpu.gateway.gateway_queue import GatewayANDQueue, GatewayQueue
+from skyplane_tpu.gateway.operators.gateway_operator import (
+    GatewayObjStoreReadOperator,
+    GatewayObjStoreWriteOperator,
+    GatewayOperator,
+    GatewayRandomDataGenOperator,
+    GatewayReadLocalOperator,
+    GatewaySenderOperator,
+    GatewayWaitReceiverOperator,
+    GatewayWriteLocalOperator,
+)
+from skyplane_tpu.gateway.operators.gateway_receiver import GatewayReceiver
+from skyplane_tpu.ops.cdc import CDCParams
+from skyplane_tpu.ops.dedup import SegmentStore
+from skyplane_tpu.utils.logger import logger
+
+
+class GatewayDaemon:
+    def __init__(
+        self,
+        region: str,
+        chunk_dir: str,
+        gateway_program: dict,
+        gateway_info: Dict[str, dict],
+        gateway_id: str,
+        control_port: int = 8081,
+        bind_host: str = "0.0.0.0",
+        e2ee_key: Optional[bytes] = None,
+        use_tls: bool = True,
+        cdc_params: Optional[CDCParams] = None,
+    ):
+        self.region = region
+        self.gateway_id = gateway_id
+        self.gateway_info = gateway_info
+        self.cdc_params = cdc_params or CDCParams()
+        self.chunk_store = ChunkStore(chunk_dir)
+        self.error_event = threading.Event()
+        self.error_queue: "queue.Queue[str]" = queue.Queue()
+        self.e2ee_key = e2ee_key
+        self.use_tls = use_tls
+
+        # dedup receive? (any receive op with dedup=True)
+        program_json = json.dumps(gateway_program)
+        dedup_receive = '"op_type": "receive"' in program_json and '"dedup": true' in program_json
+        self.receiver = GatewayReceiver(
+            region=region,
+            chunk_store=self.chunk_store,
+            error_event=self.error_event,
+            error_queue=self.error_queue,
+            use_tls=use_tls,
+            e2ee_key=e2ee_key,
+            dedup=dedup_receive,
+            segment_store=SegmentStore(spill_dir=Path(chunk_dir) / "segments") if dedup_receive else None,
+            bind_host=bind_host,
+        )
+
+        self.upload_id_map: Dict[str, str] = {}
+        self.operators: List[GatewayOperator] = []
+        self.terminal_operators: Dict[str, List[str]] = {}  # partition -> terminal group names
+        self.handle_to_group: Dict[str, Dict[str, str]] = {}  # partition -> handle -> group
+        self._or_counter = 0
+        self._build_operators(gateway_program)
+
+        self.api = GatewayDaemonAPI(
+            chunk_store=self.chunk_store,
+            receiver=self.receiver,
+            error_event=self.error_event,
+            error_queue=self.error_queue,
+            terminal_operators=self.terminal_operators,
+            handle_to_group=self.handle_to_group,
+            region=region,
+            gateway_id=gateway_id,
+            host=bind_host,
+            port=control_port,
+            compression_stats_fn=self._compression_stats,
+        )
+        self.api.upload_id_map_update = self._update_upload_ids
+
+    # ---- construction ----
+
+    def _update_upload_ids(self, body: Dict[str, str]) -> None:
+        self.upload_id_map.update(body)
+
+    def _compression_stats(self) -> dict:
+        agg = {"chunks": 0, "raw_bytes": 0, "wire_bytes": 0, "segments": 0, "ref_segments": 0}
+        for op in self.operators:
+            if isinstance(op, GatewaySenderOperator):
+                d = op.processor.stats.as_dict()
+                for k in agg:
+                    agg[k] += d.get(k, 0)
+        agg["compression_ratio"] = (agg["raw_bytes"] / agg["wire_bytes"]) if agg["wire_bytes"] else 1.0
+        return agg
+
+    def _build_operators(self, program: dict) -> None:
+        for group in program.get("plan", []):
+            partitions = group["partitions"]
+            roots = group["value"]
+            for pid in partitions:
+                inbound = GatewayQueue()
+                self.chunk_store.add_partition(pid, inbound)
+                terminals: List[str] = []
+                handle_groups: Dict[str, str] = {}
+                for root in roots:
+                    self._walk(root, inbound, pid, terminals, handle_groups, group_label=None)
+                self.terminal_operators[pid] = sorted(set(terminals))
+                self.handle_to_group[pid] = handle_groups
+
+    def _make_output_queue(self, children: List[dict]) -> Tuple[Optional[GatewayQueue], List[Tuple[dict, GatewayQueue, Optional[str]]]]:
+        """Decide this op's output queue and each child's (input queue, terminal
+        group). Children under mux_or compete for chunks, so they share ONE
+        terminal group (any-of completion); mux_and branches each form their
+        own group (all-of completion)."""
+        if not children:
+            return None, []
+        if len(children) == 1 and children[0]["op_type"] == "mux_and":
+            and_q = GatewayANDQueue()
+            return and_q, [(gc, and_q, None) for gc in children[0].get("children", [])]
+        if len(children) == 1 and children[0]["op_type"] == "mux_or":
+            shared = GatewayQueue()
+            self._or_counter += 1
+            or_group = children[0].get("handle") or f"or_group_{self._or_counter}"
+            return shared, [(gc, shared, or_group) for gc in children[0].get("children", [])]
+        shared = GatewayQueue()
+        self._or_counter += 1
+        or_group = f"or_group_{self._or_counter}"
+        return shared, [(c, shared, or_group) for c in children]
+
+    def _walk(
+        self,
+        op: dict,
+        input_queue: GatewayQueue,
+        pid: str,
+        terminals: List[str],
+        handle_groups: Dict[str, str],
+        group_label: Optional[str],
+    ) -> None:
+        op_type = op["op_type"]
+        handle = op.get("handle") or f"{op_type}_{len(self.operators)}"
+        if op_type in ("mux_and", "mux_or"):
+            # a mux at the root: wire its children straight to the inbound queue semantics
+            out_q, child_wiring = self._make_output_queue([op])
+            # forward every inbound chunk into the mux queue via a trivial pump
+            self._spawn_pump(input_queue, out_q, handle)
+            for child, q, child_group in child_wiring:
+                self._walk(child, q, pid, terminals, handle_groups, child_group)
+            return
+
+        children = op.get("children", [])
+        output_queue, child_wiring = self._make_output_queue(children)
+        operator = self._instantiate(op_type, op, handle, input_queue, output_queue)
+        self.operators.append(operator)
+        if not child_wiring:
+            group = group_label or handle
+            terminals.append(group)
+            handle_groups[handle] = group
+        for child, q, child_group in child_wiring:
+            # once inside an or-competition branch, all downstream leaves stay in
+            # that group — each chunk traverses exactly one competing branch
+            effective = group_label if group_label is not None else child_group
+            self._walk(child, q, pid, terminals, handle_groups, effective)
+
+    def _spawn_pump(self, src: GatewayQueue, dst: GatewayQueue, handle: str) -> None:
+        src.register_handle(handle)
+
+        def pump():
+            while not self.error_event.is_set():
+                try:
+                    dst.put(src.pop(handle, timeout=0.25))
+                except queue.Empty:
+                    continue
+
+        threading.Thread(target=pump, name=f"pump-{handle}", daemon=True).start()
+
+    def _instantiate(
+        self, op_type: str, op: dict, handle: str, input_queue: GatewayQueue, output_queue: Optional[GatewayQueue]
+    ) -> GatewayOperator:
+        common = dict(
+            handle=handle,
+            region=self.region,
+            input_queue=input_queue,
+            output_queue=output_queue,
+            error_event=self.error_event,
+            error_queue=self.error_queue,
+            chunk_store=self.chunk_store,
+        )
+        if op_type == "receive":
+            return GatewayWaitReceiverOperator(**common, n_workers=4)
+        if op_type == "read_object_store":
+            return GatewayObjStoreReadOperator(
+                **common,
+                n_workers=op.get("num_connections", 16),
+                bucket_name=op["bucket_name"],
+                bucket_region=op["bucket_region"],
+            )
+        if op_type == "write_object_store":
+            return GatewayObjStoreWriteOperator(
+                **common,
+                n_workers=op.get("num_connections", 16),
+                bucket_name=op["bucket_name"],
+                bucket_region=op["bucket_region"],
+                upload_id_map=self.upload_id_map,
+            )
+        if op_type == "read_local":
+            return GatewayReadLocalOperator(**common, n_workers=op.get("num_connections", 8))
+        if op_type == "write_local":
+            return GatewayWriteLocalOperator(**common, n_workers=4)
+        if op_type == "gen_data":
+            return GatewayRandomDataGenOperator(**common, n_workers=4)
+        if op_type == "send":
+            target_id = op["target_gateway_id"]
+            info = self.gateway_info.get(target_id, {})
+            host = info.get("private_ip") if op.get("private_ip") else info.get("public_ip")
+            host = host or info.get("public_ip") or info.get("private_ip")
+            if not host:
+                raise ValueError(f"no address for target gateway {target_id}")
+            return GatewaySenderOperator(
+                **common,
+                n_workers=op.get("num_connections", 16),
+                target_gateway_id=target_id,
+                target_host=host,
+                target_control_port=info.get("control_port", 8081),
+                codec_name=op.get("compress", "none") or "none",
+                dedup=op.get("dedup", False),
+                cdc_params=self.cdc_params,
+                e2ee_key=self.e2ee_key if op.get("encrypt") else None,
+                use_tls=self.use_tls,
+            )
+        raise ValueError(f"unknown operator type {op_type!r}")
+
+    # ---- run loop ----
+
+    def run(self) -> None:
+        self.api.start()
+        for op in self.operators:
+            op.start_workers()
+        logger.fs.info(
+            f"[daemon {self.gateway_id}] running: {len(self.operators)} operators, control port {self.api.port}"
+        )
+        try:
+            while not self.api.shutdown_requested.is_set():
+                self.api.pull_chunk_status_queue()
+                if self.error_event.is_set():
+                    while True:
+                        try:
+                            self.api.record_error(self.error_queue.get_nowait())
+                        except queue.Empty:
+                            break
+                    logger.fs.error(f"[daemon {self.gateway_id}] stopping on operator error")
+                    break
+                time.sleep(0.05)
+        finally:
+            self.api.pull_chunk_status_queue()
+            for op in self.operators:
+                op.stop_workers(timeout=2.0)
+            self.receiver.stop_all()
+            # keep the API up briefly so the client can collect errors/status
+            time.sleep(0.2)
+
+    def stop(self) -> None:
+        self.api.shutdown_requested.set()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="skyplane_tpu gateway daemon")
+    parser.add_argument("--region", default=os.environ.get("SKYPLANE_REGION", "local:local"))
+    parser.add_argument("--chunk-dir", default=os.environ.get("SKYPLANE_CHUNK_DIR", "/tmp/skyplane_tpu/chunks"))
+    parser.add_argument("--program-file", default=os.environ.get("GATEWAY_PROGRAM_FILE"))
+    parser.add_argument("--info-file", default=os.environ.get("GATEWAY_INFO_FILE"))
+    parser.add_argument("--gateway-id", default=os.environ.get("GATEWAY_ID", "gateway_0"))
+    parser.add_argument("--control-port", type=int, default=int(os.environ.get("GATEWAY_CONTROL_PORT", "8081")))
+    parser.add_argument("--bind-host", default="0.0.0.0")
+    parser.add_argument("--e2ee-key-file", default=os.environ.get("E2EE_KEY_FILE"))
+    parser.add_argument("--disable-tls", action="store_true")
+    args = parser.parse_args(argv)
+
+    program = json.loads(Path(args.program_file).read_text())
+    info = json.loads(Path(args.info_file).read_text()) if args.info_file else {}
+    e2ee_key = None
+    if args.e2ee_key_file and Path(args.e2ee_key_file).exists():
+        e2ee_key = Path(args.e2ee_key_file).read_bytes()
+    daemon = GatewayDaemon(
+        region=args.region,
+        chunk_dir=args.chunk_dir,
+        gateway_program=program,
+        gateway_info=info,
+        gateway_id=args.gateway_id,
+        control_port=args.control_port,
+        bind_host=args.bind_host,
+        e2ee_key=e2ee_key,
+        use_tls=not args.disable_tls,
+    )
+    daemon.run()
+
+
+if __name__ == "__main__":
+    main()

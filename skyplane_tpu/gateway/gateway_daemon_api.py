@@ -1,0 +1,268 @@
+"""Gateway control-plane HTTP API.
+
+Reference parity: skyplane/gateway/gateway_daemon_api.py:20-354 (Flask behind
+stunnel). Implemented on stdlib ThreadingHTTPServer — gateway VMs need no web
+framework. Route surface is kept 1:1 so the client tracker logic maps
+directly:
+
+  GET  /api/v1/status                      liveness + region
+  POST /api/v1/shutdown                    graceful stop
+  POST /api/v1/servers                     new receiver data port -> {server_port}
+  DELETE /api/v1/servers/<port>            stop a receiver port
+  POST /api/v1/chunk_requests              register chunk batch (json list)
+  GET  /api/v1/chunk_requests              all chunk requests + states
+  GET  /api/v1/incomplete_chunk_requests   pending only
+  GET  /api/v1/chunk_status_log            drained chunk state transitions
+  POST /api/v1/upload_id_maps              dest_key -> multipart upload id
+  GET  /api/v1/errors                      operator tracebacks
+  GET  /api/v1/profile/socket/receiver     per-recv socket profile events
+  GET  /api/v1/profile/compression         TPU data-path stats (ratio, dedup)
+
+Completion accounting (the reference's most bug-prone logic, SURVEY §7 #6):
+an explicit per-chunk refcount of terminal-operator completions — a chunk is
+complete when every terminal handle of its partition has reported complete;
+its staged file is then deleted (reference: gateway_daemon_api.py:89-155).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Set
+
+from skyplane_tpu.chunk import ChunkRequest, ChunkState
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.operators.gateway_receiver import GatewayReceiver
+from skyplane_tpu.utils.logger import logger
+
+
+class GatewayDaemonAPI:
+    def __init__(
+        self,
+        chunk_store: ChunkStore,
+        receiver: GatewayReceiver,
+        error_event: threading.Event,
+        error_queue: "queue.Queue[str]",
+        terminal_operators: Dict[str, List[str]],  # partition -> [terminal group names]
+        handle_to_group: Optional[Dict[str, Dict[str, str]]] = None,  # partition -> handle -> group
+        *,
+        region: str,
+        gateway_id: str,
+        host: str = "0.0.0.0",
+        port: int = 8081,
+        compression_stats_fn=None,
+    ):
+        self.chunk_store = chunk_store
+        self.receiver = receiver
+        self.error_event = error_event
+        self.error_queue = error_queue
+        self.terminal_operators = terminal_operators
+        self.handle_to_group = handle_to_group or {}
+        self.region = region
+        self.gateway_id = gateway_id
+        self.compression_stats_fn = compression_stats_fn or (lambda: {})
+
+        self._lock = threading.Lock()
+        self.chunk_requests: Dict[str, dict] = {}  # chunk_id -> chunk request dict
+        self.chunk_status: Dict[str, str] = {}  # chunk_id -> latest aggregate state
+        self.chunk_status_log: List[dict] = []
+        self._terminal_done: Dict[str, Set[str]] = {}  # chunk_id -> completed terminal handles
+        self._errors: List[str] = []
+        self.shutdown_requested = threading.Event()
+
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet; goes to fs log
+                logger.fs.debug(f"[api] {fmt % args}")
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_json(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b"{}"
+                return json.loads(raw or b"{}")
+
+            def do_GET(self):
+                try:
+                    api._handle_get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.fs.error(f"[api] GET {self.path} error: {e}")
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    api._handle_post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.fs.error(f"[api] POST {self.path} error: {e}")
+                    self._send(500, {"error": str(e)})
+
+            def do_DELETE(self):
+                try:
+                    api._handle_delete(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, name="gateway-api", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ---- status-queue pump (called from the daemon main loop) ----
+
+    def pull_chunk_status_queue(self) -> int:
+        """Drain operator status records; account terminal completions; GC
+        fully-complete chunk files. Returns records processed."""
+        n = 0
+        while True:
+            try:
+                rec = self.chunk_store.chunk_status_queue.get_nowait()
+            except queue.Empty:
+                break
+            n += 1
+            with self._lock:
+                self.chunk_status_log.append(rec)
+                chunk_id = rec["chunk_id"]
+                partition = rec.get("partition", "default")
+                state = rec["state"]
+                handle = rec.get("handle")
+                terminals = self.terminal_operators.get(partition, [])
+                group = self.handle_to_group.get(partition, {}).get(handle, handle)
+                if state == ChunkState.complete.to_short_str() and group in terminals:
+                    done = self._terminal_done.setdefault(chunk_id, set())
+                    done.add(group)
+                    if len(done) == len(terminals):
+                        self.chunk_status[chunk_id] = "complete"
+                        self._gc_chunk(chunk_id)
+                    else:
+                        self.chunk_status[chunk_id] = "partial"
+                elif state == ChunkState.failed.to_short_str():
+                    self.chunk_status[chunk_id] = "failed"
+                elif chunk_id not in self.chunk_status or self.chunk_status[chunk_id] not in ("complete", "partial"):
+                    self.chunk_status[chunk_id] = state
+        return n
+
+    def _gc_chunk(self, chunk_id: str) -> None:
+        for suffix in (".chunk", ".done"):
+            p = self.chunk_store.chunk_dir / f"{chunk_id}{suffix}"
+            if p.exists():
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
+
+    def record_error(self, tb: str) -> None:
+        with self._lock:
+            self._errors.append(tb)
+
+    # ---- routing ----
+
+    def _handle_get(self, req) -> None:
+        path = req.path.rstrip("/")
+        if path == "/api/v1/status":
+            req._send(
+                200,
+                {
+                    "status": "ok",
+                    "region": self.region,
+                    "gateway_id": self.gateway_id,
+                    "error": self.error_event.is_set(),
+                },
+            )
+        elif path == "/api/v1/chunk_requests":
+            with self._lock:
+                req._send(200, {"chunk_requests": list(self.chunk_requests.values()), "status": dict(self.chunk_status)})
+        elif path == "/api/v1/incomplete_chunk_requests":
+            with self._lock:
+                incomplete = {
+                    cid: cr for cid, cr in self.chunk_requests.items() if self.chunk_status.get(cid) != "complete"
+                }
+                req._send(200, {"chunk_requests": list(incomplete.values())})
+        elif path == "/api/v1/chunk_status_log":
+            with self._lock:
+                # aggregate view the tracker consumes: chunk_id -> state
+                req._send(200, {"chunk_status_log": list(self.chunk_status_log), "chunk_status": dict(self.chunk_status)})
+        elif path == "/api/v1/errors":
+            while True:
+                try:
+                    self._errors.append(self.error_queue.get_nowait())
+                except queue.Empty:
+                    break
+            with self._lock:
+                req._send(200, {"errors": list(self._errors)})
+        elif path == "/api/v1/profile/socket/receiver":
+            events = []
+            while True:
+                try:
+                    events.append(self.receiver.socket_profile_events.get_nowait())
+                except queue.Empty:
+                    break
+            req._send(200, {"events": events})
+        elif path == "/api/v1/profile/compression":
+            req._send(200, self.compression_stats_fn())
+        else:
+            req._send(404, {"error": f"no route {req.path}"})
+
+    def _handle_post(self, req) -> None:
+        path = req.path.rstrip("/")
+        if path == "/api/v1/shutdown":
+            self.shutdown_requested.set()
+            req._send(200, {"status": "shutting down"})
+        elif path == "/api/v1/servers":
+            port = self.receiver.start_server()
+            req._send(200, {"server_port": port})
+        elif path == "/api/v1/chunk_requests":
+            body = req._read_json()
+            if not isinstance(body, list):
+                req._send(400, {"error": "expected a json list of chunk requests"})
+                return
+            n = 0
+            for d in body:
+                cr = ChunkRequest.from_dict(d)
+                with self._lock:
+                    if cr.chunk.chunk_id in self.chunk_requests:
+                        continue  # idempotent re-register
+                    self.chunk_requests[cr.chunk.chunk_id] = d
+                self.chunk_store.add_chunk_request(cr, ChunkState.registered)
+                n += 1
+            req._send(200, {"status": "ok", "registered": n})
+        elif path == "/api/v1/upload_id_maps":
+            body = req._read_json()
+            self.upload_id_map_update(body)
+            req._send(200, {"status": "ok", "entries": len(body)})
+        else:
+            req._send(404, {"error": f"no route {req.path}"})
+
+    def _handle_delete(self, req) -> None:
+        parts = req.path.rstrip("/").split("/")
+        if len(parts) == 5 and parts[:4] == ["", "api", "v1", "servers"]:
+            ok = self.receiver.stop_server(int(parts[4]))
+            req._send(200 if ok else 404, {"status": "ok" if ok else "unknown port"})
+        else:
+            req._send(404, {"error": f"no route {req.path}"})
+
+    # injected by the daemon (write operators hold a reference to the dict)
+    upload_id_map_update = staticmethod(lambda body: None)

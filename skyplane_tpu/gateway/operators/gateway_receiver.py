@@ -1,0 +1,166 @@
+"""Receiver: TLS data-socket server landing chunks into the chunk store.
+
+Reference parity: skyplane/gateway/operators/gateway_receiver.py:69-237 —
+ephemeral listener ports created on demand via the control API, per-connection
+handler, 4 MB recv_into pump, decrypt/decompress, chunk-file write + size
+verify. Differences: handlers are threads; decode goes through
+DataPathProcessor (codec dispatch from the wire header, dedup recipe
+resolution against a SegmentStore with bounded ref-wait).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import ssl
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
+from skyplane_tpu.gateway.cert import generate_self_signed_certificate
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.crypto import ChunkCipher
+from skyplane_tpu.ops.dedup import SegmentStore
+from skyplane_tpu.ops.pipeline import DataPathProcessor
+from skyplane_tpu.utils.logger import logger
+
+RECV_BLOCK = 4 * 1024 * 1024
+
+
+class GatewayReceiver:
+    def __init__(
+        self,
+        region: str,
+        chunk_store: ChunkStore,
+        error_event: threading.Event,
+        error_queue: "queue.Queue[str]",
+        recv_block_size: int = RECV_BLOCK,
+        use_tls: bool = True,
+        e2ee_key: Optional[bytes] = None,
+        dedup: bool = False,
+        segment_store: Optional[SegmentStore] = None,
+        bind_host: str = "0.0.0.0",
+    ):
+        self.region = region
+        self.chunk_store = chunk_store
+        self.error_event = error_event
+        self.error_queue = error_queue
+        self.recv_block_size = recv_block_size
+        self.use_tls = use_tls
+        self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
+        self.segment_store = segment_store if segment_store is not None else (SegmentStore() if dedup else None)
+        self.processor = DataPathProcessor(codec_name="none", dedup=dedup)
+        self.bind_host = bind_host
+        self._servers: Dict[int, socket.socket] = {}
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self.socket_profile_events: "queue.Queue[dict]" = queue.Queue()
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if use_tls:
+            cert_dir = Path(chunk_store.chunk_dir) / "certs"
+            cert, key = generate_self_signed_certificate("skyplane-tpu-gateway", cert_dir / "cert.pem", cert_dir / "key.pem")
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(certfile=str(cert), keyfile=str(key))
+
+    def start_server(self) -> int:
+        """Bind a new ephemeral data port; returns the port (reference :69-114)."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.bind_host, 0))
+        sock.listen(64)
+        port = sock.getsockname()[1]
+        with self._lock:
+            self._servers[port] = sock
+        t = threading.Thread(target=self._accept_loop, args=(sock, port), name=f"receiver-accept-{port}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        logger.fs.info(f"[receiver] listening on {self.bind_host}:{port}")
+        return port
+
+    def stop_server(self, port: int) -> bool:
+        with self._lock:
+            sock = self._servers.pop(port, None)
+        if sock is None:
+            return False
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return True
+
+    def stop_all(self) -> None:
+        with self._lock:
+            ports = list(self._servers)
+        for p in ports:
+            self.stop_server(p)
+
+    def _accept_loop(self, server_sock: socket.socket, port: int) -> None:
+        while not self.error_event.is_set():
+            try:
+                conn, addr = server_sock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._ssl_ctx is not None:
+                try:
+                    conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
+                except ssl.SSLError as e:
+                    logger.fs.warning(f"[receiver:{port}] TLS handshake failed from {addr}: {e}")
+                    conn.close()
+                    continue
+            t = threading.Thread(target=self._conn_loop, args=(conn, port), name=f"receiver-conn-{port}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _conn_loop(self, conn: socket.socket, port: int) -> None:
+        """Pump chunks off one connection until the peer closes (reference :142-237)."""
+        try:
+            while not self.error_event.is_set():
+                try:
+                    header = WireProtocolHeader.from_socket(conn)
+                except (ConnectionError, OSError):
+                    return  # clean peer close
+                t0 = time.time()
+                try:
+                    payload = self._recv_exact(conn, header.data_len)
+                except (ConnectionError, OSError) as e:
+                    # peer died mid-payload (e.g. sender resetting a broken socket
+                    # before retrying) — drop the partial chunk, it will be re-sent
+                    logger.fs.warning(f"[receiver:{port}] connection lost mid-chunk {header.chunk_id}: {e}")
+                    return
+                self.socket_profile_events.put(
+                    {"port": port, "chunk_id": header.chunk_id, "bytes": header.data_len, "time_s": time.time() - t0}
+                )
+                if header.is_encrypted:
+                    if self.cipher is None:
+                        raise RuntimeError("received encrypted chunk but no E2EE key configured")
+                    payload = self.cipher.open(payload)
+                data = self.processor.restore(payload, header, store=self.segment_store)
+                fpath = self.chunk_store.chunk_path(header.chunk_id)
+                fpath.write_bytes(data)
+                fpath.with_suffix(".done").touch()
+                logger.fs.debug(f"[receiver:{port}] landed chunk {header.chunk_id} ({len(data)}B raw, {header.data_len}B wire)")
+        except Exception:  # noqa: BLE001 — fatal receiver error stops the daemon
+            tb = traceback.format_exc()
+            logger.fs.error(f"[receiver:{port}] fatal: {tb}")
+            self.error_queue.put(tb)
+            self.error_event.set()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _recv_exact(self, conn: socket.socket, n: int) -> bytes:
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = conn.recv_into(view[got:], min(self.recv_block_size, n - got))
+            if r == 0:
+                raise ConnectionError(f"socket closed mid-payload ({got}/{n} bytes)")
+            got += r
+        return bytes(buf)

@@ -1,0 +1,71 @@
+"""Object store interface ABC + object metadata model.
+
+Reference parity: skyplane/obj_store/object_store_interface.py:8-85 —
+``ObjectStoreObject`` dataclass and the interface surface (ranged
+download_object with streaming md5, multipart-aware upload_object,
+initiate/complete multipart).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Iterator, List, Optional, Tuple
+
+from skyplane_tpu.obj_store.storage_interface import StorageInterface
+
+
+@dataclass
+class ObjectStoreObject:
+    key: str
+    provider: Optional[str] = None
+    bucket: Optional[str] = None
+    size: Optional[int] = None
+    last_modified: Optional[datetime] = None
+    mime_type: Optional[str] = None
+
+    def full_path(self) -> str:
+        raise NotImplementedError
+
+    def exists(self, obj_store) -> bool:
+        return obj_store.exists(self.key)
+
+
+class ObjectStoreInterface(StorageInterface):
+    def get_obj_size(self, obj_name: str) -> int:
+        raise NotImplementedError
+
+    def get_obj_last_modified(self, obj_name: str):
+        raise NotImplementedError
+
+    def get_obj_mime_type(self, obj_name: str) -> Optional[str]:
+        return None
+
+    def download_object(
+        self,
+        src_object_name: str,
+        dst_file_path,
+        offset_bytes: Optional[int] = None,
+        size_bytes: Optional[int] = None,
+        write_at_offset: bool = False,
+        generate_md5: bool = False,
+    ) -> Optional[str]:
+        """Ranged download to a local path; returns hex md5 when requested."""
+        raise NotImplementedError
+
+    def upload_object(
+        self,
+        src_file_path,
+        dst_object_name: str,
+        part_number: Optional[int] = None,
+        upload_id: Optional[str] = None,
+        check_md5: Optional[str] = None,
+        mime_type: Optional[str] = None,
+    ) -> None:
+        raise NotImplementedError
+
+    def initiate_multipart_upload(self, dst_object_name: str, mime_type: Optional[str] = None) -> str:
+        raise NotImplementedError
+
+    def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        raise NotImplementedError

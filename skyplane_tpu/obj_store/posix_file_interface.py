@@ -1,0 +1,185 @@
+"""POSIX filesystem backend — the local:// data path and test harness backbone.
+
+Reference parity: skyplane/obj_store/posix_file_interface.py. A "bucket" is a
+directory; keys are relative paths beneath it. Multipart upload stages parts
+as ``<key>.sky_part<N>`` files and concatenates on complete, matching the
+cloud-multipart lifecycle so the gateway write operator code path is
+identical across backends.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import uuid
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Iterator, List, Optional
+
+from skyplane_tpu.exceptions import NoSuchObjectException
+from skyplane_tpu.obj_store.object_store_interface import ObjectStoreInterface, ObjectStoreObject
+
+
+class POSIXFile(ObjectStoreObject):
+    def full_path(self) -> str:
+        return os.path.join(self.bucket or "", self.key)
+
+
+class POSIXInterface(ObjectStoreInterface):
+    provider = "local"
+
+    def __init__(self, bucket_dir: str):
+        self.bucket_name = bucket_dir or "/"
+        self.root = Path(bucket_dir or "/")
+        self._mpu_lock = threading.Lock()
+        self._mpu: dict = {}  # upload_id -> dest key
+
+    def path(self) -> str:
+        return str(self.root)
+
+    def region_tag(self) -> str:
+        return "local:local"
+
+    def bucket_exists(self) -> bool:
+        return self.root.is_dir()
+
+    def create_bucket(self, region_tag: str = "local:local") -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def delete_bucket(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    def _abs(self, key: str) -> Path:
+        p = (self.root / key.lstrip("/")).resolve()
+        root = self.root.resolve()
+        if p != root and root not in p.parents:
+            raise NoSuchObjectException(f"key {key!r} escapes bucket root {root}")
+        return p
+
+    def exists(self, obj_name: str) -> bool:
+        return self._abs(obj_name).is_file()
+
+    def get_obj_size(self, obj_name: str) -> int:
+        p = self._abs(obj_name)
+        if not p.is_file():
+            raise NoSuchObjectException(obj_name)
+        return p.stat().st_size
+
+    def get_obj_last_modified(self, obj_name: str) -> datetime:
+        return datetime.fromtimestamp(self._abs(obj_name).stat().st_mtime, tz=timezone.utc)
+
+    def list_objects(self, prefix: str = "") -> Iterator[POSIXFile]:
+        base = self.root
+        if not base.is_dir():
+            return
+        for p in sorted(base.rglob("*")):
+            if not p.is_file() or p.name.startswith(".sky_tmp") or ".sky_part" in p.name:
+                continue
+            key = str(p.relative_to(base))
+            if prefix and not key.startswith(prefix):
+                continue
+            st = p.stat()
+            yield POSIXFile(
+                key=key,
+                provider="local",
+                bucket=str(base),
+                size=st.st_size,
+                last_modified=datetime.fromtimestamp(st.st_mtime, tz=timezone.utc),
+            )
+
+    def delete_objects(self, keys: List[str]) -> None:
+        for k in keys:
+            p = self._abs(k)
+            if p.exists():
+                p.unlink()
+
+    def download_object(
+        self,
+        src_object_name: str,
+        dst_file_path,
+        offset_bytes: Optional[int] = None,
+        size_bytes: Optional[int] = None,
+        write_at_offset: bool = False,
+        generate_md5: bool = False,
+    ) -> Optional[str]:
+        src = self._abs(src_object_name)
+        if not src.is_file():
+            raise NoSuchObjectException(src_object_name)
+        md5 = hashlib.md5() if generate_md5 else None
+        with open(src, "rb") as fin:
+            if offset_bytes:
+                fin.seek(offset_bytes)
+            remaining = size_bytes if size_bytes is not None else None
+            mode = "r+b" if (write_at_offset and Path(dst_file_path).exists()) else "wb"
+            with open(dst_file_path, mode) as fout:
+                if write_at_offset and offset_bytes:
+                    fout.seek(offset_bytes)
+                while True:
+                    want = 4 << 20 if remaining is None else min(4 << 20, remaining)
+                    if want == 0:
+                        break
+                    block = fin.read(want)
+                    if not block:
+                        break
+                    fout.write(block)
+                    if md5:
+                        md5.update(block)
+                    if remaining is not None:
+                        remaining -= len(block)
+        return md5.hexdigest() if md5 else None
+
+    def upload_object(
+        self,
+        src_file_path,
+        dst_object_name: str,
+        part_number: Optional[int] = None,
+        upload_id: Optional[str] = None,
+        check_md5: Optional[str] = None,
+        mime_type: Optional[str] = None,
+    ) -> None:
+        # multipart state is carried in the filename, not instance memory — the
+        # gateway process completing an upload is not the one that initiated it
+        if upload_id is not None and part_number is not None:
+            base = self._abs(dst_object_name)
+            dest = base.with_name(base.name + f".sky_part{part_number}")
+        else:
+            dest = self._abs(dst_object_name)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        data = Path(src_file_path).read_bytes()
+        if check_md5 is not None:
+            got = hashlib.md5(data).hexdigest()
+            if got != check_md5:
+                from skyplane_tpu.exceptions import ChecksumMismatchException
+
+                raise ChecksumMismatchException(f"{dst_object_name}: md5 {got} != expected {check_md5}")
+        tmp = dest.with_name(f".sky_tmp_{uuid.uuid4().hex}")
+        tmp.write_bytes(data)
+        tmp.rename(dest)
+
+    def initiate_multipart_upload(self, dst_object_name: str, mime_type: Optional[str] = None) -> str:
+        upload_id = uuid.uuid4().hex
+        with self._mpu_lock:
+            self._mpu[upload_id] = dst_object_name
+        return upload_id
+
+    def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        dest = self._abs(dst_object_name)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        part_paths = sorted(
+            dest.parent.glob(f"{dest.name}.sky_part*"),
+            key=lambda p: int(p.name.rsplit(".sky_part", 1)[1]),
+        )
+        if not part_paths:
+            raise NoSuchObjectException(f"no staged parts for {dst_object_name} (upload {upload_id})")
+        tmp = dest.with_name(f".sky_tmp_{uuid.uuid4().hex}")
+        with open(tmp, "wb") as out:
+            for p in part_paths:
+                out.write(p.read_bytes())
+        tmp.rename(dest)
+        for p in part_paths:
+            p.unlink()
+        with self._mpu_lock:
+            self._mpu.pop(upload_id, None)

@@ -1,0 +1,85 @@
+"""Storage interface factory: region-tag dispatch to concrete backends.
+
+Reference parity: skyplane/obj_store/storage_interface.py:10-79. Region tags
+are ``provider:region`` (e.g. ``aws:us-east-1``, ``gcp:us-central1-a``,
+``local:local``); provider prefix picks the backend class. Backends with
+missing SDKs raise MissingDependencyException at create time, not import
+time.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from skyplane_tpu.exceptions import MissingDependencyException, SkyplaneTpuException
+
+
+class StorageInterface:
+    provider: str = "abstract"
+
+    def bucket(self) -> str:
+        return self.bucket_name  # type: ignore[attr-defined]
+
+    def path(self) -> str:
+        raise NotImplementedError
+
+    def region_tag(self) -> str:
+        raise NotImplementedError
+
+    def bucket_exists(self) -> bool:
+        raise NotImplementedError
+
+    def exists(self, obj_name: str) -> bool:
+        raise NotImplementedError
+
+    def create_bucket(self, region_tag: str) -> None:
+        raise NotImplementedError
+
+    def delete_bucket(self) -> None:
+        raise NotImplementedError
+
+    def list_objects(self, prefix: str = "") -> Iterator:
+        raise NotImplementedError
+
+    def delete_objects(self, keys: List[str]) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(region_tag: str, bucket: str) -> "StorageInterface":
+        """Factory (reference: storage_interface.py:38-78)."""
+        provider = region_tag.split(":")[0]
+        if provider in ("aws", "s3"):
+            try:
+                from skyplane_tpu.obj_store.s3_interface import S3Interface
+            except ImportError as e:
+                raise MissingDependencyException(f"AWS support requires boto3: {e}") from e
+            return S3Interface(bucket)
+        if provider in ("gcp", "gs"):
+            try:
+                from skyplane_tpu.obj_store.gcs_interface import GCSInterface
+            except ImportError as e:
+                raise MissingDependencyException(f"GCS support requires google-cloud-storage: {e}") from e
+            return GCSInterface(bucket)
+        if provider == "azure":
+            try:
+                from skyplane_tpu.obj_store.azure_blob_interface import AzureBlobInterface
+            except ImportError as e:
+                raise MissingDependencyException(f"Azure support requires azure-storage-blob: {e}") from e
+            return AzureBlobInterface(bucket)
+        if provider in ("r2", "cloudflare"):
+            try:
+                from skyplane_tpu.obj_store.r2_interface import R2Interface
+            except ImportError as e:
+                raise MissingDependencyException(f"R2 support requires boto3: {e}") from e
+            return R2Interface(bucket)
+        if provider == "hdfs":
+            try:
+                from skyplane_tpu.obj_store.hdfs_interface import HDFSInterface
+            except ImportError as e:
+                raise MissingDependencyException(f"HDFS support requires pyarrow: {e}") from e
+            return HDFSInterface(bucket)
+        if provider in ("local", "posix", "file"):
+            from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+
+            return POSIXInterface(bucket)
+        raise SkyplaneTpuException(f"unknown provider {provider!r} in region tag {region_tag!r}")

@@ -1,0 +1,15 @@
+"""TPU data-path kernels.
+
+This package is the TPU-native replacement for the CPU codec path the
+reference delegates to native libraries (LZ4 via the lz4 C wheel, MD5 via
+hashlib; reference: skyplane/gateway/operators/gateway_operator.py:350-364).
+Everything here operates on HBM-resident uint8 chunk batches:
+
+- :mod:`skyplane_tpu.ops.u32`          — uint32 mod-(2^31-1) field primitives
+- :mod:`skyplane_tpu.ops.gear`         — Gear rolling hash + CDC boundary candidates
+- :mod:`skyplane_tpu.ops.cdc`          — content-defined chunking (device hash, host select)
+- :mod:`skyplane_tpu.ops.fingerprint`  — 8-lane polynomial segment fingerprints
+- :mod:`skyplane_tpu.ops.blockpack`    — block-suppress codec (encode/decode)
+- :mod:`skyplane_tpu.ops.codecs`       — host-facing codec registry (none/zstd/tpu/...)
+- :mod:`skyplane_tpu.ops.pipeline`     — fused batched data-path step (the "flagship model")
+"""

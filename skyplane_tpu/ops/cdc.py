@@ -1,0 +1,97 @@
+"""Content-defined chunking: device-parallel hash, host boundary selection.
+
+The expensive stage — rolling-hash every byte and testing the boundary
+predicate — runs on TPU (ops/gear.py). What remains is enforcing
+min/max segment lengths over the sparse candidate list, which is a greedy
+sequential pass but touches only ~N/avg_size positions, so it runs on host
+over the candidate indices (a few thousand ints per 64 MB chunk).
+
+Determinism contract: boundaries are a pure function of the chunk bytes and
+the (min, avg, max) parameters, so sender and receiver / dedup index always
+agree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
+
+
+@dataclass(frozen=True)
+class CDCParams:
+    min_bytes: int = 16 * 1024
+    avg_bytes: int = 64 * 1024
+    max_bytes: int = 256 * 1024
+
+    def __post_init__(self):
+        from skyplane_tpu.ops.fingerprint import MAX_SEGMENT_BYTES
+
+        if not (0 < self.min_bytes <= self.avg_bytes <= self.max_bytes):
+            raise ValueError(f"CDC params must satisfy 0 < min <= avg <= max, got {self}")
+        if self.max_bytes > MAX_SEGMENT_BYTES:
+            # the fingerprint power tables only cover MAX_SEGMENT_BYTES; beyond
+            # that, positions would alias and distinct segments could collide
+            raise ValueError(f"cdc max_bytes {self.max_bytes} exceeds fingerprint MAX_SEGMENT_BYTES {MAX_SEGMENT_BYTES}")
+
+    @property
+    def mask_bits(self) -> int:
+        return max(1, int(np.log2(self.avg_bytes)))
+
+
+def select_boundaries(candidates: np.ndarray, n: int, params: CDCParams) -> np.ndarray:
+    """Greedy min/max enforcement over sorted candidate positions.
+
+    candidates: positions p where a boundary MAY end a segment (segment ends
+    AFTER byte p, i.e. cut at p+1). Returns segment end offsets, always
+    terminated by n.
+    """
+    ends: List[int] = []
+    start = 0
+    for p in candidates:
+        cut = int(p) + 1
+        if cut - start < params.min_bytes:
+            continue
+        # honor max: if the candidate overshoots, insert forced cuts first
+        while cut - start > params.max_bytes:
+            start += params.max_bytes
+            ends.append(start)
+        if cut - start >= params.min_bytes:
+            ends.append(cut)
+            start = cut
+    while n - start > params.max_bytes:
+        start += params.max_bytes
+        ends.append(start)
+    if start < n or not ends:
+        ends.append(n)
+    return np.asarray(ends, dtype=np.int64)
+
+
+def cdc_segment_ends(data: bytes | np.ndarray, params: CDCParams = CDCParams()) -> np.ndarray:
+    """Full CDC for one chunk: returns segment end offsets (last == len(data))."""
+    arr = np.frombuffer(data, np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) else np.asarray(data, np.uint8)
+    if len(arr) == 0:
+        return np.asarray([0], dtype=np.int64)
+    h = gear_hash(jnp.asarray(arr))
+    mask = boundary_candidate_mask(h, params.mask_bits)
+    candidates = np.flatnonzero(np.asarray(mask))
+    return select_boundaries(candidates, len(arr), params)
+
+
+def segment_ids_and_rev_pos(ends: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-byte (segment_id, reversed-position-in-segment) vectors for the
+    fingerprint kernel, computed vectorized on host."""
+    ends = np.asarray(ends, dtype=np.int64)
+    seg_ids = np.zeros(n, dtype=np.int32)
+    if len(ends) > 1:
+        seg_ids[ends[:-1]] = 1
+        seg_ids = np.cumsum(seg_ids, dtype=np.int32)
+    starts = np.concatenate([[0], ends[:-1]])
+    pos = np.arange(n, dtype=np.int32) - starts[seg_ids].astype(np.int32)
+    seg_len = (ends - starts).astype(np.int32)
+    rev_pos = seg_len[seg_ids] - 1 - pos
+    return seg_ids, rev_pos

@@ -1,0 +1,99 @@
+"""Host-facing codec registry for the chunk data path.
+
+Reference parity: the reference offers a single LZ4-frame CPU codec toggled by
+``compress`` (skyplane/gateway/operators/gateway_operator.py:358-361,
+gateway_receiver.py:191-201). Here codecs are first-class, carried per-chunk
+in the wire header (chunk.py Codec), and include the TPU block-suppress path:
+
+  none       — identity
+  zstd       — CPU zstandard frame (the CPU reference path; lz4-class speed at
+               better ratios)
+  tpu        — blockpack container (ops/blockpack.py), zero/const suppression
+               entirely on device
+  tpu_zstd   — blockpack, then zstd over the compacted container (device does
+               suppression; CPU entropy-codes only surviving literals)
+  native_lz  — C++ LZ codec from skyplane_tpu/native (registered lazily)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple
+
+from skyplane_tpu.chunk import Codec
+from skyplane_tpu.exceptions import CodecException
+
+
+class CodecSpec(NamedTuple):
+    name: str
+    codec_id: Codec
+    encode: Callable[[bytes], bytes]
+    decode: Callable[[bytes], bytes]
+
+
+def _zstd():
+    import zstandard
+
+    return zstandard
+
+
+def _encode_zstd(data: bytes) -> bytes:
+    return _zstd().ZstdCompressor(level=3).compress(data)
+
+
+def _decode_zstd(buf: bytes) -> bytes:
+    return _zstd().ZstdDecompressor().decompress(buf)
+
+
+def _encode_tpu(data: bytes) -> bytes:
+    from skyplane_tpu.ops import blockpack
+
+    return blockpack.encode_container(data)
+
+
+def _decode_tpu(buf: bytes) -> bytes:
+    from skyplane_tpu.ops import blockpack
+
+    return blockpack.decode_container(buf)
+
+
+def _encode_tpu_zstd(data: bytes) -> bytes:
+    return _encode_zstd(_encode_tpu(data))
+
+
+def _decode_tpu_zstd(buf: bytes) -> bytes:
+    return _decode_tpu(_decode_zstd(buf))
+
+
+def _encode_native(data: bytes) -> bytes:
+    from skyplane_tpu.native import lz as native_lz
+
+    return native_lz.compress(data)
+
+
+def _decode_native(buf: bytes) -> bytes:
+    from skyplane_tpu.native import lz as native_lz
+
+    return native_lz.decompress(buf)
+
+
+_REGISTRY: Dict[str, CodecSpec] = {
+    "none": CodecSpec("none", Codec.NONE, lambda b: b, lambda b: b),
+    "zstd": CodecSpec("zstd", Codec.ZSTD, _encode_zstd, _decode_zstd),
+    "tpu": CodecSpec("tpu", Codec.TPU_BLOCK, _encode_tpu, _decode_tpu),
+    "tpu_zstd": CodecSpec("tpu_zstd", Codec.TPU_BLOCK_ZSTD, _encode_tpu_zstd, _decode_tpu_zstd),
+    "native_lz": CodecSpec("native_lz", Codec.NATIVE_LZ, _encode_native, _decode_native),
+}
+
+_BY_ID: Dict[int, CodecSpec] = {int(spec.codec_id): spec for spec in _REGISTRY.values()}
+
+
+def get_codec(name: str) -> CodecSpec:
+    if name not in _REGISTRY:
+        raise CodecException(f"unknown codec {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def get_codec_by_id(codec_id: int) -> CodecSpec:
+    if codec_id not in _BY_ID:
+        raise CodecException(f"unknown codec id {codec_id}")
+    return _BY_ID[codec_id]

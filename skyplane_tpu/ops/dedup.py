@@ -1,0 +1,218 @@
+"""Cross-object dedup: sender-side fingerprint index, receiver-side segment
+store, and the recipe wire format.
+
+A chunk processed with dedup on becomes a *recipe*: an ordered list of
+segments, each either a REF (16-byte fingerprint the receiver already holds)
+or a LITERAL (bytes carried in this frame, codec-compressed as one blob).
+The wire header flags the payload with ChunkFlags.RECIPE and ``raw_data_len``
+keeps the pre-dedup byte count so effective-throughput accounting works
+(reference analog: raw_data_len vs data_len bookkeeping in
+skyplane/chunk.py:96-155 for compression only).
+
+Consistency contract (SURVEY §7 hard part #3): a sender only emits REF(fp)
+after it has previously emitted LITERAL(fp) *on the same ordered channel* (or
+learned it from the receiver's index snapshot), and the receiver stores every
+literal segment before acking the chunk — so refs always resolve in-order.
+Multicast destinations each get their own SenderDedupIndex keyed by
+destination gateway id.
+
+Recipe container layout (little-endian):
+  magic 0xDE 0xD1 | ver(1) | n_entries(4) | entry... | lit_blob
+  entry: kind(1: 0=REF 1=LIT) | fp(16) | seg_len(8)
+  lit_blob: codec-compressed concatenation of LITERAL segment bytes.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from skyplane_tpu.exceptions import CodecException, DedupIntegrityException
+
+MAGIC = b"\xde\xd1"
+VERSION = 1
+_ENTRY = struct.Struct("<B16sQ")
+KIND_REF = 0
+KIND_LIT = 1
+
+
+class SenderDedupIndex:
+    """Bounded LRU of fingerprints known to be resident at one destination."""
+
+    def __init__(self, max_entries: int = 4_000_000):
+        self._lru: "OrderedDict[bytes, None]" = OrderedDict()
+        self._max = max_entries
+        self._lock = threading.Lock()
+
+    def __contains__(self, fp: bytes) -> bool:
+        with self._lock:
+            if fp in self._lru:
+                self._lru.move_to_end(fp)
+                return True
+            return False
+
+    def add(self, fp: bytes) -> None:
+        with self._lock:
+            self._lru[fp] = None
+            self._lru.move_to_end(fp)
+            while len(self._lru) > self._max:
+                self._lru.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+
+class SegmentStore:
+    """Receiver-side fingerprint -> segment bytes store.
+
+    In-memory LRU bounded by bytes, with optional disk spill directory so the
+    working set can exceed RAM (gateway VMs stage chunks on disk anyway,
+    reference: skyplane/gateway/chunk_store.py:108-109).
+    """
+
+    def __init__(self, max_bytes: int = 4 << 30, spill_dir: Optional[Path] = None):
+        self._mem: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._mem_bytes = 0
+        self._max_bytes = max_bytes
+        self._spill_dir = Path(spill_dir) if spill_dir else None
+        if self._spill_dir:
+            self._spill_dir.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._arrival = threading.Condition(self._lock)
+
+    def _spill_path(self, fp: bytes) -> Optional[Path]:
+        return self._spill_dir / f"{fp.hex()}.seg" if self._spill_dir else None
+
+    def put(self, fp: bytes, data: bytes) -> None:
+        with self._lock:
+            if fp in self._mem:
+                self._mem.move_to_end(fp)
+                return
+            self._mem[fp] = data
+            self._mem_bytes += len(data)
+            while self._mem_bytes > self._max_bytes and self._mem:
+                old_fp, old_data = self._mem.popitem(last=False)
+                self._mem_bytes -= len(old_data)
+                p = self._spill_path(old_fp)
+                if p is not None and not p.exists():
+                    p.write_bytes(old_data)
+            self._arrival.notify_all()
+
+    def get(self, fp: bytes, wait_timeout: float = 0.0) -> bytes:
+        """Resolve a fingerprint, optionally blocking for in-flight literals.
+
+        With parallel sender sockets a REF can land before its LITERAL
+        (SURVEY §7 hard part #3); ``wait_timeout`` > 0 turns unresolved refs
+        into a bounded wait on literal arrival instead of an instant failure.
+        """
+        import time as _time
+
+        deadline = _time.monotonic() + wait_timeout
+        with self._lock:
+            while True:
+                if fp in self._mem:
+                    self._mem.move_to_end(fp)
+                    return self._mem[fp]
+                p = self._spill_path(fp)
+                if p is not None and p.exists():
+                    return p.read_bytes()
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise DedupIntegrityException(f"unresolvable dedup ref {fp.hex()}")
+                self._arrival.wait(timeout=min(remaining, 1.0))
+
+    def __contains__(self, fp: bytes) -> bool:
+        if fp in self._mem:
+            return True
+        p = self._spill_path(fp)
+        return p is not None and p.exists()
+
+
+def build_recipe(
+    segments: List[Tuple[bytes, bytes]],  # [(fp16, seg_bytes), ...] in order
+    index: SenderDedupIndex,
+    encode_blob,
+) -> Tuple[bytes, int, int, List[bytes]]:
+    """Assemble a recipe for one chunk.
+
+    Returns (wire_bytes, n_ref_segments, n_literal_bytes_pre_codec,
+    new_fingerprints). The index is NOT mutated here: the caller must commit
+    ``new_fingerprints`` via ``index.add`` only after the frame is
+    successfully delivered — otherwise a failed send would poison the index
+    and later retries would emit REFs the receiver cannot resolve.
+    Repeats *within* this chunk are still deduped (they travel in the same
+    frame, so in-order resolution is guaranteed).
+    """
+    entries = bytearray()
+    lit_parts: List[bytes] = []
+    n_ref = 0
+    emitted_here: set = set()
+    new_fps: List[bytes] = []
+    for fp, seg in segments:
+        if fp in index or fp in emitted_here:
+            entries += _ENTRY.pack(KIND_REF, fp, len(seg))
+            n_ref += 1
+        else:
+            entries += _ENTRY.pack(KIND_LIT, fp, len(seg))
+            lit_parts.append(seg)
+            emitted_here.add(fp)
+            new_fps.append(fp)
+    lit_blob = encode_blob(b"".join(lit_parts))
+    head = MAGIC + struct.pack("<BI", VERSION, len(segments))
+    return head + bytes(entries) + lit_blob, n_ref, sum(len(p) for p in lit_parts), new_fps
+
+
+def parse_recipe(
+    buf: bytes,
+    store: SegmentStore,
+    decode_blob,
+    ref_wait_timeout: float = 0.0,
+    verify_literals: bool = False,
+) -> bytes:
+    """Receiver side: resolve a recipe back into raw chunk bytes.
+
+    Every literal segment is inserted into ``store`` so later refs resolve.
+    With ``verify_literals``, each literal's fingerprint is recomputed before
+    admission — a corrupted literal stored under a healthy fingerprint would
+    propagate to every future chunk that REFs it.
+    """
+    if buf[:2] != MAGIC:
+        raise CodecException("not a dedup recipe (bad magic)")
+    ver, n_entries = struct.unpack_from("<BI", buf, 2)
+    if ver != VERSION:
+        raise CodecException(f"unsupported recipe version {ver}")
+    off = 2 + struct.calcsize("<BI")
+    entries = []
+    for _ in range(n_entries):
+        kind, fp, seg_len = _ENTRY.unpack_from(buf, off)
+        off += _ENTRY.size
+        entries.append((kind, fp, seg_len))
+    lit_blob = decode_blob(buf[off:])
+    out: List[bytes] = []
+    lit_off = 0
+    for kind, fp, seg_len in entries:
+        if kind == KIND_LIT:
+            seg = lit_blob[lit_off : lit_off + seg_len]
+            if len(seg) != seg_len:
+                raise DedupIntegrityException("literal blob shorter than recipe entries")
+            lit_off += seg_len
+            if verify_literals:
+                from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
+
+                if segment_fingerprint_host(seg) != fp:
+                    raise DedupIntegrityException(f"literal segment fingerprint mismatch (claimed {fp.hex()})")
+            store.put(fp, seg)
+            out.append(seg)
+        elif kind == KIND_REF:
+            seg = store.get(fp, wait_timeout=ref_wait_timeout)
+            if len(seg) != seg_len:
+                raise DedupIntegrityException(f"dedup ref {fp.hex()} length mismatch")
+            out.append(seg)
+        else:
+            raise CodecException(f"bad recipe entry kind {kind}")
+    if lit_off != len(lit_blob):
+        raise DedupIntegrityException("literal blob longer than recipe entries")
+    return b"".join(out)

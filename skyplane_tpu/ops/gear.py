@@ -1,0 +1,79 @@
+"""Gear rolling hash for content-defined chunking, as a parallel windowed sum.
+
+The classic Gear CDC loop is sequential:  ``h = (h << 1) + G[b_t]``
+(one byte per iteration). Because the shift discards bits past 31, the hash
+after byte t depends only on the last 32 bytes:
+
+    h_t = sum_{i=0}^{31} G[b_{t-i}] << i        (mod 2^32)
+
+which is a 32-tap weighted correlation — embarrassingly parallel, and the
+formulation this module evaluates on the VPU. Boundary candidates are
+positions where the top ``mask_bits`` of ``h_t`` are zero (FastCDC-style
+high-bit mask; avg segment ≈ 2^mask_bits bytes). Min/max segment-length
+enforcement is inherently sequential over the (sparse) candidate list and is
+done on host in ops/cdc.py.
+
+Reference behavior being replaced: the reference has no dedup at all; this is
+the TPU-native data-path addition (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GEAR_WINDOW = 32
+_GEAR_SEED = 0x5EED_CDC1
+
+
+def splitmix64_stream(seed: int, n: int) -> np.ndarray:
+    """Deterministic uint64 stream (splitmix64). Implemented in-repo so the
+    values are stable across numpy versions — gear tables and fingerprint
+    bases MUST agree between every gateway in a deployment (cross-host dedup
+    determinism contract)."""
+    mask = (1 << 64) - 1
+    out = np.empty(n, dtype=np.uint64)
+    x = seed & mask
+    for i in range(n):
+        x = (x + 0x9E3779B97F4A7C15) & mask
+        z = x
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & mask
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & mask
+        out[i] = z ^ (z >> 31)
+    return out
+
+
+GEAR_TABLE = (splitmix64_stream(_GEAR_SEED, 256) & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def gear_hash(data_u8: jax.Array) -> jax.Array:
+    """[N] uint8 -> [N] uint32 rolling gear hash, parallel windowed-sum form.
+
+    Matches the sequential recurrence h_t = (h_{t-1} << 1) + G[b_t] for all
+    t >= 31 (earlier positions see an implicit zero-filled prefix, which only
+    suppresses boundaries in the first window — harmless for CDC).
+    """
+    table = jnp.asarray(GEAR_TABLE)
+    g = table[data_u8.astype(jnp.int32)]  # [N] uint32
+    h = g
+    for i in range(1, GEAR_WINDOW):
+        shifted = jnp.concatenate([jnp.zeros((i,), jnp.uint32), g[:-i]])
+        h = h + (shifted << np.uint32(i))
+    return h
+
+
+def boundary_candidate_mask(h: jax.Array, mask_bits: int) -> jax.Array:
+    """[N] uint32 -> [N] bool: True where the top mask_bits of the hash are zero."""
+    return (h >> np.uint32(32 - mask_bits)) == 0
+
+
+def gear_hash_np(data: np.ndarray) -> np.ndarray:
+    """Sequential numpy reference implementation (the classic Gear loop)."""
+    h = np.uint32(0)
+    out = np.empty(len(data), dtype=np.uint32)
+    table = GEAR_TABLE
+    for t in range(len(data)):
+        h = np.uint32(((int(h) << 1) + int(table[data[t]])) & 0xFFFFFFFF)
+        out[t] = h
+    return out

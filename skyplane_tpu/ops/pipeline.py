@@ -1,0 +1,250 @@
+"""The fused TPU data-path: one jittable step + the host-side processor.
+
+``datapath_step`` is the flagship device function (what ``__graft_entry__``
+exposes): for a batch of equal-length chunks it computes, in one compiled
+program —
+
+  * Gear rolling hashes + CDC boundary-candidate mask   (ops/gear.py)
+  * blockpack tags + compacted literals                 (ops/blockpack.py)
+  * fixed-stride 8-lane segment fingerprints            (ops/fingerprint.py)
+
+``DataPathProcessor`` is the host orchestration the gateway operators call
+per chunk: content-defined chunking (device hash, host select), dedup recipe
+assembly, codec encode/decode, and end-to-end fingerprints. Input sizes are
+padded to power-of-two buckets so XLA compiles a handful of shapes, not one
+per chunk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skyplane_tpu.chunk import ChunkFlags, Codec, WireProtocolHeader
+from skyplane_tpu.exceptions import ChecksumMismatchException, CodecException
+from skyplane_tpu.ops import blockpack
+from skyplane_tpu.ops.cdc import CDCParams, cdc_segment_ends, segment_ids_and_rev_pos
+from skyplane_tpu.ops.codecs import CodecSpec, get_codec, get_codec_by_id
+from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex, build_recipe, parse_recipe
+from skyplane_tpu.ops.fingerprint import (
+    finalize_fingerprint,
+    segment_fingerprint_device,
+)
+from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
+
+MIN_BUCKET = 1 << 16  # 64 KiB
+
+
+def _bucket_size(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+@partial(jax.jit, static_argnames=("block_bytes", "fp_seg_bytes", "mask_bits"))
+def datapath_step(batch: jax.Array, block_bytes: int = 512, fp_seg_bytes: int = 1 << 16, mask_bits: int = 16):
+    """Fused per-batch device step. batch: [B, N] uint8, N % fp_seg_bytes == 0.
+
+    Returns dict of device arrays:
+      candidates [B, N] bool — CDC boundary candidates
+      tags       [B, N/block_bytes] uint8 — blockpack block tags
+      literals   [B, N] uint8 — compacted literal bytes (dense prefix)
+      n_lit      [B] int32 — valid literal byte count
+      fp_lanes   [B, N/fp_seg_bytes, 8] uint32 — fixed-stride segment fingerprints
+    """
+    n = batch.shape[-1]
+    if n % fp_seg_bytes or n % block_bytes:
+        raise ValueError(f"N={n} must be divisible by fp_seg_bytes and block_bytes")
+    n_segments = n // fp_seg_bytes
+
+    def one(chunk):
+        h = gear_hash(chunk)
+        candidates = boundary_candidate_mask(h, mask_bits)
+        tags, literals, n_lit = blockpack.encode_device(chunk, block_bytes=block_bytes)
+        pos = jax.lax.iota(jnp.int32, n)
+        seg_ids = pos // fp_seg_bytes
+        rev_pos = fp_seg_bytes - 1 - (pos % fp_seg_bytes)
+        fp_lanes = segment_fingerprint_device(chunk, seg_ids, rev_pos, n_segments=n_segments)
+        return dict(candidates=candidates, tags=tags, literals=literals, n_lit=n_lit, fp_lanes=fp_lanes)
+
+    return jax.vmap(one)(batch)
+
+
+@dataclass
+class ProcessedPayload:
+    """Sender-side result for one chunk."""
+
+    wire_bytes: bytes
+    codec: Codec
+    is_compressed: bool
+    is_recipe: bool
+    raw_len: int
+    fingerprint: str  # 32 hex chars, end-to-end identity of the raw bytes
+    n_segments: int = 0
+    n_ref_segments: int = 0
+    literal_bytes: int = 0  # pre-codec literal bytes shipped (dedup mode)
+    new_fingerprints: list = field(default_factory=list)  # commit to index AFTER delivery
+
+
+@dataclass
+class DataPathStats:
+    """Cumulative sender-side accounting (feeds /profile/compression)."""
+
+    chunks: int = 0
+    raw_bytes: int = 0
+    wire_bytes: int = 0
+    segments: int = 0
+    ref_segments: int = 0
+
+    def observe(self, p: ProcessedPayload) -> None:
+        self.chunks += 1
+        self.raw_bytes += p.raw_len
+        self.wire_bytes += len(p.wire_bytes)
+        self.segments += p.n_segments
+        self.ref_segments += p.n_ref_segments
+
+    def as_dict(self) -> dict:
+        ratio = self.raw_bytes / self.wire_bytes if self.wire_bytes else 1.0
+        return {
+            "chunks": self.chunks,
+            "raw_bytes": self.raw_bytes,
+            "wire_bytes": self.wire_bytes,
+            "compression_ratio": ratio,
+            "segments": self.segments,
+            "ref_segments": self.ref_segments,
+        }
+
+
+class DataPathProcessor:
+    """Per-connection host orchestrator for the TPU data path.
+
+    Encode path (sender): CDC -> segment fingerprints -> dedup recipe ->
+    codec; or plain codec when dedup is off. Decode path (receiver) is the
+    exact inverse, driven by wire-header codec/flags — no out-of-band config
+    needed (SURVEY §7 wire-compat requirement).
+    """
+
+    def __init__(
+        self,
+        codec_name: str = "tpu_zstd",
+        dedup: bool = True,
+        cdc_params: CDCParams = CDCParams(),
+        verify_checksums: bool = True,
+    ):
+        self.codec: CodecSpec = get_codec(codec_name)
+        self.dedup = dedup
+        self.cdc_params = cdc_params
+        self.verify_checksums = verify_checksums
+        self.stats = DataPathStats()
+
+    # ---- fingerprints ----
+
+    def _segment_fps(self, arr: np.ndarray, ends: np.ndarray) -> List[bytes]:
+        """8-lane device fingerprints for explicit segment ends -> 16-byte digests."""
+        n = len(arr)
+        bucket = _bucket_size(n)
+        padded = arr if n == bucket else np.concatenate([arr, np.zeros(bucket - n, np.uint8)])
+        # padding becomes one trailing garbage segment slot
+        ends_dev = ends if n == bucket else np.concatenate([ends, [bucket]])
+        seg_ids, rev_pos = segment_ids_and_rev_pos(ends_dev, bucket)
+        n_slots = 1
+        while n_slots < len(ends_dev):
+            n_slots <<= 1
+        from skyplane_tpu.ops.fingerprint import MAX_SEGMENT_BYTES
+
+        # clamp is only ever active for the trailing garbage pad slot — real
+        # segments are bounded by CDCParams.max_bytes <= MAX_SEGMENT_BYTES
+        lanes = np.asarray(
+            segment_fingerprint_device(
+                jnp.asarray(padded),
+                jnp.asarray(seg_ids),
+                jnp.asarray(np.minimum(rev_pos, MAX_SEGMENT_BYTES - 1)),
+                n_segments=n_slots,
+            )
+        )
+        starts = np.concatenate([[0], ends[:-1]])
+        return [
+            bytes.fromhex(finalize_fingerprint(lanes[i], int(ends[i] - starts[i])))
+            for i in range(len(ends))
+        ]
+
+    def _chunk_fingerprint(self, seg_fps: List[bytes], raw_len: int) -> str:
+        h = hashlib.blake2b(b"".join(seg_fps) + raw_len.to_bytes(8, "little"), digest_size=16)
+        return h.hexdigest()
+
+    # ---- encode ----
+
+    def process(self, data: bytes, index: Optional[SenderDedupIndex] = None) -> ProcessedPayload:
+        raw_len = len(data)
+        if self.dedup and index is not None and raw_len > 0:
+            arr = np.frombuffer(data, np.uint8)
+            ends = cdc_segment_ends(arr, self.cdc_params)
+            seg_fps = self._segment_fps(arr, ends)
+            starts = np.concatenate([[0], ends[:-1]])
+            segments = [(seg_fps[i], data[starts[i] : ends[i]]) for i in range(len(ends))]
+            wire, n_ref, lit_bytes, new_fps = build_recipe(segments, index, self.codec.encode)
+            payload = ProcessedPayload(
+                wire_bytes=wire,
+                codec=self.codec.codec_id,
+                is_compressed=self.codec.codec_id != Codec.NONE,
+                is_recipe=True,
+                raw_len=raw_len,
+                fingerprint=self._chunk_fingerprint(seg_fps, raw_len),
+                n_segments=len(segments),
+                n_ref_segments=n_ref,
+                literal_bytes=lit_bytes,
+                new_fingerprints=new_fps,
+            )
+        else:
+            wire = self.codec.encode(data)
+            if len(wire) >= raw_len and self.codec.codec_id != Codec.NONE:
+                # incompressible chunk: ship raw (receiver dispatches on header codec)
+                wire, codec_id = data, Codec.NONE
+            else:
+                codec_id = self.codec.codec_id
+            fp = hashlib.blake2b(data, digest_size=16).hexdigest()
+            payload = ProcessedPayload(
+                wire_bytes=wire,
+                codec=codec_id,
+                is_compressed=codec_id != Codec.NONE,
+                is_recipe=False,
+                raw_len=raw_len,
+                fingerprint=fp,
+            )
+        self.stats.observe(payload)
+        return payload
+
+    # ---- decode ----
+
+    def restore(
+        self,
+        payload: bytes,
+        header: WireProtocolHeader,
+        store: Optional[SegmentStore] = None,
+        ref_wait_timeout: float = 60.0,
+    ) -> bytes:
+        codec = get_codec_by_id(header.codec)
+        if header.is_recipe:
+            if store is None:
+                raise CodecException("recipe payload but no SegmentStore configured")
+            data = parse_recipe(
+                payload, store, codec.decode, ref_wait_timeout=ref_wait_timeout, verify_literals=self.verify_checksums
+            )
+        else:
+            data = codec.decode(payload)
+        if len(data) != header.raw_data_len:
+            raise ChecksumMismatchException(
+                f"chunk {header.chunk_id}: raw length {len(data)} != header {header.raw_data_len}"
+            )
+        if self.verify_checksums and not header.is_recipe and header.fingerprint != "0" * 32:
+            got = hashlib.blake2b(data, digest_size=16).hexdigest()
+            if got != header.fingerprint:
+                raise ChecksumMismatchException(f"chunk {header.chunk_id}: fingerprint mismatch")
+        return data

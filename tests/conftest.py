@@ -1,11 +1,30 @@
 import os
 
 # Force CPU with 8 virtual devices BEFORE jax is imported anywhere, so sharding
-# tests exercise a multi-chip mesh without TPU hardware.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# tests exercise a multi-chip mesh without TPU hardware. This must OVERRIDE the
+# environment: the dev image globally sets JAX_PLATFORMS=axon (the real-TPU
+# tunnel), and running unit tests against a tunneled chip is both slow and
+# contended. Opt back in with SKYPLANE_TPU_TEST_REAL_DEVICE=1.
+if not os.environ.get("SKYPLANE_TPU_TEST_REAL_DEVICE"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The dev image injects an `axon` (real-TPU tunnel) jax plugin from
+# sitecustomize, which imports jax at interpreter startup — env vars set here
+# are too late for jax's config default. Update the live config so test-time
+# backend selection really is CPU (client creation for the tunnel can hang
+# when the chip is contended).
+if not os.environ.get("SKYPLANE_TPU_TEST_REAL_DEVICE"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 # Keep test runs hermetic: never read the developer's real config file.
 os.environ.setdefault("SKYPLANE_TPU_CONFIG_ROOT", "/tmp/skyplane_tpu_test_config")
+
+# Persistent XLA compile cache: kernel shapes repeat across test runs, so this
+# turns 30-60s CPU compiles into cache hits after the first full run.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")

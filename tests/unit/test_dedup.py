@@ -1,0 +1,116 @@
+import numpy as np
+import pytest
+
+from skyplane_tpu.exceptions import DedupIntegrityException, NoSuchObjectException
+from skyplane_tpu.ops.dedup import (
+    SegmentStore,
+    SenderDedupIndex,
+    build_recipe,
+    parse_recipe,
+)
+from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
+
+rng = np.random.default_rng(3)
+ident = lambda b: b
+
+
+def _seg(n=1000):
+    data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+    return segment_fingerprint_host(data), data
+
+
+def test_recipe_roundtrip_and_dedup():
+    index = SenderDedupIndex()
+    store = SegmentStore()
+    s1, s2 = _seg(), _seg()
+    segments = [s1, s2, s1]  # in-chunk repeat -> 1 REF
+    wire, n_ref, lit_bytes, new_fps = build_recipe(segments, index, ident)
+    assert n_ref == 1 and len(new_fps) == 2
+    assert len(index) == 0, "build_recipe must not mutate the index before delivery"
+    out = parse_recipe(wire, store, ident, verify_literals=True)
+    assert out == s1[1] + s2[1] + s1[1]
+    # commit, then second chunk refs everything
+    for fp in new_fps:
+        index.add(fp)
+    wire2, n_ref2, lit2, new2 = build_recipe([s1, s2], index, ident)
+    assert n_ref2 == 2 and lit2 == 0 and not new2
+    assert parse_recipe(wire2, store, ident) == s1[1] + s2[1]
+    assert len(wire2) < 100  # refs only: ~25B/entry
+
+
+def test_recipe_rejects_corrupted_literal():
+    index = SenderDedupIndex()
+    store = SegmentStore()
+    fp, data = _seg()
+    wire, *_ = build_recipe([(fp, data)], index, ident)
+    corrupted = bytearray(wire)
+    corrupted[-1] ^= 0xFF  # flip a literal byte
+    with pytest.raises(DedupIntegrityException):
+        parse_recipe(bytes(corrupted), store, ident, verify_literals=True)
+    # and nothing was admitted to the store under the healthy fingerprint
+    assert fp not in store
+
+
+def test_unresolvable_ref_raises():
+    store = SegmentStore()
+    fp, data = _seg()
+    index = SenderDedupIndex()
+    index.add(fp)  # sender thinks receiver has it
+    wire, n_ref, *_ = build_recipe([(fp, data)], index, ident)
+    assert n_ref == 1
+    with pytest.raises(DedupIntegrityException):
+        parse_recipe(wire, store, ident, ref_wait_timeout=0.1)
+
+
+def test_segment_store_spill(tmp_path):
+    store = SegmentStore(max_bytes=2000, spill_dir=tmp_path / "spill")
+    segs = [_seg(900) for _ in range(5)]
+    for fp, data in segs:
+        store.put(fp, data)
+    for fp, data in segs:
+        assert store.get(fp) == data  # spilled entries still resolve
+
+
+def test_device_and_host_fingerprints_agree():
+    import jax.numpy as jnp
+
+    from skyplane_tpu.ops.cdc import segment_ids_and_rev_pos
+    from skyplane_tpu.ops.fingerprint import finalize_fingerprint, segment_fingerprint_device
+
+    data = rng.integers(0, 256, 3000, dtype=np.uint8)
+    ends = np.array([1200, 3000])
+    seg_ids, rev_pos = segment_ids_and_rev_pos(ends, 3000)
+    lanes = np.asarray(segment_fingerprint_device(jnp.asarray(data), jnp.asarray(seg_ids), jnp.asarray(rev_pos), n_segments=2))
+    host0 = segment_fingerprint_host(data[:1200].tobytes())
+    host1 = segment_fingerprint_host(data[1200:].tobytes())
+    assert bytes.fromhex(finalize_fingerprint(lanes[0], 1200)) == host0
+    assert bytes.fromhex(finalize_fingerprint(lanes[1], 1800)) == host1
+
+
+def test_posix_bucket_escape(tmp_path):
+    from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+
+    (tmp_path / "bucket").mkdir()
+    (tmp_path / "bucket2").mkdir()
+    (tmp_path / "bucket2" / "secret").write_bytes(b"x")
+    iface = POSIXInterface(str(tmp_path / "bucket"))
+    with pytest.raises(NoSuchObjectException):
+        iface.exists("../bucket2/secret")
+
+
+def test_and_queue_requeue_single_branch():
+    from skyplane_tpu.chunk import Chunk, ChunkRequest
+    from skyplane_tpu.gateway.gateway_queue import GatewayANDQueue
+
+    q = GatewayANDQueue()
+    q.register_handle("a")
+    q.register_handle("b")
+    cr = ChunkRequest(chunk=Chunk(src_key="s", dest_key="d", chunk_id="0" * 32, chunk_length_bytes=1))
+    q.put(cr)
+    assert q.pop("a", timeout=0.1) is cr and q.pop("b", timeout=0.1) is cr
+    q.put_for_handle("a", cr)  # requeue only to branch a
+    assert q.pop("a", timeout=0.1) is cr
+    import queue as _q
+
+    with pytest.raises(_q.Empty):
+        q.get_nowait("b")
