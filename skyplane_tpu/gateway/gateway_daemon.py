@@ -46,6 +46,15 @@ from skyplane_tpu.ops.dedup import SegmentStore
 from skyplane_tpu.utils.logger import logger
 
 
+def _iter_program_ops(program: dict):
+    """Yield every op dict in a gateway program (depth-first)."""
+    stack = [op for group in program.get("plan", []) for op in group.get("value", [])]
+    while stack:
+        op = stack.pop()
+        yield op
+        stack.extend(op.get("children", []))
+
+
 class GatewayDaemon:
     def __init__(
         self,
@@ -70,9 +79,10 @@ class GatewayDaemon:
         self.e2ee_key = e2ee_key
         self.use_tls = use_tls
 
-        # dedup receive? (any receive op with dedup=True)
-        program_json = json.dumps(gateway_program)
-        dedup_receive = '"op_type": "receive"' in program_json and '"dedup": true' in program_json
+        dedup_receive = any(
+            op.get("op_type") == "receive" and op.get("dedup")
+            for op in _iter_program_ops(gateway_program)
+        )
         self.receiver = GatewayReceiver(
             region=region,
             chunk_store=self.chunk_store,

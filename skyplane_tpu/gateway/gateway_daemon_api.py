@@ -151,14 +151,18 @@ class GatewayDaemonAPI:
                 handle = rec.get("handle")
                 terminals = self.terminal_operators.get(partition, [])
                 group = self.handle_to_group.get(partition, {}).get(handle, handle)
-                if state == ChunkState.complete.to_short_str() and group in terminals:
-                    done = self._terminal_done.setdefault(chunk_id, set())
-                    done.add(group)
-                    if len(done) == len(terminals):
-                        self.chunk_status[chunk_id] = "complete"
-                        self._gc_chunk(chunk_id)
-                    else:
-                        self.chunk_status[chunk_id] = "partial"
+                if state == ChunkState.complete.to_short_str():
+                    if group in terminals:
+                        done = self._terminal_done.setdefault(chunk_id, set())
+                        done.add(group)
+                        if len(done) == len(terminals):
+                            self.chunk_status[chunk_id] = "complete"
+                            self._gc_chunk(chunk_id)
+                        else:
+                            self.chunk_status[chunk_id] = "partial"
+                    # a NON-terminal complete (e.g. WaitReceiver before the
+                    # write) must never set the aggregate to 'complete' — the
+                    # tracker would read the destination mid-write
                 elif state == ChunkState.failed.to_short_str():
                     self.chunk_status[chunk_id] = "failed"
                 elif chunk_id not in self.chunk_status or self.chunk_status[chunk_id] not in ("complete", "partial"):

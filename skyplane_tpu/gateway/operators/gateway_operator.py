@@ -190,8 +190,9 @@ class GatewayWriteLocalOperator(GatewayOperator):
         return True
 
 
-class GatewayObjStoreReadOperator(GatewayOperator):
-    """Ranged object-store download into the chunk store (reference :511-589)."""
+class _ObjStoreOperator(GatewayOperator):
+    """Shared plumbing for object-store operators: per-worker-thread interface
+    instances (cloud SDK clients are not thread-safe across workers)."""
 
     def __init__(self, *args, bucket_name: str, bucket_region: str, **kwargs):
         super().__init__(*args, **kwargs)
@@ -206,6 +207,10 @@ class GatewayObjStoreReadOperator(GatewayOperator):
             self._iface_local.iface = StorageInterface.create(self.bucket_region, self.bucket_name)
         return self._iface_local.iface
 
+
+class GatewayObjStoreReadOperator(_ObjStoreOperator):
+    """Ranged object-store download into the chunk store (reference :511-589)."""
+
     def process(self, chunk_req: ChunkRequest, worker_id: int) -> bool:
         chunk = chunk_req.chunk
         fpath = self.chunk_store.chunk_path(chunk.chunk_id)
@@ -219,22 +224,12 @@ class GatewayObjStoreReadOperator(GatewayOperator):
         return True
 
 
-class GatewayObjStoreWriteOperator(GatewayOperator):
+class GatewayObjStoreWriteOperator(_ObjStoreOperator):
     """Multipart-aware object-store upload (reference :592-647)."""
 
-    def __init__(self, *args, bucket_name: str, bucket_region: str, upload_id_map: Dict[str, str], **kwargs):
+    def __init__(self, *args, upload_id_map: Dict[str, str], **kwargs):
         super().__init__(*args, **kwargs)
-        self.bucket_name = bucket_name
-        self.bucket_region = bucket_region
         self.upload_id_map = upload_id_map  # dest_key -> upload_id (client-pushed)
-        self._iface_local = threading.local()
-
-    def _iface(self):
-        if not hasattr(self._iface_local, "iface"):
-            from skyplane_tpu.obj_store.storage_interface import StorageInterface
-
-            self._iface_local.iface = StorageInterface.create(self.bucket_region, self.bucket_name)
-        return self._iface_local.iface
 
     def process(self, chunk_req: ChunkRequest, worker_id: int) -> bool:
         chunk = chunk_req.chunk
@@ -359,11 +354,16 @@ class GatewaySenderOperator(GatewayOperator):
                 sock = self._sock()
                 header.to_socket(sock)
                 sock.sendall(wire)
-                # only now are this chunk's literal segments resident at the
-                # receiver — safe to dedup against them in future chunks
+                # wait for the receiver's application-level ack: sendall only
+                # proves the bytes reached the local TCP buffer. The ack means
+                # the chunk (and its dedup literals) is durably landed, so the
+                # fingerprint commit and 'complete' below are truthful.
+                ack = sock.recv(1)
+                if ack != b"\x06":
+                    raise OSError(f"bad/missing chunk ack ({ack!r})")
                 if self.dedup_index is not None:
-                    for fp in payload.new_fingerprints:
-                        self.dedup_index.add(fp)
+                    for fp, size in payload.new_fingerprints:
+                        self.dedup_index.add(fp, size)
                 return True
             except (OSError, ssl.SSLError) as e:
                 logger.fs.warning(f"[{self.handle}:{worker_id}] socket error (attempt {attempt + 1}): {e}")

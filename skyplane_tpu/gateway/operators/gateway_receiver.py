@@ -28,6 +28,7 @@ from skyplane_tpu.ops.pipeline import DataPathProcessor
 from skyplane_tpu.utils.logger import logger
 
 RECV_BLOCK = 4 * 1024 * 1024
+ACK_BYTE = b"\x06"  # per-chunk delivery ack written back on the data socket
 
 
 class GatewayReceiver:
@@ -142,6 +143,10 @@ class GatewayReceiver:
                 fpath = self.chunk_store.chunk_path(header.chunk_id)
                 fpath.write_bytes(data)
                 fpath.with_suffix(".done").touch()
+                # application-level ack: the sender commits dedup fingerprints
+                # and marks the chunk complete only after this lands — TCP
+                # sendall() alone proves nothing about delivery
+                conn.sendall(ACK_BYTE)
                 logger.fs.debug(f"[receiver:{port}] landed chunk {header.chunk_id} ({len(data)}B raw, {header.data_len}B wire)")
         except Exception:  # noqa: BLE001 — fatal receiver error stops the daemon
             tb = traceback.format_exc()

@@ -48,38 +48,30 @@ class StorageInterface:
     def create(region_tag: str, bucket: str) -> "StorageInterface":
         """Factory (reference: storage_interface.py:38-78)."""
         provider = region_tag.split(":")[0]
-        if provider in ("aws", "s3"):
-            try:
-                from skyplane_tpu.obj_store.s3_interface import S3Interface
-            except ImportError as e:
-                raise MissingDependencyException(f"AWS support requires boto3: {e}") from e
-            return S3Interface(bucket)
-        if provider in ("gcp", "gs"):
-            try:
-                from skyplane_tpu.obj_store.gcs_interface import GCSInterface
-            except ImportError as e:
-                raise MissingDependencyException(f"GCS support requires google-cloud-storage: {e}") from e
-            return GCSInterface(bucket)
-        if provider == "azure":
-            try:
-                from skyplane_tpu.obj_store.azure_blob_interface import AzureBlobInterface
-            except ImportError as e:
-                raise MissingDependencyException(f"Azure support requires azure-storage-blob: {e}") from e
-            return AzureBlobInterface(bucket)
-        if provider in ("r2", "cloudflare"):
-            try:
-                from skyplane_tpu.obj_store.r2_interface import R2Interface
-            except ImportError as e:
-                raise MissingDependencyException(f"R2 support requires boto3: {e}") from e
-            return R2Interface(bucket)
-        if provider == "hdfs":
-            try:
-                from skyplane_tpu.obj_store.hdfs_interface import HDFSInterface
-            except ImportError as e:
-                raise MissingDependencyException(f"HDFS support requires pyarrow: {e}") from e
-            return HDFSInterface(bucket)
-        if provider in ("local", "posix", "file"):
-            from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+        backends = {
+            "aws": ("skyplane_tpu.obj_store.s3_interface", "S3Interface", "boto3"),
+            "s3": ("skyplane_tpu.obj_store.s3_interface", "S3Interface", "boto3"),
+            "gcp": ("skyplane_tpu.obj_store.gcs_interface", "GCSInterface", "google-cloud-storage"),
+            "gs": ("skyplane_tpu.obj_store.gcs_interface", "GCSInterface", "google-cloud-storage"),
+            "azure": ("skyplane_tpu.obj_store.azure_blob_interface", "AzureBlobInterface", "azure-storage-blob"),
+            "r2": ("skyplane_tpu.obj_store.r2_interface", "R2Interface", "boto3"),
+            "cloudflare": ("skyplane_tpu.obj_store.r2_interface", "R2Interface", "boto3"),
+            "hdfs": ("skyplane_tpu.obj_store.hdfs_interface", "HDFSInterface", "pyarrow"),
+            "local": ("skyplane_tpu.obj_store.posix_file_interface", "POSIXInterface", None),
+            "posix": ("skyplane_tpu.obj_store.posix_file_interface", "POSIXInterface", None),
+            "file": ("skyplane_tpu.obj_store.posix_file_interface", "POSIXInterface", None),
+        }
+        if provider not in backends:
+            raise SkyplaneTpuException(f"unknown provider {provider!r} in region tag {region_tag!r}")
+        module_name, cls_name, sdk = backends[provider]
+        import importlib
 
-            return POSIXInterface(bucket)
-        raise SkyplaneTpuException(f"unknown provider {provider!r} in region tag {region_tag!r}")
+        try:
+            module = importlib.import_module(module_name)
+        except ModuleNotFoundError as e:
+            if e.name and e.name.startswith("skyplane_tpu"):
+                raise MissingDependencyException(f"backend module {module_name} is not implemented") from e
+            raise MissingDependencyException(
+                f"{provider} support requires the {sdk} package (failed importing {e.name})"
+            ) from e
+        return getattr(module, cls_name)(bucket)

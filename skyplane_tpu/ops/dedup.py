@@ -40,11 +40,18 @@ KIND_LIT = 1
 
 
 class SenderDedupIndex:
-    """Bounded LRU of fingerprints known to be resident at one destination."""
+    """Bounded LRU of fingerprints known to be resident at one destination.
 
-    def __init__(self, max_entries: int = 4_000_000):
-        self._lru: "OrderedDict[bytes, None]" = OrderedDict()
-        self._max = max_entries
+    Bounded by SEGMENT BYTES, and must be sized strictly below the
+    receiver-side SegmentStore capacity (mem + spill): a sender REF to a
+    segment the receiver has already evicted is an unrecoverable
+    DedupIntegrityException. Default 16 GiB vs the receiver's 4+32 GiB.
+    """
+
+    def __init__(self, max_bytes: int = 16 << 30):
+        self._lru: "OrderedDict[bytes, int]" = OrderedDict()  # fp -> segment size
+        self._max_bytes = max_bytes
+        self._bytes = 0
         self._lock = threading.Lock()
 
     def __contains__(self, fp: bytes) -> bool:
@@ -54,12 +61,16 @@ class SenderDedupIndex:
                 return True
             return False
 
-    def add(self, fp: bytes) -> None:
+    def add(self, fp: bytes, size: int = 0) -> None:
         with self._lock:
-            self._lru[fp] = None
-            self._lru.move_to_end(fp)
-            while len(self._lru) > self._max:
-                self._lru.popitem(last=False)
+            if fp in self._lru:
+                self._lru.move_to_end(fp)
+                return
+            self._lru[fp] = size
+            self._bytes += size
+            while self._bytes > self._max_bytes and self._lru:
+                _, old_size = self._lru.popitem(last=False)
+                self._bytes -= old_size
 
     def __len__(self) -> int:
         return len(self._lru)
@@ -73,13 +84,20 @@ class SegmentStore:
     reference: skyplane/gateway/chunk_store.py:108-109).
     """
 
-    def __init__(self, max_bytes: int = 4 << 30, spill_dir: Optional[Path] = None):
+    def __init__(self, max_bytes: int = 4 << 30, spill_dir: Optional[Path] = None, spill_max_bytes: int = 32 << 30):
         self._mem: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._mem_bytes = 0
         self._max_bytes = max_bytes
         self._spill_dir = Path(spill_dir) if spill_dir else None
+        self._spill_max_bytes = spill_max_bytes
+        self._spill_bytes = 0
+        self._spill_order: "OrderedDict[bytes, int]" = OrderedDict()  # fp -> size, insertion order
         if self._spill_dir:
             self._spill_dir.mkdir(parents=True, exist_ok=True)
+            # spill is per-run state: stale files from a previous daemon would
+            # never be REF'd (fresh sender index) but would eat disk forever
+            for stale in self._spill_dir.glob("*.seg"):
+                stale.unlink()
         self._lock = threading.Lock()
         self._arrival = threading.Condition(self._lock)
 
@@ -97,8 +115,17 @@ class SegmentStore:
                 old_fp, old_data = self._mem.popitem(last=False)
                 self._mem_bytes -= len(old_data)
                 p = self._spill_path(old_fp)
-                if p is not None and not p.exists():
+                if p is not None and old_fp not in self._spill_order:
                     p.write_bytes(old_data)
+                    self._spill_order[old_fp] = len(old_data)
+                    self._spill_bytes += len(old_data)
+                    # bound spill disk usage: drop the oldest spilled segments
+                    while self._spill_bytes > self._spill_max_bytes and self._spill_order:
+                        drop_fp, drop_sz = self._spill_order.popitem(last=False)
+                        self._spill_bytes -= drop_sz
+                        dp = self._spill_path(drop_fp)
+                        if dp is not None and dp.exists():
+                            dp.unlink()
             self._arrival.notify_all()
 
     def get(self, fp: bytes, wait_timeout: float = 0.0) -> bytes:
@@ -139,9 +166,9 @@ def build_recipe(
     """Assemble a recipe for one chunk.
 
     Returns (wire_bytes, n_ref_segments, n_literal_bytes_pre_codec,
-    new_fingerprints). The index is NOT mutated here: the caller must commit
-    ``new_fingerprints`` via ``index.add`` only after the frame is
-    successfully delivered — otherwise a failed send would poison the index
+    new_fingerprints as [(fp, size), ...]). The index is NOT mutated here: the
+    caller must commit ``new_fingerprints`` via ``index.add(fp, size)`` only
+    after the frame is successfully delivered (acked) — otherwise a failed send would poison the index
     and later retries would emit REFs the receiver cannot resolve.
     Repeats *within* this chunk are still deduped (they travel in the same
     frame, so in-order resolution is guaranteed).
@@ -159,7 +186,7 @@ def build_recipe(
             entries += _ENTRY.pack(KIND_LIT, fp, len(seg))
             lit_parts.append(seg)
             emitted_here.add(fp)
-            new_fps.append(fp)
+            new_fps.append((fp, len(seg)))
     lit_blob = encode_blob(b"".join(lit_parts))
     head = MAGIC + struct.pack("<BI", VERSION, len(segments))
     return head + bytes(entries) + lit_blob, n_ref, sum(len(p) for p in lit_parts), new_fps

@@ -1,0 +1,12 @@
+"""Multi-chip scaling of the data path over a jax.sharding.Mesh.
+
+The reference scales with processes and parallel TCP sockets
+(SURVEY §2.9); the TPU-native analog for on-gateway compute is SPMD over a
+device mesh: chunk batches shard over the ``data`` axis, and long chunks
+shard *within* the byte dimension over the ``seq`` axis (sequence
+parallelism) with a 31-byte halo exchange for the rolling-hash window.
+"""
+
+from skyplane_tpu.parallel.datapath_spmd import make_spmd_datapath, default_mesh
+
+__all__ = ["make_spmd_datapath", "default_mesh"]

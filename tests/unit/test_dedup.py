@@ -30,8 +30,8 @@ def test_recipe_roundtrip_and_dedup():
     out = parse_recipe(wire, store, ident, verify_literals=True)
     assert out == s1[1] + s2[1] + s1[1]
     # commit, then second chunk refs everything
-    for fp in new_fps:
-        index.add(fp)
+    for fp, size in new_fps:
+        index.add(fp, size)
     wire2, n_ref2, lit2, new2 = build_recipe([s1, s2], index, ident)
     assert n_ref2 == 2 and lit2 == 0 and not new2
     assert parse_recipe(wire2, store, ident) == s1[1] + s2[1]
