@@ -1,0 +1,62 @@
+"""SkyplaneClient: top-level user facade.
+
+Reference parity: skyplane/api/client.py:20-106.
+"""
+
+from __future__ import annotations
+
+import uuid
+from pathlib import Path
+from typing import Optional
+
+from skyplane_tpu.api.config import AWSConfig, AzureConfig, GCPConfig, TransferConfig
+from skyplane_tpu.api.pipeline import Pipeline
+from skyplane_tpu.api.provisioner import Provisioner
+from skyplane_tpu.config_paths import tmp_log_dir
+from skyplane_tpu.utils.logger import logger
+
+
+class SkyplaneClient:
+    def __init__(
+        self,
+        aws_config: Optional[AWSConfig] = None,
+        azure_config: Optional[AzureConfig] = None,
+        gcp_config: Optional[GCPConfig] = None,
+        transfer_config: Optional[TransferConfig] = None,
+        log_dir: Optional[str] = None,
+    ):
+        self.clientid = uuid.uuid4().hex
+        self.aws_config = aws_config
+        self.azure_config = azure_config
+        self.gcp_config = gcp_config
+        self.transfer_config = transfer_config or TransferConfig()
+        self.log_dir = Path(log_dir) if log_dir else tmp_log_dir / "client" / self.clientid
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        self.provisioner = Provisioner(
+            host_uuid=self.clientid, autoshutdown_minutes=self.transfer_config.autoshutdown_minutes
+        )
+
+    def pipeline(self, planning_algorithm: str = "direct", max_instances: int = 1, debug: bool = False) -> Pipeline:
+        return Pipeline(
+            planning_algorithm=planning_algorithm,
+            max_instances=max_instances,
+            transfer_config=self.transfer_config,
+            provisioner=self.provisioner,
+            debug=debug,
+        )
+
+    def copy(self, src: str, dst: str, recursive: bool = False, max_instances: int = 1) -> None:
+        """Blocking convenience copy (reference: client.py:85-102)."""
+        pipe = self.pipeline(max_instances=max_instances)
+        pipe.queue_copy(src, dst, recursive=recursive)
+        pipe.start(progress=False)
+
+    def sync(self, src: str, dst: str, max_instances: int = 1) -> None:
+        pipe = self.pipeline(max_instances=max_instances)
+        pipe.queue_sync(src, dst)
+        pipe.start(progress=False)
+
+    def object_store(self):
+        from skyplane_tpu.api.obj_store import ObjectStore
+
+        return ObjectStore()

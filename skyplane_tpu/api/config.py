@@ -1,0 +1,107 @@
+"""Per-transfer configuration + per-cloud auth config dataclasses.
+
+Reference parity: skyplane/api/config.py:16-117 (frozen TransferConfig of
+data-path knobs; cloud auth dataclasses with make_auth_provider). TPU-native
+additions: codec/dedup/CDC knobs instead of a single lz4 toggle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from skyplane_tpu.ops.cdc import CDCParams
+
+
+@dataclass(frozen=True)
+class TransferConfig:
+    # data path
+    compress: str = "tpu_zstd"  # none | zstd | tpu | tpu_zstd | native_lz
+    dedup: bool = True
+    encrypt_e2e: bool = True
+    encrypt_socket_tls: bool = True
+    verify_checksums: bool = True
+    use_bbr: bool = True
+    num_connections: int = 32
+    cdc_min_bytes: int = 16 * 1024
+    cdc_avg_bytes: int = 64 * 1024
+    cdc_max_bytes: int = 256 * 1024
+    # chunking
+    multipart_enabled: bool = True
+    multipart_threshold_mb: int = 128
+    multipart_chunk_size_mb: int = 64
+    multipart_max_chunks: int = 9990
+    # provisioning
+    aws_instance_class: str = "m5.8xlarge"
+    azure_instance_class: str = "Standard_D32_v5"
+    gcp_instance_class: str = "n2-standard-32"
+    aws_use_spot_instances: bool = False
+    azure_use_spot_instances: bool = False
+    gcp_use_spot_instances: bool = False
+    gcp_use_premium_network: bool = True
+    autoshutdown_minutes: int = 15
+
+    def cdc_params(self) -> CDCParams:
+        return CDCParams(self.cdc_min_bytes, self.cdc_avg_bytes, self.cdc_max_bytes)
+
+    @staticmethod
+    def from_cloud_config(cfg) -> "TransferConfig":
+        """Build from the flag registry (reference: cli_transfer.py:113-135)."""
+        return TransferConfig(
+            compress=cfg.get_flag("compress"),
+            dedup=cfg.get_flag("dedup"),
+            encrypt_e2e=cfg.get_flag("encrypt_e2e"),
+            encrypt_socket_tls=cfg.get_flag("encrypt_socket_tls"),
+            verify_checksums=cfg.get_flag("verify_checksums"),
+            use_bbr=cfg.get_flag("bbr"),
+            num_connections=cfg.get_flag("num_connections"),
+            cdc_min_bytes=cfg.get_flag("cdc_min_bytes"),
+            cdc_avg_bytes=cfg.get_flag("cdc_avg_bytes"),
+            cdc_max_bytes=cfg.get_flag("cdc_max_bytes"),
+            multipart_enabled=cfg.get_flag("multipart_enabled"),
+            multipart_threshold_mb=cfg.get_flag("multipart_min_threshold_mb"),
+            multipart_chunk_size_mb=cfg.get_flag("multipart_chunk_size_mb"),
+            multipart_max_chunks=cfg.get_flag("multipart_max_chunks"),
+            aws_instance_class=cfg.get_flag("aws_instance_class"),
+            azure_instance_class=cfg.get_flag("azure_instance_class"),
+            gcp_instance_class=cfg.get_flag("gcp_instance_class"),
+            aws_use_spot_instances=cfg.get_flag("aws_use_spot_instances"),
+            azure_use_spot_instances=cfg.get_flag("azure_use_spot_instances"),
+            gcp_use_spot_instances=cfg.get_flag("gcp_use_spot_instances"),
+            gcp_use_premium_network=cfg.get_flag("gcp_use_premium_network"),
+            autoshutdown_minutes=cfg.get_flag("autoshutdown_minutes"),
+        )
+
+
+@dataclass
+class AWSConfig:
+    aws_enabled: bool = True
+
+    def make_auth_provider(self):
+        from skyplane_tpu.compute.aws.aws_auth import AWSAuthentication
+
+        return AWSAuthentication(self)
+
+
+@dataclass
+class GCPConfig:
+    gcp_project_id: Optional[str] = None
+    gcp_enabled: bool = True
+
+    def make_auth_provider(self):
+        from skyplane_tpu.compute.gcp.gcp_auth import GCPAuthentication
+
+        return GCPAuthentication(self)
+
+
+@dataclass
+class AzureConfig:
+    azure_subscription_id: Optional[str] = None
+    azure_resource_group: Optional[str] = None
+    azure_umi_name: Optional[str] = None
+    azure_enabled: bool = True
+
+    def make_auth_provider(self):
+        from skyplane_tpu.compute.azure.azure_auth import AzureAuthentication
+
+        return AzureAuthentication(self)

@@ -1,0 +1,180 @@
+"""Dataplane: a provisioned gateway network executing transfer jobs.
+
+Reference parity: skyplane/api/dataplane.py:42-332 — provision (bind servers
+to topology gateways, generate the E2EE key, ship program/info files, start
+gateways in parallel), run/run_async via TransferProgressTracker, error-log
+polling, log collection, auto_deprovision context manager.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import requests
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.provisioner import Provisioner
+from skyplane_tpu.exceptions import GatewayException, SkyplaneTpuException
+from skyplane_tpu.gateway.crypto import generate_key
+from skyplane_tpu.planner.topology import TopologyPlan, TopologyPlanGateway
+from skyplane_tpu.utils import do_parallel
+from skyplane_tpu.utils.logger import logger
+
+
+class BoundGateway:
+    """A topology gateway bound to a provisioned server."""
+
+    def __init__(self, plan_gateway: TopologyPlanGateway, server):
+        self.plan_gateway = plan_gateway
+        self.server = server
+
+    @property
+    def gateway_id(self) -> str:
+        return self.plan_gateway.gateway_id
+
+    @property
+    def region_tag(self) -> str:
+        return self.plan_gateway.region_tag
+
+    def control_url(self) -> str:
+        return self.server.control_url()
+
+    def queue_depth(self) -> int:
+        """Pending chunk count, used for least-loaded dispatch
+        (reference: transfer_job.py:686-710)."""
+        try:
+            r = requests.get(f"{self.control_url()}/incomplete_chunk_requests", timeout=5)
+            return len(r.json().get("chunk_requests", []))
+        except requests.RequestException:
+            return 1 << 30  # unreachable gateways sort last
+
+    def errors(self) -> List[str]:
+        try:
+            r = requests.get(f"{self.control_url()}/errors", timeout=5)
+            return r.json().get("errors", [])
+        except requests.RequestException as e:
+            return [f"(error endpoint unreachable: {e})"]
+
+
+class Dataplane:
+    def __init__(self, topology: TopologyPlan, provisioner: Provisioner, transfer_config: TransferConfig, debug: bool = False):
+        self.topology = topology
+        self.provisioner = provisioner
+        self.transfer_config = transfer_config
+        self.debug = debug
+        self.provisioned = False
+        self.bound_gateways: Dict[str, BoundGateway] = {}
+        self._e2ee_key: Optional[bytes] = None
+        self._trackers: List = []
+
+    @property
+    def src_region_tag(self) -> str:
+        return self.topology.src_region_tag
+
+    @property
+    def dst_region_tags(self) -> List[str]:
+        return self.topology.dest_region_tags
+
+    # ---- provisioning ----
+
+    def provision(self, spinner: bool = False) -> None:
+        """Reference: dataplane.py:129-230."""
+        if self.provisioned:
+            raise SkyplaneTpuException("dataplane already provisioned")
+        task_ids = {}
+        for gw in self.topology.gateways.values():
+            provider = gw.region_tag.split(":")[0]
+            task_ids[gw.gateway_id] = self.provisioner.add_task(provider, gw.region_tag, gw.vm_type)
+        self.provisioner.init_global()
+        servers = self.provisioner.provision()
+        for gateway_id, task_uuid in task_ids.items():
+            server = servers[task_uuid]
+            gw = self.topology.gateways[gateway_id]
+            gw.public_ip = server.public_ip()
+            gw.private_ip = server.private_ip()
+            gw.control_port = server.control_port
+            self.bound_gateways[gateway_id] = BoundGateway(gw, server)
+        if self.transfer_config.encrypt_e2e:
+            self._e2ee_key = generate_key()
+        gateway_info = self.topology.get_gateway_info_json()
+
+        def start(bound: BoundGateway) -> None:
+            bound.server.start_gateway(
+                gateway_program=bound.plan_gateway.gateway_program.to_dict(),
+                gateway_info=gateway_info,
+                gateway_id=bound.gateway_id,
+                e2ee_key=self._e2ee_key,
+                use_tls=self.transfer_config.encrypt_socket_tls,
+                use_bbr=self.transfer_config.use_bbr,
+            )
+
+        do_parallel(start, list(self.bound_gateways.values()), n=16, desc="starting gateways", spinner=spinner)
+        self.provisioned = True
+
+    def deprovision(self, max_jobs: int = 64) -> None:
+        """Reference: dataplane.py:244-273 — wait for trackers, tear down."""
+        for t in self._trackers:
+            if t.is_alive():
+                t.join(timeout=5)
+        self.provisioner.deprovision()
+        self.provisioned = False
+
+    @contextmanager
+    def auto_deprovision(self):
+        try:
+            yield self
+        finally:
+            try:
+                self.deprovision()
+            except Exception as e:  # noqa: BLE001
+                logger.fs.error(f"auto_deprovision failed: {e}")
+
+    # ---- queries ----
+
+    def source_gateways(self) -> List[BoundGateway]:
+        return [self.bound_gateways[g.gateway_id] for g in self.topology.source_gateways() if g.gateway_id in self.bound_gateways]
+
+    def sink_gateways(self) -> List[BoundGateway]:
+        return [self.bound_gateways[g.gateway_id] for g in self.topology.sink_gateways() if g.gateway_id in self.bound_gateways]
+
+    def check_error_logs(self) -> Dict[str, List[str]]:
+        """Poll every gateway's /errors (reference: dataplane.py:275-292)."""
+        results = do_parallel(lambda b: b.errors(), list(self.bound_gateways.values()), n=16)
+        return {b.gateway_id: errs for b, errs in results if errs}
+
+    def copy_gateway_logs(self, out_dir) -> None:
+        """Collect daemon logs for debugging (reference: dataplane.py:232-242)."""
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        for bound in self.bound_gateways.values():
+            try:
+                if hasattr(bound.server, "workdir"):
+                    log = Path(bound.server.workdir) / "daemon.log"
+                    if log.exists():
+                        (out / f"{bound.gateway_id}.log").write_text(log.read_text())
+                else:
+                    bound.server.download_file("/tmp/skyplane_tpu/daemon.log", out / f"{bound.gateway_id}.log")
+            except Exception as e:  # noqa: BLE001
+                logger.fs.warning(f"could not collect logs from {bound.gateway_id}: {e}")
+
+    # ---- execution ----
+
+    def run_async(self, jobs: List, hooks=None):
+        """Start a TransferProgressTracker thread (reference: dataplane.py:310-322)."""
+        if not self.provisioned:
+            raise SkyplaneTpuException("dataplane must be provisioned before running jobs")
+        from skyplane_tpu.api.tracker import TransferProgressTracker
+
+        tracker = TransferProgressTracker(self, jobs, self.transfer_config, hooks)
+        self._trackers.append(tracker)
+        tracker.start()
+        return tracker
+
+    def run(self, jobs: List, hooks=None) -> None:
+        tracker = self.run_async(jobs, hooks)
+        tracker.join()
+        if tracker.error:
+            raise tracker.error

@@ -1,0 +1,32 @@
+"""Thin convenience wrapper over storage interfaces.
+
+Reference parity: skyplane/api/obj_store.py (download/upload/exists/
+create_bucket helpers keyed by region tag).
+"""
+
+from __future__ import annotations
+
+from skyplane_tpu.obj_store.storage_interface import StorageInterface
+
+
+class ObjectStore:
+    def _iface(self, region_tag: str, bucket: str) -> StorageInterface:
+        return StorageInterface.create(region_tag, bucket)
+
+    def download_object(self, bucket: str, provider: str, key: str, filename: str) -> None:
+        self._iface(f"{provider}:infer", bucket).download_object(key, filename)
+
+    def upload_object(self, filename: str, bucket: str, provider: str, key: str) -> None:
+        self._iface(f"{provider}:infer", bucket).upload_object(filename, key)
+
+    def exists(self, bucket: str, provider: str, key: str) -> bool:
+        return self._iface(f"{provider}:infer", bucket).exists(key)
+
+    def bucket_exists(self, bucket: str, provider: str) -> bool:
+        return self._iface(f"{provider}:infer", bucket).bucket_exists()
+
+    def create_bucket(self, region_tag: str, bucket: str) -> None:
+        self._iface(region_tag, bucket).create_bucket(region_tag)
+
+    def delete_bucket(self, bucket: str, provider: str) -> None:
+        self._iface(f"{provider}:infer", bucket).delete_bucket()

@@ -1,0 +1,83 @@
+"""Pipeline: queue jobs, plan, provision, run.
+
+Reference parity: skyplane/api/pipeline.py:24-187.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.dataplane import Dataplane
+from skyplane_tpu.api.provisioner import Provisioner
+from skyplane_tpu.api.tracker import TransferHook
+from skyplane_tpu.api.transfer_job import CopyJob, SyncJob, TransferJob
+from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.planner.planner import get_planner
+from skyplane_tpu.utils.logger import logger
+
+
+class Pipeline:
+    def __init__(
+        self,
+        planning_algorithm: str = "direct",
+        max_instances: int = 1,
+        transfer_config: Optional[TransferConfig] = None,
+        provisioner: Optional[Provisioner] = None,
+        debug: bool = False,
+    ):
+        self.planning_algorithm = planning_algorithm
+        self.max_instances = max_instances
+        self.transfer_config = transfer_config or TransferConfig()
+        self.provisioner = provisioner or Provisioner(autoshutdown_minutes=self.transfer_config.autoshutdown_minutes)
+        self.debug = debug
+        self.jobs_to_dispatch: List[TransferJob] = []
+
+    # ---- job queueing (reference :130-175) ----
+
+    def queue_copy(self, src: str, dst: str, recursive: bool = False) -> CopyJob:
+        job = CopyJob(src, [dst] if isinstance(dst, str) else dst, recursive=recursive)
+        self.jobs_to_dispatch.append(job)
+        return job
+
+    def queue_sync(self, src: str, dst: str) -> SyncJob:
+        job = SyncJob(src, [dst] if isinstance(dst, str) else dst, recursive=True)
+        self.jobs_to_dispatch.append(job)
+        return job
+
+    # ---- planning / execution ----
+
+    def planner(self):
+        return get_planner(self.planning_algorithm, self.transfer_config, n_instances=self.max_instances)
+
+    def create_dataplane(self, debug: bool = False) -> Dataplane:
+        if not self.jobs_to_dispatch:
+            raise SkyplaneTpuException("no jobs queued; call queue_copy/queue_sync first")
+        topology = self.planner().plan(self.jobs_to_dispatch)
+        return Dataplane(topology, self.provisioner, self.transfer_config, debug=debug or self.debug)
+
+    def start(self, debug: bool = False, progress: bool = False, hooks: Optional[TransferHook] = None) -> None:
+        """Provision, run all queued jobs, deprovision (reference :91-128)."""
+        dp = self.create_dataplane(debug)
+        with dp.auto_deprovision():
+            dp.provision(spinner=progress)
+            if progress and hooks is None:
+                from skyplane_tpu.cli.impl.progress_bar import ProgressBarTransferHook
+
+                hooks = ProgressBarTransferHook(dp.topology.dest_region_tags)
+            dp.run(self.jobs_to_dispatch, hooks)
+        self.jobs_to_dispatch.clear()
+
+    def estimate_total_cost(self) -> float:
+        """$ estimate = egress $/GB x total GB (reference :177-187)."""
+        topology = self.planner().plan(self.jobs_to_dispatch)
+        total_gb = 0.0
+        for job in self.jobs_to_dispatch:
+            for pair in job.chunker.transfer_pair_generator(job.src_prefix, job.dst_prefixes, job.recursive) if job.chunker else []:
+                total_gb += (pair.src_obj.size or 0) / 1e9
+        # fall back to listing sizes directly when the chunker hasn't run
+        if total_gb == 0.0:
+            for job in self.jobs_to_dispatch:
+                for obj in job.src_iface.list_objects(prefix=job.src_prefix.rstrip("/") if job.recursive else job.src_prefix):
+                    total_gb += (obj.size or 0) / 1e9
+        return topology.cost_per_gb * total_gb

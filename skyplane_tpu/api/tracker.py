@@ -1,0 +1,155 @@
+"""Transfer progress tracking: dispatch jobs, monitor gateways to completion.
+
+Reference parity: skyplane/api/tracker.py:28-399 — TransferHook interface,
+tracker thread that dispatches every job, polls sink gateways'
+chunk status, surfaces gateway errors as GatewayException, then finalizes
+(multipart completion) and verifies each job.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+import requests
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.exceptions import GatewayException, SkyplaneTpuException, TransferFailedException
+from skyplane_tpu.utils.logger import logger
+
+
+class TransferHook:
+    """Progress callback surface (reference: tracker.py:28-54)."""
+
+    def on_dispatch_start(self) -> None: ...
+
+    def on_chunk_dispatched(self, chunks: List) -> None: ...
+
+    def on_dispatch_end(self) -> None: ...
+
+    def on_chunk_completed(self, chunks: List, region_tag: Optional[str] = None) -> None: ...
+
+    def on_transfer_end(self) -> None: ...
+
+    def on_transfer_error(self, error: Exception) -> None: ...
+
+
+class EmptyTransferHook(TransferHook):
+    pass
+
+
+class TransferProgressTracker(threading.Thread):
+    POLL_INTERVAL_S = 0.1
+
+    def __init__(self, dataplane, jobs: List, transfer_config: TransferConfig, hooks: Optional[TransferHook] = None):
+        super().__init__(name="transfer-tracker", daemon=True)
+        self.dataplane = dataplane
+        self.jobs = jobs
+        self.transfer_config = transfer_config
+        self.hooks = hooks or EmptyTransferHook()
+        self.error: Optional[Exception] = None
+        # chunk accounting
+        self.dispatched_chunk_ids: List[str] = []
+        self.chunk_sizes: Dict[str, int] = {}
+        self.complete_chunk_ids: Set[str] = set()
+        self._lock = threading.Lock()
+
+    # ---- queries (reference: tracker.py:372-399) ----
+
+    def query_bytes_dispatched(self) -> int:
+        with self._lock:
+            return sum(self.chunk_sizes.get(c, 0) for c in self.dispatched_chunk_ids)
+
+    def query_bytes_remaining(self) -> int:
+        with self._lock:
+            pending = set(self.dispatched_chunk_ids) - self.complete_chunk_ids
+            return sum(self.chunk_sizes.get(c, 0) for c in pending)
+
+    def is_complete(self) -> bool:
+        with self._lock:
+            return bool(self.dispatched_chunk_ids) and set(self.dispatched_chunk_ids) <= self.complete_chunk_ids
+
+    # ---- main loop ----
+
+    def run(self) -> None:
+        try:
+            for job in self.jobs:
+                self._dispatch_job(job)
+            self._monitor_to_completion()
+            for job in self.jobs:
+                job.finalize()
+            for job in self.jobs:
+                job.verify()
+            self.hooks.on_transfer_end()
+        except Exception as e:  # noqa: BLE001
+            self.error = e
+            logger.fs.error(f"[tracker] transfer failed: {e}")
+            self.hooks.on_transfer_error(e)
+
+    def _dispatch_job(self, job) -> None:
+        self.hooks.on_dispatch_start()
+        batch: List = []
+        for chunk in job.dispatch(self.dataplane, self.transfer_config):
+            with self._lock:
+                self.dispatched_chunk_ids.append(chunk.chunk_id)
+                self.chunk_sizes[chunk.chunk_id] = chunk.chunk_length_bytes
+            batch.append(chunk)
+            if len(batch) >= 100:
+                self.hooks.on_chunk_dispatched(batch)
+                batch = []
+        self.hooks.on_chunk_dispatched(batch)
+        self.hooks.on_dispatch_end()
+
+    def _poll_gateway_status(self, gateway) -> Dict[str, str]:
+        try:
+            r = requests.get(f"{gateway.control_url()}/chunk_status_log", timeout=10)
+            r.raise_for_status()
+            return r.json().get("chunk_status", {})
+        except requests.RequestException as e:
+            logger.fs.warning(f"[tracker] status poll failed for {gateway.gateway_id}: {e}")
+            return {}
+
+    def _check_gateway_errors(self) -> None:
+        errors = self.dataplane.check_error_logs()
+        real = {gid: errs for gid, errs in errors.items() if any(not e.startswith("(error endpoint") for e in errs)}
+        if real:
+            gid, errs = next(iter(real.items()))
+            raise GatewayException(f"gateway {gid} reported {len(errs)} errors", gateway_id=gid, tracebacks=errs)
+
+    def _monitor_to_completion(self, timeout_s: float = 24 * 3600) -> None:
+        """Poll sink gateways until every dispatched chunk lands at every
+        destination region (reference: tracker.py:267-332)."""
+        with self._lock:
+            if not self.dispatched_chunk_ids:
+                return  # nothing to transfer (e.g. sync with everything current)
+        sinks = self.dataplane.sink_gateways()
+        if not sinks:
+            raise SkyplaneTpuException("topology has no sink gateways")
+        by_region: Dict[str, List] = {}
+        for gw in sinks:
+            by_region.setdefault(gw.region_tag, []).append(gw)
+        reported_complete: Set[str] = set()
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            self._check_gateway_errors()
+            region_complete: Dict[str, Set[str]] = {}
+            for region, gws in by_region.items():
+                done: Set[str] = set()
+                for gw in gws:
+                    status = self._poll_gateway_status(gw)
+                    done |= {cid for cid, st in status.items() if st == "complete"}
+                region_complete[region] = done
+            # a chunk is complete when EVERY destination region has landed it
+            all_complete = set.intersection(*region_complete.values()) if region_complete else set()
+            with self._lock:
+                self.complete_chunk_ids = all_complete
+                newly = all_complete - reported_complete
+                target = set(self.dispatched_chunk_ids)
+            if newly:
+                self.hooks.on_chunk_completed([cid for cid in newly])
+                reported_complete |= newly
+            if target and target <= all_complete:
+                return
+            time.sleep(self.POLL_INTERVAL_S)
+        raise TransferFailedException(f"transfer timed out after {timeout_s}s")

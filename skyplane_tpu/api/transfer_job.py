@@ -1,0 +1,439 @@
+"""Transfer jobs + chunker: object listing, key mapping, chunk splitting,
+dispatch, finalize, verify.
+
+Reference parity: skyplane/api/transfer_job.py:61-865 —
+``map_object_key_prefix`` (the subtle cp/sync path semantics incl. the
+issue-490 regression), ``Chunker`` (multipart splitting with upload-id
+initiation, generator combinators), ``CopyJob.dispatch`` (batched HTTP POST
+to least-loaded source gateways), ``finalize`` (parallel multipart
+completion), ``verify`` (dest listing vs transfer list), and ``SyncJob``
+delta-copy filtering.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Generator, Iterable, List, Optional, Tuple
+
+import requests
+
+from skyplane_tpu.chunk import Chunk, ChunkRequest
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.exceptions import (
+    MissingObjectException,
+    SkyplaneTpuException,
+    TransferFailedException,
+)
+from skyplane_tpu.obj_store.object_store_interface import ObjectStoreObject
+from skyplane_tpu.obj_store.storage_interface import StorageInterface
+from skyplane_tpu.utils import do_parallel
+from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.utils.path import parse_path
+
+
+def map_object_key_prefix(source_prefix: str, source_key: str, dest_prefix: str, recursive: bool = False) -> str:
+    """Map a source object key to its destination key.
+
+    Semantics match the reference (transfer_job.py:192-241, unit-tested in
+    tests/unit_nocloud/test_api_chunker.py):
+
+    non-recursive — copying exactly one object:
+      * ``source_key`` must equal ``source_prefix``
+      * dest_prefix ending in "/" (or empty) → dest_prefix + basename(source_key)
+      * otherwise dest_prefix IS the destination key
+    recursive — copying a prefix subtree:
+      * the source prefix is treated as a directory: a key matches only if it
+        equals the prefix or continues it at a "/" boundary (issue-490: prefix
+        "a/b" must NOT capture "a/bc/d")
+      * destination key = dest_prefix joined with the suffix after the prefix
+    """
+    if not recursive:
+        if source_key != source_prefix:
+            raise MissingObjectException(
+                f"non-recursive copy requires an exact object: {source_key!r} != {source_prefix!r} (pass recursive=True?)"
+            )
+        if dest_prefix == "" or dest_prefix == "/":
+            return source_key.rsplit("/", 1)[-1]
+        if dest_prefix.endswith("/"):
+            return dest_prefix + source_key.rsplit("/", 1)[-1]
+        return dest_prefix
+    # recursive
+    prefix = source_prefix
+    if prefix and not prefix.endswith("/"):
+        prefix += "/"
+    if source_key == source_prefix.rstrip("/"):
+        suffix = source_key.rsplit("/", 1)[-1]
+    elif source_key.startswith(prefix):
+        suffix = source_key[len(prefix) :]
+    else:
+        raise MissingObjectException(f"source key {source_key!r} does not fall under prefix {source_prefix!r}")
+    if dest_prefix == "" or dest_prefix == "/":
+        return suffix
+    if dest_prefix.endswith("/"):
+        return dest_prefix + suffix
+    return dest_prefix + "/" + suffix
+
+
+@dataclass
+class TransferPair:
+    src_obj: ObjectStoreObject
+    dst_objs: Dict[str, ObjectStoreObject]  # dest region tag -> object
+
+
+@dataclass
+class GatewayMessage:
+    """Out-of-band message to a gateway (upload-id map entries)."""
+
+    # region_tag -> {dest_key: upload_id}
+    upload_id_mapping: Optional[Dict[str, Dict[str, str]]] = None
+
+
+def batch_generator(gen: Iterable, batch_size: int) -> Generator[List, None, None]:
+    batch: List = []
+    for item in gen:
+        batch.append(item)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+def prefetch_generator(gen: Iterable, buffer_size: int) -> Generator:
+    """Pull from ``gen`` in a background thread, up to buffer_size ahead
+    (reference: transfer_job.py:391-447)."""
+    sentinel = object()
+    q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+    err: List[BaseException] = []
+
+    def worker():
+        try:
+            for item in gen:
+                q.put(item)
+        except BaseException as e:  # noqa: BLE001
+            err.append(e)
+        finally:
+            q.put(sentinel)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is sentinel:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+def tail_generator(gen: Iterable, out_list: List) -> Generator:
+    for item in gen:
+        out_list.append(item)
+        yield item
+
+
+class Chunker:
+    """Splits transfer pairs into chunks; initiates multipart uploads.
+
+    Reference parity: transfer_job.py:61-171,327-389.
+    """
+
+    def __init__(
+        self,
+        src_iface: StorageInterface,
+        dst_ifaces: List[StorageInterface],
+        transfer_config: TransferConfig,
+        num_partitions: int = 1,
+    ):
+        self.src_iface = src_iface
+        self.dst_ifaces = dst_ifaces
+        self.transfer_config = transfer_config
+        self.num_partitions = num_partitions
+        self.multipart_upload_queue: "queue.Queue[GatewayMessage]" = queue.Queue()
+        self.initiated_uploads: List[Tuple[StorageInterface, str, str]] = []  # (iface, dest_key, upload_id)
+
+    def transfer_pair_generator(
+        self,
+        src_prefix: str,
+        dst_prefixes: List[str],
+        recursive: bool,
+        post_filter_fn: Optional[Callable[[ObjectStoreObject], bool]] = None,
+    ) -> Generator[TransferPair, None, None]:
+        """List the source and map each object to destination keys
+        (reference :243-325)."""
+        found = False
+        for obj in self.src_iface.list_objects(prefix=src_prefix.rstrip("/") if recursive else src_prefix):
+            if recursive:
+                prefix = src_prefix.rstrip("/")
+                if not (obj.key == prefix or obj.key.startswith(prefix + "/") or prefix == ""):
+                    continue
+            else:
+                if obj.key != src_prefix:
+                    continue
+            found = True
+            if post_filter_fn is not None and not post_filter_fn(obj):
+                continue
+            dst_objs = {}
+            for iface, dst_prefix in zip(self.dst_ifaces, dst_prefixes):
+                dest_key = map_object_key_prefix(src_prefix, obj.key, dst_prefix, recursive=recursive)
+                dst_objs[iface.region_tag()] = ObjectStoreObject(
+                    key=dest_key, provider=iface.provider, bucket=iface.bucket(), size=obj.size, mime_type=obj.mime_type
+                )
+            yield TransferPair(src_obj=obj, dst_objs=dst_objs)
+        if not found:
+            raise MissingObjectException(f"no objects found under source prefix {src_prefix!r}")
+
+    def chunk(self, pairs: Iterable[TransferPair]) -> Generator[Chunk, None, None]:
+        """Emit chunks for each pair; large objects become multipart parts
+        (reference :327-389)."""
+        cfg = self.transfer_config
+        threshold = cfg.multipart_threshold_mb << 20
+        part_size = cfg.multipart_chunk_size_mb << 20
+        multipart = cfg.multipart_enabled and any(
+            hasattr(iface, "initiate_multipart_upload") for iface in self.dst_ifaces
+        )
+        partition_counter = 0
+        for pair in pairs:
+            size = pair.src_obj.size or 0
+            # partition names must match the planner's per-partition programs
+            # (single-partition plans use "default", reference: planner.py:283-383)
+            partition_id = "default" if self.num_partitions == 1 else str(partition_counter % self.num_partitions)
+            partition_counter += 1
+            if multipart and size > threshold:
+                yield from self._chunk_multipart(pair, size, part_size, cfg.multipart_max_chunks, partition_id)
+            else:
+                sample_dst = next(iter(pair.dst_objs.values()))
+                yield Chunk(
+                    src_key=pair.src_obj.key,
+                    dest_key=sample_dst.key,
+                    chunk_id=uuid.uuid4().hex,
+                    chunk_length_bytes=size,
+                    partition_id=partition_id,
+                    mime_type=pair.src_obj.mime_type,
+                )
+
+    def _chunk_multipart(self, pair: TransferPair, size: int, part_size: int, max_parts: int, partition_id: str):
+        n_parts = math.ceil(size / part_size)
+        if n_parts > max_parts:
+            part_size = math.ceil(size / max_parts)
+            n_parts = math.ceil(size / part_size)
+        sample_dst = next(iter(pair.dst_objs.values()))
+        # initiate one multipart upload per destination, announce to sink gateways
+        mapping: Dict[str, Dict[str, str]] = {}
+        for iface in self.dst_ifaces:
+            dst_obj = pair.dst_objs[iface.region_tag()]
+            upload_id = iface.initiate_multipart_upload(dst_obj.key, mime_type=pair.src_obj.mime_type)
+            mapping.setdefault(iface.region_tag(), {})[dst_obj.key] = upload_id
+            self.initiated_uploads.append((iface, dst_obj.key, upload_id))
+        self.multipart_upload_queue.put(GatewayMessage(upload_id_mapping=mapping))
+        offset = 0
+        for part in range(1, n_parts + 1):
+            length = min(part_size, size - offset)
+            yield Chunk(
+                src_key=pair.src_obj.key,
+                dest_key=sample_dst.key,
+                chunk_id=uuid.uuid4().hex,
+                chunk_length_bytes=length,
+                partition_id=partition_id,
+                file_offset_bytes=offset,
+                part_number=part,
+                multi_part=True,
+                mime_type=pair.src_obj.mime_type,
+            )
+            offset += length
+
+
+class TransferJob:
+    """Base job (reference :453-531): lazily-bound interfaces from URIs."""
+
+    def __init__(self, src_path: str, dst_paths: List[str], recursive: bool = False, requester_pays: bool = False):
+        self.src_path = src_path
+        self.dst_paths = dst_paths if isinstance(dst_paths, list) else [dst_paths]
+        self.recursive = recursive
+        self.requester_pays = requester_pays
+        self.uuid = str(uuid.uuid4())
+        self.transfer_list: List[TransferPair] = []
+        self._src_iface: Optional[StorageInterface] = None
+        self._dst_ifaces: Optional[List[StorageInterface]] = None
+
+    @property
+    def src_prefix(self) -> str:
+        return parse_path(self.src_path)[2]
+
+    @property
+    def dst_prefixes(self) -> List[str]:
+        return [parse_path(p)[2] for p in self.dst_paths]
+
+    @property
+    def src_iface(self) -> StorageInterface:
+        if self._src_iface is None:
+            provider, bucket, _ = parse_path(self.src_path)
+            self._src_iface = StorageInterface.create(f"{provider}:infer", bucket)
+        return self._src_iface
+
+    @property
+    def dst_ifaces(self) -> List[StorageInterface]:
+        if self._dst_ifaces is None:
+            self._dst_ifaces = []
+            for p in self.dst_paths:
+                provider, bucket, _ = parse_path(p)
+                self._dst_ifaces.append(StorageInterface.create(f"{provider}:infer", bucket))
+        return self._dst_ifaces
+
+    def dispatch(self, dataplane, transfer_config: TransferConfig) -> Generator[Chunk, None, None]:
+        raise NotImplementedError
+
+    def finalize(self) -> None:
+        raise NotImplementedError
+
+    def verify(self) -> None:
+        raise NotImplementedError
+
+
+class CopyJob(TransferJob):
+    """Copy job: dispatch chunk batches to source gateways (reference :565-781)."""
+
+    DISPATCH_BATCH_SIZE = 100
+    PREFETCH = 32
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.chunker: Optional[Chunker] = None
+        self._dispatched_chunks: List[Chunk] = []
+
+    def _post_filter_fn(self, obj: ObjectStoreObject) -> bool:
+        return True
+
+    def dispatch(self, dataplane, transfer_config: TransferConfig) -> Generator[Chunk, None, None]:
+        self.chunker = Chunker(
+            self.src_iface, self.dst_ifaces, transfer_config, num_partitions=1
+        )
+        pairs = self.chunker.transfer_pair_generator(
+            self.src_prefix, self.dst_prefixes, self.recursive, post_filter_fn=self._post_filter_fn
+        )
+        pairs = tail_generator(pairs, self.transfer_list)
+        chunk_gen = self.chunker.chunk(pairs)
+        chunk_gen = prefetch_generator(chunk_gen, self.PREFETCH * self.DISPATCH_BATCH_SIZE)
+
+        src_gateways = dataplane.source_gateways()
+        sink_gateways = dataplane.sink_gateways()
+        session = requests.Session()
+
+        for batch in batch_generator(chunk_gen, self.DISPATCH_BATCH_SIZE):
+            # flush any multipart upload-id mappings to every sink gateway first
+            self._flush_upload_ids(session, sink_gateways)
+            reqs = [self._to_request(c, dataplane) for c in batch]
+            target = min(src_gateways, key=lambda g: g.queue_depth())
+            body = [r.as_dict() for r in reqs]
+            for attempt in range(4):
+                try:
+                    resp = session.post(f"{target.control_url()}/chunk_requests", json=body, timeout=60)
+                    resp.raise_for_status()
+                    break
+                except requests.RequestException as e:
+                    if attempt == 3:
+                        raise
+                    logger.fs.warning(f"chunk dispatch retry to {target.gateway_id}: {e}")
+                    import time as _time
+
+                    _time.sleep(0.5 * (attempt + 1))
+            self._dispatched_chunks.extend(batch)
+            yield from batch
+        self._flush_upload_ids(session, sink_gateways)
+
+    def _flush_upload_ids(self, session, sink_gateways) -> None:
+        assert self.chunker is not None
+        while True:
+            try:
+                msg = self.chunker.multipart_upload_queue.get_nowait()
+            except queue.Empty:
+                break
+            if not msg.upload_id_mapping:
+                continue
+            for gw in sink_gateways:
+                entries = msg.upload_id_mapping.get(gw.region_tag, {})
+                if not entries:
+                    continue
+                resp = session.post(f"{gw.control_url()}/upload_id_maps", json=entries, timeout=60)
+                resp.raise_for_status()
+
+    def _to_request(self, chunk: Chunk, dataplane) -> ChunkRequest:
+        src_provider, src_bucket, _ = parse_path(self.src_path)
+        dst_provider, dst_bucket, _ = parse_path(self.dst_paths[0])
+        return ChunkRequest(
+            chunk=chunk,
+            src_region=dataplane.src_region_tag,
+            dst_region=dataplane.dst_region_tags[0],
+            src_type="object_store",
+            dst_type="object_store",
+            src_object_store_bucket=src_bucket,
+            dst_object_store_bucket=dst_bucket,
+        )
+
+    def finalize(self) -> None:
+        """Complete all multipart uploads in parallel (reference :719-744)."""
+        if self.chunker is None or not self.chunker.initiated_uploads:
+            return
+        do_parallel(
+            lambda entry: entry[0].complete_multipart_upload(entry[1], entry[2]),
+            self.chunker.initiated_uploads,
+            n=16,
+        )
+
+    def verify(self) -> None:
+        """Check every mapped destination object exists (reference :746-781).
+
+        The listing is scoped to the common prefix of the destination keys —
+        an unscoped list of a large (or filesystem-rooted) bucket would walk
+        everything.
+        """
+        import os.path
+
+        for iface in self.dst_ifaces:
+            region = iface.region_tag()
+            dest_keys = {pair.dst_objs[region].key for pair in self.transfer_list}
+            if not dest_keys:
+                continue
+            common = os.path.commonprefix(sorted(dest_keys))
+            scan_prefix = common.rsplit("/", 1)[0] + "/" if "/" in common else ""
+            found = {obj.key for obj in iface.list_objects(prefix=scan_prefix)}
+            missing = dest_keys - found
+            if missing:
+                raise TransferFailedException(f"{len(missing)} objects missing at {region}", failed_objects=sorted(missing)[:32])
+
+    def size_gb(self) -> float:
+        return sum((p.src_obj.size or 0) for p in self.transfer_list) / 1e9
+
+
+class SyncJob(CopyJob):
+    """Delta copy: skip destination objects that are already current
+    (reference :792-865)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._dest_listing: Optional[Dict[str, Dict[str, ObjectStoreObject]]] = None
+
+    def _load_dest_listing(self) -> None:
+        if self._dest_listing is None:
+            self._dest_listing = {}
+            for iface in self.dst_ifaces:
+                self._dest_listing[iface.region_tag()] = {obj.key: obj for obj in iface.list_objects()}
+
+    def _post_filter_fn(self, obj: ObjectStoreObject) -> bool:
+        """Copy only new or changed objects (size or mtime newer)."""
+        self._load_dest_listing()
+        assert self._dest_listing is not None
+        for iface, dst_prefix in zip(self.dst_ifaces, self.dst_prefixes):
+            try:
+                dest_key = map_object_key_prefix(self.src_prefix, obj.key, dst_prefix, recursive=self.recursive)
+            except MissingObjectException:
+                return False
+            existing = self._dest_listing[iface.region_tag()].get(dest_key)
+            if existing is None or existing.size != obj.size:
+                return True
+            if obj.last_modified and existing.last_modified and obj.last_modified > existing.last_modified:
+                return True
+        return False

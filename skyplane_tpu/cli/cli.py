@@ -1,0 +1,120 @@
+"""skyplane-tpu CLI: cp, sync, init, deprovision, config, ssh.
+
+Reference parity: skyplane/cli/cli.py:20-105 (Typer app) — implemented with
+click (typer is not in this image). Transfer orchestration (path parsing,
+fallbacks, confirmation, progress) lives in cli_transfer.py like the
+reference's cli_transfer.py:113-423.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+
+from skyplane_tpu import __version__
+
+
+@click.group()
+@click.version_option(__version__)
+def main():
+    """skyplane-tpu: TPU-accelerated bulk cloud data transfer."""
+
+
+@main.command()
+@click.argument("src")
+@click.argument("dst", nargs=-1, required=True)
+@click.option("-r", "--recursive", is_flag=True, help="copy a prefix tree")
+@click.option("-y", "--yes", is_flag=True, help="skip confirmation")
+@click.option("--max-instances", default=None, type=int, help="gateway VMs per region")
+@click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided"]))
+@click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz"]))
+@click.option("--dedup/--no-dedup", default=None, help="content-defined dedup on the TPU path")
+@click.option("--debug", is_flag=True, help="collect gateway logs on exit")
+def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, debug):
+    """Copy objects between clouds: skyplane-tpu cp s3://a/ gs://b/ [-r]."""
+    from skyplane_tpu.cli.cli_transfer import run_transfer
+
+    sys.exit(run_transfer(src, list(dst), recursive=recursive, sync=False, yes=yes,
+                          max_instances=max_instances, solver=solver, compress=compress, dedup=dedup, debug=debug))
+
+
+@main.command()
+@click.argument("src")
+@click.argument("dst", nargs=-1, required=True)
+@click.option("-y", "--yes", is_flag=True)
+@click.option("--max-instances", default=None, type=int)
+@click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided"]))
+@click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz"]))
+@click.option("--dedup/--no-dedup", default=None)
+@click.option("--debug", is_flag=True)
+def sync(src, dst, yes, max_instances, solver, compress, dedup, debug):
+    """Delta-copy only new or changed objects (always recursive)."""
+    from skyplane_tpu.cli.cli_transfer import run_transfer
+
+    sys.exit(run_transfer(src, list(dst), recursive=True, sync=True, yes=yes,
+                          max_instances=max_instances, solver=solver, compress=compress, dedup=dedup, debug=debug))
+
+
+@main.command()
+@click.option("--non-interactive", is_flag=True, help="skip prompts; detect credentials only")
+def init(non_interactive):
+    """Detect cloud credentials and write ~/.skyplane_tpu/config."""
+    from skyplane_tpu.cli.cli_init import run_init
+
+    sys.exit(run_init(non_interactive))
+
+
+@main.command()
+def deprovision():
+    """Terminate all skyplane-tpu gateway VMs across clouds."""
+    from skyplane_tpu.cli.cli_cloud import run_deprovision
+
+    sys.exit(run_deprovision())
+
+
+@main.group()
+def config():
+    """Get or set configuration flags."""
+
+
+@config.command("get")
+@click.argument("name")
+def config_get(name):
+    from skyplane_tpu.config_paths import cloud_config
+    from skyplane_tpu.exceptions import BadConfigException
+
+    try:
+        click.echo(cloud_config.get_flag(name))
+    except BadConfigException as e:
+        raise click.ClickException(str(e)) from e
+
+
+@config.command("set")
+@click.argument("name")
+@click.argument("value")
+def config_set(name, value):
+    from skyplane_tpu.config_paths import cloud_config, config_path
+    from skyplane_tpu.exceptions import BadConfigException
+
+    cfg = cloud_config.reload()
+    try:
+        cfg.set_flag(name, value)
+    except BadConfigException as e:
+        raise click.ClickException(str(e)) from e
+    cfg.to_config_file(config_path)
+    click.echo(f"Set {name} = {cfg.get_flag(name)}")
+
+
+@config.command("list")
+def config_list():
+    from skyplane_tpu.config import SkyplaneConfig
+    from skyplane_tpu.config_paths import cloud_config
+
+    cfg = cloud_config
+    for name in SkyplaneConfig.flag_names():
+        click.echo(f"{name} = {cfg.get_flag(name)}")
+
+
+if __name__ == "__main__":
+    main()

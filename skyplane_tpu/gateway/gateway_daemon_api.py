@@ -246,11 +246,16 @@ class GatewayDaemonAPI:
             n = 0
             for d in body:
                 cr = ChunkRequest.from_dict(d)
+                # claim the id and enqueue under one lock so a concurrent
+                # duplicate POST can neither double-enqueue (TOCTOU) nor
+                # see a recorded-but-never-queued chunk; roll the claim back
+                # if enqueueing fails so the client's retry is honest
                 with self._lock:
                     if cr.chunk.chunk_id in self.chunk_requests:
                         continue  # idempotent re-register
+                    self.chunk_store.add_chunk_request(cr, ChunkState.registered)
+                    # recorded only after a successful enqueue, atomically with it
                     self.chunk_requests[cr.chunk.chunk_id] = d
-                self.chunk_store.add_chunk_request(cr, ChunkState.registered)
                 n += 1
             req._send(200, {"status": "ok", "registered": n})
         elif path == "/api/v1/upload_id_maps":

@@ -29,9 +29,10 @@ class POSIXFile(ObjectStoreObject):
 class POSIXInterface(ObjectStoreInterface):
     provider = "local"
 
-    def __init__(self, bucket_dir: str):
+    def __init__(self, bucket_dir: str, region_tag: str = "local:local"):
         self.bucket_name = bucket_dir or "/"
         self.root = Path(bucket_dir or "/")
+        self._region_tag = region_tag
         self._mpu_lock = threading.Lock()
         self._mpu: dict = {}  # upload_id -> dest key
 
@@ -39,7 +40,7 @@ class POSIXInterface(ObjectStoreInterface):
         return str(self.root)
 
     def region_tag(self) -> str:
-        return "local:local"
+        return self._region_tag
 
     def bucket_exists(self) -> bool:
         return self.root.is_dir()
@@ -75,8 +76,31 @@ class POSIXInterface(ObjectStoreInterface):
         base = self.root
         if not base.is_dir():
             return
-        for p in sorted(base.rglob("*")):
-            if not p.is_file() or p.name.startswith(".sky_tmp") or ".sky_part" in p.name:
+        # walk only the deepest existing directory of the prefix — with the
+        # filesystem-root "bucket" a full rglob would scan the whole disk
+        scan_root = base
+        if prefix:
+            # scan the parent even when the prefix names a directory: object
+            # stores use STRING prefixes, so "tmp/da" must also match the
+            # sibling file "tmp/data.txt"
+            scan_root = (base / prefix).parent
+            if not scan_root.is_dir():
+                return
+        def safe_walk(root: Path):
+            try:
+                entries = sorted(root.iterdir())
+            except (PermissionError, OSError):
+                return
+            for entry in entries:
+                if entry.is_dir():
+                    if entry.is_symlink():
+                        continue  # only dir symlinks can create cycles
+                    yield from safe_walk(entry)
+                elif entry.is_file():  # follows file symlinks like rglob did
+                    yield entry
+
+        for p in safe_walk(scan_root):
+            if p.name.startswith(".sky_tmp") or ".sky_part" in p.name:
                 continue
             key = str(p.relative_to(base))
             if prefix and not key.startswith(prefix):

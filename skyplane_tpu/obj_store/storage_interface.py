@@ -74,4 +74,12 @@ class StorageInterface:
             raise MissingDependencyException(
                 f"{provider} support requires the {sdk} package (failed importing {e.name})"
             ) from e
-        return getattr(module, cls_name)(bucket)
+        cls = getattr(module, cls_name)
+        # backends that care about the caller's region tag declare a
+        # region_tag kwarg (e.g. POSIX "sites"); cloud backends infer their
+        # region from the bucket and take only the bucket name
+        import inspect
+
+        if "region_tag" in inspect.signature(cls.__init__).parameters and not region_tag.endswith(":infer"):
+            return cls(bucket, region_tag=region_tag)
+        return cls(bucket)

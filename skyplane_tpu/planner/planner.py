@@ -1,0 +1,281 @@
+"""Planners: jobs -> TopologyPlan of per-gateway operator programs.
+
+Reference parity: skyplane/planner/planner.py:30-505 — quota-aware VM-type
+fallback ladder, MulticastDirectPlanner (default), one-sided variants for
+providers that can't host VMs, and same-region direct writes. TPU-native
+extension: planners decide ``compress``/``dedup`` per edge, enabling the
+codec when the compression-ratio x egress-price product beats raw bandwidth
+(BASELINE.json north star); egress prices come from planner/pricing.py.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.exceptions import InsufficientVCPUException, SkyplaneTpuException
+from skyplane_tpu.gateway.gateway_program import (
+    GatewayMuxAnd,
+    GatewayMuxOr,
+    GatewayProgram,
+    GatewayReadObjectStore,
+    GatewayReceive,
+    GatewaySend,
+    GatewayWriteObjectStore,
+)
+from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+from skyplane_tpu.planner.topology import TopologyPlan
+from skyplane_tpu.utils.logger import logger
+
+# vCPU counts per instance class, smallest-last fallback ladder
+# (reference: data/vcpu_info.csv + planner.py:114-159)
+VCPU_INFO: Dict[str, List[Tuple[str, int]]] = {
+    "aws": [("m5.8xlarge", 32), ("m5.4xlarge", 16), ("m5.2xlarge", 8), ("m5.xlarge", 4), ("m5.large", 2)],
+    "gcp": [("n2-standard-32", 32), ("n2-standard-16", 16), ("n2-standard-8", 8), ("n2-standard-4", 4)],
+    "azure": [("Standard_D32_v5", 32), ("Standard_D16_v5", 16), ("Standard_D8_v5", 8), ("Standard_D4_v5", 4)],
+    "local": [("local", 0)],
+    "test": [("test", 0)],
+}
+
+
+class Planner:
+    def __init__(self, transfer_config: TransferConfig, quota_limits_file: Optional[str] = None, n_instances: int = 1):
+        self.transfer_config = transfer_config
+        self.n_instances = n_instances
+        self.quota_limits: Dict[str, int] = {}
+        if quota_limits_file and Path(quota_limits_file).exists():
+            self.quota_limits = json.loads(Path(quota_limits_file).read_text())
+
+    def _region_quota(self, region_tag: str) -> Optional[int]:
+        """vCPU quota for a region, if known (reference loads per-cloud quota
+        files saved by `init`; tests inject a JSON map)."""
+        if region_tag in self.quota_limits:
+            return self.quota_limits[region_tag]
+        provider = region_tag.split(":")[0]
+        return self.quota_limits.get(provider)
+
+    def _calculate_vm_types(self, region_tag: str) -> Tuple[str, int]:
+        """Pick the largest instance class fitting the vCPU quota, walking
+        down the ladder; compute how many instances fit
+        (reference: planner.py:114-159)."""
+        provider = region_tag.split(":")[0]
+        ladder = VCPU_INFO.get(provider)
+        if ladder is None:
+            raise SkyplaneTpuException(f"no instance ladder for provider {provider!r}")
+        preferred = {
+            "aws": self.transfer_config.aws_instance_class,
+            "gcp": self.transfer_config.gcp_instance_class,
+            "azure": self.transfer_config.azure_instance_class,
+        }.get(provider)
+        quota = self._region_quota(region_tag)
+        if quota is None:
+            return preferred or ladder[0][0], self.n_instances
+        # try preferred first, then fall down the ladder
+        ordered = ladder
+        if preferred is not None:
+            pref_entry = next(((n, v) for n, v in ladder if n == preferred), None)
+            if pref_entry:
+                ordered = [pref_entry] + [e for e in ladder if e[0] != preferred]
+        for name, vcpus in ordered:
+            if vcpus == 0:
+                return name, self.n_instances
+            n_fit = quota // vcpus
+            if n_fit >= 1:
+                return name, min(self.n_instances, n_fit)
+        raise InsufficientVCPUException(
+            f"quota of {quota} vCPUs in {region_tag} cannot fit even {ordered[-1][0]} ({ordered[-1][1]} vCPUs)"
+        )
+
+    def _get_vm_type_and_instances(self, region_tags: List[str]) -> Tuple[Dict[str, str], int]:
+        """Choose per-region VM types and the min instance count across all
+        regions (reference: planner.py:161-192)."""
+        vm_types: Dict[str, str] = {}
+        n_instances = self.n_instances
+        for tag in region_tags:
+            vm, n = self._calculate_vm_types(tag)
+            vm_types[tag] = vm
+            n_instances = min(n_instances, n)
+        return vm_types, n_instances
+
+    def _edge_codec(self, src_region: str, dst_region: str) -> Tuple[str, bool]:
+        """Decide (codec, dedup) for a WAN edge: enable the TPU path when the
+        expected ratio x egress price beats shipping raw bytes."""
+        cfg = self.transfer_config
+        if cfg.compress == "none":
+            return "none", False
+        egress = get_egress_cost_per_gb(src_region, dst_region)
+        if egress == 0.0 and src_region == dst_region:
+            return "none", False  # same region: no egress cost, bandwidth is LAN
+        return cfg.compress, cfg.dedup
+
+    def plan(self, jobs: List) -> TopologyPlan:
+        raise NotImplementedError
+
+
+class MulticastDirectPlanner(Planner):
+    """Default planner: direct src->dst(s) with per-destination fan-out
+    (reference: planner.py:277-383)."""
+
+    def plan(self, jobs: List) -> TopologyPlan:
+        if not jobs:
+            raise SkyplaneTpuException("no jobs to plan")
+        src_region = jobs[0].src_iface.region_tag()
+        dst_regions = [iface.region_tag() for iface in jobs[0].dst_ifaces]
+        for job in jobs[1:]:
+            if job.src_iface.region_tag() != src_region or [i.region_tag() for i in job.dst_ifaces] != dst_regions:
+                raise SkyplaneTpuException("all jobs in one dataplane must share src/dst regions")
+
+        plan = TopologyPlan(src_region, dst_regions)
+        vm_types, n_instances = self._get_vm_type_and_instances([src_region] + [r for r in dst_regions if r != src_region])
+
+        src_gateways = [plan.add_gateway(src_region) for _ in range(n_instances)]
+        dst_gateways: Dict[str, List] = {}
+        for region in dst_regions:
+            if region == src_region:
+                continue
+            dst_gateways[region] = [plan.add_gateway(region) for _ in range(n_instances)]
+
+        cfg = self.transfer_config
+        for job in jobs:
+            partition = "default"
+            src_bucket = job.src_iface.bucket()
+            dst_ifaces = job.dst_ifaces
+            # source program: read -> (mux_and over destinations) -> sends
+            for gw in src_gateways:
+                program = gw.gateway_program
+                read = GatewayReadObjectStore(
+                    bucket_name=src_bucket, bucket_region=src_region, num_connections=cfg.num_connections
+                )
+                read_h = program.add_operator(read, partition_id=partition)
+                parent_for_dests = read_h
+                if len(dst_regions) > 1:
+                    mux = GatewayMuxAnd()
+                    parent_for_dests = program.add_operator(mux, parent_handle=read_h, partition_id=partition)
+                for iface, region in zip(dst_ifaces, dst_regions):
+                    if region == src_region:
+                        # same-region: write directly from the source gateway
+                        program.add_operator(
+                            GatewayWriteObjectStore(
+                                bucket_name=iface.bucket(), bucket_region=region, num_connections=cfg.num_connections
+                            ),
+                            parent_handle=parent_for_dests,
+                            partition_id=partition,
+                        )
+                        continue
+                    targets = dst_gateways[region]
+                    conns = max(1, cfg.num_connections // max(1, len(targets)))
+                    codec, dedup = self._edge_codec(src_region, region)
+                    parent = parent_for_dests
+                    if len(targets) > 1:
+                        mux_or = GatewayMuxOr()
+                        parent = program.add_operator(mux_or, parent_handle=parent_for_dests, partition_id=partition)
+                    for target in targets:
+                        program.add_operator(
+                            GatewaySend(
+                                target_gateway_id=target.gateway_id,
+                                region=region,
+                                num_connections=conns,
+                                compress=codec,
+                                encrypt=cfg.encrypt_e2e,
+                                dedup=dedup,
+                                private_ip=(src_region.split(":")[0] == region.split(":")[0] == "gcp"),
+                            ),
+                            parent_handle=parent,
+                            partition_id=partition,
+                        )
+            # destination programs: receive -> write
+            for iface, region in zip(dst_ifaces, dst_regions):
+                if region == src_region:
+                    continue
+                codec, dedup = self._edge_codec(src_region, region)
+                for gw in dst_gateways[region]:
+                    program = gw.gateway_program
+                    recv = GatewayReceive(decrypt=cfg.encrypt_e2e, dedup=dedup)
+                    recv_h = program.add_operator(recv, partition_id=partition)
+                    program.add_operator(
+                        GatewayWriteObjectStore(
+                            bucket_name=iface.bucket(), bucket_region=region, num_connections=cfg.num_connections
+                        ),
+                        parent_handle=recv_h,
+                        partition_id=partition,
+                    )
+        for gw in plan.gateways.values():
+            gw.vm_type = vm_types.get(gw.region_tag)
+        # $/GB of logical data: one egress charge per distinct WAN edge (a
+        # multicast pays egress once per destination region)
+        plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
+        return plan
+
+
+class DirectPlannerSourceOneSided(MulticastDirectPlanner):
+    """VMs only in the source region; writes go straight to the remote object
+    store over its API (reference: planner.py:386-443). Used when the
+    destination provider can't host VMs (e.g. Cloudflare R2)."""
+
+    def plan(self, jobs: List) -> TopologyPlan:
+        src_region = jobs[0].src_iface.region_tag()
+        dst_regions = [iface.region_tag() for iface in jobs[0].dst_ifaces]
+        plan = TopologyPlan(src_region, dst_regions)
+        vm_types, n_instances = self._get_vm_type_and_instances([src_region])
+        cfg = self.transfer_config
+        for _ in range(n_instances):
+            gw = plan.add_gateway(src_region)
+            program = gw.gateway_program
+            read_h = program.add_operator(
+                GatewayReadObjectStore(
+                    bucket_name=jobs[0].src_iface.bucket(), bucket_region=src_region, num_connections=cfg.num_connections
+                )
+            )
+            parent = read_h
+            if len(dst_regions) > 1:
+                parent = program.add_operator(GatewayMuxAnd(), parent_handle=read_h)
+            for iface, region in zip(jobs[0].dst_ifaces, dst_regions):
+                program.add_operator(
+                    GatewayWriteObjectStore(bucket_name=iface.bucket(), bucket_region=region, num_connections=cfg.num_connections),
+                    parent_handle=parent,
+                )
+            gw.vm_type = vm_types.get(src_region)
+        plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
+        return plan
+
+
+class DirectPlannerDestOneSided(MulticastDirectPlanner):
+    """VMs only in the destination region(s); they read the remote source
+    store directly (reference: planner.py:446-505)."""
+
+    def plan(self, jobs: List) -> TopologyPlan:
+        src_region = jobs[0].src_iface.region_tag()
+        dst_regions = [iface.region_tag() for iface in jobs[0].dst_ifaces]
+        plan = TopologyPlan(src_region, dst_regions)
+        vm_types, n_instances = self._get_vm_type_and_instances(dst_regions)
+        cfg = self.transfer_config
+        for iface, region in zip(jobs[0].dst_ifaces, dst_regions):
+            for _ in range(n_instances):
+                gw = plan.add_gateway(region)
+                program = gw.gateway_program
+                read_h = program.add_operator(
+                    GatewayReadObjectStore(
+                        bucket_name=jobs[0].src_iface.bucket(), bucket_region=src_region, num_connections=cfg.num_connections
+                    )
+                )
+                program.add_operator(
+                    GatewayWriteObjectStore(bucket_name=iface.bucket(), bucket_region=region, num_connections=cfg.num_connections),
+                    parent_handle=read_h,
+                )
+                gw.vm_type = vm_types.get(region)
+        plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
+        return plan
+
+
+def get_planner(name: str, transfer_config: TransferConfig, **kw) -> Planner:
+    """Planner selection by name (reference: api/pipeline.py:63-71)."""
+    planners = {
+        "direct": MulticastDirectPlanner,
+        "src_one_sided": DirectPlannerSourceOneSided,
+        "dst_one_sided": DirectPlannerDestOneSided,
+    }
+    if name not in planners:
+        raise SkyplaneTpuException(f"unknown planner {name!r}; available: {sorted(planners)}")
+    return planners[name](transfer_config, **kw)

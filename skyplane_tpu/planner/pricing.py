@@ -1,0 +1,71 @@
+"""Cloud egress pricing used by planners and cost estimation.
+
+Reference parity: skyplane/compute/cloud_provider.py:22-56 static dispatch +
+data/aws_transfer_costs.csv. We carry a compact published-price model
+(2023-era public list prices, $/GB) rather than a full region-pair CSV;
+overridable via a JSON file for operators who track their own rates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+# $/GB egress to the public internet / cross-cloud (published list prices)
+_INTERNET_EGRESS = {
+    "aws": 0.09,
+    "gcp": 0.12,
+    "azure": 0.0875,
+    "r2": 0.0,  # Cloudflare R2: free egress
+    "local": 0.0,
+    "test": 0.0,
+}
+
+# $/GB within the same cloud, cross-region
+_INTRA_CLOUD = {
+    "aws": 0.02,
+    "gcp": 0.01,
+    "azure": 0.02,
+    "local": 0.0,
+    "test": 0.0,
+}
+
+_override_cache: Optional[dict] = None
+
+
+def _overrides() -> dict:
+    global _override_cache
+    if _override_cache is None:
+        path = os.environ.get("SKYPLANE_TPU_PRICING_FILE")
+        _override_cache = json.loads(Path(path).read_text()) if path and Path(path).exists() else {}
+    return _override_cache
+
+
+def get_egress_cost_per_gb(src_region_tag: str, dst_region_tag: str) -> float:
+    """$/GB for data leaving src toward dst (reference: cloud_provider.py:22-56)."""
+    key = f"{src_region_tag}->{dst_region_tag}"
+    if key in _overrides():
+        return float(_overrides()[key])
+    src_provider, _, src_region = src_region_tag.partition(":")
+    dst_provider, _, dst_region = dst_region_tag.partition(":")
+    if src_region_tag == dst_region_tag:
+        return 0.0
+    if src_provider == "test" or dst_provider == "test":
+        return 0.0
+    if src_provider == dst_provider:
+        return _INTRA_CLOUD.get(src_provider, 0.02)
+    return _INTERNET_EGRESS.get(src_provider, 0.09)
+
+
+def get_instance_cost_per_hr(region_tag: str, vm_type: Optional[str]) -> float:
+    """Rough on-demand $/hr for gateway VM classes (reference:
+    solver.py:34 uses a single $0.54/hr basis)."""
+    provider = region_tag.split(":")[0]
+    table = {
+        "aws": {"m5.8xlarge": 1.54, "m5.4xlarge": 0.77, "m5.2xlarge": 0.38, "m5.xlarge": 0.19, "m5.large": 0.10},
+        "gcp": {"n2-standard-32": 1.55, "n2-standard-16": 0.78, "n2-standard-8": 0.39, "n2-standard-4": 0.19},
+        "azure": {"Standard_D32_v5": 1.54, "Standard_D16_v5": 0.77, "Standard_D8_v5": 0.38, "Standard_D4_v5": 0.19},
+    }
+    return table.get(provider, {}).get(vm_type or "", 0.0)

@@ -1,0 +1,106 @@
+"""Topology plan: the planner's output IR binding gateways to programs.
+
+Reference parity: skyplane/planner/topology.py:12-185 — per-gateway
+(region_tag, gateway_id, vm_type, gateway_program), IP binding after
+provisioning, source/sink queries by operator type, and the gateway-info
+JSON the daemons use for peer addressing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from skyplane_tpu.gateway.gateway_program import GatewayProgram
+
+
+@dataclass
+class TopologyPlanGateway:
+    region_tag: str
+    gateway_id: str
+    gateway_program: GatewayProgram
+    vm_type: Optional[str] = None
+    public_ip: Optional[str] = None
+    private_ip: Optional[str] = None
+    control_port: int = 8081
+
+    @property
+    def provider(self) -> str:
+        return self.region_tag.split(":")[0]
+
+    def program_ops(self) -> List[dict]:
+        return [op for group in self.gateway_program.to_dict()["plan"] for op in group["value"]]
+
+    def _has_op(self, op_type: str) -> bool:
+        def walk(ops):
+            for op in ops:
+                if op["op_type"] == op_type:
+                    return True
+                if walk(op.get("children", [])):
+                    return True
+            return False
+
+        return walk(self.program_ops())
+
+
+class TopologyPlan:
+    def __init__(self, src_region_tag: str, dest_region_tags: List[str], cost_per_gb: float = 0.0):
+        self.src_region_tag = src_region_tag
+        self.dest_region_tags = dest_region_tags
+        self.cost_per_gb = cost_per_gb
+        self.gateways: Dict[str, TopologyPlanGateway] = {}
+        self._counter = 0
+
+    def add_gateway(self, region_tag: str, program: Optional[GatewayProgram] = None) -> TopologyPlanGateway:
+        gateway_id = f"gateway_{self._counter}"
+        self._counter += 1
+        gw = TopologyPlanGateway(region_tag=region_tag, gateway_id=gateway_id, gateway_program=program or GatewayProgram())
+        self.gateways[gateway_id] = gw
+        return gw
+
+    def get_region_gateways(self, region_tag: str) -> List[TopologyPlanGateway]:
+        return [g for g in self.gateways.values() if g.region_tag == region_tag]
+
+    def get_outgoing_paths(self, gateway_id: str) -> Dict[str, int]:
+        """target_gateway_id -> num_connections, scanned from send ops
+        (reference: topology.py:118-128)."""
+        out: Dict[str, int] = {}
+
+        def walk(ops):
+            for op in ops:
+                if op["op_type"] == "send":
+                    out[op["target_gateway_id"]] = out.get(op["target_gateway_id"], 0) + op.get("num_connections", 0)
+                walk(op.get("children", []))
+
+        walk(self.gateways[gateway_id].program_ops())
+        return out
+
+    def source_gateways(self) -> List[TopologyPlanGateway]:
+        """Gateways that ingest chunks from the client (read ops or gen_data)."""
+        return [
+            g
+            for g in self.gateways.values()
+            if g._has_op("read_object_store") or g._has_op("gen_data") or g._has_op("read_local")
+        ]
+
+    def sink_gateways(self) -> List[TopologyPlanGateway]:
+        """Gateways that land chunks at the destination (write ops)."""
+        return [g for g in self.gateways.values() if g._has_op("write_object_store") or g._has_op("write_local")]
+
+    def get_gateway_info_json(self) -> Dict[str, dict]:
+        """Peer addressing map shipped to every daemon (reference :134-144)."""
+        return {
+            gid: {
+                "region_tag": gw.region_tag,
+                "public_ip": gw.public_ip,
+                "private_ip": gw.private_ip,
+                "control_port": gw.control_port,
+            }
+            for gid, gw in self.gateways.items()
+        }
+
+    def per_region_count(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for gw in self.gateways.values():
+            counts[gw.region_tag] = counts.get(gw.region_tag, 0) + 1
+        return counts

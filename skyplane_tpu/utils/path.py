@@ -35,7 +35,9 @@ def parse_path(path: str) -> Tuple[str, str, str]:
         provider = _SCHEMES[scheme]
         rest = path[len(match.group(0)) :]
         if provider == "local":
-            return "local", "", "/" + rest.lstrip("/")
+            # POSIX "bucket" is the filesystem root; keys are root-relative so
+            # they line up with POSIXInterface.list_objects output
+            return "local", "/", rest.lstrip("/")
         if provider == "azure":
             # azure://<storage_account>/<container>/<key>
             parts = rest.split("/", 2)
@@ -51,4 +53,4 @@ def parse_path(path: str) -> Tuple[str, str, str]:
             raise BadConfigException(f"missing bucket in {path!r}")
         return provider, bucket, key
     # bare filesystem path
-    return "local", "", path
+    return "local", "/", path.lstrip("/")

@@ -1,0 +1,123 @@
+"""Key-prefix mapping + chunker tests (reference test model:
+tests/unit_nocloud/test_api_chunker.py:14-95 incl. the issue-490 case)."""
+
+import uuid
+from pathlib import Path
+
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.transfer_job import Chunker, map_object_key_prefix
+from skyplane_tpu.exceptions import MissingObjectException
+from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+
+
+class TestMapObjectKeyPrefix:
+    def test_exact_object_to_exact_key(self):
+        assert map_object_key_prefix("a/b/c.txt", "a/b/c.txt", "x/y.txt") == "x/y.txt"
+
+    def test_exact_object_into_dir(self):
+        assert map_object_key_prefix("a/b/c.txt", "a/b/c.txt", "x/") == "x/c.txt"
+
+    def test_exact_object_to_empty_dest(self):
+        assert map_object_key_prefix("a/b/c.txt", "a/b/c.txt", "") == "c.txt"
+
+    def test_non_recursive_requires_exact(self):
+        with pytest.raises(MissingObjectException):
+            map_object_key_prefix("a/b", "a/b/c.txt", "x")
+
+    def test_recursive_basic(self):
+        assert map_object_key_prefix("a/b", "a/b/c.txt", "dst", recursive=True) == "dst/c.txt"
+        assert map_object_key_prefix("a/b/", "a/b/c.txt", "dst/", recursive=True) == "dst/c.txt"
+
+    def test_recursive_nested(self):
+        assert map_object_key_prefix("a", "a/b/c/d.txt", "z", recursive=True) == "z/b/c/d.txt"
+
+    def test_recursive_empty_dest(self):
+        assert map_object_key_prefix("a/b", "a/b/c.txt", "", recursive=True) == "c.txt"
+
+    def test_recursive_root_prefix(self):
+        assert map_object_key_prefix("", "a/b.txt", "dst", recursive=True) == "dst/a/b.txt"
+
+    def test_issue_490_boundary(self):
+        # prefix "a/b" must NOT capture "a/bc/d.txt"
+        with pytest.raises(MissingObjectException):
+            map_object_key_prefix("a/b", "a/bc/d.txt", "dst", recursive=True)
+
+    def test_recursive_prefix_itself(self):
+        # copying prefix "a/b" where an object is exactly "a/b"
+        assert map_object_key_prefix("a/b", "a/b", "dst", recursive=True) == "dst/b"
+
+
+@pytest.fixture
+def posix_bucket(tmp_path):
+    (tmp_path / "data").mkdir()
+    (tmp_path / "data" / "small.bin").write_bytes(b"x" * 1000)
+    (tmp_path / "data" / "big.bin").write_bytes(b"y" * (3 << 20))
+    (tmp_path / "data" / "sub").mkdir()
+    (tmp_path / "data" / "sub" / "nested.bin").write_bytes(b"z" * 500)
+    return POSIXInterface(str(tmp_path))
+
+
+class TestChunker:
+    def _chunker(self, src, dsts, **cfg):
+        config = TransferConfig(multipart_threshold_mb=1, multipart_chunk_size_mb=1, **cfg)
+        return Chunker(src, dsts, config)
+
+    def test_pair_generation_recursive(self, posix_bucket, tmp_path):
+        dst = POSIXInterface(str(tmp_path / "out"))
+        chunker = self._chunker(posix_bucket, [dst])
+        pairs = list(chunker.transfer_pair_generator("data", ["copied"], recursive=True))
+        keys = sorted(p.src_obj.key for p in pairs)
+        assert keys == ["data/big.bin", "data/small.bin", "data/sub/nested.bin"]
+        dst_keys = sorted(p.dst_objs[dst.region_tag()].key for p in pairs)
+        assert dst_keys == ["copied/big.bin", "copied/small.bin", "copied/sub/nested.bin"]
+
+    def test_pair_generation_single(self, posix_bucket, tmp_path):
+        dst = POSIXInterface(str(tmp_path / "out"))
+        chunker = self._chunker(posix_bucket, [dst])
+        pairs = list(chunker.transfer_pair_generator("data/small.bin", ["renamed.bin"], recursive=False))
+        assert len(pairs) == 1
+        assert pairs[0].dst_objs[dst.region_tag()].key == "renamed.bin"
+
+    def test_missing_source_raises(self, posix_bucket, tmp_path):
+        dst = POSIXInterface(str(tmp_path / "out"))
+        chunker = self._chunker(posix_bucket, [dst])
+        with pytest.raises(MissingObjectException):
+            list(chunker.transfer_pair_generator("nope", ["x"], recursive=True))
+
+    def test_multipart_split(self, posix_bucket, tmp_path):
+        dst = POSIXInterface(str(tmp_path / "out"))
+        chunker = self._chunker(posix_bucket, [dst])
+        pairs = list(chunker.transfer_pair_generator("data/big.bin", ["big_copy.bin"], recursive=False))
+        chunks = list(chunker.chunk(pairs))
+        assert len(chunks) == 3  # 3 MiB at 1 MiB parts
+        assert all(c.multi_part for c in chunks)
+        assert [c.part_number for c in chunks] == [1, 2, 3]
+        assert sum(c.chunk_length_bytes for c in chunks) == 3 << 20
+        assert chunks[1].file_offset_bytes == 1 << 20
+        # upload ids initiated + announced
+        assert len(chunker.initiated_uploads) == 1
+        msg = chunker.multipart_upload_queue.get_nowait()
+        assert dst.region_tag() in msg.upload_id_mapping
+
+    def test_small_object_single_chunk(self, posix_bucket, tmp_path):
+        dst = POSIXInterface(str(tmp_path / "out"))
+        chunker = self._chunker(posix_bucket, [dst])
+        pairs = list(chunker.transfer_pair_generator("data/small.bin", ["s.bin"], recursive=False))
+        chunks = list(chunker.chunk(pairs))
+        assert len(chunks) == 1
+        assert not chunks[0].multi_part
+        assert chunks[0].chunk_length_bytes == 1000
+
+    def test_max_parts_cap(self, tmp_path):
+        (tmp_path / "huge").mkdir()
+        (tmp_path / "huge" / "f.bin").write_bytes(b"a" * (10 << 20))
+        src = POSIXInterface(str(tmp_path))
+        dst = POSIXInterface(str(tmp_path / "out"))
+        config = TransferConfig(multipart_threshold_mb=1, multipart_chunk_size_mb=1, multipart_max_chunks=4)
+        chunker = Chunker(src, [dst], config)
+        pairs = list(chunker.transfer_pair_generator("huge/f.bin", ["f.bin"], recursive=False))
+        chunks = list(chunker.chunk(pairs))
+        assert len(chunks) <= 4
+        assert sum(c.chunk_length_bytes for c in chunks) == 10 << 20

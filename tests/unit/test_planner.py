@@ -1,0 +1,103 @@
+"""Planner tests: topology shape, quota fallback ladder (reference model:
+tests/unit_nocloud/test_fall_back.py:17-44), codec decisions."""
+
+import json
+
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.transfer_job import CopyJob
+from skyplane_tpu.exceptions import InsufficientVCPUException
+from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+from skyplane_tpu.planner.planner import (
+    DirectPlannerDestOneSided,
+    DirectPlannerSourceOneSided,
+    MulticastDirectPlanner,
+    get_planner,
+)
+
+
+def make_job(tmp_path, src_region="test:src", dst_regions=("test:dst",)):
+    (tmp_path / "srcbucket").mkdir(exist_ok=True)
+    (tmp_path / "srcbucket" / "obj").write_bytes(b"hello")
+    job = CopyJob("s3://srcbucket/obj", [f"s3://dstbucket{i}/obj" for i in range(len(dst_regions))])
+    job._src_iface = POSIXInterface(str(tmp_path / "srcbucket"), region_tag=src_region)
+    job._dst_ifaces = [
+        POSIXInterface(str(tmp_path / f"dstbucket{i}"), region_tag=r) for i, r in enumerate(dst_regions)
+    ]
+    return job
+
+
+def test_direct_plan_shape(tmp_path):
+    planner = MulticastDirectPlanner(TransferConfig())
+    plan = planner.plan([make_job(tmp_path)])
+    assert len(plan.gateways) == 2
+    srcs, sinks = plan.source_gateways(), plan.sink_gateways()
+    assert len(srcs) == 1 and len(sinks) == 1
+    paths = plan.get_outgoing_paths(srcs[0].gateway_id)
+    assert paths == {sinks[0].gateway_id: TransferConfig().num_connections}
+
+
+def test_multicast_plan_shape(tmp_path):
+    planner = MulticastDirectPlanner(TransferConfig())
+    plan = planner.plan([make_job(tmp_path, dst_regions=("test:d1", "test:d2", "test:d3"))])
+    assert len(plan.gateways) == 4  # 1 src + 3 dst
+    src = plan.source_gateways()[0]
+    # connections split across destinations; mux_and fans out
+    paths = plan.get_outgoing_paths(src.gateway_id)
+    assert len(paths) == 3
+
+
+def test_same_region_writes_directly(tmp_path):
+    planner = MulticastDirectPlanner(TransferConfig())
+    plan = planner.plan([make_job(tmp_path, src_region="test:r", dst_regions=("test:r",))])
+    assert len(plan.gateways) == 1  # no separate destination gateway
+    gw = next(iter(plan.gateways.values()))
+    assert gw._has_op("write_object_store") and not gw._has_op("send")
+
+
+def test_one_sided_plans(tmp_path):
+    src_side = DirectPlannerSourceOneSided(TransferConfig()).plan([make_job(tmp_path)])
+    assert all(g.region_tag == "test:src" for g in src_side.gateways.values())
+    assert not any(g._has_op("send") for g in src_side.gateways.values())
+    dst_side = DirectPlannerDestOneSided(TransferConfig()).plan([make_job(tmp_path)])
+    assert all(g.region_tag == "test:dst" for g in dst_side.gateways.values())
+
+
+def test_quota_fallback_ladder(tmp_path):
+    quota = tmp_path / "quota.json"
+    quota.write_text(json.dumps({"aws:us-east-1": 16, "aws:eu-west-1": 8}))
+    planner = MulticastDirectPlanner(TransferConfig(), quota_limits_file=str(quota), n_instances=4)
+    # 16 vCPUs -> m5.4xlarge (16 vCPU) x1
+    vm, n = planner._calculate_vm_types("aws:us-east-1")
+    assert vm == "m5.4xlarge" and n == 1
+    vm, n = planner._calculate_vm_types("aws:eu-west-1")
+    assert vm == "m5.2xlarge" and n == 1
+    # unknown region: preferred class, requested instance count
+    vm, n = planner._calculate_vm_types("aws:ap-south-1")
+    assert vm == "m5.8xlarge" and n == 4
+
+
+def test_quota_insufficient(tmp_path):
+    quota = tmp_path / "quota.json"
+    quota.write_text(json.dumps({"aws:us-east-1": 1}))
+    planner = MulticastDirectPlanner(TransferConfig(), quota_limits_file=str(quota))
+    with pytest.raises(InsufficientVCPUException):
+        planner._calculate_vm_types("aws:us-east-1")
+
+
+def test_multi_instance_plan(tmp_path):
+    planner = MulticastDirectPlanner(TransferConfig(), n_instances=3)
+    plan = planner.plan([make_job(tmp_path)])
+    assert len(plan.source_gateways()) == 3
+    assert len(plan.sink_gateways()) == 3
+    # each source splits its connections across 3 dst gateways via mux_or
+    src = plan.source_gateways()[0]
+    paths = plan.get_outgoing_paths(src.gateway_id)
+    assert len(paths) == 3
+    assert all(v == TransferConfig().num_connections // 3 for v in paths.values())
+
+
+def test_get_planner_names():
+    for name in ("direct", "src_one_sided", "dst_one_sided"):
+        assert get_planner(name, TransferConfig()) is not None

@@ -60,8 +60,8 @@ def test_timer():
         ("gcs://b/", ("gs", "b", "")),
         ("azure://acct/container/key", ("azure", "acct/container", "key")),
         ("r2://accountid/bucket", ("r2", "accountid", "bucket")),
-        ("local:///tmp/x", ("local", "", "/tmp/x")),
-        ("/tmp/y", ("local", "", "/tmp/y")),
+        ("local:///tmp/x", ("local", "/", "tmp/x")),
+        ("/tmp/y", ("local", "/", "tmp/y")),
         ("hdfs://namenode/path", ("hdfs", "namenode", "path")),
     ],
 )
