@@ -146,12 +146,12 @@ class Chunker:
         src_iface: StorageInterface,
         dst_ifaces: List[StorageInterface],
         transfer_config: TransferConfig,
-        num_partitions: int = 1,
+        partition_id: str = "default",
     ):
         self.src_iface = src_iface
         self.dst_ifaces = dst_ifaces
         self.transfer_config = transfer_config
-        self.num_partitions = num_partitions
+        self.partition_id = partition_id
         self.multipart_upload_queue: "queue.Queue[GatewayMessage]" = queue.Queue()
         self.initiated_uploads: List[Tuple[StorageInterface, str, str]] = []  # (iface, dest_key, upload_id)
 
@@ -192,26 +192,23 @@ class Chunker:
         cfg = self.transfer_config
         threshold = cfg.multipart_threshold_mb << 20
         part_size = cfg.multipart_chunk_size_mb << 20
-        multipart = cfg.multipart_enabled and any(
-            hasattr(iface, "initiate_multipart_upload") for iface in self.dst_ifaces
-        )
-        partition_counter = 0
+        # every destination must really support multipart (the base-class
+        # method exists everywhere, so hasattr would be vacuous)
+        multipart = cfg.multipart_enabled and all(iface.supports_multipart for iface in self.dst_ifaces)
         for pair in pairs:
             size = pair.src_obj.size or 0
-            # partition names must match the planner's per-partition programs
-            # (single-partition plans use "default", reference: planner.py:283-383)
-            partition_id = "default" if self.num_partitions == 1 else str(partition_counter % self.num_partitions)
-            partition_counter += 1
+            dest_keys = {rt: obj.key for rt, obj in pair.dst_objs.items()}
             if multipart and size > threshold:
-                yield from self._chunk_multipart(pair, size, part_size, cfg.multipart_max_chunks, partition_id)
+                yield from self._chunk_multipart(pair, size, part_size, cfg.multipart_max_chunks, self.partition_id)
             else:
                 sample_dst = next(iter(pair.dst_objs.values()))
                 yield Chunk(
                     src_key=pair.src_obj.key,
                     dest_key=sample_dst.key,
+                    dest_keys=dest_keys,
                     chunk_id=uuid.uuid4().hex,
                     chunk_length_bytes=size,
-                    partition_id=partition_id,
+                    partition_id=self.partition_id,
                     mime_type=pair.src_obj.mime_type,
                 )
 
@@ -229,12 +226,14 @@ class Chunker:
             mapping.setdefault(iface.region_tag(), {})[dst_obj.key] = upload_id
             self.initiated_uploads.append((iface, dst_obj.key, upload_id))
         self.multipart_upload_queue.put(GatewayMessage(upload_id_mapping=mapping))
+        dest_keys = {rt: obj.key for rt, obj in pair.dst_objs.items()}
         offset = 0
         for part in range(1, n_parts + 1):
             length = min(part_size, size - offset)
             yield Chunk(
                 src_key=pair.src_obj.key,
                 dest_key=sample_dst.key,
+                dest_keys=dest_keys,
                 chunk_id=uuid.uuid4().hex,
                 chunk_length_bytes=length,
                 partition_id=partition_id,
@@ -308,9 +307,10 @@ class CopyJob(TransferJob):
         return True
 
     def dispatch(self, dataplane, transfer_config: TransferConfig) -> Generator[Chunk, None, None]:
-        self.chunker = Chunker(
-            self.src_iface, self.dst_ifaces, transfer_config, num_partitions=1
-        )
+        # chunks are tagged with this job's uuid so multi-job dataplanes route
+        # each job's chunks to ITS operator DAG (reference: partition_id = job
+        # uuid, planner.py:283-383)
+        self.chunker = Chunker(self.src_iface, self.dst_ifaces, transfer_config, partition_id=self.uuid)
         pairs = self.chunker.transfer_pair_generator(
             self.src_prefix, self.dst_prefixes, self.recursive, post_filter_fn=self._post_filter_fn
         )

@@ -1,0 +1,127 @@
+"""Anonymous usage statistics (opt-in, local-first).
+
+Reference parity: skyplane/api/usage.py:23-365 — stable anonymous client id,
+structured transfer/error records, enable/disable via config flag +
+``SKYPLANE_TPU_USAGE_STATS`` env. Records are always written locally under
+/tmp/skyplane_tpu/metrics; remote push only happens when an endpoint is
+explicitly configured (``SKYPLANE_TPU_USAGE_ENDPOINT``) — there is no
+hard-coded collection server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from skyplane_tpu import __version__
+from skyplane_tpu.config_paths import host_uuid_path, tmp_log_dir
+from skyplane_tpu.utils.logger import logger
+
+USAGE_STATS_ENV = "SKYPLANE_TPU_USAGE_STATS"
+USAGE_ENDPOINT_ENV = "SKYPLANE_TPU_USAGE_ENDPOINT"
+
+
+def usage_stats_enabled(cloud_config=None) -> bool:
+    env = os.environ.get(USAGE_STATS_ENV)
+    if env is not None:
+        return env.strip().lower() in ("1", "true", "yes", "on")
+    if cloud_config is not None:
+        try:
+            return bool(cloud_config.get_flag("usage_stats"))
+        except Exception:  # noqa: BLE001
+            return False
+    return False
+
+
+def _client_id() -> str:
+    """Stable anonymous id persisted per host (reference :51-66)."""
+    try:
+        if host_uuid_path.exists():
+            return host_uuid_path.read_text().strip()
+        cid = uuid.uuid4().hex
+        host_uuid_path.parent.mkdir(parents=True, exist_ok=True)
+        host_uuid_path.write_text(cid)
+        return cid
+    except OSError:
+        return "ephemeral-" + uuid.uuid4().hex
+
+
+@dataclass
+class UsageStatsToReport:
+    """Schema (reference :79-115)."""
+
+    schema_version: str = "0.1"
+    client_id: str = field(default_factory=_client_id)
+    session_id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    version: str = __version__
+    timestamp: float = field(default_factory=time.time)
+    source_region: Optional[str] = None
+    destination_regions: Optional[list] = None
+    transfer_size_gb: Optional[float] = None
+    throughput_gbps: Optional[float] = None
+    compression_ratio: Optional[float] = None
+    dedup_ratio: Optional[float] = None
+    error: Optional[str] = None
+    arguments: Optional[dict] = None
+
+
+class UsageClient:
+    def __init__(self, cloud_config=None):
+        self.enabled = usage_stats_enabled(cloud_config)
+        self.metrics_dir = tmp_log_dir / "metrics"
+
+    def _write_local(self, stats: UsageStatsToReport) -> Optional[Path]:
+        try:
+            self.metrics_dir.mkdir(parents=True, exist_ok=True)
+            path = self.metrics_dir / "usage_stats.jsonl"
+            with path.open("a") as f:
+                f.write(json.dumps(asdict(stats)) + "\n")
+            return path
+        except OSError as e:
+            logger.fs.warning(f"usage stats write failed: {e}")
+            return None
+
+    def _push_remote(self, stats: UsageStatsToReport) -> None:
+        endpoint = os.environ.get(USAGE_ENDPOINT_ENV)
+        if not endpoint:
+            return
+        try:
+            import requests
+
+            requests.post(endpoint, json=asdict(stats), timeout=5)
+        except Exception as e:  # noqa: BLE001 - telemetry must never break transfers
+            logger.fs.debug(f"usage stats push failed: {e}")
+
+    def log_transfer(
+        self,
+        src_region: str,
+        dest_regions: list,
+        size_gb: float,
+        throughput_gbps: float,
+        compression_ratio: Optional[float] = None,
+        args: Optional[dict] = None,
+    ) -> None:
+        if not self.enabled:
+            return
+        stats = UsageStatsToReport(
+            source_region=src_region,
+            destination_regions=dest_regions,
+            transfer_size_gb=size_gb,
+            throughput_gbps=throughput_gbps,
+            compression_ratio=compression_ratio,
+            arguments=args,
+        )
+        self._write_local(stats)
+        self._push_remote(stats)
+
+    def log_exception(self, error: str, args: Optional[dict] = None) -> None:
+        if not self.enabled:
+            return
+        stats = UsageStatsToReport(error=error[:2000], arguments=args)
+        self._write_local(stats)
+        self._push_remote(stats)

@@ -87,6 +87,9 @@ class Chunk:
     chunk_length_bytes: int
     partition_id: str = "default"
     mime_type: Optional[str] = None
+    # multicast with differing destination prefixes: per-region destination
+    # keys; write operators prefer dest_keys[their region] over dest_key
+    dest_keys: Optional[dict] = None  # region_tag -> key
 
     # multipart upload bookkeeping
     file_offset_bytes: Optional[int] = None

@@ -1,0 +1,119 @@
+"""Throughput-grid profiling: measure pairwise gateway throughput and emit
+the solver's profile CSV.
+
+Reference parity: skyplane/cli/experiments/cli_profile.py:44-92 — provisions
+a VM mesh per region pair, runs pairwise throughput probes, writes
+``src_region,dst_region,gbps`` rows (with resume support across runs).
+Instead of shelling out to iperf3, the probe drives our own data plane: a
+GatewayRandomDataGen -> GatewaySend program against a receiving gateway, so
+the measured number includes the real wire protocol + TLS stack.
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+import uuid
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import requests
+
+from skyplane_tpu.utils.logger import logger
+
+
+def measure_pair(src_server, dst_server, probe_mb: int = 256, num_connections: int = 8, timeout: float = 300.0) -> float:
+    """Measure src->dst gateway throughput in Gbps using gen_data chunks."""
+    from skyplane_tpu.chunk import Chunk, ChunkRequest
+
+    n_chunks = 8
+    chunk_mb = probe_mb // n_chunks
+    reqs = []
+    for _ in range(n_chunks):
+        chunk = Chunk(
+            src_key="synthetic",
+            dest_key=f"/tmp/skyplane_tpu/probe/{uuid.uuid4().hex}",
+            chunk_id=uuid.uuid4().hex,
+            chunk_length_bytes=chunk_mb << 20,
+        )
+        reqs.append(ChunkRequest(chunk=chunk, src_type="gen_data", dst_type="local"))
+    t0 = time.time()
+    resp = requests.post(f"{src_server.control_url()}/chunk_requests", json=[r.as_dict() for r in reqs], timeout=60)
+    resp.raise_for_status()
+    ids = {r.chunk.chunk_id for r in reqs}
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = requests.get(f"{dst_server.control_url()}/chunk_status_log", timeout=30).json()["chunk_status"]
+        if all(status.get(cid) == "complete" for cid in ids):
+            elapsed = time.time() - t0
+            return probe_mb * 8 / 1000 / elapsed
+        time.sleep(0.5)
+    raise TimeoutError(f"throughput probe {src_server.instance_id}->{dst_server.instance_id} timed out")
+
+
+def run_throughput_grid(
+    region_pairs: List[Tuple[str, str]],
+    output_csv: str,
+    probe_mb: int = 256,
+    resume: bool = True,
+) -> Dict[Tuple[str, str], float]:
+    """Provision a gateway per distinct region, probe every pair, write the CSV.
+
+    Resume: existing rows in ``output_csv`` are kept and their pairs skipped
+    (reference: cli_profile.py:89-92).
+    """
+    from skyplane_tpu.api.provisioner import Provisioner
+    from skyplane_tpu.gateway.gateway_program import GatewayGenData, GatewayProgram, GatewayReceive, GatewaySend, GatewayWriteLocal
+
+    out_path = Path(output_csv)
+    results: Dict[Tuple[str, str], float] = {}
+    if resume and out_path.exists():
+        with out_path.open() as f:
+            for row in csv.DictReader(f):
+                results[(row["src_region"], row["dst_region"])] = float(row["gbps"])
+
+    regions = sorted({r for pair in region_pairs for r in pair})
+    provisioner = Provisioner()
+    tasks = {region: provisioner.add_task(region.split(":")[0], region) for region in regions}
+    provisioner.init_global()
+    servers = provisioner.provision()
+    by_region = {region: servers[tid] for region, tid in tasks.items()}
+    try:
+        # every gateway runs a bidirectional probe program: gen_data->send is
+        # installed per-probe by registering chunks; receive->write is standing
+        for region, server in by_region.items():
+            program = GatewayProgram()
+            recv = program.add_operator(GatewayReceive())
+            program.add_operator(GatewayWriteLocal(), parent_handle=recv)
+            # sender legs are added per peer below
+            server.start_gateway(program.to_dict(), {}, f"probe_{region}")
+        for src_region, dst_region in region_pairs:
+            if (src_region, dst_region) in results:
+                continue
+            # ship a src program with gen_data -> send to this peer
+            src = by_region[src_region]
+            dst = by_region[dst_region]
+            program = GatewayProgram()
+            gen = program.add_operator(GatewayGenData(size_mb=probe_mb))
+            program.add_operator(
+                GatewaySend(target_gateway_id=f"probe_{dst_region}", region=dst_region, num_connections=8),
+                parent_handle=gen,
+            )
+            info = {f"probe_{dst_region}": {"public_ip": dst.public_ip(), "control_port": dst.control_port}}
+            src.start_gateway(program.to_dict(), info, f"probe_{src_region}")
+            gbps = measure_pair(src, dst, probe_mb=probe_mb)
+            results[(src_region, dst_region)] = gbps
+            logger.fs.info(f"throughput {src_region}->{dst_region}: {gbps:.2f} Gbps")
+            _write_csv(out_path, results)
+    finally:
+        provisioner.deprovision()
+    return results
+
+
+def _write_csv(path: Path, results: Dict[Tuple[str, str], float]) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["src_region", "dst_region", "gbps"])
+        for (src, dst), gbps in sorted(results.items()):
+            writer.writerow([src, dst, f"{gbps:.4f}"])

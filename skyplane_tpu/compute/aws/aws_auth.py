@@ -1,0 +1,38 @@
+"""AWS authentication provider.
+
+Reference parity: skyplane/compute/aws/aws_auth.py (boto3 session + region
+enumeration with caching).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Optional
+
+import boto3
+
+
+class AWSAuthentication:
+    def __init__(self, config=None):
+        self.config = config
+
+    @lru_cache(maxsize=None)
+    def get_boto3_session(self, region: Optional[str] = None) -> boto3.Session:
+        return boto3.Session(region_name=region)
+
+    def get_boto3_client(self, service: str, region: Optional[str] = None):
+        return self.get_boto3_session(region).client(service, region_name=region)
+
+    def get_boto3_resource(self, service: str, region: Optional[str] = None):
+        return self.get_boto3_session(region).resource(service, region_name=region)
+
+    def enabled(self) -> bool:
+        try:
+            return self.get_boto3_session().get_credentials() is not None
+        except Exception:  # noqa: BLE001
+            return False
+
+    @lru_cache(maxsize=1)
+    def get_enabled_regions(self) -> List[str]:
+        ec2 = self.get_boto3_client("ec2", "us-east-1")
+        return [r["RegionName"] for r in ec2.describe_regions(AllRegions=False)["Regions"]]

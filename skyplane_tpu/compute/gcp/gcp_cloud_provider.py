@@ -1,0 +1,204 @@
+"""GCP gateway provisioning over the Compute Engine REST API.
+
+Reference parity: skyplane/compute/gcp/gcp_cloud_provider.py:50-218 +
+gcp_network.py — ``skyplane`` VPC with gateway firewall rules, instance
+insert/wait/delete, label-based queries, premium vs standard network tier.
+Implemented with google.auth AuthorizedSession (no googleapiclient).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from pathlib import Path
+from typing import List, Optional
+
+from skyplane_tpu.compute.cloud_provider import CloudProvider
+from skyplane_tpu.compute.gcp.gcp_auth import GCPAuthentication
+from skyplane_tpu.compute.server import SSHServer, ServerState
+from skyplane_tpu.config_paths import key_root
+from skyplane_tpu.utils.logger import logger
+
+COMPUTE = "https://compute.googleapis.com/compute/v1"
+NETWORK_NAME = "skyplane-tpu"
+LABEL = "skyplane-tpu"
+UBUNTU_IMAGE = "projects/ubuntu-os-cloud/global/images/family/ubuntu-2204-lts"
+
+
+class GCPServer(SSHServer):
+    def __init__(self, auth: GCPAuthentication, region: str, zone: str, name: str, host: str, private_host: str, key_path: str):
+        super().__init__(f"gcp:{region}", name, host, "skyplane", key_path, private_host)
+        self.auth = auth
+        self.zone = zone
+
+    def instance_state(self) -> ServerState:
+        r = self.auth.session().get(f"{COMPUTE}/projects/{self.auth.project_id}/zones/{self.zone}/instances/{self.instance_id}")
+        if r.status_code == 404:
+            return ServerState.TERMINATED
+        status = r.json().get("status", "")
+        return {
+            "PROVISIONING": ServerState.PENDING,
+            "STAGING": ServerState.PENDING,
+            "RUNNING": ServerState.RUNNING,
+            "STOPPING": ServerState.SUSPENDED,
+            "SUSPENDED": ServerState.SUSPENDED,
+            "TERMINATED": ServerState.TERMINATED,
+        }.get(status, ServerState.UNKNOWN)
+
+    def terminate_instance(self) -> None:
+        self.auth.session().delete(
+            f"{COMPUTE}/projects/{self.auth.project_id}/zones/{self.zone}/instances/{self.instance_id}"
+        )
+
+
+class GCPCloudProvider(CloudProvider):
+    provider_name = "gcp"
+
+    def __init__(self, use_spot: bool = False, premium_network: bool = True):
+        self.auth = GCPAuthentication()
+        self.use_spot = use_spot
+        self.premium_network = premium_network
+
+    # ---- ssh keys ----
+
+    def _key_path(self) -> Path:
+        return Path(key_root) / "gcp" / "skyplane-tpu.pem"
+
+    def ensure_keypair(self) -> Path:
+        path = self._key_path()
+        if path.exists():
+            return path
+        path.parent.mkdir(parents=True, exist_ok=True)
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=3072)
+        path.write_bytes(
+            key.private_bytes(
+                serialization.Encoding.PEM, serialization.PrivateFormat.TraditionalOpenSSL, serialization.NoEncryption()
+            )
+        )
+        path.chmod(0o600)
+        pub = key.public_key().public_bytes(serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
+        path.with_suffix(".pub").write_bytes(pub + b" skyplane\n")
+        return path
+
+    # ---- network ----
+
+    def _wait_op(self, op_url: str, timeout: float = 300.0) -> None:
+        session = self.auth.session()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            r = session.get(op_url).json()
+            if r.get("status") == "DONE":
+                if "error" in r:
+                    raise RuntimeError(f"GCP operation failed: {r['error']}")
+                return
+            time.sleep(2)
+        raise TimeoutError(f"GCP operation timed out: {op_url}")
+
+    def setup_global(self) -> None:
+        """Create the skyplane VPC + firewall rules if missing
+        (reference: gcp_network.py)."""
+        session = self.auth.session()
+        project = self.auth.project_id
+        r = session.get(f"{COMPUTE}/projects/{project}/global/networks/{NETWORK_NAME}")
+        if r.status_code == 404:
+            op = session.post(
+                f"{COMPUTE}/projects/{project}/global/networks",
+                json={"name": NETWORK_NAME, "autoCreateSubnetworks": True},
+            ).json()
+            self._wait_op(op["selfLink"])
+        for rule, ports in (("ssh", ["22"]), ("gateway", ["8081", "1024-65535"])):
+            name = f"{NETWORK_NAME}-{rule}"
+            r = session.get(f"{COMPUTE}/projects/{project}/global/firewalls/{name}")
+            if r.status_code == 404:
+                session.post(
+                    f"{COMPUTE}/projects/{project}/global/firewalls",
+                    json={
+                        "name": name,
+                        "network": f"projects/{project}/global/networks/{NETWORK_NAME}",
+                        "allowed": [{"IPProtocol": "tcp", "ports": ports}],
+                        "sourceRanges": ["0.0.0.0/0"],
+                    },
+                )
+
+    def setup_region(self, region: str) -> None:
+        self.ensure_keypair()
+
+    # ---- instances ----
+
+    def _zone(self, region: str) -> str:
+        return region if region[-2] == "-" else f"{region}-a"
+
+    def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> GCPServer:
+        region = region_tag.split(":")[-1]
+        zone = self._zone(region)
+        project = self.auth.project_id
+        session = self.auth.session()
+        key_path = self.ensure_keypair()
+        pub_key = key_path.with_suffix(".pub").read_text().strip()
+        name = f"skyplane-tpu-{uuid.uuid4().hex[:8]}"
+        body = {
+            "name": name,
+            "machineType": f"zones/{zone}/machineTypes/{vm_type or 'n2-standard-32'}",
+            "labels": {LABEL: "true", **{k: str(v).lower() for k, v in (tags or {}).items()}},
+            "disks": [
+                {
+                    "boot": True,
+                    "autoDelete": True,
+                    "initializeParams": {"sourceImage": UBUNTU_IMAGE, "diskSizeGb": "128", "diskType": f"zones/{zone}/diskTypes/pd-ssd"},
+                }
+            ],
+            "networkInterfaces": [
+                {
+                    "network": f"projects/{project}/global/networks/{NETWORK_NAME}",
+                    "accessConfigs": [
+                        {
+                            "name": "External NAT",
+                            "type": "ONE_TO_ONE_NAT",
+                            "networkTier": "PREMIUM" if self.premium_network else "STANDARD",
+                        }
+                    ],
+                }
+            ],
+            "metadata": {"items": [{"key": "ssh-keys", "value": f"skyplane:{pub_key}"}]},
+            "scheduling": {"preemptible": self.use_spot},
+        }
+        op = session.post(f"{COMPUTE}/projects/{project}/zones/{zone}/instances", json=body).json()
+        if "error" in op:
+            raise RuntimeError(f"GCP provision failed: {op['error']}")
+        self._wait_op(op["selfLink"])
+        inst = session.get(f"{COMPUTE}/projects/{project}/zones/{zone}/instances/{name}").json()
+        nic = inst["networkInterfaces"][0]
+        public_ip = nic.get("accessConfigs", [{}])[0].get("natIP", "")
+        return GCPServer(self.auth, region, zone, name, public_ip, nic.get("networkIP", ""), str(key_path))
+
+    def get_matching_instances(self, tags: Optional[dict] = None, **kw) -> List[GCPServer]:
+        session = self.auth.session()
+        project = self.auth.project_id
+        servers: List[GCPServer] = []
+        r = session.get(
+            f"{COMPUTE}/projects/{project}/aggregated/instances", params={"filter": f"labels.{LABEL}=true"}
+        ).json()
+        for zone_key, group in r.get("items", {}).items():
+            for inst in group.get("instances", []):
+                if inst.get("status") not in ("RUNNING", "PROVISIONING", "STAGING"):
+                    continue
+                zone = zone_key.split("/")[-1]
+                region = zone.rsplit("-", 1)[0]
+                nic = inst["networkInterfaces"][0]
+                servers.append(
+                    GCPServer(
+                        self.auth,
+                        region,
+                        zone,
+                        inst["name"],
+                        nic.get("accessConfigs", [{}])[0].get("natIP", ""),
+                        nic.get("networkIP", ""),
+                        str(self._key_path()),
+                    )
+                )
+        return servers
+
+    def teardown_global(self) -> None: ...

@@ -83,6 +83,27 @@ class GatewayDaemon:
             op.get("op_type") == "receive" and op.get("dedup")
             for op in _iter_program_ops(gateway_program)
         )
+        # relay gateways (receive feeding only sends) keep payloads opaque:
+        # no decrypt/decode at intermediate hops (reference relay semantics).
+        # The landing mode is a property of the single shared receiver, so a
+        # program mixing relay-receives with decode-receives is rejected
+        # loudly rather than corrupting the decode path.
+        relay_receives, decode_receives = 0, 0
+        for op in _iter_program_ops(gateway_program):
+            if op.get("op_type") == "receive":
+                subtree = list(_iter_program_ops({"plan": [{"value": op.get("children", [])}]}))
+                has_send = any(o.get("op_type") == "send" for o in subtree)
+                has_write = any(o.get("op_type", "").startswith("write") for o in subtree)
+                if has_send and not has_write:
+                    relay_receives += 1
+                else:
+                    decode_receives += 1
+        if relay_receives and decode_receives:
+            raise ValueError(
+                "gateway program mixes relay-style receives (forward-only) with decode receives; "
+                "split these across separate gateways"
+            )
+        raw_forward = relay_receives > 0
         self.receiver = GatewayReceiver(
             region=region,
             chunk_store=self.chunk_store,
@@ -93,6 +114,7 @@ class GatewayDaemon:
             dedup=dedup_receive,
             segment_store=SegmentStore(spill_dir=Path(chunk_dir) / "segments") if dedup_receive else None,
             bind_host=bind_host,
+            raw_forward=raw_forward,
         )
 
         self.upload_id_map: Dict[str, str] = {}

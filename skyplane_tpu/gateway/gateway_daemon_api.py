@@ -170,7 +170,7 @@ class GatewayDaemonAPI:
         return n
 
     def _gc_chunk(self, chunk_id: str) -> None:
-        for suffix in (".chunk", ".done"):
+        for suffix in (".chunk", ".done", ".hdr"):
             p = self.chunk_store.chunk_dir / f"{chunk_id}{suffix}"
             if p.exists():
                 try:

@@ -10,6 +10,7 @@ resolution against a SegmentStore with bounded ref-wait).
 
 from __future__ import annotations
 
+import json
 import queue
 import socket
 import ssl
@@ -44,6 +45,7 @@ class GatewayReceiver:
         dedup: bool = False,
         segment_store: Optional[SegmentStore] = None,
         bind_host: str = "0.0.0.0",
+        raw_forward: bool = False,
     ):
         self.region = region
         self.chunk_store = chunk_store
@@ -55,6 +57,10 @@ class GatewayReceiver:
         self.segment_store = segment_store if segment_store is not None else (SegmentStore() if dedup else None)
         self.processor = DataPathProcessor(codec_name="none", dedup=dedup)
         self.bind_host = bind_host
+        # relay mode: payloads stay opaque (no decrypt/decode); the wire header
+        # is persisted beside the chunk so the forwarding sender can re-frame
+        # it unchanged (reference: relays forward without decrypt/decompress)
+        self.raw_forward = raw_forward
         self._servers: Dict[int, socket.socket] = {}
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
@@ -135,19 +141,34 @@ class GatewayReceiver:
                 self.socket_profile_events.put(
                     {"port": port, "chunk_id": header.chunk_id, "bytes": header.data_len, "time_s": time.time() - t0}
                 )
-                if header.is_encrypted:
-                    if self.cipher is None:
-                        raise RuntimeError("received encrypted chunk but no E2EE key configured")
-                    payload = self.cipher.open(payload)
-                data = self.processor.restore(payload, header, store=self.segment_store)
                 fpath = self.chunk_store.chunk_path(header.chunk_id)
-                fpath.write_bytes(data)
+                if self.raw_forward:
+                    fpath.write_bytes(payload)
+                    fpath.with_suffix(".hdr").write_text(
+                        json.dumps(
+                            {
+                                "codec": header.codec,
+                                "flags": header.flags,
+                                "fingerprint": header.fingerprint,
+                                "raw_data_len": header.raw_data_len,
+                            }
+                        )
+                    )
+                else:
+                    if header.is_encrypted:
+                        if self.cipher is None:
+                            raise RuntimeError("received encrypted chunk but no E2EE key configured")
+                        payload = self.cipher.open(payload)
+                    data = self.processor.restore(payload, header, store=self.segment_store)
+                    fpath.write_bytes(data)
                 fpath.with_suffix(".done").touch()
                 # application-level ack: the sender commits dedup fingerprints
                 # and marks the chunk complete only after this lands — TCP
                 # sendall() alone proves nothing about delivery
                 conn.sendall(ACK_BYTE)
-                logger.fs.debug(f"[receiver:{port}] landed chunk {header.chunk_id} ({len(data)}B raw, {header.data_len}B wire)")
+                logger.fs.debug(
+                    f"[receiver:{port}] landed chunk {header.chunk_id} ({header.raw_data_len}B raw, {header.data_len}B wire)"
+                )
         except Exception:  # noqa: BLE001 — fatal receiver error stops the daemon
             tb = traceback.format_exc()
             logger.fs.error(f"[receiver:{port}] fatal: {tb}")

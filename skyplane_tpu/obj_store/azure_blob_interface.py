@@ -1,0 +1,173 @@
+"""Azure Blob Storage backend.
+
+Reference parity: skyplane/obj_store/azure_blob_interface.py:30-255 —
+"multipart" is block-blob staging: each part stages as a base64 block id
+(reference :241) and ``complete_multipart_upload`` commits the ordered block
+list (reference :213). The bucket name is ``<storage_account>/<container>``.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import uuid
+from typing import Iterator, List, Optional
+
+from azure.storage.blob import BlobServiceClient
+
+from skyplane_tpu.exceptions import ChecksumMismatchException, NoSuchObjectException
+from skyplane_tpu.obj_store.object_store_interface import ObjectStoreInterface, ObjectStoreObject
+
+
+def _block_id(part_number: int) -> str:
+    return base64.b64encode(f"block{part_number:08d}".encode()).decode()
+
+
+class AzureBlobObject(ObjectStoreObject):
+    def full_path(self) -> str:
+        account, container = (self.bucket or "/").split("/", 1)
+        return f"https://{account}.blob.core.windows.net/{container}/{self.key}"
+
+
+class AzureBlobInterface(ObjectStoreInterface):
+    provider = "azure"
+
+    def __init__(self, bucket_name: str, max_concurrency: int = 8):
+        # bucket_name = "<storage_account>/<container>"
+        self.bucket_name = bucket_name
+        self.account_name, _, self.container_name = bucket_name.partition("/")
+        self.max_concurrency = max_concurrency
+        self._service: Optional[BlobServiceClient] = None
+
+    @property
+    def service_client(self) -> BlobServiceClient:
+        if self._service is None:
+            from azure.identity import DefaultAzureCredential
+
+            self._service = BlobServiceClient(
+                account_url=f"https://{self.account_name}.blob.core.windows.net",
+                credential=DefaultAzureCredential(),
+            )
+        return self._service
+
+    @property
+    def container_client(self):
+        return self.service_client.get_container_client(self.container_name)
+
+    def region_tag(self) -> str:
+        return f"azure:{self.azure_region}"
+
+    @property
+    def azure_region(self) -> str:
+        # storage account location requires the management API; default to the
+        # account's primary endpoint hint when unavailable
+        try:
+            props = self.service_client.get_account_information()
+            return props.get("location", "infer")  # not always exposed
+        except Exception:  # noqa: BLE001
+            return "infer"
+
+    def path(self) -> str:
+        return f"azure://{self.bucket_name}"
+
+    def bucket_exists(self) -> bool:
+        try:
+            self.container_client.get_container_properties()
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def create_bucket(self, region_tag: str) -> None:
+        if not self.bucket_exists():
+            self.service_client.create_container(self.container_name)
+
+    def delete_bucket(self) -> None:
+        self.service_client.delete_container(self.container_name)
+
+    def exists(self, obj_name: str) -> bool:
+        return self.container_client.get_blob_client(obj_name).exists()
+
+    def get_obj_size(self, obj_name: str) -> int:
+        try:
+            return self.container_client.get_blob_client(obj_name).get_blob_properties().size
+        except Exception as e:  # noqa: BLE001
+            raise NoSuchObjectException(f"azure://{self.bucket_name}/{obj_name}") from e
+
+    def get_obj_last_modified(self, obj_name: str):
+        return self.container_client.get_blob_client(obj_name).get_blob_properties().last_modified
+
+    def get_obj_mime_type(self, obj_name: str) -> Optional[str]:
+        props = self.container_client.get_blob_client(obj_name).get_blob_properties()
+        return props.content_settings.content_type
+
+    def list_objects(self, prefix: str = "") -> Iterator[AzureBlobObject]:
+        for blob in self.container_client.list_blobs(name_starts_with=prefix or None):
+            yield AzureBlobObject(
+                key=blob.name,
+                provider="azure",
+                bucket=self.bucket_name,
+                size=blob.size,
+                last_modified=blob.last_modified,
+                mime_type=getattr(blob.content_settings, "content_type", None),
+            )
+
+    def delete_objects(self, keys: List[str]) -> None:
+        for key in keys:
+            self.container_client.delete_blob(key)
+
+    def download_object(
+        self,
+        src_object_name: str,
+        dst_file_path,
+        offset_bytes: Optional[int] = None,
+        size_bytes: Optional[int] = None,
+        write_at_offset: bool = False,
+        generate_md5: bool = False,
+    ) -> Optional[str]:
+        blob = self.container_client.get_blob_client(src_object_name)
+        stream = blob.download_blob(offset=offset_bytes, length=size_bytes, max_concurrency=self.max_concurrency)
+        data = stream.readall()
+        from pathlib import Path
+
+        mode = "r+b" if (write_at_offset and Path(dst_file_path).exists()) else "wb"
+        with open(dst_file_path, mode) as f:
+            if write_at_offset and offset_bytes:
+                f.seek(offset_bytes)
+            f.write(data)
+        return hashlib.md5(data).hexdigest() if generate_md5 else None
+
+    def upload_object(
+        self,
+        src_file_path,
+        dst_object_name: str,
+        part_number: Optional[int] = None,
+        upload_id: Optional[str] = None,
+        check_md5: Optional[str] = None,
+        mime_type: Optional[str] = None,
+    ) -> None:
+        data = open(src_file_path, "rb").read()
+        if check_md5 is not None:
+            got = hashlib.md5(data).hexdigest()
+            if got != check_md5:
+                raise ChecksumMismatchException(f"azure://{self.bucket_name}/{dst_object_name}")
+        blob = self.container_client.get_blob_client(dst_object_name)
+        if upload_id is not None and part_number is not None:
+            blob.stage_block(block_id=_block_id(part_number), data=data)
+        else:
+            from azure.storage.blob import ContentSettings
+
+            settings = ContentSettings(content_type=mime_type) if mime_type else None
+            blob.upload_blob(data, overwrite=True, content_settings=settings, max_concurrency=self.max_concurrency)
+
+    def initiate_multipart_upload(self, dst_object_name: str, mime_type: Optional[str] = None) -> str:
+        # block blobs have no server-side session; the "upload id" is a token
+        # and parts are identified by deterministic block ids
+        return uuid.uuid4().hex
+
+    def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        from azure.storage.blob import BlobBlock
+
+        blob = self.container_client.get_blob_client(dst_object_name)
+        uncommitted = blob.get_block_list(block_list_type="uncommitted")[1]
+        blocks = sorted(uncommitted, key=lambda b: b.id)
+        blob.commit_block_list([BlobBlock(block_id=b.id) for b in blocks])

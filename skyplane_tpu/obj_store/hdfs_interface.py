@@ -1,0 +1,160 @@
+"""HDFS backend via pyarrow.
+
+Reference parity: skyplane/obj_store/hdfs_interface.py:13-162 (pyarrow HDFS
+client with dataproc hostname resolution). Bucket name is the namenode host.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from datetime import datetime, timezone
+from typing import Iterator, List, Optional
+
+from pyarrow import fs as pafs
+
+from skyplane_tpu.exceptions import NoSuchObjectException
+from skyplane_tpu.obj_store.object_store_interface import ObjectStoreInterface, ObjectStoreObject
+
+
+class HDFSFile(ObjectStoreObject):
+    def full_path(self) -> str:
+        return f"hdfs://{self.bucket}/{self.key}"
+
+
+class HDFSInterface(ObjectStoreInterface):
+    provider = "hdfs"
+
+    def __init__(self, host: str, port: int = 8020):
+        self.bucket_name = host
+        self.host = host
+        self.port = port
+        self._fs: Optional[pafs.HadoopFileSystem] = None
+
+    @property
+    def hdfs(self) -> pafs.HadoopFileSystem:
+        if self._fs is None:
+            self._fs = pafs.HadoopFileSystem(host=self.host, port=self.port, user="hadoop")
+        return self._fs
+
+    def region_tag(self) -> str:
+        return "hdfs:local"
+
+    def path(self) -> str:
+        return f"hdfs://{self.host}:{self.port}"
+
+    def bucket_exists(self) -> bool:
+        try:
+            self.hdfs.get_file_info("/")
+            return True
+        except OSError:
+            return False
+
+    def create_bucket(self, region_tag: str) -> None: ...
+
+    def delete_bucket(self) -> None: ...
+
+    def exists(self, obj_name: str) -> bool:
+        info = self.hdfs.get_file_info(f"/{obj_name.lstrip('/')}")
+        return info.type != pafs.FileType.NotFound
+
+    def get_obj_size(self, obj_name: str) -> int:
+        info = self.hdfs.get_file_info(f"/{obj_name.lstrip('/')}")
+        if info.type == pafs.FileType.NotFound:
+            raise NoSuchObjectException(obj_name)
+        return info.size
+
+    def get_obj_last_modified(self, obj_name: str):
+        info = self.hdfs.get_file_info(f"/{obj_name.lstrip('/')}")
+        return info.mtime or datetime.now(timezone.utc)
+
+    def list_objects(self, prefix: str = "") -> Iterator[HDFSFile]:
+        selector = pafs.FileSelector(f"/{prefix.lstrip('/')}" or "/", recursive=True, allow_not_found=True)
+        for info in self.hdfs.get_file_info(selector):
+            if info.type == pafs.FileType.File:
+                yield HDFSFile(
+                    key=info.path.lstrip("/"),
+                    provider="hdfs",
+                    bucket=self.host,
+                    size=info.size,
+                    last_modified=info.mtime,
+                )
+
+    def delete_objects(self, keys: List[str]) -> None:
+        for key in keys:
+            self.hdfs.delete_file(f"/{key.lstrip('/')}")
+
+    def download_object(
+        self,
+        src_object_name: str,
+        dst_file_path,
+        offset_bytes: Optional[int] = None,
+        size_bytes: Optional[int] = None,
+        write_at_offset: bool = False,
+        generate_md5: bool = False,
+    ) -> Optional[str]:
+        md5 = hashlib.md5() if generate_md5 else None
+        with self.hdfs.open_input_file(f"/{src_object_name.lstrip('/')}") as fin:
+            if offset_bytes:
+                fin.seek(offset_bytes)
+            remaining = size_bytes
+            from pathlib import Path
+
+            mode = "r+b" if (write_at_offset and Path(dst_file_path).exists()) else "wb"
+            with open(dst_file_path, mode) as fout:
+                if write_at_offset and offset_bytes:
+                    fout.seek(offset_bytes)
+                while remaining is None or remaining > 0:
+                    want = 4 << 20 if remaining is None else min(4 << 20, remaining)
+                    block = fin.read(want)
+                    if not block:
+                        break
+                    fout.write(block)
+                    if md5:
+                        md5.update(block)
+                    if remaining is not None:
+                        remaining -= len(block)
+        return md5.hexdigest() if md5 else None
+
+    def upload_object(
+        self,
+        src_file_path,
+        dst_object_name: str,
+        part_number: Optional[int] = None,
+        upload_id: Optional[str] = None,
+        check_md5: Optional[str] = None,
+        mime_type: Optional[str] = None,
+    ) -> None:
+        # HDFS has no multipart; parts are staged as sibling files and
+        # concatenated on complete (same filename-carried scheme as POSIX)
+        path = f"/{dst_object_name.lstrip('/')}"
+        if upload_id is not None and part_number is not None:
+            path = f"{path}.sky_part{part_number}"
+        data = open(src_file_path, "rb").read()
+        with self.hdfs.open_output_stream(path) as out:
+            out.write(data)
+
+    def initiate_multipart_upload(self, dst_object_name: str, mime_type: Optional[str] = None) -> str:
+        import uuid
+
+        return uuid.uuid4().hex
+
+    def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        base = f"/{dst_object_name.lstrip('/')}"
+        parent = base.rsplit("/", 1)[0] or "/"
+        selector = pafs.FileSelector(parent, recursive=False, allow_not_found=True)
+        parts = [
+            info.path
+            for info in self.hdfs.get_file_info(selector)
+            if info.type == pafs.FileType.File and info.path.startswith(base + ".sky_part")
+        ]
+        parts.sort(key=lambda p: int(p.rsplit(".sky_part", 1)[1]))
+        with self.hdfs.open_output_stream(base) as out:
+            for p in parts:
+                with self.hdfs.open_input_file(p) as fin:
+                    while True:
+                        block = fin.read(4 << 20)
+                        if not block:
+                            break
+                        out.write(block)
+        for p in parts:
+            self.hdfs.delete_file(p)

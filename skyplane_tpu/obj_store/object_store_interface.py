@@ -32,6 +32,8 @@ class ObjectStoreObject:
 
 
 class ObjectStoreInterface(StorageInterface):
+    supports_multipart = True
+
     def get_obj_size(self, obj_name: str) -> int:
         raise NotImplementedError
 

@@ -78,14 +78,24 @@ class POSIXInterface(ObjectStoreInterface):
             return
         # walk only the deepest existing directory of the prefix — with the
         # filesystem-root "bucket" a full rglob would scan the whole disk
-        scan_root = base
+        # Determine minimal scan roots for string-prefix semantics ("tmp/da"
+        # matches both tmp/da/* and tmp/data.txt) WITHOUT walking the prefix's
+        # whole parent — with a filesystem-root bucket that parent can be "/".
+        scan_roots = [base]
         if prefix:
-            # scan the parent even when the prefix names a directory: object
-            # stores use STRING prefixes, so "tmp/da" must also match the
-            # sibling file "tmp/data.txt"
-            scan_root = (base / prefix).parent
-            if not scan_root.is_dir():
-                return
+            candidate = base / prefix
+            if prefix.endswith("/"):
+                if not candidate.is_dir():
+                    return
+                scan_roots = [candidate]
+            else:
+                parent = candidate.parent
+                if not parent.is_dir():
+                    return
+                try:
+                    scan_roots = [e for e in parent.iterdir() if e.name.startswith(candidate.name)]
+                except (PermissionError, OSError):
+                    return
         def safe_walk(root: Path):
             try:
                 entries = sorted(root.iterdir())
@@ -99,7 +109,15 @@ class POSIXInterface(ObjectStoreInterface):
                 elif entry.is_file():  # follows file symlinks like rglob did
                     yield entry
 
-        for p in safe_walk(scan_root):
+        candidates = []
+        for root in scan_roots:
+            if root.is_file() and not root.is_symlink():
+                candidates.append(root)
+            elif root.is_dir() and not root.is_symlink():
+                candidates.extend(safe_walk(root))
+            elif root.is_file():  # symlinked file at the top level
+                candidates.append(root)
+        for p in sorted(candidates):
             if p.name.startswith(".sky_tmp") or ".sky_part" in p.name:
                 continue
             key = str(p.relative_to(base))

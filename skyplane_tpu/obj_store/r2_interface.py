@@ -1,0 +1,48 @@
+"""Cloudflare R2 backend: S3-compatible API at an account endpoint.
+
+Reference parity: skyplane/obj_store/r2_interface.py:19-51. Bucket name is
+``<account_id>/<bucket>``; since R2 cannot host VMs the planners auto-select
+one-sided topologies (cli_transfer.py reference :329-335, mirrored in
+skyplane_tpu/cli/cli_transfer.py).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from typing import Optional
+
+from skyplane_tpu.obj_store.s3_interface import S3Interface, S3Object
+
+
+class R2Object(S3Object):
+    def full_path(self) -> str:
+        return f"r2://{self.bucket}/{self.key}"
+
+
+class R2Interface(S3Interface):
+    provider = "r2"
+
+    def __init__(self, bucket_name: str):
+        # bucket_name = "<account_id>/<bucket>"
+        self.account_id, _, bucket = bucket_name.partition("/")
+        super().__init__(bucket)
+        self.endpoint_url = f"https://{self.account_id}.r2.cloudflarestorage.com"
+
+    def region_tag(self) -> str:
+        return "r2:infer"
+
+    def path(self) -> str:
+        return f"r2://{self.account_id}/{self.bucket_name}"
+
+    @lru_cache(maxsize=1)
+    def _s3_client(self, region: Optional[str] = None):
+        import boto3
+
+        return boto3.client(
+            "s3",
+            endpoint_url=self.endpoint_url,
+            aws_access_key_id=os.environ.get("R2_ACCESS_KEY_ID"),
+            aws_secret_access_key=os.environ.get("R2_SECRET_ACCESS_KEY"),
+            region_name="auto",
+        )

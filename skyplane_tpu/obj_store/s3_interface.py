@@ -1,0 +1,214 @@
+"""Amazon S3 backend.
+
+Reference parity: skyplane/obj_store/s3_interface.py:37-258 — ranged GET with
+streaming md5, Content-MD5 uploads with checksum-mismatch mapping, multipart
+initiate/complete with part listing, paginated listing, requester-pays.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from functools import lru_cache
+from typing import Iterator, List, Optional
+
+import boto3
+import botocore.exceptions
+
+from skyplane_tpu.exceptions import (
+    ChecksumMismatchException,
+    MissingBucketException,
+    NoSuchObjectException,
+    PermissionsException,
+)
+from skyplane_tpu.obj_store.object_store_interface import ObjectStoreInterface, ObjectStoreObject
+
+
+class S3Object(ObjectStoreObject):
+    def full_path(self) -> str:
+        return f"s3://{self.bucket}/{self.key}"
+
+
+class S3Interface(ObjectStoreInterface):
+    provider = "aws"
+
+    def __init__(self, bucket_name: str, requester_pays: bool = False):
+        self.bucket_name = bucket_name
+        self.requester_pays = requester_pays
+        self._cached_region: Optional[str] = None
+
+    @property
+    def aws_region(self) -> str:
+        if self._cached_region is None:
+            client = boto3.client("s3")
+            try:
+                resp = client.get_bucket_location(Bucket=self.bucket_name)
+                self._cached_region = resp.get("LocationConstraint") or "us-east-1"
+            except botocore.exceptions.ClientError as e:
+                code = e.response.get("Error", {}).get("Code", "")
+                if code in ("NoSuchBucket", "404"):
+                    raise MissingBucketException(f"s3://{self.bucket_name}") from e
+                if code in ("AccessDenied", "403"):
+                    raise PermissionsException(f"cannot query region of s3://{self.bucket_name}") from e
+                raise
+        return self._cached_region
+
+    def region_tag(self) -> str:
+        return f"aws:{self.aws_region}"
+
+    def path(self) -> str:
+        return f"s3://{self.bucket_name}"
+
+    @lru_cache(maxsize=8)
+    def _s3_client(self, region: Optional[str] = None):
+        return boto3.client("s3", region_name=region or self.aws_region)
+
+    def _extra_args(self) -> dict:
+        return {"RequestPayer": "requester"} if self.requester_pays else {}
+
+    def bucket_exists(self) -> bool:
+        try:
+            client = boto3.client("s3")
+            client.head_bucket(Bucket=self.bucket_name)
+            return True
+        except botocore.exceptions.ClientError:
+            return False
+
+    def create_bucket(self, region_tag: str) -> None:
+        region = region_tag.split(":")[-1]
+        client = boto3.client("s3", region_name=region)
+        if not self.bucket_exists():
+            if region == "us-east-1":
+                client.create_bucket(Bucket=self.bucket_name)
+            else:
+                client.create_bucket(Bucket=self.bucket_name, CreateBucketConfiguration={"LocationConstraint": region})
+        self._cached_region = region
+
+    def delete_bucket(self) -> None:
+        self._s3_client().delete_bucket(Bucket=self.bucket_name)
+
+    def exists(self, obj_name: str) -> bool:
+        try:
+            self._s3_client().head_object(Bucket=self.bucket_name, Key=obj_name, **self._extra_args())
+            return True
+        except botocore.exceptions.ClientError:
+            return False
+
+    def get_obj_size(self, obj_name: str) -> int:
+        try:
+            resp = self._s3_client().head_object(Bucket=self.bucket_name, Key=obj_name, **self._extra_args())
+            return resp["ContentLength"]
+        except botocore.exceptions.ClientError as e:
+            raise NoSuchObjectException(f"s3://{self.bucket_name}/{obj_name}") from e
+
+    def get_obj_last_modified(self, obj_name: str):
+        resp = self._s3_client().head_object(Bucket=self.bucket_name, Key=obj_name, **self._extra_args())
+        return resp["LastModified"]
+
+    def get_obj_mime_type(self, obj_name: str) -> Optional[str]:
+        resp = self._s3_client().head_object(Bucket=self.bucket_name, Key=obj_name, **self._extra_args())
+        return resp.get("ContentType")
+
+    def list_objects(self, prefix: str = "") -> Iterator[S3Object]:
+        paginator = self._s3_client().get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket_name, Prefix=prefix, **self._extra_args()):
+            for obj in page.get("Contents", []):
+                yield S3Object(
+                    key=obj["Key"],
+                    provider="aws",
+                    bucket=self.bucket_name,
+                    size=obj["Size"],
+                    last_modified=obj["LastModified"],
+                )
+
+    def delete_objects(self, keys: List[str]) -> None:
+        client = self._s3_client()
+        for i in range(0, len(keys), 1000):
+            batch = keys[i : i + 1000]
+            client.delete_objects(Bucket=self.bucket_name, Delete={"Objects": [{"Key": k} for k in batch]})
+
+    def download_object(
+        self,
+        src_object_name: str,
+        dst_file_path,
+        offset_bytes: Optional[int] = None,
+        size_bytes: Optional[int] = None,
+        write_at_offset: bool = False,
+        generate_md5: bool = False,
+    ) -> Optional[str]:
+        args = dict(self._extra_args())
+        if offset_bytes is not None or size_bytes is not None:
+            start = offset_bytes or 0
+            end = "" if size_bytes is None else start + size_bytes - 1
+            args["Range"] = f"bytes={start}-{end}"
+        try:
+            resp = self._s3_client().get_object(Bucket=self.bucket_name, Key=src_object_name, **args)
+        except botocore.exceptions.ClientError as e:
+            if e.response.get("Error", {}).get("Code") == "NoSuchKey":
+                raise NoSuchObjectException(f"s3://{self.bucket_name}/{src_object_name}") from e
+            raise
+        md5 = hashlib.md5() if generate_md5 else None
+        from pathlib import Path
+
+        mode = "r+b" if (write_at_offset and Path(dst_file_path).exists()) else "wb"
+        with open(dst_file_path, mode) as f:
+            if write_at_offset and offset_bytes:
+                f.seek(offset_bytes)
+            for block in resp["Body"].iter_chunks(chunk_size=4 << 20):
+                f.write(block)
+                if md5:
+                    md5.update(block)
+        return md5.hexdigest() if md5 else None
+
+    def upload_object(
+        self,
+        src_file_path,
+        dst_object_name: str,
+        part_number: Optional[int] = None,
+        upload_id: Optional[str] = None,
+        check_md5: Optional[str] = None,
+        mime_type: Optional[str] = None,
+    ) -> None:
+        client = self._s3_client()
+        data = open(src_file_path, "rb").read()
+        args = {}
+        if check_md5:
+            args["ContentMD5"] = base64.b64encode(bytes.fromhex(check_md5)).decode()
+        try:
+            if upload_id is not None and part_number is not None:
+                client.upload_part(
+                    Bucket=self.bucket_name,
+                    Key=dst_object_name,
+                    PartNumber=part_number,
+                    UploadId=upload_id,
+                    Body=data,
+                    **args,
+                )
+            else:
+                if mime_type:
+                    args["ContentType"] = mime_type
+                client.put_object(Bucket=self.bucket_name, Key=dst_object_name, Body=data, **args)
+        except botocore.exceptions.ClientError as e:
+            if e.response.get("Error", {}).get("Code") in ("InvalidDigest", "BadDigest"):
+                raise ChecksumMismatchException(f"s3://{self.bucket_name}/{dst_object_name}") from e
+            raise
+
+    def initiate_multipart_upload(self, dst_object_name: str, mime_type: Optional[str] = None) -> str:
+        args = {"ContentType": mime_type} if mime_type else {}
+        resp = self._s3_client().create_multipart_upload(Bucket=self.bucket_name, Key=dst_object_name, **args)
+        return resp["UploadId"]
+
+    def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        client = self._s3_client()
+        parts = []
+        paginator = client.get_paginator("list_parts")
+        for page in paginator.paginate(Bucket=self.bucket_name, Key=dst_object_name, UploadId=upload_id):
+            for part in page.get("Parts", []):
+                parts.append({"PartNumber": part["PartNumber"], "ETag": part["ETag"]})
+        parts.sort(key=lambda p: p["PartNumber"])
+        client.complete_multipart_upload(
+            Bucket=self.bucket_name,
+            Key=dst_object_name,
+            UploadId=upload_id,
+            MultipartUpload={"Parts": parts},
+        )

@@ -16,6 +16,9 @@ from skyplane_tpu.exceptions import MissingDependencyException, SkyplaneTpuExcep
 
 class StorageInterface:
     provider: str = "abstract"
+    # backends that implement real part-numbered multipart set True; the
+    # chunker falls back to single-chunk transfers otherwise
+    supports_multipart: bool = False
 
     def bucket(self) -> str:
         return self.bucket_name  # type: ignore[attr-defined]

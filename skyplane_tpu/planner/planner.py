@@ -110,15 +110,9 @@ class Planner:
             return "none", False  # same region: no egress cost, bandwidth is LAN
         return cfg.compress, cfg.dedup
 
-    def plan(self, jobs: List) -> TopologyPlan:
-        raise NotImplementedError
-
-
-class MulticastDirectPlanner(Planner):
-    """Default planner: direct src->dst(s) with per-destination fan-out
-    (reference: planner.py:277-383)."""
-
-    def plan(self, jobs: List) -> TopologyPlan:
+    @staticmethod
+    def _validate_jobs(jobs: List):
+        """All jobs in one dataplane must share src/dst regions; returns them."""
         if not jobs:
             raise SkyplaneTpuException("no jobs to plan")
         src_region = jobs[0].src_iface.region_tag()
@@ -126,7 +120,19 @@ class MulticastDirectPlanner(Planner):
         for job in jobs[1:]:
             if job.src_iface.region_tag() != src_region or [i.region_tag() for i in job.dst_ifaces] != dst_regions:
                 raise SkyplaneTpuException("all jobs in one dataplane must share src/dst regions")
+        return src_region, dst_regions
 
+    def plan(self, jobs: List) -> TopologyPlan:
+        raise NotImplementedError
+
+
+class MulticastDirectPlanner(Planner):
+    """Default planner: direct src->dst(s) with per-destination fan-out
+    (reference: planner.py:277-383). Each job gets its own partition (the
+    job uuid) so multi-job dataplanes keep per-job operator DAGs."""
+
+    def plan(self, jobs: List) -> TopologyPlan:
+        src_region, dst_regions = self._validate_jobs(jobs)
         plan = TopologyPlan(src_region, dst_regions)
         vm_types, n_instances = self._get_vm_type_and_instances([src_region] + [r for r in dst_regions if r != src_region])
 
@@ -139,7 +145,7 @@ class MulticastDirectPlanner(Planner):
 
         cfg = self.transfer_config
         for job in jobs:
-            partition = "default"
+            partition = job.uuid
             src_bucket = job.src_iface.bucket()
             dst_ifaces = job.dst_ifaces
             # source program: read -> (mux_and over destinations) -> sends
@@ -215,27 +221,32 @@ class DirectPlannerSourceOneSided(MulticastDirectPlanner):
     destination provider can't host VMs (e.g. Cloudflare R2)."""
 
     def plan(self, jobs: List) -> TopologyPlan:
-        src_region = jobs[0].src_iface.region_tag()
-        dst_regions = [iface.region_tag() for iface in jobs[0].dst_ifaces]
+        src_region, dst_regions = self._validate_jobs(jobs)
         plan = TopologyPlan(src_region, dst_regions)
         vm_types, n_instances = self._get_vm_type_and_instances([src_region])
         cfg = self.transfer_config
         for _ in range(n_instances):
             gw = plan.add_gateway(src_region)
             program = gw.gateway_program
-            read_h = program.add_operator(
-                GatewayReadObjectStore(
-                    bucket_name=jobs[0].src_iface.bucket(), bucket_region=src_region, num_connections=cfg.num_connections
+            for job in jobs:
+                partition = job.uuid
+                read_h = program.add_operator(
+                    GatewayReadObjectStore(
+                        bucket_name=job.src_iface.bucket(), bucket_region=src_region, num_connections=cfg.num_connections
+                    ),
+                    partition_id=partition,
                 )
-            )
-            parent = read_h
-            if len(dst_regions) > 1:
-                parent = program.add_operator(GatewayMuxAnd(), parent_handle=read_h)
-            for iface, region in zip(jobs[0].dst_ifaces, dst_regions):
-                program.add_operator(
-                    GatewayWriteObjectStore(bucket_name=iface.bucket(), bucket_region=region, num_connections=cfg.num_connections),
-                    parent_handle=parent,
-                )
+                parent = read_h
+                if len(dst_regions) > 1:
+                    parent = program.add_operator(GatewayMuxAnd(), parent_handle=read_h, partition_id=partition)
+                for iface, region in zip(job.dst_ifaces, dst_regions):
+                    program.add_operator(
+                        GatewayWriteObjectStore(
+                            bucket_name=iface.bucket(), bucket_region=region, num_connections=cfg.num_connections
+                        ),
+                        parent_handle=parent,
+                        partition_id=partition,
+                    )
             gw.vm_type = vm_types.get(src_region)
         plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
         return plan
@@ -246,24 +257,28 @@ class DirectPlannerDestOneSided(MulticastDirectPlanner):
     store directly (reference: planner.py:446-505)."""
 
     def plan(self, jobs: List) -> TopologyPlan:
-        src_region = jobs[0].src_iface.region_tag()
-        dst_regions = [iface.region_tag() for iface in jobs[0].dst_ifaces]
+        src_region, dst_regions = self._validate_jobs(jobs)
         plan = TopologyPlan(src_region, dst_regions)
         vm_types, n_instances = self._get_vm_type_and_instances(dst_regions)
         cfg = self.transfer_config
-        for iface, region in zip(jobs[0].dst_ifaces, dst_regions):
+        for dst_index, region in enumerate(dst_regions):
             for _ in range(n_instances):
                 gw = plan.add_gateway(region)
                 program = gw.gateway_program
-                read_h = program.add_operator(
-                    GatewayReadObjectStore(
-                        bucket_name=jobs[0].src_iface.bucket(), bucket_region=src_region, num_connections=cfg.num_connections
+                for job in jobs:
+                    read_h = program.add_operator(
+                        GatewayReadObjectStore(
+                            bucket_name=job.src_iface.bucket(), bucket_region=src_region, num_connections=cfg.num_connections
+                        ),
+                        partition_id=job.uuid,
                     )
-                )
-                program.add_operator(
-                    GatewayWriteObjectStore(bucket_name=iface.bucket(), bucket_region=region, num_connections=cfg.num_connections),
-                    parent_handle=read_h,
-                )
+                    program.add_operator(
+                        GatewayWriteObjectStore(
+                            bucket_name=job.dst_ifaces[dst_index].bucket(), bucket_region=region, num_connections=cfg.num_connections
+                        ),
+                        parent_handle=read_h,
+                        partition_id=job.uuid,
+                    )
                 gw.vm_type = vm_types.get(region)
         plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
         return plan

@@ -1,0 +1,266 @@
+"""Throughput-profile solvers: overlay routing over measured region-pair grids.
+
+Reference parity: skyplane/planner/solver.py:104-351 (profile-based solver),
+solver_ron.py:7-46 (best single relay), solver_ilp.py:15-134 (min-cost flow
+MILP). The MILP is re-posed as an LP (scipy.optimize.linprog — cvxpy/GUROBI
+are not dependencies) with integer instance counts recovered by rounding up,
+which is exact for the instance-limited regimes the reference targets.
+
+The throughput grid ships as a published-NIC-limit synthetic profile
+(solver constants, reference solver.py:28-36) and is replaced by measured
+iperf3 grids from `skyplane-tpu experiments throughput-grid` (cli/experiments).
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from skyplane_tpu.planner.pricing import get_egress_cost_per_gb, get_instance_cost_per_hr
+
+# per-VM NIC limits (egress_gbps, ingress_gbps) — reference: solver.py:28-30
+NIC_LIMITS = {"aws": (5.0, 10.0), "gcp": (7.0, 16.0), "azure": (16.0, 16.0), "local": (100.0, 100.0), "test": (100.0, 100.0)}
+CONNS_PER_LINK = 64  # connections to saturate a path — reference: solver.py:33
+
+
+@dataclass
+class ThroughputProblem:
+    src: str  # region tag
+    dst: str
+    required_throughput_gbits: float
+    gbyte_to_transfer: float = 1.0
+    instance_limit: int = 8
+    const_throughput_grid_gbits: Optional[np.ndarray] = None
+
+
+@dataclass
+class ThroughputSolution:
+    problem: ThroughputProblem
+    is_feasible: bool
+    throughput_achieved_gbits: float = 0.0
+    cost_egress_by_edge: Dict[Tuple[str, str], float] = field(default_factory=dict)
+    cost_total: float = 0.0
+    # edge -> (flow_gbits, n_connections); instances per region
+    edge_flow_gbits: Dict[Tuple[str, str], float] = field(default_factory=dict)
+    instances_per_region: Dict[str, int] = field(default_factory=dict)
+    path: List[str] = field(default_factory=list)
+
+
+class ThroughputSolver:
+    """Loads the region-pair throughput grid and answers path queries."""
+
+    def __init__(self, profile_path: Optional[str] = None):
+        self.grid: Dict[Tuple[str, str], float] = {}
+        if profile_path and Path(profile_path).exists():
+            with open(profile_path) as f:
+                for row in csv.DictReader(f):
+                    self.grid[(row["src_region"], row["dst_region"])] = float(row["gbps"])
+
+    def get_path_throughput(self, src: str, dst: str) -> float:
+        """Single-VM achievable Gbps on src->dst."""
+        if src == dst:
+            return min(NIC_LIMITS.get(src.split(":")[0], (5.0, 5.0)))
+        if (src, dst) in self.grid:
+            return self.grid[(src, dst)]
+        # fall back to NIC-limit model: min(src egress cap, dst ingress cap),
+        # derated 40% for WAN (observed gap between NIC and cross-region TCP)
+        src_e = NIC_LIMITS.get(src.split(":")[0], (5.0, 10.0))[0]
+        dst_i = NIC_LIMITS.get(dst.split(":")[0], (5.0, 10.0))[1]
+        same_provider = src.split(":")[0] == dst.split(":")[0]
+        derate = 0.8 if same_provider else 0.6
+        return min(src_e, dst_i) * derate
+
+    def get_path_cost(self, src: str, dst: str) -> float:
+        return get_egress_cost_per_gb(src, dst)
+
+    def get_baseline_throughput_and_cost(self, p: ThroughputProblem) -> Tuple[float, float]:
+        """Direct path with p.instance_limit VMs (reference: solver.py:144-150)."""
+        tput = self.get_path_throughput(p.src, p.dst) * p.instance_limit
+        cost = self.get_path_cost(p.src, p.dst) * p.gbyte_to_transfer
+        return tput, cost
+
+
+class ThroughputSolverRON(ThroughputSolver):
+    """Best single-relay overlay (reference: solver_ron.py:7-46)."""
+
+    def solve(self, p: ThroughputProblem, candidate_regions: List[str]) -> ThroughputSolution:
+        direct = self.get_path_throughput(p.src, p.dst)
+        best_path = [p.src, p.dst]
+        best_tput = direct
+        for inter in candidate_regions:
+            if inter in (p.src, p.dst):
+                continue
+            tput = min(self.get_path_throughput(p.src, inter), self.get_path_throughput(inter, p.dst))
+            if tput > best_tput:
+                best_tput = tput
+                best_path = [p.src, inter, p.dst]
+        total = best_tput * p.instance_limit
+        edges = list(zip(best_path[:-1], best_path[1:]))
+        egress = {e: self.get_path_cost(*e) * p.gbyte_to_transfer for e in edges}
+        sol = ThroughputSolution(
+            problem=p,
+            is_feasible=total >= p.required_throughput_gbits,
+            throughput_achieved_gbits=total,
+            cost_egress_by_edge=egress,
+            cost_total=sum(egress.values()),
+            edge_flow_gbits={e: total for e in edges},
+            instances_per_region={r: p.instance_limit for r in best_path},
+            path=best_path,
+        )
+        return sol
+
+
+class ThroughputSolverILP(ThroughputSolver):
+    """Min-cost overlay flow via LP relaxation (reference: solver_ilp.py:15-134).
+
+    Variables: flow f_e >= 0 on each directed edge of the candidate region
+    graph. Constraints: flow conservation (src emits R, dst absorbs R,
+    relays conserve), per-region egress/ingress NIC caps scaled by the
+    instance limit. Objective: egress $ + instance $ (instances implied by
+    NIC utilization, priced per region-hour over the transfer duration).
+    """
+
+    def solve_min_cost(
+        self,
+        p: ThroughputProblem,
+        candidate_regions: List[str],
+        solver_verbose: bool = False,
+    ) -> ThroughputSolution:
+        from scipy.optimize import linprog
+
+        regions = [p.src] + [r for r in candidate_regions if r not in (p.src, p.dst)] + [p.dst]
+        n = len(regions)
+        idx = {r: i for i, r in enumerate(regions)}
+        edges = [(a, b) for a in regions for b in regions if a != b]
+        e_idx = {e: i for i, e in enumerate(edges)}
+        R = p.required_throughput_gbits
+
+        # objective: egress $/GB * (GB moved over edge per unit time ~ flow) +
+        # instance cost per flow-unit (instances = flow / per-VM cap)
+        transfer_hours = max(p.gbyte_to_transfer * 8 / max(R, 1e-6) / 3600, 1e-6)
+        c = np.zeros(len(edges))
+        for e, i in e_idx.items():
+            egress_cost = self.get_path_cost(*e) * p.gbyte_to_transfer / max(R, 1e-6)
+            src_cap = self.get_path_throughput(*e)
+            vm_cost = get_instance_cost_per_hr(e[0], None) or 1.54
+            c[i] = egress_cost + transfer_hours * vm_cost / max(src_cap, 1e-6)
+
+        # conservation: A_eq x = b_eq
+        a_eq = np.zeros((n, len(edges)))
+        b_eq = np.zeros(n)
+        for (a, b), i in e_idx.items():
+            a_eq[idx[a], i] += 1  # outflow
+            a_eq[idx[b], i] -= 1  # inflow
+        b_eq[idx[p.src]] = R
+        b_eq[idx[p.dst]] = -R
+
+        # NIC caps: per-region egress and ingress <= limit * instances
+        a_ub = []
+        b_ub = []
+        for r in regions:
+            prov = r.split(":")[0]
+            egress_cap, ingress_cap = NIC_LIMITS.get(prov, (5.0, 10.0))
+            out_row = np.zeros(len(edges))
+            in_row = np.zeros(len(edges))
+            for (a, b), i in e_idx.items():
+                if a == r:
+                    out_row[i] = 1
+                if b == r:
+                    in_row[i] = 1
+            a_ub.append(out_row)
+            b_ub.append(egress_cap * p.instance_limit)
+            a_ub.append(in_row)
+            b_ub.append(ingress_cap * p.instance_limit)
+        # per-edge cap: path throughput * instances
+        for (a, b), i in e_idx.items():
+            row = np.zeros(len(edges))
+            row[i] = 1
+            a_ub.append(row)
+            b_ub.append(self.get_path_throughput(a, b) * p.instance_limit)
+
+        res = linprog(c, A_ub=np.array(a_ub), b_ub=np.array(b_ub), A_eq=a_eq, b_eq=b_eq, bounds=(0, None), method="highs")
+        if not res.success:
+            return ThroughputSolution(problem=p, is_feasible=False)
+        flows = {e: float(res.x[i]) for e, i in e_idx.items() if res.x[i] > 1e-6}
+        instances: Dict[str, int] = {}
+        for r in regions:
+            prov = r.split(":")[0]
+            egress_cap, ingress_cap = NIC_LIMITS.get(prov, (5.0, 10.0))
+            out_flow = sum(f for (a, _), f in flows.items() if a == r)
+            in_flow = sum(f for (_, b), f in flows.items() if b == r)
+            need = max(out_flow / egress_cap, in_flow / ingress_cap)
+            if need > 1e-9:
+                instances[r] = int(np.ceil(need))
+        egress = {e: self.get_path_cost(*e) * p.gbyte_to_transfer * (f / R) for e, f in flows.items()}
+        return ThroughputSolution(
+            problem=p,
+            is_feasible=True,
+            throughput_achieved_gbits=R,
+            cost_egress_by_edge=egress,
+            cost_total=float(res.fun),
+            edge_flow_gbits=flows,
+            instances_per_region=instances,
+        )
+
+
+def solution_to_topology(sol: ThroughputSolution, jobs: List, transfer_config) -> "TopologyPlan":
+    """Convert an overlay solution into per-gateway programs.
+
+    Rebuilt against the new TopologyPlan (the reference's
+    ``to_replication_topology`` was bit-rotted, SURVEY §2.4). Relay gateways
+    forward without decode: receive -> send preserves wire payloads.
+    """
+    from skyplane_tpu.gateway.gateway_program import (
+        GatewayReadObjectStore,
+        GatewayReceive,
+        GatewaySend,
+        GatewayWriteObjectStore,
+    )
+    from skyplane_tpu.planner.topology import TopologyPlan
+
+    if not sol.path:
+        raise ValueError("solution has no explicit path; only path-form solutions convert to topologies")
+    p = sol.problem
+    plan = TopologyPlan(p.src, [p.dst])
+    cfg = transfer_config
+    job = jobs[0]
+    # one gateway per region on the path (instance scaling handled by planner count)
+    gws = {region: plan.add_gateway(region) for region in sol.path}
+    for i, region in enumerate(sol.path):
+        program = gws[region].gateway_program
+        is_first = i == 0
+        is_last = i == len(sol.path) - 1
+        if is_first:
+            parent = program.add_operator(
+                GatewayReadObjectStore(
+                    bucket_name=job.src_iface.bucket(), bucket_region=p.src, num_connections=cfg.num_connections
+                )
+            )
+        else:
+            parent = program.add_operator(GatewayReceive(decrypt=cfg.encrypt_e2e and is_last, dedup=cfg.dedup and is_last))
+        if is_last:
+            program.add_operator(
+                GatewayWriteObjectStore(
+                    bucket_name=job.dst_ifaces[0].bucket(), bucket_region=p.dst, num_connections=cfg.num_connections
+                ),
+                parent_handle=parent,
+            )
+        else:
+            nxt = sol.path[i + 1]
+            program.add_operator(
+                GatewaySend(
+                    target_gateway_id=gws[nxt].gateway_id,
+                    region=nxt,
+                    num_connections=cfg.num_connections,
+                    compress=cfg.compress if is_first else "none",  # relays forward as-is
+                    encrypt=cfg.encrypt_e2e and is_first,
+                    dedup=cfg.dedup and is_first,
+                ),
+                parent_handle=parent,
+            )
+    plan.cost_per_gb = sum(get_egress_cost_per_gb(a, b) for a, b in zip(sol.path[:-1], sol.path[1:]))
+    return plan

@@ -1,0 +1,133 @@
+"""Overlay relay chain: src -> relay -> dst on localhost.
+
+The relay daemon gets NO E2EE key: raw_forward mode must pass encrypted
+payloads through untouched (reference relay semantics — forward without
+decrypt/decompress).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+import requests
+
+from skyplane_tpu.gateway.crypto import generate_key
+from tests.integration.harness import LocalGateway, dispatch_file, start_gateway, wait_complete
+
+rng = np.random.default_rng(31)
+
+
+@pytest.mark.slow
+def test_three_hop_relay_encrypted(tmp_path):
+    key = generate_key()
+    # destination: receive(decrypt) -> write_local
+    dst = start_gateway(
+        {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "receive",
+                            "handle": "recv",
+                            "decrypt": True,
+                            "dedup": False,
+                            "children": [{"op_type": "write_local", "handle": "write", "children": []}],
+                        }
+                    ],
+                }
+            ]
+        },
+        {},
+        "gw_dst",
+        str(tmp_path / "dst_chunks"),
+        e2ee_key=key,
+    )
+    # relay: receive -> send (no key on purpose)
+    relay = start_gateway(
+        {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "receive",
+                            "handle": "recv",
+                            "decrypt": False,
+                            "dedup": False,
+                            "children": [
+                                {
+                                    "op_type": "send",
+                                    "handle": "fwd",
+                                    "target_gateway_id": "gw_dst",
+                                    "region": "local:c",
+                                    "num_connections": 2,
+                                    "compress": "none",
+                                    "encrypt": False,
+                                    "dedup": False,
+                                    "children": [],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        },
+        {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}},
+        "gw_relay",
+        str(tmp_path / "relay_chunks"),
+        e2ee_key=None,  # relay must never need the key
+    )
+    # source: read_local -> send(zstd, encrypted)
+    src = start_gateway(
+        {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "read_local",
+                            "handle": "read",
+                            "num_connections": 2,
+                            "children": [
+                                {
+                                    "op_type": "send",
+                                    "handle": "send",
+                                    "target_gateway_id": "gw_relay",
+                                    "region": "local:b",
+                                    "num_connections": 2,
+                                    "compress": "zstd",
+                                    "encrypt": True,
+                                    "dedup": False,
+                                    "children": [],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        },
+        {"gw_relay": {"public_ip": "127.0.0.1", "control_port": relay.control_port}},
+        "gw_src",
+        str(tmp_path / "src_chunks"),
+        e2ee_key=key,
+    )
+    try:
+        payload = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes() + bytes(1 << 20)
+        fsrc = tmp_path / "data.bin"
+        fdst = tmp_path / "out" / "data.bin"
+        fsrc.write_bytes(payload)
+        ids = dispatch_file(src, fsrc, fdst, chunk_bytes=512 * 1024)
+        # the chunk must ALSO be pre-registered at the relay? no — the source
+        # sender pre-registers at the relay, and the relay's sender pre-registers
+        # at the destination (hop-by-hop control flow)
+        wait_complete(dst, ids, timeout=120)
+        got = fdst.read_bytes()
+        assert hashlib.md5(got).hexdigest() == hashlib.md5(payload).hexdigest()
+        # relay really forwarded ciphertext: its chunk dir must contain no plaintext
+        stats = requests.get(relay.url("profile/compression"), timeout=5).json()
+        assert stats["chunks"] == 0 or stats["raw_bytes"] == 0  # no DataPathProcessor work at relay
+    finally:
+        src.stop()
+        relay.stop()
+        dst.stop()

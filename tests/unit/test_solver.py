@@ -1,0 +1,84 @@
+"""Solver tests: RON relay selection, LP min-cost flow, topology conversion."""
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.planner.solver import (
+    ThroughputProblem,
+    ThroughputSolver,
+    ThroughputSolverILP,
+    ThroughputSolverRON,
+    solution_to_topology,
+)
+
+
+def grid_solver(grid):
+    s = ThroughputSolverRON()
+    s.grid = dict(grid)
+    return s
+
+
+def test_direct_path_fallback_model():
+    s = ThroughputSolver()
+    # aws->gcp: min(aws egress 5, gcp ingress 16) * 0.6 cross-provider derate
+    assert s.get_path_throughput("aws:us-east-1", "gcp:us-central1") == pytest.approx(3.0)
+
+
+def test_ron_picks_relay_when_faster():
+    grid = {
+        ("aws:a", "aws:b"): 1.0,
+        ("aws:a", "aws:c"): 6.0,
+        ("aws:c", "aws:b"): 5.0,
+    }
+    s = grid_solver(grid)
+    p = ThroughputProblem(src="aws:a", dst="aws:b", required_throughput_gbits=4.0, instance_limit=1)
+    sol = s.solve(p, ["aws:c"])
+    assert sol.path == ["aws:a", "aws:c", "aws:b"]
+    assert sol.throughput_achieved_gbits == pytest.approx(5.0)
+    assert sol.is_feasible
+
+
+def test_ron_prefers_direct_when_best():
+    grid = {("aws:a", "aws:b"): 9.0, ("aws:a", "aws:c"): 6.0, ("aws:c", "aws:b"): 5.0}
+    s = grid_solver(grid)
+    sol = s.solve(ThroughputProblem("aws:a", "aws:b", 1.0, instance_limit=1), ["aws:c"])
+    assert sol.path == ["aws:a", "aws:b"]
+
+
+def test_ilp_flow_conservation_and_feasibility():
+    s = ThroughputSolverILP()
+    p = ThroughputProblem(src="aws:us-east-1", dst="gcp:us-central1", required_throughput_gbits=6.0, instance_limit=4)
+    sol = s.solve_min_cost(p, ["azure:eastus"])
+    assert sol.is_feasible
+    # flow out of src equals required throughput
+    out = sum(f for (a, _), f in sol.edge_flow_gbits.items() if a == p.src)
+    back = sum(f for (_, b), f in sol.edge_flow_gbits.items() if b == p.src)
+    assert out - back == pytest.approx(6.0, abs=1e-4)
+    assert sol.instances_per_region.get(p.src, 0) >= 1
+
+
+def test_ilp_infeasible_when_demand_exceeds_caps():
+    s = ThroughputSolverILP()
+    p = ThroughputProblem(src="aws:a", dst="aws:b", required_throughput_gbits=1000.0, instance_limit=1)
+    sol = s.solve_min_cost(p, [])
+    assert not sol.is_feasible
+
+
+def test_solution_to_topology_relay_chain(tmp_path):
+    from skyplane_tpu.api.transfer_job import CopyJob
+    from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "x").write_bytes(b"d")
+    job = CopyJob("local:///x", ["local:///x"])
+    job._src_iface = POSIXInterface(str(tmp_path / "src"), region_tag="aws:a")
+    job._dst_ifaces = [POSIXInterface(str(tmp_path / "dst"), region_tag="aws:b")]
+    grid = {("aws:a", "aws:b"): 1.0, ("aws:a", "aws:c"): 6.0, ("aws:c", "aws:b"): 5.0}
+    s = grid_solver(grid)
+    sol = s.solve(ThroughputProblem("aws:a", "aws:b", 4.0, instance_limit=1), ["aws:c"])
+    plan = solution_to_topology(sol, [job], TransferConfig())
+    assert len(plan.gateways) == 3
+    relay = plan.get_region_gateways("aws:c")[0]
+    # relay receives and forwards without writing
+    assert relay._has_op("receive") and relay._has_op("send") and not relay._has_op("write_object_store")
