@@ -1,0 +1,26 @@
+# Gateway image (reference analog: Dockerfile:1-60 — python-slim + stunnel).
+# TLS terminates inside the daemon (ssl module), so no stunnel sidecar; the
+# image carries g++ for the native codec and the jax TPU wheel is expected to
+# be layered by the TPU VM runtime.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ openssh-client \
+    && rm -rf /var/lib/apt/lists/* \
+    # raise fd limits and socket buffer ceilings for the byte pump
+    && echo '* soft nofile 1048576' >> /etc/security/limits.conf \
+    && echo '* hard nofile 1048576' >> /etc/security/limits.conf
+
+WORKDIR /pkg
+COPY pyproject.toml README.md ./
+COPY skyplane_tpu ./skyplane_tpu
+RUN pip install --no-cache-dir -e .[gcp]
+
+ENV SKYPLANE_REGION="" \
+    GATEWAY_PROGRAM_FILE=/skyplane/program.json \
+    GATEWAY_INFO_FILE=/skyplane/info.json \
+    GATEWAY_ID=gateway_0 \
+    GATEWAY_CONTROL_PORT=8081
+
+EXPOSE 8081
+CMD ["python", "-m", "skyplane_tpu.gateway.gateway_daemon"]

@@ -1,0 +1,24 @@
+"""Airflow operator wrapping a transfer (reference analog:
+examples/airflow_operator.py). Requires apache-airflow in the host env."""
+
+from typing import List, Optional
+
+
+class SkyplaneTpuOperator:
+    """Drop-in BaseOperator subclass body — inherit from
+    airflow.models.BaseOperator in an Airflow deployment."""
+
+    template_fields = ("src", "dst")
+
+    def __init__(self, src: str, dst: str, recursive: bool = True, max_instances: int = 1, **kwargs):
+        self.src = src
+        self.dst = dst
+        self.recursive = recursive
+        self.max_instances = max_instances
+
+    def execute(self, context=None):
+        from skyplane_tpu import SkyplaneClient
+
+        client = SkyplaneClient()
+        client.copy(self.src, self.dst, recursive=self.recursive, max_instances=self.max_instances)
+        return {"src": self.src, "dst": self.dst}

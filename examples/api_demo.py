@@ -1,0 +1,25 @@
+"""Python API demo (reference analog: examples/api_demo.py).
+
+Copies a prefix between object stores with the TPU data path enabled,
+reporting dedup/compression stats afterwards.
+"""
+
+from skyplane_tpu import SkyplaneClient, TransferConfig
+
+client = SkyplaneClient(
+    transfer_config=TransferConfig(
+        compress="tpu_zstd",  # blockpack on TPU + zstd literals
+        dedup=True,  # content-defined dedup across objects
+        num_connections=32,
+    )
+)
+
+# blocking convenience copy
+client.copy("s3://my-bucket/dataset/", "gs://my-bucket/dataset/", recursive=True)
+
+# or the pipeline API for multi-job / multicast transfers
+pipe = client.pipeline(max_instances=2)
+pipe.queue_copy("s3://src/snapshots/", "gs://dst-a/snapshots/", recursive=True)
+pipe.queue_copy("s3://src/snapshots/", "azure://acct/dst-b/snapshots/", recursive=True)
+print(f"estimated egress cost: ${pipe.estimate_total_cost():.2f}")
+pipe.start(progress=True)

@@ -1,0 +1,29 @@
+"""Feed training data across clouds ahead of a JAX training job
+(reference analog: examples/pytorch_training.py).
+
+Pattern: sync the dataset shard prefix into the training region before the
+job starts; sync is idempotent delta-copy, so re-running costs nothing when
+the data is already current.
+"""
+
+import jax
+
+from skyplane_tpu import SkyplaneClient, TransferConfig
+
+DATASET = "s3://my-datasets/imagenet-shards/"
+LOCAL_REGION_BUCKET = "gs://training-scratch-us/imagenet-shards/"
+
+
+def stage_dataset() -> None:
+    client = SkyplaneClient(transfer_config=TransferConfig(compress="tpu_zstd", dedup=True))
+    client.sync(DATASET, LOCAL_REGION_BUCKET)
+
+
+def train() -> None:
+    # ... standard jax/flax input pipeline reading from LOCAL_REGION_BUCKET ...
+    print(f"training on {jax.device_count()} devices from {LOCAL_REGION_BUCKET}")
+
+
+if __name__ == "__main__":
+    stage_dataset()
+    train()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Generate synthetic transfer corpora (reference analog: scripts/gen_data/).
+
+Profiles:
+  random    — incompressible uniform bytes
+  snapshot  — base image + N mutated snapshots (clustered writes, zero
+              extents): the dedup benchmark workload
+  text      — highly compressible repeated text
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import numpy as np
+
+
+def gen_random(out: Path, n_files: int, file_mb: int, rng) -> None:
+    for i in range(n_files):
+        (out / f"random_{i:04d}.bin").write_bytes(
+            rng.integers(0, 256, file_mb << 20, dtype=np.uint8).tobytes()
+        )
+
+
+def gen_snapshot(out: Path, n_files: int, file_mb: int, rng, mutate_frac: float = 0.03) -> None:
+    block = 4096
+    n_blocks = (file_mb << 20) // block
+    base = rng.integers(0, 256, size=(n_blocks, block), dtype=np.uint8)
+    zero_runs = rng.integers(0, n_blocks, max(1, n_blocks // 64))
+    for start in zero_runs:
+        base[start : start + 16] = 0
+    (out / "snapshot_0000.img").write_bytes(base.tobytes())
+    snap = base
+    for i in range(1, n_files):
+        snap = snap.copy()
+        n_sites = max(1, int(n_blocks * mutate_frac / 8))
+        for start in rng.integers(0, n_blocks, n_sites):
+            length = int(rng.geometric(1 / 8))
+            snap[start : start + length] = rng.integers(0, 256, size=(min(length, n_blocks - start), block), dtype=np.uint8)
+        (out / f"snapshot_{i:04d}.img").write_bytes(snap.tobytes())
+
+
+def gen_text(out: Path, n_files: int, file_mb: int, rng) -> None:
+    words = ["the", "quick", "brown", "fox", "transfer", "gateway", "chunk", "tpu", "dedup", "stream"]
+    for i in range(n_files):
+        parts = rng.choice(words, size=(file_mb << 20) // 6)
+        (out / f"text_{i:04d}.txt").write_bytes((" ".join(parts)).encode()[: file_mb << 20])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("--profile", choices=["random", "snapshot", "text"], default="snapshot")
+    ap.add_argument("--files", type=int, default=4)
+    ap.add_argument("--file-mb", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    out = Path(args.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(args.seed)
+    {"random": gen_random, "snapshot": gen_snapshot, "text": gen_text}[args.profile](out, args.files, args.file_mb, rng)
+    total = sum(p.stat().st_size for p in out.iterdir())
+    print(f"wrote {args.files} files ({total / 1e6:.0f} MB) to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Plot per-socket receiver throughput from a gateway's profile endpoint
+(reference analog: scripts/plot_socket_profile.py).
+
+Usage: python scripts/plot_socket_profile.py http://<gateway>:8081 out.png
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import requests
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    base = sys.argv[1].rstrip("/")
+    out = sys.argv[2] if len(sys.argv) > 2 else "socket_profile.png"
+    try:
+        events = requests.get(f"{base}/api/v1/profile/socket/receiver", timeout=30).json()["events"]
+    except requests.RequestException as e:
+        print(f"error: gateway unreachable at {base}: {e}")
+        sys.exit(1)
+    if not events:
+        print("no socket profile events recorded")
+        return
+    by_port: dict = {}
+    for e in events:
+        by_port.setdefault(e["port"], []).append(e)
+    print(f"{len(events)} events across {len(by_port)} sockets")
+    for port, evs in sorted(by_port.items()):
+        total = sum(e["bytes"] for e in evs)
+        t = sum(e["time_s"] for e in evs) or 1e-9
+        print(f"  port {port}: {len(evs)} chunks, {total / 1e6:.1f} MB, {total * 8 / 1e9 / t:.2f} Gbps burst")
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(8, 4))
+        for port, evs in sorted(by_port.items()):
+            rates = [e["bytes"] * 8 / 1e9 / max(e["time_s"], 1e-9) for e in evs]
+            ax.plot(range(len(rates)), rates, marker="o", ms=2, lw=0.8, label=f"port {port}")
+        ax.set_xlabel("chunk #")
+        ax.set_ylabel("burst Gbps")
+        ax.legend(fontsize=6)
+        fig.tight_layout()
+        fig.savefig(out, dpi=120)
+        print(f"wrote {out}")
+    except ImportError:
+        print("(matplotlib not installed; text summary only)")
+
+
+if __name__ == "__main__":
+    main()

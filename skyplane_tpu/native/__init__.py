@@ -1,0 +1,64 @@
+"""Native (C++) data-path components, built on demand with g++.
+
+The compiled library is cached next to the sources; set
+``SKYPLANE_TPU_NATIVE_BUILD_DIR`` to relocate build artifacts (e.g. on
+read-only installs).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+from skyplane_tpu.exceptions import MissingDependencyException
+
+_SRC_DIR = Path(__file__).parent
+_BUILD_LOCK = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_dir() -> Path:
+    override = os.environ.get("SKYPLANE_TPU_NATIVE_BUILD_DIR")
+    return Path(override) if override else _SRC_DIR
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if needed) and load libskyfastlz."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _BUILD_LOCK:
+        if _lib is not None:
+            return _lib
+        src = _SRC_DIR / "fastlz.cpp"
+        out = _build_dir() / "libskyfastlz.so"
+        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+            out.parent.mkdir(parents=True, exist_ok=True)
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", str(src), "-o", str(out)]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+            except FileNotFoundError as e:
+                raise MissingDependencyException("native codec requires g++ in PATH") from e
+            if proc.returncode != 0:
+                # -march=native can fail in emulated environments; retry portable
+                cmd = ["g++", "-O3", "-shared", "-fPIC", str(src), "-o", str(out)]
+                proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+                if proc.returncode != 0:
+                    raise MissingDependencyException(f"native codec build failed: {proc.stderr[-2000:]}")
+        lib = ctypes.CDLL(str(out))
+        for name, restype, argtypes in (
+            ("skyfastlz_max_compressed_size", ctypes.c_uint64, [ctypes.c_uint64]),
+            ("skyfastlz_compress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
+            ("skyfastlz_decompressed_size", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64]),
+            ("skyfastlz_decompress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
+            ("skyfastlz_checksum64", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = restype
+            fn.argtypes = argtypes
+        _lib = lib
+        return _lib

@@ -1,0 +1,35 @@
+"""Python bindings for the native LZ codec (codec name ``native_lz``)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from skyplane_tpu.exceptions import CodecException
+from skyplane_tpu.native import load_library
+
+
+def compress(data: bytes) -> bytes:
+    lib = load_library()
+    cap = lib.skyfastlz_max_compressed_size(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.skyfastlz_compress(data, len(data), out, cap)
+    if n == 0:
+        raise CodecException("native_lz compression failed")
+    return out.raw[:n]
+
+
+def decompress(buf: bytes) -> bytes:
+    if len(buf) < 11 or buf[:2] != b"SL" or buf[2] != 1:
+        raise CodecException("native_lz: bad container header")
+    raw_len = int.from_bytes(buf[3:11], "little")
+    lib = load_library()
+    out = ctypes.create_string_buffer(max(raw_len, 1))
+    n = lib.skyfastlz_decompress(buf, len(buf), out, raw_len)
+    if n != raw_len:
+        raise CodecException(f"native_lz decompression failed ({n} != {raw_len})")
+    return out.raw[:raw_len]
+
+
+def checksum64(data: bytes, seed: int = 0) -> int:
+    lib = load_library()
+    return int(lib.skyfastlz_checksum64(data, len(data), seed))

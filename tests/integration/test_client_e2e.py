@@ -116,3 +116,28 @@ def test_sync_skips_unchanged(tmp_path):
         obj for obj in job2.src_iface.list_objects() if job2._post_filter_fn(obj)
     ]
     assert filtered == []  # nothing to re-copy
+
+
+@pytest.mark.slow
+def test_multicast_two_destinations(tmp_path):
+    """1 source -> 2 destination regions: mux_and fan-out, per-region dest keys,
+    completion requires BOTH destinations to land every chunk."""
+    src_root = tmp_path / "siteA"
+    d1_root = tmp_path / "siteB"
+    d2_root = tmp_path / "siteC"
+    data = _fill_bucket(src_root, n_files=2)
+    d1_root.mkdir()
+    d2_root.mkdir()
+    job = CopyJob("local:///", ["local:///b/", "local:///c/"], recursive=True)
+    job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job._dst_ifaces = [
+        POSIXInterface(str(d1_root), region_tag="local:siteB"),
+        POSIXInterface(str(d2_root), region_tag="local:siteC"),
+    ]
+    job.src_path = "local:///"
+    job.dst_paths = ["local:///", "local:///"]
+    cfg = TransferConfig(compress="zstd", dedup=False, multipart_threshold_mb=1024)
+    _run_pipeline(job, cfg)
+    for name, payload in data.items():
+        assert (d1_root / name).read_bytes() == payload, f"dest B missing/corrupt {name}"
+        assert (d2_root / name).read_bytes() == payload, f"dest C missing/corrupt {name}"

@@ -1,0 +1,77 @@
+"""Native C++ LZ codec tests."""
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.native import lz
+
+rng = np.random.default_rng(55)
+
+
+@pytest.mark.parametrize(
+    "case",
+    ["empty", "tiny", "zeros", "repeat", "random", "text", "mixed"],
+)
+def test_roundtrip(case):
+    if case == "empty":
+        data = b""
+    elif case == "tiny":
+        data = b"abc"
+    elif case == "zeros":
+        data = bytes(100_000)
+    elif case == "repeat":
+        data = b"abcdefgh" * 20_000
+    elif case == "random":
+        data = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    elif case == "text":
+        data = (b"the quick brown fox jumps over the lazy dog. " * 5000)[:180_000]
+    else:
+        data = bytes(50_000) + rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes() + b"xy" * 25_000
+    comp = lz.compress(data)
+    assert lz.decompress(comp) == data
+
+
+def test_compresses_redundant_data():
+    data = b"abcdefgh" * 20_000
+    comp = lz.compress(data)
+    assert len(comp) < len(data) // 10
+
+
+def test_random_data_bounded_expansion():
+    data = rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes()
+    comp = lz.compress(data)
+    assert len(comp) < len(data) * 1.01 + 64
+
+
+def test_corrupt_stream_rejected():
+    from skyplane_tpu.exceptions import CodecException
+
+    comp = bytearray(lz.compress(b"hello world " * 1000))
+    comp[2] ^= 0xFF  # break version byte
+    with pytest.raises(CodecException):
+        lz.decompress(bytes(comp))
+
+
+def test_truncated_stream_rejected():
+    from skyplane_tpu.exceptions import CodecException
+
+    comp = lz.compress(b"hello world " * 1000)
+    with pytest.raises(CodecException):
+        lz.decompress(comp[: len(comp) // 2])
+
+
+def test_checksum64():
+    a = lz.checksum64(b"some data")
+    b = lz.checksum64(b"some data")
+    c = lz.checksum64(b"some datb")
+    d = lz.checksum64(b"some data", seed=1)
+    assert a == b and a != c and a != d
+    assert 0 <= a < 1 << 64
+
+
+def test_codec_registry_integration():
+    from skyplane_tpu.ops.codecs import get_codec
+
+    spec = get_codec("native_lz")
+    data = b"registry " * 10_000
+    assert spec.decode(spec.encode(data)) == data
