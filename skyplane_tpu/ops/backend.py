@@ -1,0 +1,19 @@
+"""Backend selection: device kernels on accelerators, numpy on CPU backends."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_is_accelerator: Optional[bool] = None
+
+
+def on_accelerator() -> bool:
+    global _is_accelerator
+    if _is_accelerator is None:
+        try:
+            import jax
+
+            _is_accelerator = jax.devices()[0].platform not in ("cpu",)
+        except Exception:  # noqa: BLE001 - no usable jax backend => host paths
+            _is_accelerator = False
+    return _is_accelerator

@@ -104,8 +104,9 @@ def _unpack_tags(buf: bytes, n_blocks: int) -> np.ndarray:
     return t[:n_blocks]
 
 
-def encode_container(data: bytes, block_bytes: int = DEFAULT_BLOCK_BYTES, device_fn=None) -> bytes:
-    """Host entry: raw bytes -> blockpack container (device does the heavy stage)."""
+def encode_container(data: bytes, block_bytes: int = DEFAULT_BLOCK_BYTES) -> bytes:
+    """Host entry: raw bytes -> blockpack container. Runs the device kernel on
+    accelerators, the bit-identical numpy path on CPU backends."""
     n_raw = len(data)
     block_log2 = int(block_bytes).bit_length() - 1
     if (1 << block_log2) != block_bytes:
@@ -116,11 +117,17 @@ def encode_container(data: bytes, block_bytes: int = DEFAULT_BLOCK_BYTES, device
     arr = np.frombuffer(data, np.uint8)
     if pad:
         arr = np.concatenate([arr, np.zeros(pad, np.uint8)])
-    fn = device_fn or encode_device
-    tags, literals, n_lit = fn(jnp.asarray(arr), block_bytes=block_bytes)
-    tags_np = np.asarray(tags)
-    n_lit = int(n_lit)
-    lit_np = np.asarray(literals[:n_lit]) if n_lit else np.empty(0, np.uint8)
+    from skyplane_tpu.ops.backend import on_accelerator
+
+    if on_accelerator():
+        tags, literals, n_lit = encode_device(jnp.asarray(arr), block_bytes=block_bytes)
+        tags_np = np.asarray(tags)
+        n_lit = int(n_lit)
+        lit_np = np.asarray(literals[:n_lit]) if n_lit else np.empty(0, np.uint8)
+    else:
+        from skyplane_tpu.ops.host_fallback import blockpack_encode_host
+
+        tags_np, lit_np, n_lit = blockpack_encode_host(arr, block_bytes)
     header = MAGIC + struct.pack("<BBQQ", VERSION, block_log2, n_raw, n_lit)
     return header + _pack_tags(tags_np) + lit_np.tobytes()
 
@@ -143,8 +150,15 @@ def decode_container(buf: bytes) -> bytes:
     literals = np.frombuffer(buf[off + tag_bytes : off + tag_bytes + n_lit], np.uint8)
     if len(literals) != n_lit:
         raise CodecException("truncated blockpack container")
-    # device gather expects a static-size literal buffer >= any index it reads
-    lit_padded = np.zeros(max(n_padded, 1), np.uint8)
-    lit_padded[:n_lit] = literals
-    out = decode_device(jnp.asarray(tags), jnp.asarray(lit_padded), block_bytes=block_bytes)
-    return np.asarray(out)[:n_raw].tobytes()
+    from skyplane_tpu.ops.backend import on_accelerator
+
+    if on_accelerator():
+        # device gather expects a static-size literal buffer >= any index it reads
+        lit_padded = np.zeros(max(n_padded, 1), np.uint8)
+        lit_padded[:n_lit] = literals
+        out = np.asarray(decode_device(jnp.asarray(tags), jnp.asarray(lit_padded), block_bytes=block_bytes))
+    else:
+        from skyplane_tpu.ops.host_fallback import blockpack_decode_host
+
+        out = blockpack_decode_host(tags, literals, block_bytes)
+    return out[:n_raw].tobytes()

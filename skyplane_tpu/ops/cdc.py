@@ -72,13 +72,23 @@ def select_boundaries(candidates: np.ndarray, n: int, params: CDCParams) -> np.n
 
 
 def cdc_segment_ends(data: bytes | np.ndarray, params: CDCParams = CDCParams()) -> np.ndarray:
-    """Full CDC for one chunk: returns segment end offsets (last == len(data))."""
+    """Full CDC for one chunk: returns segment end offsets (last == len(data)).
+
+    Device gear hash on accelerators; bit-identical numpy on CPU backends.
+    """
     arr = np.frombuffer(data, np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) else np.asarray(data, np.uint8)
     if len(arr) == 0:
         return np.asarray([0], dtype=np.int64)
-    h = gear_hash(jnp.asarray(arr))
-    mask = boundary_candidate_mask(h, params.mask_bits)
-    candidates = np.flatnonzero(np.asarray(mask))
+    from skyplane_tpu.ops.backend import on_accelerator
+
+    if on_accelerator():
+        h = gear_hash(jnp.asarray(arr))
+        mask = np.asarray(boundary_candidate_mask(h, params.mask_bits))
+    else:
+        from skyplane_tpu.ops.host_fallback import boundary_candidates_host, gear_hash_host
+
+        mask = boundary_candidates_host(gear_hash_host(arr), params.mask_bits)
+    candidates = np.flatnonzero(mask)
     return select_boundaries(candidates, len(arr), params)
 
 

@@ -50,16 +50,23 @@ GEAR_TABLE = (splitmix64_stream(_GEAR_SEED, 256) & np.uint64(0xFFFFFFFF)).astype
 def gear_hash(data_u8: jax.Array) -> jax.Array:
     """[N] uint8 -> [N] uint32 rolling gear hash, parallel windowed-sum form.
 
-    Matches the sequential recurrence h_t = (h_{t-1} << 1) + G[b_t] for all
-    t >= 31 (earlier positions see an implicit zero-filled prefix, which only
-    suppresses boundaries in the first window — harmless for CDC).
+    Matches the sequential recurrence h_t = (h_{t-1} << 1) + G[b_t] for all t
+    (the zero-filled prefix reproduces the h_0 = 0 start). Evaluated by
+    log-doubling: with S_k(t) = sum_{i<2^k} g_{t-i} << i,
+    S_{k+1}(t) = S_k(t) + (S_k(t - 2^k) << 2^k) — 5 shifted adds instead of 31.
     """
     table = jnp.asarray(GEAR_TABLE)
     g = table[data_u8.astype(jnp.int32)]  # [N] uint32
+    return _windowed_sum_doubling(g)
+
+
+def _windowed_sum_doubling(g: jax.Array) -> jax.Array:
     h = g
-    for i in range(1, GEAR_WINDOW):
-        shifted = jnp.concatenate([jnp.zeros((i,), jnp.uint32), g[:-i]])
-        h = h + (shifted << np.uint32(i))
+    off = 1
+    while off < GEAR_WINDOW:
+        shifted = jnp.concatenate([jnp.zeros((off,), jnp.uint32), h[:-off]])
+        h = h + (shifted << np.uint32(off))
+        off <<= 1
     return h
 
 

@@ -95,7 +95,11 @@ class ProcessedPayload:
 
 @dataclass
 class DataPathStats:
-    """Cumulative sender-side accounting (feeds /profile/compression)."""
+    """Cumulative sender-side accounting (feeds /profile/compression).
+
+    observe() is called from every worker of an operator pool sharing one
+    processor, and numpy/zstd release the GIL mid-call — so updates take a
+    lock."""
 
     chunks: int = 0
     raw_bytes: int = 0
@@ -103,12 +107,18 @@ class DataPathStats:
     segments: int = 0
     ref_segments: int = 0
 
+    def __post_init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+
     def observe(self, p: ProcessedPayload) -> None:
-        self.chunks += 1
-        self.raw_bytes += p.raw_len
-        self.wire_bytes += len(p.wire_bytes)
-        self.segments += p.n_segments
-        self.ref_segments += p.n_ref_segments
+        with self._lock:
+            self.chunks += 1
+            self.raw_bytes += p.raw_len
+            self.wire_bytes += len(p.wire_bytes)
+            self.segments += p.n_segments
+            self.ref_segments += p.n_ref_segments
 
     def as_dict(self) -> dict:
         ratio = self.raw_bytes / self.wire_bytes if self.wire_bytes else 1.0
@@ -146,8 +156,22 @@ class DataPathProcessor:
 
     # ---- fingerprints ----
 
+    @staticmethod
+    def _on_accelerator() -> bool:
+        from skyplane_tpu.ops.backend import on_accelerator
+
+        return on_accelerator()
+
     def _segment_fps(self, arr: np.ndarray, ends: np.ndarray) -> List[bytes]:
-        """8-lane device fingerprints for explicit segment ends -> 16-byte digests."""
+        """8-lane segment fingerprints -> 16-byte digests.
+
+        Uses the device kernel on accelerators; on a CPU jax backend the
+        vectorized numpy host path is ~4x faster than XLA-CPU's segment_sum.
+        Both produce identical digests (tested)."""
+        if not self._on_accelerator():
+            from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
+
+            return segment_fingerprints_host_batch(arr, ends)
         n = len(arr)
         bucket = _bucket_size(n)
         padded = arr if n == bucket else np.concatenate([arr, np.zeros(bucket - n, np.uint8)])

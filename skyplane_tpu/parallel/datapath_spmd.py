@@ -61,10 +61,12 @@ def _gear_hash_halo(chunk: jax.Array, axis_name: str) -> jax.Array:
         perm=[(i, i + 1) for i in range(n_dev - 1)],
     )  # [W-1] from left neighbor; zeros on device 0
     g_ext = jnp.concatenate([halo, g])  # [n_local + W - 1]
-    h = g_ext[GEAR_WINDOW - 1 :]  # i = 0 term
-    for i in range(1, GEAR_WINDOW):
-        h = h + (g_ext[GEAR_WINDOW - 1 - i : -i] << np.uint32(i))
-    return h
+    # same doubling kernel as the unsharded path (single source of truth for
+    # the cross-host determinism contract); the first W-1 outputs are halo
+    # positions and are discarded — local positions see the full window
+    from skyplane_tpu.ops.gear import _windowed_sum_doubling
+
+    return _windowed_sum_doubling(g_ext)[GEAR_WINDOW - 1 :]
 
 
 def make_spmd_datapath(

@@ -1,0 +1,72 @@
+"""Host numpy fallbacks must be bit-identical to the device kernels."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skyplane_tpu.ops import blockpack
+from skyplane_tpu.ops.gear import gear_hash
+from skyplane_tpu.ops.host_fallback import (
+    blockpack_decode_host,
+    blockpack_encode_host,
+    boundary_candidates_host,
+    gear_hash_host,
+)
+
+rng = np.random.default_rng(77)
+
+
+def test_gear_host_matches_device():
+    data = rng.integers(0, 256, 100_000, dtype=np.uint8)
+    np.testing.assert_array_equal(gear_hash_host(data), np.asarray(gear_hash(jnp.asarray(data))))
+
+
+def test_boundary_candidates_host():
+    data = rng.integers(0, 256, 1 << 18, dtype=np.uint8)
+    h = gear_hash_host(data)
+    mask = boundary_candidates_host(h, 10)
+    rate = mask.mean()
+    assert 0.5 * 2**-10 < rate < 2 * 2**-10
+
+
+@pytest.mark.parametrize("case", ["zeros", "const", "random", "mixed"])
+def test_blockpack_host_matches_device(case):
+    n = 8192
+    block = 512
+    if case == "zeros":
+        data = np.zeros(n, np.uint8)
+    elif case == "const":
+        data = np.full(n, 0xAB, np.uint8)
+    elif case == "random":
+        data = rng.integers(0, 256, n, dtype=np.uint8)
+    else:
+        data = np.concatenate(
+            [np.zeros(block, np.uint8), np.full(block, 7, np.uint8), rng.integers(0, 256, block, dtype=np.uint8)] * 5
+        )
+        n = len(data)
+    tags_d, lit_d, n_lit_d = blockpack.encode_device(jnp.asarray(data), block_bytes=block)
+    tags_h, lit_h, n_lit_h = blockpack_encode_host(data, block)
+    np.testing.assert_array_equal(np.asarray(tags_d), tags_h)
+    assert int(n_lit_d) == n_lit_h
+    np.testing.assert_array_equal(np.asarray(lit_d[:n_lit_h]), lit_h)
+    # host decode inverts host encode
+    np.testing.assert_array_equal(blockpack_decode_host(tags_h, lit_h, block), data)
+
+
+def test_container_roundtrip_uses_host_on_cpu():
+    # conftest forces CPU backend, so these exercise the host path
+    data = rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes() + bytes(50_000)
+    assert blockpack.decode_container(blockpack.encode_container(data)) == data
+
+
+def test_batch_host_fingerprints_match_per_segment():
+    from skyplane_tpu.ops.fingerprint import segment_fingerprint_host, segment_fingerprints_host_batch
+
+    data = rng.integers(0, 256, 20_000, dtype=np.uint8)
+    ends = np.array([5000, 5017, 12_000, 20_000])
+    batch = segment_fingerprints_host_batch(data, ends)
+    start = 0
+    for i, e in enumerate(ends):
+        assert batch[i] == segment_fingerprint_host(data[start:e].tobytes())
+        start = int(e)
