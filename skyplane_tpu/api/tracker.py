@@ -73,6 +73,7 @@ class TransferProgressTracker(threading.Thread):
     # ---- main loop ----
 
     def run(self) -> None:
+        t0 = time.time()
         try:
             for job in self.jobs:
                 self._dispatch_job(job)
@@ -82,10 +83,33 @@ class TransferProgressTracker(threading.Thread):
             for job in self.jobs:
                 job.verify()
             self.hooks.on_transfer_end()
+            self._report_usage(time.time() - t0, error=None)
         except Exception as e:  # noqa: BLE001
             self.error = e
             logger.fs.error(f"[tracker] transfer failed: {e}")
             self.hooks.on_transfer_error(e)
+            self._report_usage(time.time() - t0, error=e)
+
+    def _report_usage(self, elapsed_s: float, error: Optional[Exception]) -> None:
+        """Opt-in anonymous stats on every outcome (reference: tracker.py:165-264)."""
+        try:
+            from skyplane_tpu.api.usage import UsageClient
+
+            client = UsageClient()
+            if not client.enabled:
+                return
+            size_gb = self.query_bytes_dispatched() / 1e9
+            if error is not None:
+                client.log_exception(f"{type(error).__name__}: {error}")
+            else:
+                client.log_transfer(
+                    src_region=self.dataplane.src_region_tag,
+                    dest_regions=self.dataplane.dst_region_tags,
+                    size_gb=size_gb,
+                    throughput_gbps=(size_gb * 8 / elapsed_s) if elapsed_s > 0 else 0.0,
+                )
+        except Exception as e:  # noqa: BLE001 - telemetry must never break transfers
+            logger.fs.debug(f"usage reporting failed: {e}")
 
     def _dispatch_job(self, job) -> None:
         self.hooks.on_dispatch_start()

@@ -128,7 +128,10 @@ class GatewayDaemonAPI:
         self._thread.start()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # shutdown() handshakes with serve_forever and blocks forever if the
+        # serving thread never started
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
 
     # ---- status-queue pump (called from the daemon main loop) ----

@@ -1,0 +1,57 @@
+"""IBM Cloud Object Storage backend (S3-compatible via ibm-cos-sdk).
+
+Reference parity: skyplane/obj_store/cos_interface.py (ibm_boto3 S3-like
+client). Bucket name is ``<bucket>`` with region from the service endpoint;
+credentials via IBM_API_KEY_ID / IBM_SERVICE_INSTANCE_ID env or
+~/.bluemix/cos_credentials.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skyplane_tpu.obj_store.s3_interface import S3Interface, S3Object
+
+
+class COSObject(S3Object):
+    def full_path(self) -> str:
+        return f"cos://{self.bucket}/{self.key}"
+
+
+class COSInterface(S3Interface):
+    provider = "cos"
+    object_cls = COSObject
+
+    def __init__(self, bucket_name: str, region_tag: Optional[str] = None):
+        # region comes from the factory's region tag ("cos:eu-de"), from a
+        # "<region>/<bucket>" bucket spec, or from IBM_COS_REGION
+        region = None
+        if region_tag and ":" in region_tag and not region_tag.endswith(":infer"):
+            region = region_tag.split(":", 1)[1]
+        if "/" in bucket_name:
+            region, bucket_name = bucket_name.split("/", 1)
+        super().__init__(bucket_name)
+        self._region = region or os.environ.get("IBM_COS_REGION", "us-south")
+
+    @property
+    def aws_region(self) -> str:  # reused by S3Interface plumbing
+        return self._region
+
+    def region_tag(self) -> str:
+        return f"cos:{self._region}"
+
+    def path(self) -> str:
+        return f"cos://{self._region}/{self.bucket_name}"
+
+    def _make_client(self, region: str):
+        import ibm_boto3
+        from ibm_botocore.client import Config
+
+        return ibm_boto3.client(
+            "s3",
+            ibm_api_key_id=os.environ.get("IBM_API_KEY_ID"),
+            ibm_service_instance_id=os.environ.get("IBM_SERVICE_INSTANCE_ID"),
+            config=Config(signature_version="oauth"),
+            endpoint_url=f"https://s3.{self._region}.cloud-object-storage.appdomain.cloud",
+        )

@@ -9,7 +9,6 @@ skyplane_tpu/cli/cli_transfer.py).
 from __future__ import annotations
 
 import os
-from functools import lru_cache
 from typing import Optional
 
 from skyplane_tpu.obj_store.s3_interface import S3Interface, S3Object
@@ -22,6 +21,7 @@ class R2Object(S3Object):
 
 class R2Interface(S3Interface):
     provider = "r2"
+    object_cls = R2Object
 
     def __init__(self, bucket_name: str):
         # bucket_name = "<account_id>/<bucket>"
@@ -29,14 +29,17 @@ class R2Interface(S3Interface):
         super().__init__(bucket)
         self.endpoint_url = f"https://{self.account_id}.r2.cloudflarestorage.com"
 
+    @property
+    def aws_region(self) -> str:
+        return "auto"
+
     def region_tag(self) -> str:
         return "r2:infer"
 
     def path(self) -> str:
         return f"r2://{self.account_id}/{self.bucket_name}"
 
-    @lru_cache(maxsize=1)
-    def _s3_client(self, region: Optional[str] = None):
+    def _make_client(self, region: str):
         import boto3
 
         return boto3.client(

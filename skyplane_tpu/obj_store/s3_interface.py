@@ -31,16 +31,18 @@ class S3Object(ObjectStoreObject):
 
 class S3Interface(ObjectStoreInterface):
     provider = "aws"
+    object_cls = S3Object  # subclasses (R2/COS/SCP) override for full_path()
 
     def __init__(self, bucket_name: str, requester_pays: bool = False):
         self.bucket_name = bucket_name
         self.requester_pays = requester_pays
         self._cached_region: Optional[str] = None
+        self._clients: dict = {}  # region -> client (per-instance, not lru on self)
 
     @property
     def aws_region(self) -> str:
         if self._cached_region is None:
-            client = boto3.client("s3")
+            client = self._make_client("us-east-1")
             try:
                 resp = client.get_bucket_location(Bucket=self.bucket_name)
                 self._cached_region = resp.get("LocationConstraint") or "us-east-1"
@@ -59,24 +61,29 @@ class S3Interface(ObjectStoreInterface):
     def path(self) -> str:
         return f"s3://{self.bucket_name}"
 
-    @lru_cache(maxsize=8)
+    def _make_client(self, region: str):
+        """Build the provider client; endpoint-override subclasses replace this."""
+        return boto3.client("s3", region_name=region)
+
     def _s3_client(self, region: Optional[str] = None):
-        return boto3.client("s3", region_name=region or self.aws_region)
+        region = region or self.aws_region
+        if region not in self._clients:
+            self._clients[region] = self._make_client(region)
+        return self._clients[region]
 
     def _extra_args(self) -> dict:
         return {"RequestPayer": "requester"} if self.requester_pays else {}
 
     def bucket_exists(self) -> bool:
         try:
-            client = boto3.client("s3")
-            client.head_bucket(Bucket=self.bucket_name)
+            self._make_client("us-east-1").head_bucket(Bucket=self.bucket_name)
             return True
         except botocore.exceptions.ClientError:
             return False
 
     def create_bucket(self, region_tag: str) -> None:
         region = region_tag.split(":")[-1]
-        client = boto3.client("s3", region_name=region)
+        client = self._make_client(region)
         if not self.bucket_exists():
             if region == "us-east-1":
                 client.create_bucket(Bucket=self.bucket_name)
@@ -113,9 +120,9 @@ class S3Interface(ObjectStoreInterface):
         paginator = self._s3_client().get_paginator("list_objects_v2")
         for page in paginator.paginate(Bucket=self.bucket_name, Prefix=prefix, **self._extra_args()):
             for obj in page.get("Contents", []):
-                yield S3Object(
+                yield self.object_cls(
                     key=obj["Key"],
-                    provider="aws",
+                    provider=self.provider,
                     bucket=self.bucket_name,
                     size=obj["Size"],
                     last_modified=obj["LastModified"],

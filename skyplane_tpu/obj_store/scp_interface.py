@@ -1,0 +1,53 @@
+"""Samsung Cloud Platform object storage backend.
+
+Reference parity: skyplane/obj_store/scp_interface.py (custom REST against
+the SCP object-storage API, S3-compatible data plane). Credentials via
+SCP_ACCESS_KEY / SCP_SECRET_KEY / SCP_OBS_ENDPOINT env vars; the data plane
+reuses the S3 wire protocol so the implementation subclasses S3Interface
+with an endpoint override (the reference implements raw signed REST).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skyplane_tpu.exceptions import BadConfigException
+from skyplane_tpu.obj_store.s3_interface import S3Interface, S3Object
+
+
+class SCPObject(S3Object):
+    def full_path(self) -> str:
+        return f"scp://{self.bucket}/{self.key}"
+
+
+class SCPInterface(S3Interface):
+    provider = "scp"
+    object_cls = SCPObject
+
+    def __init__(self, bucket_name: str):
+        super().__init__(bucket_name)
+        self.endpoint = os.environ.get("SCP_OBS_ENDPOINT")
+        if not self.endpoint:
+            raise BadConfigException("SCP object storage requires SCP_OBS_ENDPOINT (and SCP_ACCESS_KEY/SCP_SECRET_KEY)")
+
+    @property
+    def aws_region(self) -> str:
+        return "kr-west-1"
+
+    def region_tag(self) -> str:
+        return "scp:kr-west-1"
+
+    def path(self) -> str:
+        return f"scp://{self.bucket_name}"
+
+    def _make_client(self, region: str):
+        import boto3
+
+        return boto3.client(
+            "s3",
+            endpoint_url=self.endpoint,
+            aws_access_key_id=os.environ.get("SCP_ACCESS_KEY"),
+            aws_secret_access_key=os.environ.get("SCP_SECRET_KEY"),
+            region_name="kr-west-1",
+        )

@@ -60,6 +60,8 @@ class StorageInterface:
             "r2": ("skyplane_tpu.obj_store.r2_interface", "R2Interface", "boto3"),
             "cloudflare": ("skyplane_tpu.obj_store.r2_interface", "R2Interface", "boto3"),
             "hdfs": ("skyplane_tpu.obj_store.hdfs_interface", "HDFSInterface", "pyarrow"),
+            "cos": ("skyplane_tpu.obj_store.cos_interface", "COSInterface", "ibm-cos-sdk"),
+            "scp": ("skyplane_tpu.obj_store.scp_interface", "SCPInterface", "boto3"),
             "local": ("skyplane_tpu.obj_store.posix_file_interface", "POSIXInterface", None),
             "posix": ("skyplane_tpu.obj_store.posix_file_interface", "POSIXInterface", None),
             "file": ("skyplane_tpu.obj_store.posix_file_interface", "POSIXInterface", None),

@@ -121,15 +121,16 @@ class DataPathStats:
             self.ref_segments += p.n_ref_segments
 
     def as_dict(self) -> dict:
-        ratio = self.raw_bytes / self.wire_bytes if self.wire_bytes else 1.0
-        return {
-            "chunks": self.chunks,
-            "raw_bytes": self.raw_bytes,
-            "wire_bytes": self.wire_bytes,
-            "compression_ratio": ratio,
-            "segments": self.segments,
-            "ref_segments": self.ref_segments,
-        }
+        with self._lock:  # consistent snapshot vs concurrent observe()
+            ratio = self.raw_bytes / self.wire_bytes if self.wire_bytes else 1.0
+            return {
+                "chunks": self.chunks,
+                "raw_bytes": self.raw_bytes,
+                "wire_bytes": self.wire_bytes,
+                "compression_ratio": ratio,
+                "segments": self.segments,
+                "ref_segments": self.ref_segments,
+            }
 
 
 class DataPathProcessor:

@@ -19,6 +19,7 @@ _SCHEMES = {
     "az": "azure",
     "r2": "r2",
     "cos": "cos",
+    "scp": "scp",
     "hdfs": "hdfs",
     "local": "local",
     "file": "local",

@@ -1,0 +1,212 @@
+"""Unit tests for the gateway's completion accounting and DAG construction —
+the most bug-prone logic per SURVEY §7 (terminal-operator refcounting,
+mux_and/mux_or group semantics)."""
+
+import queue
+import threading
+import uuid
+
+import pytest
+
+from skyplane_tpu.chunk import Chunk, ChunkRequest, ChunkState
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.gateway_daemon import GatewayDaemon, _iter_program_ops
+
+
+def _req(cid=None, partition="default"):
+    return ChunkRequest(
+        chunk=Chunk(src_key="s", dest_key="d", chunk_id=cid or uuid.uuid4().hex, chunk_length_bytes=1, partition_id=partition)
+    )
+
+
+def make_api(tmp_path, terminals, handle_groups):
+    from skyplane_tpu.gateway.gateway_daemon_api import GatewayDaemonAPI
+
+    store = ChunkStore(str(tmp_path / "chunks"))
+    store.add_partition("default", __import__("skyplane_tpu.gateway.gateway_queue", fromlist=["GatewayQueue"]).GatewayQueue())
+
+    class FakeReceiver:
+        socket_profile_events = queue.Queue()
+
+        def start_server(self):
+            return 0
+
+        def stop_server(self, port):
+            return False
+
+    api = GatewayDaemonAPI(
+        chunk_store=store,
+        receiver=FakeReceiver(),
+        error_event=threading.Event(),
+        error_queue=queue.Queue(),
+        terminal_operators={"default": terminals},
+        handle_to_group={"default": handle_groups},
+        region="test:r",
+        gateway_id="gw",
+        host="127.0.0.1",
+        port=0,
+    )
+    return api, store
+
+
+class TestCompletionAccounting:
+    def test_all_terminal_groups_required(self, tmp_path):
+        api, store = make_api(tmp_path, terminals=["send_a", "send_b"], handle_groups={"send_a": "send_a", "send_b": "send_b"})
+        req = _req()
+        store.log_chunk_state(req, ChunkState.complete, "send_a")
+        api.pull_chunk_status_queue()
+        assert api.chunk_status[req.chunk.chunk_id] == "partial"
+        store.log_chunk_state(req, ChunkState.complete, "send_b")
+        api.pull_chunk_status_queue()
+        assert api.chunk_status[req.chunk.chunk_id] == "complete"
+        api.stop()
+
+    def test_non_terminal_complete_does_not_complete_chunk(self, tmp_path):
+        api, store = make_api(tmp_path, terminals=["write"], handle_groups={"write": "write"})
+        req = _req()
+        store.log_chunk_state(req, ChunkState.complete, "recv")  # non-terminal
+        api.pull_chunk_status_queue()
+        assert api.chunk_status.get(req.chunk.chunk_id) != "complete"
+        store.log_chunk_state(req, ChunkState.complete, "write")
+        api.pull_chunk_status_queue()
+        assert api.chunk_status[req.chunk.chunk_id] == "complete"
+        api.stop()
+
+    def test_or_group_any_member_completes(self, tmp_path):
+        api, store = make_api(
+            tmp_path, terminals=["grp"], handle_groups={"send_1": "grp", "send_2": "grp"}
+        )
+        req = _req()
+        store.log_chunk_state(req, ChunkState.complete, "send_1")
+        api.pull_chunk_status_queue()
+        assert api.chunk_status[req.chunk.chunk_id] == "complete"
+        api.stop()
+
+    def test_failed_state_recorded(self, tmp_path):
+        api, store = make_api(tmp_path, terminals=["w"], handle_groups={"w": "w"})
+        req = _req()
+        store.log_chunk_state(req, ChunkState.failed, "w")
+        api.pull_chunk_status_queue()
+        assert api.chunk_status[req.chunk.chunk_id] == "failed"
+        api.stop()
+
+    def test_gc_removes_staged_files_on_completion(self, tmp_path):
+        api, store = make_api(tmp_path, terminals=["w"], handle_groups={"w": "w"})
+        req = _req()
+        p = store.chunk_path(req.chunk.chunk_id)
+        p.write_bytes(b"x")
+        p.with_suffix(".done").touch()
+        store.log_chunk_state(req, ChunkState.complete, "w")
+        api.pull_chunk_status_queue()
+        assert not p.exists() and not p.with_suffix(".done").exists()
+        api.stop()
+
+
+class TestDaemonDagConstruction:
+    def _daemon(self, tmp_path, program, **kw):
+        return GatewayDaemon(
+            region="local:x",
+            chunk_dir=str(tmp_path / "c"),
+            gateway_program=program,
+            gateway_info={"peer": {"public_ip": "127.0.0.1", "control_port": 1}},
+            gateway_id="gw",
+            control_port=0,
+            bind_host="127.0.0.1",
+            use_tls=False,
+            **kw,
+        )
+
+    def test_mux_and_children_each_terminal_group(self, tmp_path):
+        program = {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "read_local",
+                            "handle": "read",
+                            "children": [
+                                {
+                                    "op_type": "mux_and",
+                                    "handle": "fan",
+                                    "children": [
+                                        {"op_type": "write_local", "handle": "w1", "children": []},
+                                        {"op_type": "write_local", "handle": "w2", "children": []},
+                                    ],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+        d = self._daemon(tmp_path, program)
+        assert sorted(d.terminal_operators["default"]) == ["w1", "w2"]
+        d.api.stop()
+
+    def test_mux_or_children_share_group(self, tmp_path):
+        program = {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "read_local",
+                            "handle": "read",
+                            "children": [
+                                {
+                                    "op_type": "mux_or",
+                                    "handle": "lb",
+                                    "children": [
+                                        {"op_type": "write_local", "handle": "w1", "children": []},
+                                        {"op_type": "write_local", "handle": "w2", "children": []},
+                                    ],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+        d = self._daemon(tmp_path, program)
+        assert d.terminal_operators["default"] == ["lb"]
+        assert d.handle_to_group["default"] == {"w1": "lb", "w2": "lb"}
+        d.api.stop()
+
+    def test_mixed_relay_and_decode_rejected(self, tmp_path):
+        program = {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "receive",
+                            "handle": "r1",
+                            "children": [
+                                {"op_type": "send", "handle": "fwd", "target_gateway_id": "peer", "region": "x", "children": []}
+                            ],
+                        },
+                        {
+                            "op_type": "receive",
+                            "handle": "r2",
+                            "children": [{"op_type": "write_local", "handle": "w", "children": []}],
+                        },
+                    ],
+                }
+            ]
+        }
+        with pytest.raises(ValueError, match="relay"):
+            self._daemon(tmp_path, program)
+
+    def test_iter_program_ops(self):
+        program = {
+            "plan": [
+                {
+                    "partitions": ["p"],
+                    "value": [
+                        {"op_type": "a", "children": [{"op_type": "b", "children": [{"op_type": "c", "children": []}]}]}
+                    ],
+                }
+            ]
+        }
+        assert sorted(op["op_type"] for op in _iter_program_ops(program)) == ["a", "b", "c"]
