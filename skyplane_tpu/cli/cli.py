@@ -74,6 +74,58 @@ def deprovision():
 
 
 @main.group()
+def cloud():
+    """Bucket and VM administration."""
+
+
+def _run_cloud_cmd(fn, *args):
+    from skyplane_tpu.exceptions import SkyplaneTpuException
+
+    try:
+        sys.exit(fn(*args))
+    except SkyplaneTpuException as e:
+        raise click.ClickException(str(e)) from e
+
+
+@cloud.command("ls")
+@click.argument("path")
+def cloud_ls(path):
+    """List objects: skyplane-tpu cloud ls s3://bucket/prefix"""
+    from skyplane_tpu.cli.cli_cloud import run_ls
+
+    _run_cloud_cmd(run_ls, path)
+
+
+@cloud.command("mb")
+@click.argument("path")
+@click.option("--region", default=None, help="cloud region for the new bucket (e.g. us-east-1)")
+def cloud_mb(path, region):
+    """Create a bucket."""
+    from skyplane_tpu.cli.cli_cloud import run_mb
+
+    _run_cloud_cmd(run_mb, path, region)
+
+
+@cloud.command("rm")
+@click.argument("path")
+@click.option("-r", "--recursive", is_flag=True)
+def cloud_rm(path, recursive):
+    """Delete objects."""
+    from skyplane_tpu.cli.cli_cloud import run_rm
+
+    _run_cloud_cmd(run_rm, path, recursive)
+
+
+@main.command()
+@click.option("--index", default=0, help="gateway index to connect to")
+def ssh(index):
+    """SSH into a running gateway VM."""
+    from skyplane_tpu.cli.cli_cloud import run_ssh
+
+    sys.exit(run_ssh(index))
+
+
+@main.group()
 def config():
     """Get or set configuration flags."""
 

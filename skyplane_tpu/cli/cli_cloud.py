@@ -14,6 +14,85 @@ from skyplane_tpu.utils import do_parallel
 console = Console()
 
 
+def run_ls(path: str) -> int:
+    """List objects under a bucket/prefix URI."""
+    from skyplane_tpu.obj_store.storage_interface import StorageInterface
+    from skyplane_tpu.utils.path import parse_path
+
+    provider, bucket, prefix = parse_path(path)
+    iface = StorageInterface.create(f"{provider}:infer", bucket)
+    n = 0
+    for obj in iface.list_objects(prefix=prefix):
+        console.print(f"{(obj.size or 0):>14,}  {obj.last_modified}  {obj.key}")
+        n += 1
+        if n >= 10_000:
+            console.print("[yellow]... truncated at 10k objects[/yellow]")
+            break
+    console.print(f"[bold]{n} objects[/bold]")
+    return 0
+
+
+def run_mb(path: str, region: str = None) -> int:
+    """Create a bucket: skyplane-tpu cloud mb s3://name --region us-east-1."""
+    from skyplane_tpu.exceptions import BadConfigException
+    from skyplane_tpu.obj_store.storage_interface import StorageInterface
+    from skyplane_tpu.utils.path import parse_path
+
+    provider, bucket, _ = parse_path(path)
+    if region is None and provider not in ("local", "posix", "file", "azure", "cos", "r2"):
+        raise BadConfigException(f"creating a {provider} bucket requires --region (e.g. --region us-east-1)")
+    region_tag = f"{provider}:{region}" if region else f"{provider}:infer"
+    iface = StorageInterface.create(region_tag, bucket)
+    iface.create_bucket(region_tag)
+    console.print(f"created {path}")
+    return 0
+
+
+def run_rm(path: str, recursive: bool = False) -> int:
+    """Delete object(s) under a URI."""
+    from skyplane_tpu.obj_store.storage_interface import StorageInterface
+    from skyplane_tpu.utils.path import parse_path
+
+    provider, bucket, key = parse_path(path)
+    iface = StorageInterface.create(f"{provider}:infer", bucket)
+    if recursive:
+        keys = [o.key for o in iface.list_objects(prefix=key)]
+    else:
+        keys = [key]
+    iface.delete_objects(keys)
+    console.print(f"deleted {len(keys)} objects")
+    return 0
+
+
+def run_ssh(gateway_index: int = 0) -> int:
+    """Interactive SSH into a running gateway (reference: cli/cli.py:76-97)."""
+    import os
+
+    from skyplane_tpu.compute.cloud_provider import get_cloud_provider
+    from skyplane_tpu.exceptions import MissingDependencyException
+
+    candidates = []
+    for provider_name in ("aws", "gcp", "azure"):
+        if not getattr(cloud_config, f"{provider_name}_enabled", False):
+            continue
+        try:
+            candidates += get_cloud_provider(provider_name).get_matching_instances()
+        except (MissingDependencyException, NotImplementedError):
+            continue
+    if not candidates:
+        console.print("[yellow]no running gateways found[/yellow]")
+        return 1
+    if not (0 <= gateway_index < len(candidates)):
+        console.print(f"[red]--index {gateway_index} out of range (found {len(candidates)} gateways)[/red]")
+        return 1
+    for i, s in enumerate(candidates):
+        marker = "->" if i == gateway_index else "  "
+        console.print(f"{marker} [{i}] {s.region_tag} {s.instance_id} {s.public_ip()}")
+    server = candidates[gateway_index]
+    os.execvp("ssh", ["ssh", "-i", server.key_path, f"{server.user}@{server.host}"])
+    return 0  # unreachable
+
+
 def run_deprovision() -> int:
     """Find and terminate all tagged skyplane-tpu instances across enabled clouds."""
     from skyplane_tpu.compute.cloud_provider import get_cloud_provider

@@ -71,6 +71,26 @@ def run_transfer(
     max_instances = max_instances or cloud_config.get_flag("max_instances")
     solver = _pick_solver(solver, src_provider, [p for p, _, _ in dst_parsed])
 
+    # local<->local and local<->cloud single-destination transfers delegate to
+    # native tools (rsync / vendor CLIs) when available — provisioning gateways
+    # for a laptop copy wastes minutes (reference: cli_transfer.py:146-196).
+    # Explicit --compress/--dedup means the user wants the gateway data path.
+    if (
+        len(dsts) == 1
+        and compress is None
+        and dedup is None
+        and cloud_config.get_flag("native_cmd_enabled")
+        and "local" in (src_provider, dst_parsed[0][0])
+    ):
+        from skyplane_tpu.cli.impl.cp_replicate_fallback import fallback_cmd
+
+        cmd = fallback_cmd(src, dsts[0], recursive, sync)
+        if cmd is not None:
+            import subprocess
+
+            console.print(f"[dim]delegating to native tool: {' '.join(cmd)}[/dim]")
+            return subprocess.run(cmd).returncode
+
     pipeline = Pipeline(planning_algorithm=solver, max_instances=max_instances, transfer_config=transfer_config)
     for dst in dsts:
         if sync:

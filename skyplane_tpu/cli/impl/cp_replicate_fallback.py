@@ -1,0 +1,66 @@
+"""Native-tool command builders for fallback transfers.
+
+Reference parity: skyplane/cli/impl/cp_replicate_fallback.py:49-140 —
+local<->cloud paths and small transfers delegate to the cloud vendors' own
+CLIs (aws s3 cp/sync, gsutil, azcopy, rsync) instead of provisioning
+gateways.
+"""
+
+from __future__ import annotations
+
+import shutil
+from typing import List, Optional
+
+from skyplane_tpu.utils.path import parse_path
+
+
+def _has(tool: str) -> bool:
+    return shutil.which(tool) is not None
+
+
+def fallback_cmd(src: str, dst: str, recursive: bool, sync: bool) -> Optional[List[str]]:
+    """Build a native CLI command for this transfer, or None if no tool fits."""
+    sp, sb, sk = parse_path(src)
+    dp, db, dk = parse_path(dst)
+    providers = {sp, dp}
+
+    def local_path(provider, key):
+        return "/" + key if provider == "local" else None
+
+    if providers <= {"local"}:
+        tool = "rsync" if _has("rsync") else "cp"
+        if tool == "rsync":
+            flags = ["-a"] if recursive or sync else []
+            return ["rsync", *flags, local_path(sp, sk), local_path(dp, dk)]
+        return ["cp", *( ["-r"] if recursive else []), local_path(sp, sk), local_path(dp, dk)]
+
+    if providers <= {"local", "aws", "s3"} and _has("aws"):
+        verb = "sync" if sync else "cp"
+        s = local_path(sp, sk) or f"s3://{sb}/{sk}"
+        d = local_path(dp, dk) or f"s3://{db}/{dk}"
+        args = ["aws", "s3", verb, s, d]
+        if recursive and not sync:
+            args.append("--recursive")
+        return args
+
+    if providers <= {"local", "gcp", "gs"} and (_has("gcloud") or _has("gsutil")):
+        s = local_path(sp, sk) or f"gs://{sb}/{sk}"
+        d = local_path(dp, dk) or f"gs://{db}/{dk}"
+        if _has("gcloud"):
+            verb = ["storage", "rsync" if sync else "cp"]
+            flags = ["-r"] if (recursive or sync) else []
+            return ["gcloud", *verb, *flags, s, d]
+        verb = "rsync" if sync else "cp"
+        flags = ["-r"] if (recursive or sync) else []
+        return ["gsutil", "-m", verb, *flags, s, d]
+
+    if providers <= {"local", "azure"} and _has("azcopy"):
+        s = local_path(sp, sk) or f"https://{sb.split('/')[0]}.blob.core.windows.net/{sb.split('/', 1)[-1]}/{sk}"
+        d = local_path(dp, dk) or f"https://{db.split('/')[0]}.blob.core.windows.net/{db.split('/', 1)[-1]}/{dk}"
+        verb = "sync" if sync else "copy"
+        args = ["azcopy", verb, s, d]
+        if recursive and not sync:
+            args.append("--recursive")
+        return args
+
+    return None

@@ -39,19 +39,22 @@ def parse_path(path: str) -> Tuple[str, str, str]:
             # POSIX "bucket" is the filesystem root; keys are root-relative so
             # they line up with POSIXInterface.list_objects output
             return "local", "/", rest.lstrip("/")
-        if provider == "azure":
-            # azure://<storage_account>/<container>/<key>
+        if provider in ("azure", "cos", "r2"):
+            # two-component buckets: azure://account/container/key,
+            # cos://region/bucket/key, r2://account/bucket/key
             parts = rest.split("/", 2)
-            if len(parts) < 2:
-                raise BadConfigException(f"azure path must be azure://account/container[/key]: {path!r}")
-            account, container = parts[0], parts[1]
+            if len(parts) < 2 or not parts[0] or not parts[1]:
+                raise BadConfigException(f"{provider} path must be {provider}://<x>/<bucket>[/key]: {path!r}")
             key = parts[2] if len(parts) > 2 else ""
-            return "azure", f"{account}/{container}", key
+            return provider, f"{parts[0]}/{parts[1]}", key
         parts = rest.split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         if not bucket:
             raise BadConfigException(f"missing bucket in {path!r}")
         return provider, bucket, key
-    # bare filesystem path
-    return "local", "/", path.lstrip("/")
+    # bare filesystem path: resolve relative paths so root-relative keys are
+    # unambiguous (consumers rebuild the path as "/" + key)
+    import os as _os
+
+    return "local", "/", _os.path.abspath(path).lstrip("/")

@@ -1,0 +1,54 @@
+"""Native-CLI fallback command builder tests (reference model:
+cp_replicate_fallback command construction)."""
+
+from unittest import mock
+
+import pytest
+
+from skyplane_tpu.cli.impl.cp_replicate_fallback import fallback_cmd
+
+
+def _with_tools(*tools):
+    return mock.patch(
+        "skyplane_tpu.cli.impl.cp_replicate_fallback._has", side_effect=lambda t: t in tools
+    )
+
+
+def test_local_to_s3_uses_aws_cli():
+    with _with_tools("aws"):
+        cmd = fallback_cmd("local:///data/dir/", "s3://bucket/prefix/", recursive=True, sync=False)
+    assert cmd[:3] == ["aws", "s3", "cp"]
+    assert "--recursive" in cmd and "/data/dir/" in cmd and "s3://bucket/prefix/" in cmd
+
+
+def test_s3_to_local_sync():
+    with _with_tools("aws"):
+        cmd = fallback_cmd("s3://b/k/", "local:///out/", recursive=True, sync=True)
+    assert cmd[:3] == ["aws", "s3", "sync"]
+    assert "--recursive" not in cmd  # sync is inherently recursive
+
+
+def test_gs_prefers_gcloud_then_gsutil():
+    with _with_tools("gcloud"):
+        cmd = fallback_cmd("local:///d/", "gs://b/", recursive=True, sync=False)
+    assert cmd[:3] == ["gcloud", "storage", "cp"]
+    with _with_tools("gsutil"):
+        cmd = fallback_cmd("local:///d/", "gs://b/", recursive=True, sync=False)
+    assert cmd[:2] == ["gsutil", "-m"]
+
+
+def test_azure_uses_azcopy():
+    with _with_tools("azcopy"):
+        cmd = fallback_cmd("azure://acct/cont/k", "local:///out", recursive=False, sync=False)
+    assert cmd[0] == "azcopy" and cmd[1] == "copy"
+    assert "acct.blob.core.windows.net" in cmd[2]
+
+
+def test_no_tool_returns_none():
+    with _with_tools():
+        assert fallback_cmd("local:///d/", "s3://b/", recursive=True, sync=False) is None
+
+
+def test_cross_cloud_not_delegated():
+    with _with_tools("aws", "gcloud"):
+        assert fallback_cmd("s3://a/", "gs://b/", recursive=True, sync=False) is None

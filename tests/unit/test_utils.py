@@ -59,7 +59,9 @@ def test_timer():
         ("gs://b/k", ("gs", "b", "k")),
         ("gcs://b/", ("gs", "b", "")),
         ("azure://acct/container/key", ("azure", "acct/container", "key")),
-        ("r2://accountid/bucket", ("r2", "accountid", "bucket")),
+        ("r2://accountid/bucket", ("r2", "accountid/bucket", "")),
+        ("r2://accountid/bucket/some/key", ("r2", "accountid/bucket", "some/key")),
+        ("cos://eu-de/bucket/k", ("cos", "eu-de/bucket", "k")),
         ("local:///tmp/x", ("local", "/", "tmp/x")),
         ("/tmp/y", ("local", "/", "tmp/y")),
         ("hdfs://namenode/path", ("hdfs", "namenode", "path")),
