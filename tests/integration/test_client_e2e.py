@@ -141,3 +141,30 @@ def test_multicast_two_destinations(tmp_path):
     for name, payload in data.items():
         assert (d1_root / name).read_bytes() == payload, f"dest B missing/corrupt {name}"
         assert (d2_root / name).read_bytes() == payload, f"dest C missing/corrupt {name}"
+
+
+@pytest.mark.slow
+def test_multi_instance_scale_out(tmp_path):
+    """max_instances=2: two source + two destination gateways, chunk batches
+    round-robined to the least-loaded source, mux_or connection splitting
+    (reference test matrix: multi-VM case, tests/integration/test_cp.py)."""
+    src_root = tmp_path / "siteA"
+    dst_root = tmp_path / "siteB"
+    data = _fill_bucket(src_root, n_files=4, size=192 * 1024)
+    dst_root.mkdir()
+    job = CopyJob("local:///", ["local:///"], recursive=True)
+    job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+    job.src_path = "local:///"
+    job.dst_paths = ["local:///"]
+    cfg = TransferConfig(compress="zstd", dedup=False, multipart_threshold_mb=1024, num_connections=4)
+    pipe = Pipeline(transfer_config=cfg, max_instances=2)
+    pipe.jobs_to_dispatch.append(job)
+    dp = pipe.create_dataplane()
+    assert len(dp.topology.source_gateways()) == 2
+    assert len(dp.topology.sink_gateways()) == 2
+    with dp.auto_deprovision():
+        dp.provision()
+        dp.run([job])
+    for name, payload in data.items():
+        assert (dst_root / name).read_bytes() == payload
