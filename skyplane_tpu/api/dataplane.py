@@ -173,8 +173,10 @@ class Dataplane:
         tracker.start()
         return tracker
 
-    def run(self, jobs: List, hooks=None) -> None:
+    def run(self, jobs: List, hooks=None):
+        """Blocking run; returns the finished tracker (for transfer_stats)."""
         tracker = self.run_async(jobs, hooks)
         tracker.join()
         if tracker.error:
             raise tracker.error
+        return tracker

@@ -56,8 +56,17 @@ class Pipeline:
         topology = self.planner().plan(self.jobs_to_dispatch)
         return Dataplane(topology, self.provisioner, self.transfer_config, debug=debug or self.debug)
 
-    def start(self, debug: bool = False, progress: bool = False, hooks: Optional[TransferHook] = None) -> None:
-        """Provision, run all queued jobs, deprovision (reference :91-128)."""
+    def start(
+        self,
+        debug: bool = False,
+        progress: bool = False,
+        hooks: Optional[TransferHook] = None,
+        stats_out: Optional[dict] = None,
+    ) -> None:
+        """Provision, run all queued jobs, deprovision (reference :91-128).
+
+        ``stats_out``, if given, receives {"stats": <transfer stats dict>}
+        after a successful run (collected before deprovisioning)."""
         dp = self.create_dataplane(debug)
         with dp.auto_deprovision():
             dp.provision(spinner=progress)
@@ -65,7 +74,9 @@ class Pipeline:
                 from skyplane_tpu.cli.impl.progress_bar import ProgressBarTransferHook
 
                 hooks = ProgressBarTransferHook(dp.topology.dest_region_tags)
-            dp.run(self.jobs_to_dispatch, hooks)
+            tracker = dp.run(self.jobs_to_dispatch, hooks)
+            if stats_out is not None:
+                stats_out["stats"] = tracker.transfer_stats
         self.jobs_to_dispatch.clear()
 
     def estimate_total_cost(self) -> float:

@@ -53,6 +53,7 @@ class TransferProgressTracker(threading.Thread):
         self.dispatched_chunk_ids: List[str] = []
         self.chunk_sizes: Dict[str, int] = {}
         self.complete_chunk_ids: Set[str] = set()
+        self.transfer_stats: Optional[dict] = None  # filled on success
         self._lock = threading.Lock()
 
     # ---- queries (reference: tracker.py:372-399) ----
@@ -82,6 +83,10 @@ class TransferProgressTracker(threading.Thread):
                 job.finalize()
             for job in self.jobs:
                 job.verify()
+            try:
+                self.transfer_stats = self._collect_transfer_stats(time.time() - t0)
+            except Exception as e:  # noqa: BLE001 - stats must never fail a delivered transfer
+                logger.fs.warning(f"[tracker] stats collection failed: {e}")
             self.hooks.on_transfer_end()
             self._report_usage(time.time() - t0, error=None)
         except Exception as e:  # noqa: BLE001
@@ -89,6 +94,37 @@ class TransferProgressTracker(threading.Thread):
             logger.fs.error(f"[tracker] transfer failed: {e}")
             self.hooks.on_transfer_error(e)
             self._report_usage(time.time() - t0, error=e)
+
+    def _collect_transfer_stats(self, elapsed_s: float) -> dict:
+        """Aggregate data-path stats from source gateways' compression profile
+        (reference surface: GET /profile/compression)."""
+        logical = self.query_bytes_dispatched()
+        stats = {
+            "seconds": round(elapsed_s, 2),
+            "logical_bytes": logical,
+            "effective_gbps": round(logical * 8 / 1e9 / elapsed_s, 4) if elapsed_s > 0 else 0.0,
+        }
+        from skyplane_tpu.utils import do_parallel
+
+        def poll(gw):
+            try:
+                prof = requests.get(f"{gw.control_url()}/profile/compression", timeout=5).json()
+                return prof if isinstance(prof, dict) else {}
+            except requests.RequestException:
+                return {}
+
+        profiles = [p for _, p in do_parallel(poll, self.dataplane.source_gateways(), n=16)]
+        wire = sum(p.get("wire_bytes", 0) for p in profiles)
+        raw = sum(p.get("raw_bytes", 0) for p in profiles)
+        refs = sum(p.get("ref_segments", 0) for p in profiles)
+        segs = sum(p.get("segments", 0) for p in profiles)
+        if raw:
+            stats.update(
+                wire_bytes=wire,
+                compression_ratio=round(raw / max(wire, 1), 2),
+                dedup_segments=f"{refs}/{segs}",
+            )
+        return stats
 
     def _report_usage(self, elapsed_s: float, error: Optional[Exception]) -> None:
         """Opt-in anonymous stats on every outcome (reference: tracker.py:165-264)."""

@@ -128,8 +128,15 @@ def run_transfer(
         return 1
 
     try:
-        pipeline.start(debug=debug, progress=True)
+        stats_box: dict = {}
+        pipeline.start(debug=debug, progress=True, stats_out=stats_box)
         console.print("[bold green]Transfer complete.[/bold green]")
+        s = stats_box.get("stats")
+        if s:
+            line = f"  {s['logical_bytes'] / 1e9:.2f} GB in {s['seconds']}s ({s['effective_gbps']} Gbps effective)"
+            if "compression_ratio" in s:
+                line += f" · wire reduction {s['compression_ratio']}x · dedup {s.get('dedup_segments', '-')}"
+            console.print(line)
         return 0
     except KeyboardInterrupt:
         console.print("[red]Interrupted — deprovisioning gateways[/red]")

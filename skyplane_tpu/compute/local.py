@@ -93,9 +93,12 @@ class LocalServer(Server):
         repo_root = str(Path(__file__).resolve().parents[2])
         env["PYTHONPATH"] = repo_root + (os.pathsep + env["PYTHONPATH"] if env["PYTHONPATH"] else "")
         # local gateways run kernels on CPU: N subprocesses sharing one real
-        # TPU tunnel would serialize (or wedge) on the chip
+        # TPU tunnel would serialize (or wedge) on the chip. Both the env var
+        # AND the daemon-side config pin are needed — sitecustomize-injected
+        # jax plugins import jax before our code runs.
         env.setdefault("SKYPLANE_LOCAL_GATEWAY_PLATFORM", "cpu")
         env["JAX_PLATFORMS"] = env["SKYPLANE_LOCAL_GATEWAY_PLATFORM"]
+        env["SKYPLANE_GATEWAY_JAX_PLATFORM"] = env["SKYPLANE_LOCAL_GATEWAY_PLATFORM"]
         log_file = open(self.workdir / "daemon.log", "w")
         self.proc = subprocess.Popen(args, stdout=log_file, stderr=subprocess.STDOUT, env=env)
         self.wait_for_gateway_ready()

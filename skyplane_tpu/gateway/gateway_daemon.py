@@ -324,6 +324,18 @@ class GatewayDaemon:
 
 
 def main(argv=None) -> None:
+    # Pin the jax platform BEFORE any kernel work: environments that inject a
+    # jax plugin via sitecustomize (e.g. the axon TPU tunnel) read
+    # JAX_PLATFORMS at interpreter start, so the env var alone cannot force a
+    # different backend — the live config must be updated too (same dance as
+    # tests/conftest.py). SKYPLANE_GATEWAY_JAX_PLATFORM=cpu makes a gateway
+    # run host/CPU kernels even on accelerator-equipped machines.
+    platform = os.environ.get("SKYPLANE_GATEWAY_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
     parser = argparse.ArgumentParser(description="skyplane_tpu gateway daemon")
     parser.add_argument("--region", default=os.environ.get("SKYPLANE_REGION", "local:local"))
     parser.add_argument("--chunk-dir", default=os.environ.get("SKYPLANE_CHUNK_DIR", "/tmp/skyplane_tpu/chunks"))

@@ -168,3 +168,31 @@ def test_multi_instance_scale_out(tmp_path):
         dp.run([job])
     for name, payload in data.items():
         assert (dst_root / name).read_bytes() == payload
+
+
+@pytest.mark.slow
+def test_cross_site_dedup_through_subprocess_daemons(tmp_path):
+    """Regression: dedup (which touches jax.devices() in the daemon) must work
+    in SUBPROCESS gateways, where sitecustomize-injected jax plugins ignore
+    the JAX_PLATFORMS env var — the daemon pins the platform via jax config
+    (SKYPLANE_GATEWAY_JAX_PLATFORM)."""
+    import numpy as _np
+
+    src_root = tmp_path / "siteA"
+    dst_root = tmp_path / "siteB"
+    src_root.mkdir()
+    dst_root.mkdir()
+    pat = _np.random.default_rng(5).integers(0, 256, 1 << 19, dtype=_np.uint8).tobytes()
+    payload = pat * 4 + bytes(1 << 19)
+    (src_root / "f.bin").write_bytes(payload)
+    job = CopyJob("local:///", ["local:///"], recursive=True)
+    job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+    job.src_path = "local:///"
+    job.dst_paths = ["local:///"]
+    pipe = Pipeline(transfer_config=TransferConfig(compress="zstd", dedup=True, multipart_threshold_mb=1024))
+    pipe.jobs_to_dispatch.append(job)
+    box = {}
+    pipe.start(stats_out=box)
+    assert (dst_root / "f.bin").read_bytes() == payload
+    assert box["stats"].get("compression_ratio", 0) > 1.5, box["stats"]
