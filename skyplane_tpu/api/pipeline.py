@@ -61,12 +61,12 @@ class Pipeline:
         debug: bool = False,
         progress: bool = False,
         hooks: Optional[TransferHook] = None,
-        stats_out: Optional[dict] = None,
-    ) -> None:
+    ) -> Optional[dict]:
         """Provision, run all queued jobs, deprovision (reference :91-128).
 
-        ``stats_out``, if given, receives {"stats": <transfer stats dict>}
-        after a successful run (collected before deprovisioning)."""
+        Returns the transfer stats dict (effective Gbps, wire reduction,
+        dedup counts) collected before deprovisioning, or None if stats
+        collection failed."""
         dp = self.create_dataplane(debug)
         with dp.auto_deprovision():
             dp.provision(spinner=progress)
@@ -75,9 +75,9 @@ class Pipeline:
 
                 hooks = ProgressBarTransferHook(dp.topology.dest_region_tags)
             tracker = dp.run(self.jobs_to_dispatch, hooks)
-            if stats_out is not None:
-                stats_out["stats"] = tracker.transfer_stats
+            stats = tracker.transfer_stats
         self.jobs_to_dispatch.clear()
+        return stats
 
     def estimate_total_cost(self) -> float:
         """$ estimate = egress $/GB x total GB (reference :177-187)."""

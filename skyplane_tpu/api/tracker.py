@@ -76,6 +76,10 @@ class TransferProgressTracker(threading.Thread):
     def run(self) -> None:
         t0 = time.time()
         try:
+            # gateway compression profiles are daemon-lifetime cumulative; a
+            # baseline snapshot makes the final stats per-run even when a
+            # dataplane is reused for several runs
+            self._profile_baseline = self._poll_profiles()
             for job in self.jobs:
                 self._dispatch_job(job)
             self._monitor_to_completion()
@@ -95,15 +99,8 @@ class TransferProgressTracker(threading.Thread):
             self.hooks.on_transfer_error(e)
             self._report_usage(time.time() - t0, error=e)
 
-    def _collect_transfer_stats(self, elapsed_s: float) -> dict:
-        """Aggregate data-path stats from source gateways' compression profile
-        (reference surface: GET /profile/compression)."""
-        logical = self.query_bytes_dispatched()
-        stats = {
-            "seconds": round(elapsed_s, 2),
-            "logical_bytes": logical,
-            "effective_gbps": round(logical * 8 / 1e9 / elapsed_s, 4) if elapsed_s > 0 else 0.0,
-        }
+    def _poll_profiles(self) -> dict:
+        """Summed source-gateway compression counters (parallel best-effort)."""
         from skyplane_tpu.utils import do_parallel
 
         def poll(gw):
@@ -114,10 +111,27 @@ class TransferProgressTracker(threading.Thread):
                 return {}
 
         profiles = [p for _, p in do_parallel(poll, self.dataplane.source_gateways(), n=16)]
-        wire = sum(p.get("wire_bytes", 0) for p in profiles)
-        raw = sum(p.get("raw_bytes", 0) for p in profiles)
-        refs = sum(p.get("ref_segments", 0) for p in profiles)
-        segs = sum(p.get("segments", 0) for p in profiles)
+        return {
+            key: sum(p.get(key, 0) for p in profiles)
+            for key in ("wire_bytes", "raw_bytes", "ref_segments", "segments")
+        }
+
+    def _collect_transfer_stats(self, elapsed_s: float) -> dict:
+        """Aggregate data-path stats from source gateways' compression profile
+        (reference surface: GET /profile/compression), as a per-run delta
+        against the baseline snapshot taken at run start."""
+        logical = self.query_bytes_dispatched()
+        stats = {
+            "seconds": round(elapsed_s, 2),
+            "logical_bytes": logical,
+            "effective_gbps": round(logical * 8 / 1e9 / elapsed_s, 4) if elapsed_s > 0 else 0.0,
+        }
+        totals = self._poll_profiles()
+        baseline = getattr(self, "_profile_baseline", {})
+        wire = totals["wire_bytes"] - baseline.get("wire_bytes", 0)
+        raw = totals["raw_bytes"] - baseline.get("raw_bytes", 0)
+        refs = totals["ref_segments"] - baseline.get("ref_segments", 0)
+        segs = totals["segments"] - baseline.get("segments", 0)
         if raw:
             stats.update(
                 wire_bytes=wire,

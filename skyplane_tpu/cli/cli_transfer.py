@@ -128,10 +128,8 @@ def run_transfer(
         return 1
 
     try:
-        stats_box: dict = {}
-        pipeline.start(debug=debug, progress=True, stats_out=stats_box)
+        s = pipeline.start(debug=debug, progress=True)
         console.print("[bold green]Transfer complete.[/bold green]")
-        s = stats_box.get("stats")
         if s:
             line = f"  {s['logical_bytes'] / 1e9:.2f} GB in {s['seconds']}s ({s['effective_gbps']} Gbps effective)"
             if "compression_ratio" in s:

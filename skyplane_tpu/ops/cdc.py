@@ -71,25 +71,33 @@ def select_boundaries(candidates: np.ndarray, n: int, params: CDCParams) -> np.n
     return np.asarray(ends, dtype=np.int64)
 
 
-def cdc_segment_ends(data: bytes | np.ndarray, params: CDCParams = CDCParams()) -> np.ndarray:
+def cdc_segment_ends(
+    data: bytes | np.ndarray, params: CDCParams = CDCParams(), device_chunk=None
+) -> np.ndarray:
     """Full CDC for one chunk: returns segment end offsets (last == len(data)).
 
     Device gear hash on accelerators; bit-identical numpy on CPU backends.
+    ``device_chunk``, if given, is the chunk already uploaded to the device
+    (possibly zero-padded past len(data)) — callers that also fingerprint on
+    device pass it to avoid a second H2D of the same bytes. Trailing padding
+    cannot change boundaries: the mask is truncated to len(data) and gear
+    positions only look backward.
     """
     arr = np.frombuffer(data, np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) else np.asarray(data, np.uint8)
-    if len(arr) == 0:
+    n = len(arr)
+    if n == 0:
         return np.asarray([0], dtype=np.int64)
     from skyplane_tpu.ops.backend import on_accelerator
 
-    if on_accelerator():
-        h = gear_hash(jnp.asarray(arr))
-        mask = np.asarray(boundary_candidate_mask(h, params.mask_bits))
+    if device_chunk is not None or on_accelerator():
+        h = gear_hash(device_chunk if device_chunk is not None else jnp.asarray(arr))
+        mask = np.asarray(boundary_candidate_mask(h, params.mask_bits))[:n]
     else:
         from skyplane_tpu.ops.host_fallback import boundary_candidates_host, gear_hash_host
 
         mask = boundary_candidates_host(gear_hash_host(arr), params.mask_bits)
     candidates = np.flatnonzero(mask)
-    return select_boundaries(candidates, len(arr), params)
+    return select_boundaries(candidates, n, params)
 
 
 def segment_ids_and_rev_pos(ends: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -192,7 +192,6 @@ def test_cross_site_dedup_through_subprocess_daemons(tmp_path):
     job.dst_paths = ["local:///"]
     pipe = Pipeline(transfer_config=TransferConfig(compress="zstd", dedup=True, multipart_threshold_mb=1024))
     pipe.jobs_to_dispatch.append(job)
-    box = {}
-    pipe.start(stats_out=box)
+    stats = pipe.start()
     assert (dst_root / "f.bin").read_bytes() == payload
-    assert box["stats"].get("compression_ratio", 0) > 1.5, box["stats"]
+    assert stats and stats.get("compression_ratio", 0) > 1.5, stats

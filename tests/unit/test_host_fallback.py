@@ -70,3 +70,29 @@ def test_batch_host_fingerprints_match_per_segment():
     for i, e in enumerate(ends):
         assert batch[i] == segment_fingerprint_host(data[start:e].tobytes())
         start = int(e)
+
+
+def test_accelerator_path_matches_host_path(monkeypatch):
+    """Force the accelerator code path on the CPU device: CDC boundaries and
+    recipe output must be identical to the host path."""
+    import skyplane_tpu.ops.backend as backend
+    from skyplane_tpu.ops.dedup import SenderDedupIndex
+    from skyplane_tpu.ops.pipeline import DataPathProcessor
+
+    data = (
+        rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+        + bytes(100_000)
+        + rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes()
+    )
+
+    def run(accel: bool):
+        monkeypatch.setattr(backend, "_is_accelerator", accel)
+        proc = DataPathProcessor(codec_name="zstd", dedup=True)
+        p = proc.process(data, SenderDedupIndex())
+        return p
+
+    host = run(False)
+    accel = run(True)
+    assert host.fingerprint == accel.fingerprint  # same segment fps -> same chunk fp
+    assert host.n_segments == accel.n_segments
+    assert host.wire_bytes == accel.wire_bytes
