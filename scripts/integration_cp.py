@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Scripted cp/sync matrix runner (reference analog: tests/integration/cp.py,
+argv-driven so CI or an operator can run one case per invocation).
+
+Usage:
+  python scripts/integration_cp.py SRC_URI DST_URI [--recursive] [--sync]
+      [--compress zstd] [--dedup] [--max-instances 2] [--expect-files N]
+
+Exit code 0 iff the transfer succeeds (and, with --expect-files, the
+destination listing matches).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable from a repo checkout without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("src")
+    ap.add_argument("dst", nargs="+")
+    ap.add_argument("--recursive", action="store_true")
+    ap.add_argument("--sync", action="store_true")
+    ap.add_argument("--compress", default=None)
+    ap.add_argument("--dedup", action="store_true", default=None)
+    ap.add_argument("--max-instances", type=int, default=1)
+    ap.add_argument("--expect-files", type=int, default=None)
+    args = ap.parse_args()
+
+    from skyplane_tpu.cli.cli_transfer import run_transfer
+
+    rc = run_transfer(
+        args.src,
+        args.dst,
+        recursive=args.recursive or args.sync,
+        sync=args.sync,
+        yes=True,
+        max_instances=args.max_instances,
+        solver="direct",
+        compress=args.compress,
+        dedup=args.dedup,
+    )
+    if rc != 0:
+        return rc
+    if args.expect_files is not None:
+        from skyplane_tpu.obj_store.storage_interface import StorageInterface
+        from skyplane_tpu.utils.path import parse_path
+
+        provider, bucket, prefix = parse_path(args.dst[0])
+        iface = StorageInterface.create(f"{provider}:infer", bucket)
+        found = sum(1 for _ in iface.list_objects(prefix=prefix))
+        if found != args.expect_files:
+            print(f"FAIL: expected {args.expect_files} objects at destination, found {found}", file=sys.stderr)
+            return 1
+        print(f"verified {found} objects at destination")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,9 +77,16 @@ class TransferProgressTracker(threading.Thread):
         t0 = time.time()
         try:
             # gateway compression profiles are daemon-lifetime cumulative; a
-            # baseline snapshot makes the final stats per-run even when a
-            # dataplane is reused for several runs
-            self._profile_baseline = self._poll_profiles()
+            # baseline snapshot makes the final stats per-run when a dataplane
+            # is REUSED. The first run on a dataplane skips the poll — its
+            # baseline is definitionally zero and the round-trip lands right
+            # after daemon startup when the control API is slowest.
+            first_run = self.dataplane._trackers[:1] == [self]
+            self._profile_baseline = (
+                {"wire_bytes": 0, "raw_bytes": 0, "ref_segments": 0, "segments": 0}
+                if first_run
+                else self._poll_profiles()
+            )
             for job in self.jobs:
                 self._dispatch_job(job)
             self._monitor_to_completion()
@@ -99,18 +106,22 @@ class TransferProgressTracker(threading.Thread):
             self.hooks.on_transfer_error(e)
             self._report_usage(time.time() - t0, error=e)
 
-    def _poll_profiles(self) -> dict:
-        """Summed source-gateway compression counters (parallel best-effort)."""
+    def _poll_profiles(self) -> Optional[dict]:
+        """Summed source-gateway compression counters, or None when any
+        gateway could not be polled — a failed poll is NOT zero counters, and
+        treating it as zero would corrupt baseline/final deltas."""
         from skyplane_tpu.utils import do_parallel
 
         def poll(gw):
             try:
                 prof = requests.get(f"{gw.control_url()}/profile/compression", timeout=5).json()
-                return prof if isinstance(prof, dict) else {}
+                return prof if isinstance(prof, dict) else None
             except requests.RequestException:
-                return {}
+                return None
 
         profiles = [p for _, p in do_parallel(poll, self.dataplane.source_gateways(), n=16)]
+        if any(p is None for p in profiles):
+            return None
         return {
             key: sum(p.get(key, 0) for p in profiles)
             for key in ("wire_bytes", "raw_bytes", "ref_segments", "segments")
@@ -127,12 +138,14 @@ class TransferProgressTracker(threading.Thread):
             "effective_gbps": round(logical * 8 / 1e9 / elapsed_s, 4) if elapsed_s > 0 else 0.0,
         }
         totals = self._poll_profiles()
-        baseline = getattr(self, "_profile_baseline", {})
-        wire = totals["wire_bytes"] - baseline.get("wire_bytes", 0)
-        raw = totals["raw_bytes"] - baseline.get("raw_bytes", 0)
-        refs = totals["ref_segments"] - baseline.get("ref_segments", 0)
-        segs = totals["segments"] - baseline.get("segments", 0)
-        if raw:
+        baseline = getattr(self, "_profile_baseline", None)
+        if totals is None or baseline is None:
+            return stats  # incomplete snapshots: report only tracker-side numbers
+        wire = totals["wire_bytes"] - baseline["wire_bytes"]
+        raw = totals["raw_bytes"] - baseline["raw_bytes"]
+        refs = totals["ref_segments"] - baseline["ref_segments"]
+        segs = totals["segments"] - baseline["segments"]
+        if raw > 0 and wire >= 0:
             stats.update(
                 wire_bytes=wire,
                 compression_ratio=round(raw / max(wire, 1), 2),
