@@ -126,6 +126,31 @@ def ssh(index):
 
 
 @main.group()
+def experiments():
+    """Profiling experiments (throughput grids for the solver)."""
+
+
+@experiments.command("throughput-grid")
+@click.argument("region_pairs", nargs=-1, required=True)
+@click.option("--output", default="throughput_grid.csv", help="profile CSV consumed by the solver")
+@click.option("--probe-mb", default=256, type=int)
+@click.option("--no-resume", is_flag=True)
+def experiments_throughput_grid(region_pairs, output, probe_mb, no_resume):
+    """Measure pairwise gateway throughput: PAIRS like aws:us-east-1,gcp:us-central1"""
+    from skyplane_tpu.cli.experiments.throughput_grid import run_throughput_grid
+
+    pairs = []
+    for spec in region_pairs:
+        src, _, dst = spec.partition(",")
+        if not dst:
+            raise click.ClickException(f"pair must be 'src_region,dst_region', got {spec!r}")
+        pairs.append((src, dst))
+    results = run_throughput_grid(pairs, output, probe_mb=probe_mb, resume=not no_resume)
+    for (src, dst), gbps in sorted(results.items()):
+        click.echo(f"{src} -> {dst}: {gbps:.2f} Gbps")
+
+
+@main.group()
 def config():
     """Get or set configuration flags."""
 

@@ -79,28 +79,27 @@ def run_throughput_grid(
     servers = provisioner.provision()
     by_region = {region: servers[tid] for region, tid in tasks.items()}
     try:
-        # every gateway runs a bidirectional probe program: gen_data->send is
-        # installed per-probe by registering chunks; receive->write is standing
-        for region, server in by_region.items():
-            program = GatewayProgram()
-            recv = program.add_operator(GatewayReceive())
-            program.add_operator(GatewayWriteLocal(), parent_handle=recv)
-            # sender legs are added per peer below
-            server.start_gateway(program.to_dict(), {}, f"probe_{region}")
+        # probes run sequentially; each pair reconfigures BOTH endpoints (the
+        # same per-gateway-program-per-partition model the planner uses) —
+        # a standing mixed program would make the two roots compete for
+        # chunks on one partition queue
         for src_region, dst_region in region_pairs:
             if (src_region, dst_region) in results:
                 continue
-            # ship a src program with gen_data -> send to this peer
             src = by_region[src_region]
             dst = by_region[dst_region]
-            program = GatewayProgram()
-            gen = program.add_operator(GatewayGenData(size_mb=probe_mb))
-            program.add_operator(
+            dst_program = GatewayProgram()
+            recv = dst_program.add_operator(GatewayReceive())
+            dst_program.add_operator(GatewayWriteLocal(), parent_handle=recv)
+            dst.start_gateway(dst_program.to_dict(), {}, f"probe_{dst_region}")
+            src_program = GatewayProgram()
+            gen = src_program.add_operator(GatewayGenData(size_mb=probe_mb))
+            src_program.add_operator(
                 GatewaySend(target_gateway_id=f"probe_{dst_region}", region=dst_region, num_connections=8),
                 parent_handle=gen,
             )
             info = {f"probe_{dst_region}": {"public_ip": dst.public_ip(), "control_port": dst.control_port}}
-            src.start_gateway(program.to_dict(), info, f"probe_{src_region}")
+            src.start_gateway(src_program.to_dict(), info, f"probe_{src_region}")
             gbps = measure_pair(src, dst, probe_mb=probe_mb)
             results[(src_region, dst_region)] = gbps
             logger.fs.info(f"throughput {src_region}->{dst_region}: {gbps:.2f} Gbps")

@@ -58,6 +58,10 @@ class LocalServer(Server):
         use_tls: bool = True,
         use_bbr: bool = True,
     ) -> None:
+        # re-starting with a new program (e.g. throughput probes) replaces the
+        # old daemon — two processes cannot share the control port
+        if self.proc is not None:
+            self.terminate_instance()
         self.workdir.mkdir(parents=True, exist_ok=True)
         program_file = self.workdir / "program.json"
         info_file = self.workdir / "info.json"

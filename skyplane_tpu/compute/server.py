@@ -190,6 +190,14 @@ class SSHServer(Server):
         use_bbr: bool = True,
     ) -> None:
         self.tune_network(use_bbr)
+        # replace any daemon from a previous start_gateway (program reconfig):
+        # bracket pattern self-excludes the remote shell; wait for exit so the
+        # new daemon can bind the control port (a half-dead old daemon would
+        # answer /status and silently keep the OLD program running)
+        self.run_command(
+            "pkill -f '[s]kyplane_tpu.gateway.gateway_daemon' || true; "
+            "for i in $(seq 1 20); do pgrep -f '[s]kyplane_tpu.gateway.gateway_daemon' >/dev/null || break; sleep 0.5; done"
+        )
         self.run_command("mkdir -p /tmp/skyplane_tpu")
         self.write_file(json.dumps(gateway_program).encode(), "/tmp/skyplane_tpu/program.json")
         self.write_file(json.dumps(gateway_info).encode(), "/tmp/skyplane_tpu/info.json")

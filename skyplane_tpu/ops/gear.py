@@ -57,6 +57,13 @@ def gear_hash(data_u8: jax.Array) -> jax.Array:
     """
     table = jnp.asarray(GEAR_TABLE)
     g = table[data_u8.astype(jnp.int32)]  # [N] uint32
+    # opt-in Pallas path: one HBM read/write instead of one per doubling pass
+    # (SKYPLANE_TPU_USE_PALLAS=1; requires TILE-aligned inputs — the data path
+    # pads chunks to power-of-two buckets so this holds there)
+    from skyplane_tpu.ops.pallas_kernels import TILE, gear_windowed_sum_pallas, use_pallas
+
+    if use_pallas() and g.shape[0] % TILE == 0:
+        return gear_windowed_sum_pallas(g)
     return _windowed_sum_doubling(g)
 
 

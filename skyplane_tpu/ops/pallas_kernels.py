@@ -1,0 +1,79 @@
+"""Pallas TPU kernels for the data-path hot loops.
+
+The XLA path materializes each doubling pass of the gear windowed sum to HBM
+(5 full-array round trips); this kernel tiles the array through VMEM and runs
+all passes on-chip, reading HBM once and writing once. Cross-tile state is a
+31-element halo carried via overlapping block reads (the input is padded by
+one tile so tile i can read its predecessor without negative indexing).
+
+Enabled with SKYPLANE_TPU_USE_PALLAS=1 (off by default until validated on
+real TPU hardware — the tunnel was unavailable this round; correctness is
+pinned by interpret-mode tests either way).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from skyplane_tpu.ops.gear import GEAR_TABLE, GEAR_WINDOW
+
+TILE = 64 * 1024  # uint32 elements per grid step: 256 KiB VMEM per ref
+
+
+def _windowed_sum_kernel(prev_ref, cur_ref, out_ref):
+    """One tile of h_t = sum_{i<32} g_{t-i} << i via log-doubling.
+
+    prev_ref/cur_ref: [TILE] uint32 (previous and current tiles of g).
+    The doubling recurrence needs GEAR_WINDOW-1 elements of left context;
+    taking them from the already-computed *input* of the previous tile (not
+    its output) is correct because the recurrence reads raw g values only.
+    """
+    ext = jnp.concatenate([prev_ref[TILE - (GEAR_WINDOW - 1) :], cur_ref[:]])  # [TILE+31]
+    h = ext
+    off = 1
+    while off < GEAR_WINDOW:
+        # shift right by `off` with zero fill, staying in VMEM
+        shifted = jnp.concatenate([jnp.zeros((off,), jnp.uint32), h[:-off]])
+        h = h + (shifted << np.uint32(off))
+        off <<= 1
+    out_ref[:] = h[GEAR_WINDOW - 1 :]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def gear_windowed_sum_pallas(g: jax.Array, interpret: bool = False) -> jax.Array:
+    """[N] uint32 gear values -> [N] uint32 rolling hashes (N % TILE == 0)."""
+    n = g.shape[0]
+    if n % TILE:
+        raise ValueError(f"N={n} must be a multiple of TILE={TILE}")
+    padded = jnp.concatenate([jnp.zeros((TILE,), jnp.uint32), g])  # zero tile in front
+    grid = (n // TILE,)
+    return pl.pallas_call(
+        _windowed_sum_kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE,), lambda i: (i,)),  # previous tile (padded offset)
+            pl.BlockSpec((TILE,), lambda i: (i + 1,)),  # current tile
+        ],
+        out_specs=pl.BlockSpec((TILE,), lambda i: (i,)),
+        interpret=interpret,
+    )(padded, padded)
+
+
+def use_pallas() -> bool:
+    return os.environ.get("SKYPLANE_TPU_USE_PALLAS", "0").strip() in ("1", "true", "on")
+
+
+def gear_hash_pallas(data_u8: jax.Array, interpret: bool = False) -> jax.Array:
+    """Full gear hash with the table gather in XLA and the windowed sum in
+    Pallas. Requires len % TILE == 0 (the data path pads chunks to power-of-
+    two buckets >= 64 KiB, so this always holds there)."""
+    table = jnp.asarray(GEAR_TABLE)
+    g = table[data_u8.astype(jnp.int32)]
+    return gear_windowed_sum_pallas(g, interpret=interpret)
