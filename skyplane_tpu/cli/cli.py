@@ -144,6 +144,8 @@ def experiments_throughput_grid(region_pairs, output, probe_mb, no_resume):
         src, _, dst = spec.partition(",")
         if not dst:
             raise click.ClickException(f"pair must be 'src_region,dst_region', got {spec!r}")
+        if src == dst:
+            raise click.ClickException(f"self-pair {spec!r}: src and dst regions must differ")
         pairs.append((src, dst))
     results = run_throughput_grid(pairs, output, probe_mb=probe_mb, resume=not no_resume)
     for (src, dst), gbps in sorted(results.items()):

@@ -196,7 +196,10 @@ class SSHServer(Server):
         # answer /status and silently keep the OLD program running)
         self.run_command(
             "pkill -f '[s]kyplane_tpu.gateway.gateway_daemon' || true; "
-            "for i in $(seq 1 20); do pgrep -f '[s]kyplane_tpu.gateway.gateway_daemon' >/dev/null || break; sleep 0.5; done"
+            "for i in $(seq 1 20); do pgrep -f '[s]kyplane_tpu.gateway.gateway_daemon' >/dev/null || break; sleep 0.5; done; "
+            # a wedged daemon that ignored SIGTERM would keep the port and ack
+            # /status for the OLD program — force it dead before starting anew
+            "pkill -9 -f '[s]kyplane_tpu.gateway.gateway_daemon' || true; sleep 0.5"
         )
         self.run_command("mkdir -p /tmp/skyplane_tpu")
         self.write_file(json.dumps(gateway_program).encode(), "/tmp/skyplane_tpu/program.json")

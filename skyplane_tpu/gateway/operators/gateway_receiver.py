@@ -21,6 +21,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
+from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.gateway.cert import generate_self_signed_certificate
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
@@ -64,6 +65,11 @@ class GatewayReceiver:
         self._servers: Dict[int, socket.socket] = {}
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
+        # payload errors (bad codec/recipe/checksum from a peer) drop the
+        # connection rather than killing the daemon — a hostile or corrupted
+        # frame must not be a gateway DoS. Persistent corruption escalates.
+        self._payload_error_count = 0
+        self.max_payload_errors = 20
         self.socket_profile_events: "queue.Queue[dict]" = queue.Queue()
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if use_tls:
@@ -166,10 +172,28 @@ class GatewayReceiver:
                 # and marks the chunk complete only after this lands — TCP
                 # sendall() alone proves nothing about delivery
                 conn.sendall(ACK_BYTE)
+                with self._lock:
+                    # successful chunks reset the payload-error budget: the
+                    # escalation threshold is a corruption RATE, not a
+                    # lifetime total that would kill long-lived daemons over
+                    # isolated transients
+                    self._payload_error_count = 0
                 logger.fs.debug(
                     f"[receiver:{port}] landed chunk {header.chunk_id} ({header.raw_data_len}B raw, {header.data_len}B wire)"
                 )
-        except Exception:  # noqa: BLE001 — fatal receiver error stops the daemon
+        except SkyplaneTpuException as e:
+            # malformed/corrupt payload from the peer: drop this connection
+            # (no ack was sent, so the sender re-queues the chunk). Repeated
+            # payload errors indicate systemic corruption -> fail the daemon.
+            with self._lock:
+                self._payload_error_count += 1
+                count = self._payload_error_count
+            logger.fs.warning(f"[receiver:{port}] dropping connection on bad payload ({count}): {e}")
+            if count >= self.max_payload_errors:
+                tb = traceback.format_exc()
+                self.error_queue.put(f"receiver exceeded {self.max_payload_errors} payload errors; last: {tb}")
+                self.error_event.set()
+        except Exception:  # noqa: BLE001 — unexpected receiver error stops the daemon
             tb = traceback.format_exc()
             logger.fs.error(f"[receiver:{port}] fatal: {tb}")
             self.error_queue.put(tb)

@@ -134,9 +134,14 @@ def encode_container(data: bytes, block_bytes: int = DEFAULT_BLOCK_BYTES) -> byt
 
 def decode_container(buf: bytes) -> bytes:
     """Host entry: blockpack container -> raw bytes."""
-    if buf[:2] != MAGIC:
+    head_len = 2 + struct.calcsize("<BBQQ")
+    if len(buf) < 2 or buf[:2] != MAGIC:
         raise CodecException("not a blockpack container (bad magic)")
+    if len(buf) < head_len:
+        raise CodecException("truncated blockpack header")
     ver, block_log2, n_raw, n_lit = struct.unpack_from("<BBQQ", buf, 2)
+    if block_log2 > 30 or n_raw > (1 << 40) or n_lit > len(buf):
+        raise CodecException("implausible blockpack header fields (corrupted container)")
     if ver != VERSION:
         raise CodecException(f"unsupported blockpack version {ver}")
     block_bytes = 1 << block_log2
@@ -146,6 +151,8 @@ def decode_container(buf: bytes) -> bytes:
     n_padded = ((n_raw + block_bytes - 1) // block_bytes) * block_bytes
     n_blocks = n_padded // block_bytes
     tag_bytes = (n_blocks + 3) // 4
+    if len(buf) < off + tag_bytes:
+        raise CodecException("truncated blockpack container (tag region)")
     tags = _unpack_tags(buf[off : off + tag_bytes], n_blocks)
     literals = np.frombuffer(buf[off + tag_bytes : off + tag_bytes + n_lit], np.uint8)
     if len(literals) != n_lit:

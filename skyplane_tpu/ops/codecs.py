@@ -41,7 +41,13 @@ def _encode_zstd(data: bytes) -> bytes:
 
 
 def _decode_zstd(buf: bytes) -> bytes:
-    return _zstd().ZstdDecompressor().decompress(buf)
+    # decode consumes bytes from the wire: corruption must surface inside the
+    # codec error contract, not as a raw ZstdError the receiver treats as fatal
+    zstd = _zstd()
+    try:
+        return zstd.ZstdDecompressor().decompress(buf)
+    except zstd.ZstdError as e:
+        raise CodecException(f"zstd decode failed (corrupt frame): {e}") from e
 
 
 def _encode_tpu(data: bytes) -> bytes:

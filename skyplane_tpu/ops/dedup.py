@@ -206,12 +206,17 @@ def parse_recipe(
     admission — a corrupted literal stored under a healthy fingerprint would
     propagate to every future chunk that REFs it.
     """
-    if buf[:2] != MAGIC:
-        raise CodecException("not a dedup recipe (bad magic)")
+    head_len = 2 + struct.calcsize("<BI")
+    if len(buf) < head_len or buf[:2] != MAGIC:
+        raise CodecException("not a dedup recipe (bad magic / truncated header)")
     ver, n_entries = struct.unpack_from("<BI", buf, 2)
     if ver != VERSION:
         raise CodecException(f"unsupported recipe version {ver}")
-    off = 2 + struct.calcsize("<BI")
+    off = head_len
+    # bound the claimed entry count by the bytes actually present — a hostile
+    # or corrupted count must not crash the handler or drive huge allocations
+    if n_entries * _ENTRY.size > len(buf) - off:
+        raise CodecException(f"recipe claims {n_entries} entries but only {len(buf) - off} bytes follow")
     entries = []
     for _ in range(n_entries):
         kind, fp, seg_len = _ENTRY.unpack_from(buf, off)

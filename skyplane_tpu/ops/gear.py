@@ -60,9 +60,12 @@ def gear_hash(data_u8: jax.Array) -> jax.Array:
     # opt-in Pallas path: one HBM read/write instead of one per doubling pass
     # (SKYPLANE_TPU_USE_PALLAS=1; requires TILE-aligned inputs — the data path
     # pads chunks to power-of-two buckets so this holds there)
+    from skyplane_tpu.ops.backend import on_accelerator
     from skyplane_tpu.ops.pallas_kernels import TILE, gear_windowed_sum_pallas, use_pallas
 
-    if use_pallas() and g.shape[0] % TILE == 0:
+    # the env flag can leak into CPU-pinned daemon subprocesses; pallas_call
+    # only lowers on real accelerators, so gate on the backend too
+    if use_pallas() and on_accelerator() and g.shape[0] % TILE == 0:
         return gear_windowed_sum_pallas(g)
     return _windowed_sum_doubling(g)
 
