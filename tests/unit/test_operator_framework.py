@@ -1,0 +1,117 @@
+"""Operator worker-loop semantics: error fan-out, transient requeue,
+completion records (reference model: gateway_operator.py:66-122 behavior)."""
+
+import queue
+import threading
+import time
+import uuid
+
+from skyplane_tpu.chunk import Chunk, ChunkRequest
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+from skyplane_tpu.gateway.operators.gateway_operator import GatewayOperator
+
+
+def _req():
+    return ChunkRequest(chunk=Chunk(src_key="s", dest_key="d", chunk_id=uuid.uuid4().hex, chunk_length_bytes=1))
+
+
+def make_operator(tmp_path, process_fn, n_workers=1, with_output=False):
+    store = ChunkStore(str(tmp_path / "chunks"))
+    in_q = GatewayQueue()
+    out_q = GatewayQueue() if with_output else None
+    error_event = threading.Event()
+    error_queue: "queue.Queue[str]" = queue.Queue()
+
+    class Op(GatewayOperator):
+        def process(self, chunk_req, worker_id):
+            return process_fn(chunk_req, worker_id)
+
+    op = Op(
+        handle="op",
+        region="test:r",
+        input_queue=in_q,
+        output_queue=out_q,
+        error_event=error_event,
+        error_queue=error_queue,
+        chunk_store=store,
+        n_workers=n_workers,
+    )
+    if out_q is not None:
+        out_q.register_handle("sink")
+    return op, in_q, out_q, error_event, error_queue, store
+
+
+def _drain_states(store):
+    states = []
+    while True:
+        try:
+            states.append(store.chunk_status_queue.get_nowait())
+        except queue.Empty:
+            return states
+
+
+def test_success_marks_complete_and_forwards(tmp_path):
+    op, in_q, out_q, error_event, _, store = make_operator(tmp_path, lambda c, w: True, with_output=True)
+    op.start_workers()
+    req = _req()
+    in_q.put(req)
+    forwarded = out_q.pop("sink", timeout=5)
+    op.stop_workers()
+    assert forwarded is req
+    states = [s["state"] for s in _drain_states(store)]
+    assert "in_progress" in states and "complete" in states
+    assert not error_event.is_set()
+
+
+def test_transient_false_requeues_until_success(tmp_path):
+    calls = {"n": 0}
+
+    def flaky(chunk_req, worker_id):
+        calls["n"] += 1
+        return calls["n"] >= 3
+
+    op, in_q, out_q, error_event, _, store = make_operator(tmp_path, flaky, with_output=True)
+    op.start_workers()
+    in_q.put(_req())
+    out_q.pop("sink", timeout=5)
+    op.stop_workers()
+    assert calls["n"] == 3
+    assert not error_event.is_set()
+
+
+def test_exception_sets_error_event_with_traceback(tmp_path):
+    def boom(chunk_req, worker_id):
+        raise RuntimeError("operator exploded")
+
+    op, in_q, _, error_event, error_queue, store = make_operator(tmp_path, boom)
+    op.start_workers()
+    in_q.put(_req())
+    assert error_event.wait(timeout=5), "error_event not set"
+    op.stop_workers()
+    tb = error_queue.get_nowait()
+    assert "operator exploded" in tb and "RuntimeError" in tb
+    states = [s["state"] for s in _drain_states(store)]
+    assert "failed" in states
+
+
+def test_workers_stop_when_sibling_errors(tmp_path):
+    """All workers of an operator stop once the error event fires
+    (reference: gateway_operator.py:108-112 fail-fast)."""
+    processed = []
+
+    def proc(chunk_req, worker_id):
+        processed.append(chunk_req.chunk.chunk_id)
+        return True
+
+    op, in_q, _, error_event, _, _ = make_operator(tmp_path, proc, n_workers=2)
+    op.start_workers()
+    in_q.put(_req())
+    time.sleep(0.5)
+    error_event.set()  # simulate another operator's fatal error
+    time.sleep(0.6)
+    n_before = len(processed)
+    in_q.put(_req())
+    time.sleep(0.6)
+    op.stop_workers()
+    assert len(processed) == n_before, "worker kept consuming after error_event"
