@@ -216,15 +216,19 @@ class TransferProgressTracker(threading.Thread):
         by_region: Dict[str, List] = {}
         for gw in sinks:
             by_region.setdefault(gw.region_tag, []).append(gw)
+        from skyplane_tpu.utils import do_parallel
+
         reported_complete: Set[str] = set()
         deadline = time.time() + timeout_s
+        poll_interval = self.POLL_INTERVAL_S
         while time.time() < deadline:
             self._check_gateway_errors()
+            statuses = dict(do_parallel(self._poll_gateway_status, sinks, n=16))
             region_complete: Dict[str, Set[str]] = {}
             for region, gws in by_region.items():
                 done: Set[str] = set()
                 for gw in gws:
-                    status = self._poll_gateway_status(gw)
+                    status = statuses.get(gw, {})
                     done |= {cid for cid, st in status.items() if st == "complete"}
                 region_complete[region] = done
             # a chunk is complete when EVERY destination region has landed it
@@ -238,5 +242,8 @@ class TransferProgressTracker(threading.Thread):
                 reported_complete |= newly
             if target and target <= all_complete:
                 return
-            time.sleep(self.POLL_INTERVAL_S)
+            time.sleep(poll_interval)
+            # back off toward 2s on long transfers: snappy completion for
+            # small copies without hammering gateways for hours on big ones
+            poll_interval = min(poll_interval * 1.5, 2.0)
         raise TransferFailedException(f"transfer timed out after {timeout_s}s")

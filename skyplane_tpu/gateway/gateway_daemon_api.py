@@ -12,7 +12,9 @@ directly:
   POST /api/v1/chunk_requests              register chunk batch (json list)
   GET  /api/v1/chunk_requests              all chunk requests + states
   GET  /api/v1/incomplete_chunk_requests   pending only
-  GET  /api/v1/chunk_status_log            drained chunk state transitions
+  GET  /api/v1/chunk_status_log            aggregate chunk_id -> state map
+                                           (?include_log=1 adds the full
+                                           transition log)
   POST /api/v1/upload_id_maps              dest_key -> multipart upload id
   GET  /api/v1/errors                      operator tracebacks
   GET  /api/v1/profile/socket/receiver     per-recv socket profile events
@@ -187,8 +189,16 @@ class GatewayDaemonAPI:
 
     # ---- routing ----
 
+    @staticmethod
+    def _split_route(req):
+        """(path, parsed query) — query strings must not break route matching."""
+        from urllib.parse import parse_qs
+
+        raw_path, _, query = req.path.partition("?")
+        return raw_path.rstrip("/"), parse_qs(query)
+
     def _handle_get(self, req) -> None:
-        path = req.path.rstrip("/")
+        path, query = self._split_route(req)
         if path == "/api/v1/status":
             req._send(
                 200,
@@ -209,9 +219,17 @@ class GatewayDaemonAPI:
                 }
                 req._send(200, {"chunk_requests": list(incomplete.values())})
         elif path == "/api/v1/chunk_status_log":
+            # the tracker polls this every 100ms: by default return only the
+            # aggregate chunk_id -> state map. The full transition log grows
+            # O(chunks x operators) and serializing it per poll made control
+            # traffic quadratic on large transfers; fetch it explicitly with
+            # ?include_log=1 (debugging / profiling).
+            include_log = query.get("include_log") == ["1"]
             with self._lock:
-                # aggregate view the tracker consumes: chunk_id -> state
-                req._send(200, {"chunk_status_log": list(self.chunk_status_log), "chunk_status": dict(self.chunk_status)})
+                payload = {"chunk_status": dict(self.chunk_status)}
+                if include_log:
+                    payload["chunk_status_log"] = list(self.chunk_status_log)
+                req._send(200, payload)
         elif path == "/api/v1/errors":
             while True:
                 try:
@@ -234,7 +252,7 @@ class GatewayDaemonAPI:
             req._send(404, {"error": f"no route {req.path}"})
 
     def _handle_post(self, req) -> None:
-        path = req.path.rstrip("/")
+        path, _ = self._split_route(req)
         if path == "/api/v1/shutdown":
             self.shutdown_requested.set()
             req._send(200, {"status": "shutting down"})
@@ -269,7 +287,8 @@ class GatewayDaemonAPI:
             req._send(404, {"error": f"no route {req.path}"})
 
     def _handle_delete(self, req) -> None:
-        parts = req.path.rstrip("/").split("/")
+        path, _ = self._split_route(req)
+        parts = path.split("/")
         if len(parts) == 5 and parts[:4] == ["", "api", "v1", "servers"]:
             ok = self.receiver.stop_server(int(parts[4]))
             req._send(200 if ok else 404, {"status": "ok" if ok else "unknown port"})
