@@ -54,6 +54,7 @@ class TransferProgressTracker(threading.Thread):
         self.chunk_sizes: Dict[str, int] = {}
         self.complete_chunk_ids: Set[str] = set()
         self.transfer_stats: Optional[dict] = None  # filled on success
+        self._unreachable_streaks: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # ---- queries (reference: tracker.py:372-399) ----
@@ -197,12 +198,40 @@ class TransferProgressTracker(threading.Thread):
             logger.fs.warning(f"[tracker] status poll failed for {gateway.gateway_id}: {e}")
             return {}
 
+    # consecutive unreachable error-polls before a gateway is declared dead.
+    # Connection-refused polls fail fast (~30 streaks ≈ 20-60s with backoff);
+    # a black-holed gateway burns the full 5s+10s request timeouts per loop,
+    # so detection there takes ~30 x ~15s ≈ 7-8 minutes.
+    UNREACHABLE_STREAK_LIMIT = 30
+
     def _check_gateway_errors(self) -> None:
         errors = self.dataplane.check_error_logs()
         real = {gid: errs for gid, errs in errors.items() if any(not e.startswith("(error endpoint") for e in errs)}
         if real:
             gid, errs = next(iter(real.items()))
             raise GatewayException(f"gateway {gid} reported {len(errs)} errors", gateway_id=gid, tracebacks=errs)
+        # a DEAD gateway reports nothing at all: without this, a crashed daemon
+        # mid-transfer would hang the client until the 24h timeout
+        unreachable = {
+            gid for gid, errs in errors.items() if errs and all(e.startswith("(error endpoint") for e in errs)
+        }
+        # EVERY gateway unreachable at once (with >1 gateway) is almost always
+        # a client-side outage (VPN/NAT drop): don't count streaks — gateways
+        # keep transferring and the client recovers when connectivity returns.
+        # Single-gateway topologies can't be disambiguated, so they still
+        # count (a dead lone gateway otherwise hangs until the 24h timeout).
+        if len(unreachable) == len(self.dataplane.bound_gateways) > 1:
+            return
+        for gid in list(self._unreachable_streaks):
+            if gid not in unreachable:
+                del self._unreachable_streaks[gid]
+        for gid in unreachable:
+            self._unreachable_streaks[gid] = self._unreachable_streaks.get(gid, 0) + 1
+            if self._unreachable_streaks[gid] >= self.UNREACHABLE_STREAK_LIMIT:
+                raise GatewayException(
+                    f"gateway {gid} unreachable for {self._unreachable_streaks[gid]} consecutive polls (crashed or partitioned)",
+                    gateway_id=gid,
+                )
 
     def _monitor_to_completion(self, timeout_s: float = 24 * 3600) -> None:
         """Poll sink gateways until every dispatched chunk lands at every

@@ -195,3 +195,39 @@ def test_cross_site_dedup_through_subprocess_daemons(tmp_path):
     stats = pipe.start()
     assert (dst_root / "f.bin").read_bytes() == payload
     assert stats and stats.get("compression_ratio", 0) > 1.5, stats
+
+
+@pytest.mark.slow
+def test_dead_gateway_surfaces_error(tmp_path, monkeypatch):
+    """A destination daemon killed mid-transfer must fail the client with a
+    GatewayException within the unreachable-streak window, not hang to the
+    24h timeout."""
+    from skyplane_tpu.api.tracker import TransferProgressTracker
+    from skyplane_tpu.exceptions import GatewayException
+
+    monkeypatch.setattr(TransferProgressTracker, "UNREACHABLE_STREAK_LIMIT", 5)
+    src_root = tmp_path / "siteA"
+    dst_root = tmp_path / "siteB"
+    _fill_bucket(src_root, n_files=1, size=64 * 1024)
+    dst_root.mkdir()
+    job = CopyJob("local:///", ["local:///"], recursive=True)
+    job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+    job.src_path = "local:///"
+    job.dst_paths = ["local:///"]
+    cfg = TransferConfig(compress="zstd", dedup=False, multipart_threshold_mb=1024)
+    pipe = Pipeline(transfer_config=cfg)
+    pipe.jobs_to_dispatch.append(job)
+    dp = pipe.create_dataplane()
+    with dp.auto_deprovision():
+        dp.provision()
+        # murder the destination daemon before dispatch
+        for bound in dp.bound_gateways.values():
+            if bound.region_tag == "local:siteB":
+                bound.server.proc.kill()
+        tracker = dp.run_async([job])
+        tracker.join(timeout=120)
+        assert not tracker.is_alive(), "tracker still running — dead gateway not detected"
+        # either detection path is a win: the unreachable-streak detector, or
+        # the source gateway's own fatal send error surfacing first
+        assert isinstance(tracker.error, GatewayException), f"expected GatewayException, got {tracker.error!r}"
