@@ -92,3 +92,96 @@ def test_dedup_stats_show_refs(pair_dirs, tmp_path):
     finally:
         src.stop()
         dst.stop()
+
+
+@pytest.mark.slow
+def test_multicast_with_dedup_everything_on(tmp_path):
+    """BASELINE config #5 shape: 1 source -> 2 destinations with dedup,
+    TPU codec, TLS, and E2EE all enabled. Each destination edge keeps its own
+    fingerprint index/store (replicated chunks must dedup independently and
+    correctly at BOTH destinations)."""
+    import requests
+
+    from skyplane_tpu.gateway.crypto import generate_key
+    from tests.integration.harness import dispatch_file, start_gateway, wait_complete
+
+    key = generate_key()
+    dsts = {}
+    for name in ("d1", "d2"):
+        dsts[name] = start_gateway(
+            {
+                "plan": [
+                    {
+                        "partitions": ["default"],
+                        "value": [
+                            {
+                                "op_type": "receive",
+                                "handle": "recv",
+                                "decrypt": True,
+                                "dedup": True,
+                                "children": [{"op_type": "write_local", "handle": "write", "children": []}],
+                            }
+                        ],
+                    }
+                ]
+            },
+            {},
+            f"gw_{name}",
+            str(tmp_path / f"{name}_chunks"),
+            e2ee_key=key,
+        )
+    info = {
+        f"gw_{name}": {"public_ip": "127.0.0.1", "control_port": gw.control_port} for name, gw in dsts.items()
+    }
+    src_program = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "num_connections": 2,
+                        "children": [
+                            {
+                                "op_type": "mux_and",
+                                "handle": "fan",
+                                "children": [
+                                    {
+                                        "op_type": "send",
+                                        "handle": f"send_{name}",
+                                        "target_gateway_id": f"gw_{name}",
+                                        "region": f"local:{name}",
+                                        "num_connections": 2,
+                                        "compress": "tpu_zstd",
+                                        "encrypt": True,
+                                        "dedup": True,
+                                        "children": [],
+                                    }
+                                    for name in dsts
+                                ],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    src = start_gateway(src_program, info, "gw_src", str(tmp_path / "src_chunks"), e2ee_key=key)
+    try:
+        pattern = rng.integers(0, 256, 256 * 1024, dtype=np.uint8).tobytes()
+        payload = pattern * 4 + bytes(512 * 1024) + pattern  # redundant
+        fsrc = tmp_path / "data.bin"
+        fsrc.write_bytes(payload)
+        # a single dispatch replicates to both destinations via mux_and
+        ids = dispatch_file(src, fsrc, tmp_path / "out" / "data.bin", chunk_bytes=512 * 1024)
+        for gw in dsts.values():
+            wait_complete(gw, ids, timeout=180)
+        got = (tmp_path / "out" / "data.bin").read_bytes()
+        assert hashlib.md5(got).hexdigest() == hashlib.md5(payload).hexdigest()
+        stats = requests.get(src.url("profile/compression"), timeout=5).json()
+        assert stats["ref_segments"] > 0, f"dedup refs expected on redundant multicast: {stats}"
+    finally:
+        src.stop()
+        for gw in dsts.values():
+            gw.stop()
