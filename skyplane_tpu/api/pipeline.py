@@ -74,7 +74,26 @@ class Pipeline:
                 from skyplane_tpu.cli.impl.progress_bar import ProgressBarTransferHook
 
                 hooks = ProgressBarTransferHook(dp.topology.dest_region_tags)
-            tracker = dp.run(self.jobs_to_dispatch, hooks)
+            try:
+                tracker = dp.run(self.jobs_to_dispatch, hooks)
+            except Exception:
+                if dp.debug:
+                    # grab daemon logs BEFORE deprovision tears the VMs down
+                    # (reference: dataplane.py:232-242). Best-effort: log
+                    # collection must never replace the root-cause error, and
+                    # each run gets its own directory so failures don't
+                    # clobber each other's diagnostics.
+                    try:
+                        import uuid as _uuid
+
+                        from skyplane_tpu.config_paths import tmp_log_dir
+
+                        log_dir = tmp_log_dir / "gateway_logs" / _uuid.uuid4().hex[:8]
+                        dp.copy_gateway_logs(log_dir)
+                        logger.error(f"transfer failed; gateway logs collected to {log_dir}")
+                    except Exception as log_e:  # noqa: BLE001
+                        logger.fs.warning(f"gateway log collection failed: {log_e}")
+                raise
             stats = tracker.transfer_stats
         self.jobs_to_dispatch.clear()
         return stats
