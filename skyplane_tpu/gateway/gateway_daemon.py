@@ -117,6 +117,21 @@ class GatewayDaemon:
             raw_forward=raw_forward,
         )
 
+        # one device batch runner per daemon, shared by every sender worker on
+        # accelerator gateways (micro-batches CDC+fingerprint device calls)
+        self.batch_runner = None
+        from skyplane_tpu.ops.backend import on_accelerator
+
+        try:
+            tpu_batch = int(os.environ.get("SKYPLANE_TPU_BATCH_CHUNKS", "8"))
+        except ValueError:
+            logger.fs.warning("ignoring malformed SKYPLANE_TPU_BATCH_CHUNKS; using 8")
+            tpu_batch = 8
+        if on_accelerator() and tpu_batch > 1:
+            from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
+
+            self.batch_runner = DeviceBatchRunner(cdc_params=self.cdc_params, max_batch=tpu_batch)
+
         self.upload_id_map: Dict[str, str] = {}
         self.operators: List[GatewayOperator] = []
         self.terminal_operators: Dict[str, List[str]] = {}  # partition -> terminal group names
@@ -287,6 +302,7 @@ class GatewayDaemon:
                 cdc_params=self.cdc_params,
                 e2ee_key=self.e2ee_key if op.get("encrypt") else None,
                 use_tls=self.use_tls,
+                batch_runner=self.batch_runner,
             )
         raise ValueError(f"unknown operator type {op_type!r}")
 

@@ -272,6 +272,7 @@ class GatewaySenderOperator(GatewayOperator):
         cdc_params: CDCParams = CDCParams(),
         e2ee_key: Optional[bytes] = None,
         use_tls: bool = True,
+        batch_runner=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -279,7 +280,9 @@ class GatewaySenderOperator(GatewayOperator):
         self.target_host = target_host
         self.target_control_port = target_control_port
         self.use_tls = use_tls
-        self.processor = DataPathProcessor(codec_name=codec_name, dedup=dedup, cdc_params=cdc_params)
+        self.processor = DataPathProcessor(
+            codec_name=codec_name, dedup=dedup, cdc_params=cdc_params, batch_runner=batch_runner
+        )
         self.dedup_index = SenderDedupIndex() if dedup else None
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
         self._local = threading.local()

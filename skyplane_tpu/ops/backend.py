@@ -10,6 +10,13 @@ _is_accelerator: Optional[bool] = None
 def on_accelerator() -> bool:
     global _is_accelerator
     if _is_accelerator is None:
+        import os
+
+        if os.environ.get("SKYPLANE_TPU_FORCE_ACCEL_PATH") == "1":
+            # test/debug override: exercise the device-kernel code paths
+            # (batch runner, device CDC/fingerprints) on a CPU backend
+            _is_accelerator = True
+            return True
         try:
             import jax
 

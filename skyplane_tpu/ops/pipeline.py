@@ -148,11 +148,15 @@ class DataPathProcessor:
         dedup: bool = True,
         cdc_params: CDCParams = CDCParams(),
         verify_checksums: bool = True,
+        batch_runner=None,
     ):
         self.codec: CodecSpec = get_codec(codec_name)
         self.dedup = dedup
         self.cdc_params = cdc_params
         self.verify_checksums = verify_checksums
+        # shared DeviceBatchRunner: micro-batches CDC+fingerprint device work
+        # across the operator's worker pool on accelerators
+        self.batch_runner = batch_runner
         self.stats = DataPathStats()
 
     # ---- fingerprints ----
@@ -215,6 +219,11 @@ class DataPathProcessor:
         if not self._on_accelerator():
             ends = cdc_segment_ends(arr, self.cdc_params)
             return ends, self._segment_fps(arr, ends)
+        if self.batch_runner is not None:
+            # the runner chunks with ITS params; both paths must agree or the
+            # same bytes would fingerprint differently depending on routing
+            assert self.batch_runner.cdc_params == self.cdc_params, "batch runner CDC params diverge from processor"
+            return self.batch_runner.cdc_and_fps(arr, self._pad_to_bucket(arr))
         device_chunk = jnp.asarray(self._pad_to_bucket(arr))  # single H2D for both passes
         ends = cdc_segment_ends(arr, self.cdc_params, device_chunk=device_chunk)
         return ends, self._segment_fps(arr, ends, device_chunk=device_chunk)
