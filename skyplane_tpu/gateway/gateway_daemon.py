@@ -380,6 +380,12 @@ def main(argv=None) -> None:
         e2ee_key=e2ee_key,
         use_tls=not args.disable_tls,
     )
+    # graceful SIGTERM (provisioner teardown / docker stop): finish the status
+    # pump and stop workers instead of dying mid-chunk. Installed here at the
+    # process entrypoint — in-process embeddings use daemon.stop() instead.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
     daemon.run()
 
 
