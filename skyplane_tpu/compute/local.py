@@ -103,8 +103,11 @@ class LocalServer(Server):
         env.setdefault("SKYPLANE_LOCAL_GATEWAY_PLATFORM", "cpu")
         env["JAX_PLATFORMS"] = env["SKYPLANE_LOCAL_GATEWAY_PLATFORM"]
         env["SKYPLANE_GATEWAY_JAX_PLATFORM"] = env["SKYPLANE_LOCAL_GATEWAY_PLATFORM"]
-        log_file = open(self.workdir / "daemon.log", "w")
-        self.proc = subprocess.Popen(args, stdout=log_file, stderr=subprocess.STDOUT, env=env)
+        # per-daemon log dir: N local daemons must not interleave one log file
+        env["SKYPLANE_TPU_LOG_DIR"] = str(self.workdir / "logs")
+        with open(self.workdir / "daemon.log", "w") as log_file:
+            # Popen duplicates the fd; closing ours prevents a leak per (re)start
+            self.proc = subprocess.Popen(args, stdout=log_file, stderr=subprocess.STDOUT, env=env)
         self.wait_for_gateway_ready()
 
     def terminate_instance(self) -> None:

@@ -248,6 +248,26 @@ class GatewayDaemonAPI:
             req._send(200, {"events": events})
         elif path == "/api/v1/profile/compression":
             req._send(200, self.compression_stats_fn())
+        elif path == "/api/v1/logs":
+            # live daemon log tail (reference analog: the dozzle container log
+            # viewer on :8888); ?bytes=N bounds the tail (default 64 KiB,
+            # capped at 8 MiB so one request can't slurp a multi-GB log)
+            from skyplane_tpu.utils.logger import _LOG_DIR
+
+            try:
+                n = int(query.get("bytes", ["65536"])[0])
+            except ValueError:
+                n = 65536
+            n = max(0, min(n, 8 << 20))
+            log_file = _LOG_DIR / "client.log"
+            if not log_file.exists():
+                req._send(200, {"log": "", "path": str(log_file)})
+            else:
+                size = log_file.stat().st_size
+                with open(log_file, "rb") as f:
+                    f.seek(max(0, size - n))
+                    tail = f.read().decode(errors="replace")
+                req._send(200, {"log": tail, "path": str(log_file), "size": size})
         else:
             req._send(404, {"error": f"no route {req.path}"})
 
