@@ -115,6 +115,7 @@ class GatewayDaemon:
             segment_store=SegmentStore(spill_dir=Path(chunk_dir) / "segments") if dedup_receive else None,
             bind_host=bind_host,
             raw_forward=raw_forward,
+            cdc_params=self.cdc_params,
         )
 
         # one device batch runner per daemon, shared by every sender worker on

@@ -47,6 +47,7 @@ class GatewayReceiver:
         segment_store: Optional[SegmentStore] = None,
         bind_host: str = "0.0.0.0",
         raw_forward: bool = False,
+        cdc_params=None,
     ):
         self.region = region
         self.chunk_store = chunk_store
@@ -56,7 +57,18 @@ class GatewayReceiver:
         self.use_tls = use_tls
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
         self.segment_store = segment_store if segment_store is not None else (SegmentStore() if dedup else None)
-        self.processor = DataPathProcessor(codec_name="none", dedup=dedup)
+        import os
+
+        from skyplane_tpu.ops.cdc import CDCParams
+
+        # paranoid re-chunking MUST use the sender's CDC params or every valid
+        # recipe would re-fingerprint differently and fail verification
+        self.processor = DataPathProcessor(
+            codec_name="none",
+            dedup=dedup,
+            cdc_params=cdc_params if cdc_params is not None else CDCParams(),
+            paranoid_verify=os.environ.get("SKYPLANE_TPU_PARANOID_VERIFY") == "1",
+        )
         self.bind_host = bind_host
         # relay mode: payloads stay opaque (no decrypt/decode); the wire header
         # is persisted beside the chunk so the forwarding sender can re-frame

@@ -149,6 +149,7 @@ class DataPathProcessor:
         cdc_params: CDCParams = CDCParams(),
         verify_checksums: bool = True,
         batch_runner=None,
+        paranoid_verify: bool = False,
     ):
         self.codec: CodecSpec = get_codec(codec_name)
         self.dedup = dedup
@@ -157,6 +158,10 @@ class DataPathProcessor:
         # shared DeviceBatchRunner: micro-batches CDC+fingerprint device work
         # across the operator's worker pool on accelerators
         self.batch_runner = batch_runner
+        # paranoid: receivers re-run CDC over RESTORED recipe chunks and check
+        # the end-to-end chunk fingerprint — catches even a poisoned segment
+        # store or a fingerprint collision, at the cost of re-hashing
+        self.paranoid_verify = paranoid_verify
         self.stats = DataPathStats()
 
     # ---- fingerprints ----
@@ -299,4 +304,15 @@ class DataPathProcessor:
             got = hashlib.blake2b(data, digest_size=16).hexdigest()
             if got != header.fingerprint:
                 raise ChecksumMismatchException(f"chunk {header.chunk_id}: fingerprint mismatch")
+        if self.paranoid_verify and header.is_recipe and header.fingerprint != "0" * 32:
+            # full end-to-end recipe verification: re-chunk the restored bytes
+            # (deterministic CDC) and rebuild the chunk fingerprint the sender
+            # embedded in the header — any wrong REF substitution surfaces here
+            arr = np.frombuffer(data, np.uint8)
+            _, seg_fps = self._cdc_and_fps(arr)
+            got = self._chunk_fingerprint(seg_fps, len(data))
+            if got != header.fingerprint:
+                raise ChecksumMismatchException(
+                    f"chunk {header.chunk_id}: paranoid recipe verification failed (restored bytes re-fingerprint differently)"
+                )
         return data

@@ -114,3 +114,46 @@ def test_and_queue_requeue_single_branch():
 
     with pytest.raises(_q.Empty):
         q.get_nowait("b")
+
+
+def test_paranoid_verify_catches_poisoned_store():
+    """A segment store poisoned with wrong bytes under a valid fingerprint
+    slips past per-literal checks (REFs trust the fp) — paranoid receivers
+    re-chunk the restored data and catch it end-to-end."""
+    from skyplane_tpu.chunk import ChunkFlags, Codec, WireProtocolHeader
+    from skyplane_tpu.exceptions import ChecksumMismatchException
+    from skyplane_tpu.ops.pipeline import DataPathProcessor
+
+    rng2 = np.random.default_rng(77)
+    data = rng2.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    sender = DataPathProcessor(codec_name="zstd", dedup=True)
+    idx = SenderDedupIndex()
+    p1 = sender.process(data, idx)
+    for fp, size in p1.new_fingerprints:
+        idx.add(fp, size)
+    p2 = sender.process(data, idx)  # all REFs
+    assert p2.n_ref_segments == p2.n_segments
+
+    # honest receiver
+    store = SegmentStore()
+    recv = DataPathProcessor(codec_name="none", dedup=True, paranoid_verify=True)
+    hdr1 = WireProtocolHeader(
+        chunk_id="a" * 32, data_len=len(p1.wire_bytes), raw_data_len=p1.raw_len,
+        codec=int(p1.codec), flags=int(ChunkFlags.COMPRESSED | ChunkFlags.RECIPE), fingerprint=p1.fingerprint,
+    )
+    assert recv.restore(p1.wire_bytes, hdr1, store=store) == data
+
+    # poison the store: swap one segment's bytes under its fingerprint
+    victim_fp = next(iter(store._mem))
+    store._mem[victim_fp] = bytes(len(store._mem[victim_fp]))
+    hdr2 = WireProtocolHeader(
+        chunk_id="b" * 32, data_len=len(p2.wire_bytes), raw_data_len=p2.raw_len,
+        codec=int(p2.codec), flags=int(ChunkFlags.COMPRESSED | ChunkFlags.RECIPE), fingerprint=p2.fingerprint,
+    )
+    with pytest.raises(ChecksumMismatchException, match="paranoid"):
+        recv.restore(p2.wire_bytes, hdr2, store=store)
+
+    # non-paranoid receiver would have accepted the corruption silently
+    lax = DataPathProcessor(codec_name="none", dedup=True, paranoid_verify=False)
+    corrupted = lax.restore(p2.wire_bytes, hdr2, store=store)
+    assert corrupted != data
