@@ -52,9 +52,14 @@ class BoundGateway:
             return 1 << 30  # unreachable gateways sort last
 
     def errors(self) -> List[str]:
+        """Marker prefixes distinguish failure classes for the tracker's
+        dead-gateway detection: a refused connection is definitive death, a
+        timeout is ambiguous (busy gateway under load, or a partition)."""
         try:
             r = requests.get(f"{self.control_url()}/errors", timeout=5)
             return r.json().get("errors", [])
+        except requests.exceptions.Timeout as e:
+            return [f"(error endpoint timeout: {e})"]
         except requests.RequestException as e:
             return [f"(error endpoint unreachable: {e})"]
 
@@ -121,6 +126,15 @@ class Dataplane:
                 t.join(timeout=5)
         self.provisioner.deprovision()
         self.provisioned = False
+        # gateways are down: now it is safe to abort incomplete multipart
+        # uploads from failed jobs (no UploadPart can still be in flight)
+        for t in self._trackers:
+            if t.error is not None:
+                for job in t.jobs:
+                    try:
+                        job.abort()
+                    except Exception as e:  # noqa: BLE001 - best effort
+                        logger.fs.warning(f"multipart abort for job failed: {e}")
 
     @contextmanager
     def auto_deprovision(self):

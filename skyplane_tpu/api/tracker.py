@@ -54,7 +54,7 @@ class TransferProgressTracker(threading.Thread):
         self.chunk_sizes: Dict[str, int] = {}
         self.complete_chunk_ids: Set[str] = set()
         self.transfer_stats: Optional[dict] = None  # filled on success
-        self._unreachable_streaks: Dict[str, int] = {}
+        self._unreachable_streaks: Dict[str, Dict[str, int]] = {}  # gid -> per-class counters
         self._lock = threading.Lock()
 
     # ---- queries (reference: tracker.py:372-399) ----
@@ -106,6 +106,10 @@ class TransferProgressTracker(threading.Thread):
             logger.fs.error(f"[tracker] transfer failed: {e}")
             self.hooks.on_transfer_error(e)
             self._report_usage(time.time() - t0, error=e)
+            # NOTE: multipart-upload abort happens in Dataplane.deprovision,
+            # AFTER gateways are torn down — aborting while gateway workers
+            # still have UploadPart calls in flight would orphan those parts
+            # (billed forever on S3, with the upload id gone)
 
     def _poll_profiles(self) -> Optional[dict]:
         """Summed source-gateway compression counters, or None when any
@@ -199,9 +203,10 @@ class TransferProgressTracker(threading.Thread):
             return {}
 
     # consecutive unreachable error-polls before a gateway is declared dead.
-    # Connection-refused polls fail fast (~30 streaks ≈ 20-60s with backoff);
-    # a black-holed gateway burns the full 5s+10s request timeouts per loop,
-    # so detection there takes ~30 x ~15s ≈ 7-8 minutes.
+    # Connection-refused polls (definitive death) fail fast: ~30 streaks ≈
+    # 20-60s with backoff. Timeout-class failures are ambiguous (busy gateway
+    # vs partition) and use 10x the limit — a black-holed gateway burning the
+    # full request timeouts per loop takes ~300 x ~15s ≈ 75+ minutes.
     UNREACHABLE_STREAK_LIMIT = 30
 
     def _check_gateway_errors(self) -> None:
@@ -210,26 +215,40 @@ class TransferProgressTracker(threading.Thread):
         if real:
             gid, errs = next(iter(real.items()))
             raise GatewayException(f"gateway {gid} reported {len(errs)} errors", gateway_id=gid, tracebacks=errs)
-        # a DEAD gateway reports nothing at all: without this, a crashed daemon
-        # mid-transfer would hang the client until the 24h timeout
-        unreachable = {
-            gid for gid, errs in errors.items() if errs and all(e.startswith("(error endpoint") for e in errs)
+        # A DEAD gateway reports nothing at all: without this, a crashed daemon
+        # mid-transfer would hang the client until the 24h timeout. Failure
+        # classes (markers from BoundGateway.errors):
+        #   refused — definitive death signal, short streak limit
+        #   timeout — ambiguous (GIL/IO-busy gateway under load, or a real
+        #             partition): 10x the limit, and never counted when EVERY
+        #             gateway times out at once (all-timeout = client-side
+        #             outage or the whole fleet busy — either way, not death)
+        refused = {
+            gid for gid, errs in errors.items() if errs and all(e.startswith("(error endpoint unreachable") for e in errs)
         }
-        # EVERY gateway unreachable at once (with >1 gateway) is almost always
-        # a client-side outage (VPN/NAT drop): don't count streaks — gateways
-        # keep transferring and the client recovers when connectivity returns.
-        # Single-gateway topologies can't be disambiguated, so they still
-        # count (a dead lone gateway otherwise hangs until the 24h timeout).
-        if len(unreachable) == len(self.dataplane.bound_gateways) > 1:
-            return
+        timeouts = {
+            gid
+            for gid, errs in errors.items()
+            if gid not in refused and errs and all(e.startswith("(error endpoint") for e in errs)
+        }
+        # when EVERY gateway times out at once, skip COUNTING timeouts this
+        # poll (fleet-wide busy moment or client outage) but do NOT reset
+        # accumulated streaks — a partitioned gateway must still converge
+        all_timeout_moment = len(timeouts) == len(self.dataplane.bound_gateways) > 1
+        # streaks are per failure CLASS: mixing them would let 30 timeout polls
+        # plus one refused poll trip the short refused limit instantly
         for gid in list(self._unreachable_streaks):
-            if gid not in unreachable:
+            if gid not in refused and gid not in timeouts:
                 del self._unreachable_streaks[gid]
-        for gid in unreachable:
-            self._unreachable_streaks[gid] = self._unreachable_streaks.get(gid, 0) + 1
-            if self._unreachable_streaks[gid] >= self.UNREACHABLE_STREAK_LIMIT:
+        for gid in refused | (set() if all_timeout_moment else timeouts):
+            cls = "refused" if gid in refused else "timeout"
+            streaks = self._unreachable_streaks.setdefault(gid, {"refused": 0, "timeout": 0})
+            streaks[cls] += 1
+            streaks["refused" if cls == "timeout" else "timeout"] = 0
+            limit = self.UNREACHABLE_STREAK_LIMIT * (10 if cls == "timeout" else 1)
+            if streaks[cls] >= limit:
                 raise GatewayException(
-                    f"gateway {gid} unreachable for {self._unreachable_streaks[gid]} consecutive polls (crashed or partitioned)",
+                    f"gateway {gid} unreachable ({cls}) for {streaks[cls]} consecutive polls (crashed or partitioned)",
                     gateway_id=gid,
                 )
 

@@ -382,6 +382,27 @@ class CopyJob(TransferJob):
             self.chunker.initiated_uploads,
             n=16,
         )
+        self.chunker.initiated_uploads.clear()  # completed: nothing to abort
+
+    def abort(self) -> None:
+        """Best-effort cleanup of initiated-but-incomplete multipart uploads —
+        open uploads otherwise bill for their staged parts indefinitely
+        (S3/GCS) or leave stray part files (POSIX/HDFS). Call only after the
+        gateways are stopped: an abort racing an in-flight UploadPart orphans
+        that part permanently."""
+        if self.chunker is None or not self.chunker.initiated_uploads:
+            return
+
+        def _abort(entry):
+            iface, key, upload_id = entry
+            try:
+                iface.abort_multipart_upload(key, upload_id)
+            except Exception as abort_e:  # noqa: BLE001 - best effort
+                logger.fs.warning(f"abort_multipart_upload({key}) failed: {abort_e}")
+
+        do_parallel(_abort, self.chunker.initiated_uploads, n=16)
+        logger.fs.info(f"aborted {len(self.chunker.initiated_uploads)} multipart uploads for job {self.uuid}")
+        self.chunker.initiated_uploads.clear()
 
     def verify(self) -> None:
         """Check every mapped destination object exists (reference :746-781).

@@ -164,6 +164,11 @@ class AzureBlobInterface(ObjectStoreInterface):
         # and parts are identified by deterministic block ids
         return uuid.uuid4().hex
 
+    def abort_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        # Azure has no explicit abort: uncommitted blocks are garbage-collected
+        # by the service after ~7 days, so this is a documented no-op.
+        return
+
     def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
         from azure.storage.blob import BlobBlock
 

@@ -190,6 +190,12 @@ class GCSInterface(ObjectStoreInterface):
             raise RuntimeError(f"GCS XML initiate returned no UploadId: {resp.text[:500]}")
         return upload_id.text
 
+    def abort_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        session = self._xml_session()
+        resp = session.delete(self._xml_url(dst_object_name), params={"uploadId": upload_id})
+        if resp.status_code not in (204, 404):
+            resp.raise_for_status()
+
     def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
         import xml.etree.ElementTree as ET
 

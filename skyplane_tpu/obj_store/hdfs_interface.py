@@ -138,6 +138,14 @@ class HDFSInterface(ObjectStoreInterface):
 
         return uuid.uuid4().hex
 
+    def abort_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        base = f"/{dst_object_name.lstrip('/')}"
+        parent = base.rsplit("/", 1)[0] or "/"
+        selector = pafs.FileSelector(parent, recursive=False, allow_not_found=True)
+        for info in self.hdfs.get_file_info(selector):
+            if info.type == pafs.FileType.File and info.path.startswith(base + ".sky_part"):
+                self.hdfs.delete_file(info.path)
+
     def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
         base = f"/{dst_object_name.lstrip('/')}"
         parent = base.rsplit("/", 1)[0] or "/"

@@ -71,3 +71,9 @@ class ObjectStoreInterface(StorageInterface):
 
     def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
         raise NotImplementedError
+
+    def abort_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        """Discard an initiated upload's staged parts. Called on transfer
+        failure — open multipart uploads otherwise keep billing for their
+        parts indefinitely (S3/GCS) or leave stray part files (POSIX/HDFS)."""
+        raise NotImplementedError

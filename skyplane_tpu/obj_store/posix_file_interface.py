@@ -207,6 +207,14 @@ class POSIXInterface(ObjectStoreInterface):
             self._mpu[upload_id] = dst_object_name
         return upload_id
 
+    def abort_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        dest = self._abs(dst_object_name)
+        if dest.parent.is_dir():
+            for p in dest.parent.glob(f"{dest.name}.sky_part*"):
+                p.unlink()
+        with self._mpu_lock:
+            self._mpu.pop(upload_id, None)
+
     def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
         dest = self._abs(dst_object_name)
         dest.parent.mkdir(parents=True, exist_ok=True)

@@ -204,6 +204,9 @@ class S3Interface(ObjectStoreInterface):
         resp = self._s3_client().create_multipart_upload(Bucket=self.bucket_name, Key=dst_object_name, **args)
         return resp["UploadId"]
 
+    def abort_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
+        self._s3_client().abort_multipart_upload(Bucket=self.bucket_name, Key=dst_object_name, UploadId=upload_id)
+
     def complete_multipart_upload(self, dst_object_name: str, upload_id: str) -> None:
         client = self._s3_client()
         parts = []

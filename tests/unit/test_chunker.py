@@ -121,3 +121,18 @@ class TestChunker:
         chunks = list(chunker.chunk(pairs))
         assert len(chunks) <= 4
         assert sum(c.chunk_length_bytes for c in chunks) == 10 << 20
+
+
+def test_abort_cleans_staged_parts(tmp_path):
+    """abort_multipart_upload removes staged part files (POSIX backend)."""
+    dst = POSIXInterface(str(tmp_path / "out"))
+    dst.create_bucket()
+    upload_id = dst.initiate_multipart_upload("obj.bin")
+    part = tmp_path / "p.bin"
+    part.write_bytes(b"x" * 100)
+    dst.upload_object(part, "obj.bin", part_number=1, upload_id=upload_id)
+    dst.upload_object(part, "obj.bin", part_number=2, upload_id=upload_id)
+    assert len(list((tmp_path / "out").glob("*.sky_part*"))) == 2
+    dst.abort_multipart_upload("obj.bin", upload_id)
+    assert list((tmp_path / "out").glob("*.sky_part*")) == []
+    assert not dst.exists("obj.bin")
