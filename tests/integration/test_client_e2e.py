@@ -231,3 +231,41 @@ def test_dead_gateway_surfaces_error(tmp_path, monkeypatch):
         # either detection path is a win: the unreachable-streak detector, or
         # the source gateway's own fatal send error surfacing first
         assert isinstance(tracker.error, GatewayException), f"expected GatewayException, got {tracker.error!r}"
+
+
+@pytest.mark.slow
+def test_multi_job_single_dataplane(tmp_path):
+    """Two copy jobs with different buckets share one dataplane: each job's
+    chunks must route through ITS partition DAG to ITS destination bucket
+    (reference matrix: pipeline multi-job case)."""
+    srcA = tmp_path / "srcA"; srcB = tmp_path / "srcB"
+    dstA = tmp_path / "dstA"; dstB = tmp_path / "dstB"
+    dataA = _fill_bucket(srcA, n_files=2, size=128 * 1024)
+    dataB = _fill_bucket(srcB, n_files=2, size=128 * 1024)
+    dstA.mkdir(); dstB.mkdir()
+
+    jobs = []
+    for src_root, dst_root in ((srcA, dstA), (srcB, dstB)):
+        job = CopyJob("local:///", ["local:///"], recursive=True)
+        job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+        job._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+        job.src_path = "local:///"
+        job.dst_paths = ["local:///"]
+        jobs.append(job)
+
+    cfg = TransferConfig(compress="zstd", dedup=False, multipart_threshold_mb=1024, num_connections=2)
+    pipe = Pipeline(transfer_config=cfg)
+    pipe.jobs_to_dispatch.extend(jobs)
+    dp = pipe.create_dataplane()
+    # one gateway per side, TWO partitions each (one per job)
+    src_gw = dp.topology.source_gateways()[0]
+    partitions = [p for group in src_gw.gateway_program.to_dict()["plan"] for p in group["partitions"]]
+    assert len(partitions) == 2
+    with dp.auto_deprovision():
+        dp.provision()
+        dp.run(jobs)
+    for name, payload in dataA.items():
+        assert (dstA / name).read_bytes() == payload, f"job A content wrong: {name}"
+        assert not (dstB / name).exists() or (dstB / name).read_bytes() != payload or name in dataB
+    for name, payload in dataB.items():
+        assert (dstB / name).read_bytes() == payload, f"job B content wrong: {name}"
