@@ -13,7 +13,6 @@ from skyplane_tpu.api.config import AWSConfig, AzureConfig, GCPConfig, TransferC
 from skyplane_tpu.api.pipeline import Pipeline
 from skyplane_tpu.api.provisioner import Provisioner
 from skyplane_tpu.config_paths import tmp_log_dir
-from skyplane_tpu.utils.logger import logger
 
 
 class SkyplaneClient:

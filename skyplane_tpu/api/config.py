@@ -7,7 +7,7 @@ additions: codec/dedup/CDC knobs instead of a single lz4 toggle.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from skyplane_tpu.ops.cdc import CDCParams

@@ -8,7 +8,6 @@ polling, log collection, auto_deprovision context manager.
 
 from __future__ import annotations
 
-import threading
 from contextlib import contextmanager
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -17,7 +16,7 @@ import requests
 
 from skyplane_tpu.api.config import TransferConfig
 from skyplane_tpu.api.provisioner import Provisioner
-from skyplane_tpu.exceptions import GatewayException, SkyplaneTpuException
+from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.gateway.crypto import generate_key
 from skyplane_tpu.planner.topology import TopologyPlan, TopologyPlanGateway
 from skyplane_tpu.utils import do_parallel

@@ -14,7 +14,6 @@ from typing import Dict, List, Optional, Tuple
 from skyplane_tpu.compute.cloud_provider import CloudProvider, get_cloud_provider
 from skyplane_tpu.compute.server import Server
 from skyplane_tpu.utils import do_parallel
-from skyplane_tpu.utils.logger import logger
 
 
 @dataclass

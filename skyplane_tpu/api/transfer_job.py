@@ -16,7 +16,7 @@ import math
 import queue
 import threading
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Generator, Iterable, List, Optional, Tuple
 
 import requests

@@ -8,7 +8,6 @@ with forced deprovision on interrupt.
 
 from __future__ import annotations
 
-import sys
 from typing import List, Optional
 
 from rich.console import Console
@@ -17,7 +16,6 @@ from skyplane_tpu.api.config import TransferConfig
 from skyplane_tpu.api.pipeline import Pipeline
 from skyplane_tpu.config_paths import cloud_config
 from skyplane_tpu.exceptions import SkyplaneTpuException
-from skyplane_tpu.utils.logger import logger
 from skyplane_tpu.utils.path import parse_path
 
 console = Console()

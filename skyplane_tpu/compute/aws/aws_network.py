@@ -7,7 +7,7 @@ authorization).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 from skyplane_tpu.utils.logger import logger
 

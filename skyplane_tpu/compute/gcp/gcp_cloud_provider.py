@@ -17,7 +17,6 @@ from skyplane_tpu.compute.cloud_provider import CloudProvider
 from skyplane_tpu.compute.gcp.gcp_auth import GCPAuthentication
 from skyplane_tpu.compute.server import SSHServer, ServerState
 from skyplane_tpu.config_paths import key_root
-from skyplane_tpu.utils.logger import logger
 
 COMPUTE = "https://compute.googleapis.com/compute/v1"
 NETWORK_NAME = "skyplane-tpu"

@@ -18,7 +18,6 @@ from typing import List, Optional
 from skyplane_tpu.compute.cloud_provider import CloudProvider
 from skyplane_tpu.compute.server import SSHServer, ServerState
 from skyplane_tpu.config_paths import key_root
-from skyplane_tpu.utils.logger import logger
 
 VPC_NAME = "skyplane-tpu"
 TAG = "skyplane-tpu"

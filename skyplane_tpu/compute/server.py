@@ -14,7 +14,6 @@ import shlex
 import subprocess
 import time
 from enum import Enum, auto
-from pathlib import Path
 from typing import Dict, Optional, Tuple
 
 import requests

@@ -7,7 +7,6 @@ onto a status queue the daemon API drains (reference: chunk_store.py:72-91).
 
 from __future__ import annotations
 
-import os
 import queue
 import shutil
 import threading

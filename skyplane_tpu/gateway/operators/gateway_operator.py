@@ -26,10 +26,10 @@ import requests
 
 import json
 
-from skyplane_tpu.chunk import ChunkRequest, ChunkState, Codec, WireProtocolHeader
+from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
-from skyplane_tpu.gateway.gateway_queue import GatewayANDQueue, GatewayQueue
+from skyplane_tpu.gateway.gateway_queue import GatewayQueue
 from skyplane_tpu.ops.cdc import CDCParams
 from skyplane_tpu.ops.dedup import SenderDedupIndex
 from skyplane_tpu.ops.pipeline import DataPathProcessor

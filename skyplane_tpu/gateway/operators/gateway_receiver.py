@@ -20,7 +20,7 @@ import traceback
 from pathlib import Path
 from typing import Dict, List, Optional
 
-from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
+from skyplane_tpu.chunk import WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.gateway.cert import generate_self_signed_certificate
 from skyplane_tpu.gateway.chunk_store import ChunkStore

@@ -8,9 +8,7 @@ part-numbered upload flow, reference :148-260).
 
 from __future__ import annotations
 
-import base64
 import hashlib
-from functools import lru_cache
 from typing import Iterator, List, Optional
 
 import requests

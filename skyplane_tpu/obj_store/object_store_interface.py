@@ -8,9 +8,9 @@ initiate/complete multipart).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from datetime import datetime
-from typing import Iterator, List, Optional, Tuple
+from typing import Optional
 
 from skyplane_tpu.obj_store.storage_interface import StorageInterface
 

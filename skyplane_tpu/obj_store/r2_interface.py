@@ -9,7 +9,6 @@ skyplane_tpu/cli/cli_transfer.py).
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 from skyplane_tpu.obj_store.s3_interface import S3Interface, S3Object
 

@@ -10,7 +10,6 @@ with an endpoint override (the reference implements raw signed REST).
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 from skyplane_tpu.exceptions import BadConfigException
 from skyplane_tpu.obj_store.s3_interface import S3Interface, S3Object

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import struct
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -20,13 +20,13 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from skyplane_tpu.chunk import ChunkFlags, Codec, WireProtocolHeader
+from skyplane_tpu.chunk import Codec, WireProtocolHeader
 from skyplane_tpu.exceptions import ChecksumMismatchException, CodecException
 from skyplane_tpu.ops import blockpack
 from skyplane_tpu.ops.cdc import CDCParams, cdc_segment_ends, segment_ids_and_rev_pos

@@ -19,7 +19,6 @@ tags/fingerprints/literal compaction are fully local after the halo exchange
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -27,7 +27,6 @@ from skyplane_tpu.gateway.gateway_program import (
 )
 from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
 from skyplane_tpu.planner.topology import TopologyPlan
-from skyplane_tpu.utils.logger import logger
 
 # vCPU counts per instance class, smallest-last fallback ladder
 # (reference: data/vcpu_info.csv + planner.py:114-159)

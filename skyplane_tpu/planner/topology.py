@@ -8,7 +8,7 @@ JSON the daemons use for peer addressing.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from skyplane_tpu.gateway.gateway_program import GatewayProgram

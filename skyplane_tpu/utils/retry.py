@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional, Tuple, Type, TypeVar
+from typing import Callable, Tuple, Type, TypeVar
 
 from skyplane_tpu.utils.logger import logger
 
