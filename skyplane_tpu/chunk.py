@@ -27,6 +27,7 @@ Frame layout (big-endian, 78 bytes):
 from __future__ import annotations
 
 import hashlib
+import re
 import socket
 from dataclasses import dataclass, field, asdict
 from enum import Enum, IntEnum, auto
@@ -38,6 +39,24 @@ from skyplane_tpu.exceptions import SkyplaneTpuException
 MAGIC = int.from_bytes(b"SKYTPU\x00\x04", "big")
 WIRE_VERSION = 4
 HEADER_LENGTH_BYTES = 78
+
+# Hard ceiling on per-chunk sizes accepted off the wire or the control API.
+# data_len/raw_data_len are attacker-controlled u64s that feed straight into
+# bytearray()/codec allocations — a hostile frame must not be able to request
+# an arbitrarily large allocation (and the resulting MemoryError must not kill
+# the daemon). 8 GiB is ~128x the default 64 MiB chunk size.
+MAX_CHUNK_BYTES = 8 << 30
+
+_CHUNK_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+
+
+def validate_chunk_id(chunk_id: str) -> str:
+    """chunk_id is joined into filesystem paths (<chunk_dir>/<id>.chunk); ids
+    arriving via the control API are arbitrary strings, so anything but the
+    canonical 32-hex uuid form (e.g. '../../x') is rejected before use."""
+    if not isinstance(chunk_id, str) or not _CHUNK_ID_RE.match(chunk_id):
+        raise SkyplaneTpuException(f"invalid chunk_id {chunk_id!r}: must be 32 lowercase hex chars")
+    return chunk_id
 
 
 class Codec(IntEnum):
@@ -156,6 +175,7 @@ class ChunkRequest:
     def from_dict(d: dict) -> "ChunkRequest":
         d = dict(d)
         d["chunk"] = Chunk.from_dict(d["chunk"])
+        validate_chunk_id(d["chunk"].chunk_id)
         return ChunkRequest(**d)
 
 
@@ -238,10 +258,16 @@ class WireProtocolHeader:
         crc = int.from_bytes(data[70:78], "big")
         if crc != _crc64(data[:70]):
             raise SkyplaneTpuException("wire header CRC mismatch")
+        data_len = int.from_bytes(data[28:36], "big")
+        raw_data_len = int.from_bytes(data[36:44], "big")
+        if data_len > MAX_CHUNK_BYTES or raw_data_len > MAX_CHUNK_BYTES:
+            raise SkyplaneTpuException(
+                f"wire header claims {max(data_len, raw_data_len)} payload bytes (> {MAX_CHUNK_BYTES} cap)"
+            )
         return WireProtocolHeader(
             chunk_id=data[12:28].hex(),
-            data_len=int.from_bytes(data[28:36], "big"),
-            raw_data_len=int.from_bytes(data[36:44], "big"),
+            data_len=data_len,
+            raw_data_len=raw_data_len,
             codec=data[44],
             flags=data[45],
             fingerprint=data[46:62].hex(),

@@ -27,6 +27,8 @@ import requests
 import json
 
 from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
+from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
 from skyplane_tpu.gateway.gateway_queue import GatewayQueue
@@ -229,15 +231,41 @@ class GatewayObjStoreReadOperator(_ObjStoreOperator):
 class GatewayObjStoreWriteOperator(_ObjStoreOperator):
     """Multipart-aware object-store upload (reference :592-647)."""
 
+    UPLOAD_ID_WAIT_S = 300.0  # how long a part may wait for its upload-id map
+
     def __init__(self, *args, upload_id_map: Dict[str, str], **kwargs):
         super().__init__(*args, **kwargs)
         self.upload_id_map = upload_id_map  # dest_key -> upload_id (client-pushed)
+        self._upload_id_first_wait: Dict[str, float] = {}  # chunk_id -> first requeue ts
+        self._wait_lock = threading.Lock()
 
     def process(self, chunk_req: ChunkRequest, worker_id: int) -> bool:
         chunk = chunk_req.chunk
         fpath = self.chunk_store.chunk_path(chunk.chunk_id)
         dest_key = (chunk.dest_keys or {}).get(self.bucket_region, chunk.dest_key)
         upload_id = self.upload_id_map.get(dest_key) if chunk.multi_part else None
+        if chunk.multi_part and upload_id is None:
+            # the client's upload-id map push raced this chunk (or failed). A
+            # whole-object put_object of one part here would be silently
+            # overwritten by the later complete_multipart_upload — corrupting
+            # the object while existence-only checks still pass. Re-queue
+            # until the map arrives (reference hard-asserts instead,
+            # skyplane/gateway/operators/gateway_operator.py:626) — but with a
+            # deadline: a map that never arrives (client died mid-dispatch)
+            # must fail the transfer loudly, not hang it at 10 Hz forever.
+            now = time.time()
+            with self._wait_lock:
+                first = self._upload_id_first_wait.setdefault(chunk.chunk_id, now)
+            if now - first > self.UPLOAD_ID_WAIT_S:
+                raise SkyplaneTpuException(
+                    f"no upload_id for multipart {dest_key} after {self.UPLOAD_ID_WAIT_S:.0f}s "
+                    "(client upload-id map push lost?)"
+                )
+            logger.fs.warning(f"[{self.handle}] no upload_id yet for multipart {dest_key}; re-queueing")
+            time.sleep(0.1)
+            return False
+        with self._wait_lock:
+            self._upload_id_first_wait.pop(chunk.chunk_id, None)
         retry_backoff(
             lambda: self._iface().upload_object(
                 fpath,
@@ -383,7 +411,27 @@ class GatewaySenderOperator(GatewayOperator):
                 # the chunk (and its dedup literals) is durably landed, so the
                 # fingerprint commit and 'complete' below are truthful.
                 ack = sock.recv(1)
-                if ack != b"\x06":
+                if ack == NACK_UNRESOLVED:
+                    if self.dedup_index is not None and payload is not None:
+                        # receiver no longer holds a segment this recipe REF'd:
+                        # forget those fingerprints so the retry resends
+                        # literals instead of replaying the same recipe
+                        for fp in payload.ref_fingerprints:
+                            self.dedup_index.discard(fp)
+                        logger.fs.warning(
+                            f"[{self.handle}:{worker_id}] receiver nacked chunk {chunk.chunk_id}; "
+                            f"dropped {len(payload.ref_fingerprints)} fps, will resend literals"
+                        )
+                        return False  # re-queue: re-process builds a literal-heavy recipe
+                    # relay path (payload is None): the staged bytes are opaque —
+                    # we CANNOT rebuild the recipe, and re-queueing would replay
+                    # the identical unresolvable frame forever. Fail fast (this
+                    # escapes the OSError socket-retry handling below on purpose).
+                    raise SkyplaneTpuException(
+                        f"downstream receiver nacked relayed chunk {chunk.chunk_id} "
+                        "(unresolvable dedup ref; relay cannot rebuild the recipe)"
+                    )
+                if ack != ACK_BYTE:
                     raise OSError(f"bad/missing chunk ack ({ack!r})")
                 if self.dedup_index is not None and payload is not None:
                     for fp, size in payload.new_fingerprints:

@@ -21,7 +21,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from skyplane_tpu.chunk import WireProtocolHeader
-from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.exceptions import DedupIntegrityException, SkyplaneTpuException
 from skyplane_tpu.gateway.cert import generate_self_signed_certificate
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
@@ -31,6 +31,7 @@ from skyplane_tpu.utils.logger import logger
 
 RECV_BLOCK = 4 * 1024 * 1024
 ACK_BYTE = b"\x06"  # per-chunk delivery ack written back on the data socket
+NACK_UNRESOLVED = b"\x15"  # REF in a recipe did not resolve: sender must resend literals
 
 
 class GatewayReceiver:
@@ -48,6 +49,7 @@ class GatewayReceiver:
         bind_host: str = "0.0.0.0",
         raw_forward: bool = False,
         cdc_params=None,
+        ref_wait_timeout: float = 60.0,
     ):
         self.region = region
         self.chunk_store = chunk_store
@@ -70,6 +72,8 @@ class GatewayReceiver:
             paranoid_verify=os.environ.get("SKYPLANE_TPU_PARANOID_VERIFY") == "1",
         )
         self.bind_host = bind_host
+        # how long a REF may wait for its in-flight LITERAL before nacking
+        self.ref_wait_timeout = ref_wait_timeout
         # relay mode: payloads stay opaque (no decrypt/decode); the wire header
         # is persisted beside the chunk so the forwarding sender can re-frame
         # it unchanged (reference: relays forward without decrypt/decompress)
@@ -82,6 +86,11 @@ class GatewayReceiver:
         # frame must not be a gateway DoS. Persistent corruption escalates.
         self._payload_error_count = 0
         self.max_payload_errors = 20
+        # unresolvable-REF nacks are an EXPECTED, recoverable condition (the
+        # sender discards fps and resends literals) — budget them separately
+        # from corruption, with a higher cap, also reset on any success
+        self._nack_count = 0
+        self.max_nacks = 200
         self.socket_profile_events: "queue.Queue[dict]" = queue.Queue()
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if use_tls:
@@ -173,11 +182,35 @@ class GatewayReceiver:
                         )
                     )
                 else:
-                    if header.is_encrypted:
-                        if self.cipher is None:
-                            raise RuntimeError("received encrypted chunk but no E2EE key configured")
+                    # E2EE is all-or-nothing per receiver: when a key is
+                    # configured, EVERY frame must be encrypted and MUST
+                    # authenticate. The ENCRYPTED flag is attacker-controlled
+                    # (header CRC is unkeyed), so a cleared flag cannot be
+                    # allowed to bypass cipher.open() — a peer that reaches
+                    # the data port would otherwise inject plaintext frames.
+                    if self.cipher is not None:
+                        if not header.is_encrypted:
+                            raise SkyplaneTpuException(
+                                f"unencrypted frame for chunk {header.chunk_id} at E2EE-enabled receiver"
+                            )
                         payload = self.cipher.open(payload)
-                    data = self.processor.restore(payload, header, store=self.segment_store)
+                    elif header.is_encrypted:
+                        raise SkyplaneTpuException("received encrypted chunk but no E2EE key configured")
+                    try:
+                        data = self.processor.restore(
+                            payload, header, store=self.segment_store, ref_wait_timeout=self.ref_wait_timeout
+                        )
+                    except DedupIntegrityException as e:
+                        # a REF pointed at a segment this receiver no longer
+                        # holds (evicted / never arrived). The stream is still
+                        # framed correctly, so nack in-band: the sender drops
+                        # those fingerprints and retries with literals. Do NOT
+                        # drop the connection — that would just replay the
+                        # same unresolvable recipe forever.
+                        logger.fs.warning(f"[receiver:{port}] nacking chunk {header.chunk_id}: {e}")
+                        conn.sendall(NACK_UNRESOLVED)
+                        self._count_nack(str(e))
+                        continue
                     fpath.write_bytes(data)
                 fpath.with_suffix(".done").touch()
                 # application-level ack: the sender commits dedup fingerprints
@@ -190,6 +223,7 @@ class GatewayReceiver:
                     # lifetime total that would kill long-lived daemons over
                     # isolated transients
                     self._payload_error_count = 0
+                    self._nack_count = 0
                 logger.fs.debug(
                     f"[receiver:{port}] landed chunk {header.chunk_id} ({header.raw_data_len}B raw, {header.data_len}B wire)"
                 )
@@ -197,14 +231,13 @@ class GatewayReceiver:
             # malformed/corrupt payload from the peer: drop this connection
             # (no ack was sent, so the sender re-queues the chunk). Repeated
             # payload errors indicate systemic corruption -> fail the daemon.
-            with self._lock:
-                self._payload_error_count += 1
-                count = self._payload_error_count
-            logger.fs.warning(f"[receiver:{port}] dropping connection on bad payload ({count}): {e}")
-            if count >= self.max_payload_errors:
-                tb = traceback.format_exc()
-                self.error_queue.put(f"receiver exceeded {self.max_payload_errors} payload errors; last: {tb}")
-                self.error_event.set()
+            logger.fs.warning(f"[receiver:{port}] dropping connection on bad payload: {e}")
+            self._count_payload_error(traceback.format_exc())
+        except MemoryError as e:
+            # an oversized (but header-cap-passing) allocation failed: hostile
+            # or corrupt frames must not be a daemon DoS — payload error path
+            logger.fs.warning(f"[receiver:{port}] dropping connection on allocation failure: {e}")
+            self._count_payload_error(f"MemoryError receiving payload: {e}")
         except Exception:  # noqa: BLE001 — unexpected receiver error stops the daemon
             tb = traceback.format_exc()
             logger.fs.error(f"[receiver:{port}] fatal: {tb}")
@@ -215,6 +248,26 @@ class GatewayReceiver:
                 conn.close()
             except OSError:
                 pass
+
+    def _count_payload_error(self, detail: str) -> None:
+        """Bump the payload-error budget; escalate to daemon failure at the cap."""
+        with self._lock:
+            self._payload_error_count += 1
+            count = self._payload_error_count
+        if count >= self.max_payload_errors:
+            self.error_queue.put(f"receiver exceeded {self.max_payload_errors} payload errors; last: {detail}")
+            self.error_event.set()
+
+    def _count_nack(self, detail: str) -> None:
+        """Bump the (recoverable) nack budget; a runaway nack storm still
+        indicates something systemically wrong — e.g. a sender that never
+        drops its fps — and eventually fails the daemon."""
+        with self._lock:
+            self._nack_count += 1
+            count = self._nack_count
+        if count >= self.max_nacks:
+            self.error_queue.put(f"receiver exceeded {self.max_nacks} consecutive dedup nacks; last: {detail}")
+            self.error_event.set()
 
     def _recv_exact(self, conn: socket.socket, n: int) -> bytes:
         buf = bytearray(n)

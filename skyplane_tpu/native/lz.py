@@ -19,9 +19,14 @@ def compress(data: bytes) -> bytes:
 
 
 def decompress(buf: bytes) -> bytes:
+    from skyplane_tpu.chunk import MAX_CHUNK_BYTES
+
     if len(buf) < 11 or buf[:2] != b"SL" or buf[2] != 1:
         raise CodecException("native_lz: bad container header")
     raw_len = int.from_bytes(buf[3:11], "little")
+    # raw_len is an attacker-controlled u64 fed straight into an allocation
+    if raw_len > MAX_CHUNK_BYTES:
+        raise CodecException(f"native_lz: container claims {raw_len} raw bytes (> {MAX_CHUNK_BYTES} cap)")
     lib = load_library()
     out = ctypes.create_string_buffer(max(raw_len, 1))
     n = lib.skyfastlz_decompress(buf, len(buf), out, raw_len)

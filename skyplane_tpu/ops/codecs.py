@@ -42,9 +42,21 @@ def _encode_zstd(data: bytes) -> bytes:
 
 def _decode_zstd(buf: bytes) -> bytes:
     # decode consumes bytes from the wire: corruption must surface inside the
-    # codec error contract, not as a raw ZstdError the receiver treats as fatal
+    # codec error contract, not as a raw ZstdError the receiver treats as fatal.
+    # The frame's embedded content size is attacker-controlled and is allocated
+    # up front by decompress() — bound it before touching the allocator.
+    from skyplane_tpu.chunk import MAX_CHUNK_BYTES
+
     zstd = _zstd()
     try:
+        params = zstd.get_frame_parameters(buf)
+        if params.content_size in (zstd.CONTENTSIZE_UNKNOWN, zstd.CONTENTSIZE_ERROR):
+            # our encoder always embeds the content size; a sizeless frame is
+            # either corrupt or hostile, and decompressing one would force an
+            # allocation of max_output_size regardless of the actual payload
+            raise CodecException("zstd frame does not declare content size (rejected)")
+        if params.content_size > MAX_CHUNK_BYTES:
+            raise CodecException(f"zstd frame claims {params.content_size} bytes (> {MAX_CHUNK_BYTES} cap)")
         return zstd.ZstdDecompressor().decompress(buf)
     except zstd.ZstdError as e:
         raise CodecException(f"zstd decode failed (corrupt frame): {e}") from e

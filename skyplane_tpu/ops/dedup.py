@@ -75,6 +75,13 @@ class SenderDedupIndex:
     def __len__(self) -> int:
         return len(self._lru)
 
+    def discard(self, fp: bytes) -> None:
+        """Forget a fingerprint (receiver nacked an unresolvable REF to it)."""
+        with self._lock:
+            size = self._lru.pop(fp, None)
+            if size is not None:
+                self._bytes -= size
+
 
 class SegmentStore:
     """Receiver-side fingerprint -> segment bytes store.
@@ -106,27 +113,38 @@ class SegmentStore:
 
     def put(self, fp: bytes, data: bytes) -> None:
         with self._lock:
-            if fp in self._mem:
-                self._mem.move_to_end(fp)
-                return
-            self._mem[fp] = data
-            self._mem_bytes += len(data)
-            while self._mem_bytes > self._max_bytes and self._mem:
-                old_fp, old_data = self._mem.popitem(last=False)
-                self._mem_bytes -= len(old_data)
-                p = self._spill_path(old_fp)
-                if p is not None and old_fp not in self._spill_order:
+            self._admit(fp, data)
+            self._arrival.notify_all()
+
+    def _admit(self, fp: bytes, data: bytes) -> None:
+        """Insert into the in-memory LRU, spilling evictees to disk. Lock held."""
+        if fp in self._mem:
+            self._mem.move_to_end(fp)
+            return
+        self._mem[fp] = data
+        self._mem_bytes += len(data)
+        while self._mem_bytes > self._max_bytes and self._mem:
+            old_fp, old_data = self._mem.popitem(last=False)
+            self._mem_bytes -= len(old_data)
+            p = self._spill_path(old_fp)
+            if p is not None:
+                if old_fp in self._spill_order:
+                    # already on disk from an earlier eviction: refresh recency
+                    self._spill_order.move_to_end(old_fp)
+                else:
                     p.write_bytes(old_data)
                     self._spill_order[old_fp] = len(old_data)
                     self._spill_bytes += len(old_data)
-                    # bound spill disk usage: drop the oldest spilled segments
-                    while self._spill_bytes > self._spill_max_bytes and self._spill_order:
-                        drop_fp, drop_sz = self._spill_order.popitem(last=False)
-                        self._spill_bytes -= drop_sz
-                        dp = self._spill_path(drop_fp)
-                        if dp is not None and dp.exists():
-                            dp.unlink()
-            self._arrival.notify_all()
+                # bound spill disk usage: drop the LEAST-RECENTLY-USED spilled
+                # segments (get() refreshes recency, so retention here stays
+                # coherent with the sender's LRU index — a hot segment the
+                # sender keeps REF'ing is never the one evicted)
+                while self._spill_bytes > self._spill_max_bytes and self._spill_order:
+                    drop_fp, drop_sz = self._spill_order.popitem(last=False)
+                    self._spill_bytes -= drop_sz
+                    dp = self._spill_path(drop_fp)
+                    if dp is not None and dp.exists():
+                        dp.unlink()
 
     def get(self, fp: bytes, wait_timeout: float = 0.0) -> bytes:
         """Resolve a fingerprint, optionally blocking for in-flight literals.
@@ -134,6 +152,10 @@ class SegmentStore:
         With parallel sender sockets a REF can land before its LITERAL
         (SURVEY §7 hard part #3); ``wait_timeout`` > 0 turns unresolved refs
         into a bounded wait on literal arrival instead of an instant failure.
+
+        Hits refresh recency on BOTH tiers (memory LRU move-to-end; spill hits
+        are promoted back into memory), so receiver retention dominates the
+        sender index's LRU — a segment the sender still REFs stays resolvable.
         """
         import time as _time
 
@@ -145,7 +167,11 @@ class SegmentStore:
                     return self._mem[fp]
                 p = self._spill_path(fp)
                 if p is not None and p.exists():
-                    return p.read_bytes()
+                    data = p.read_bytes()
+                    if fp in self._spill_order:
+                        self._spill_order.move_to_end(fp)
+                    self._admit(fp, data)  # promote hot spilled segment to memory
+                    return data
                 remaining = deadline - _time.monotonic()
                 if remaining <= 0:
                     raise DedupIntegrityException(f"unresolvable dedup ref {fp.hex()}")
@@ -162,26 +188,29 @@ def build_recipe(
     segments: List[Tuple[bytes, bytes]],  # [(fp16, seg_bytes), ...] in order
     index: SenderDedupIndex,
     encode_blob,
-) -> Tuple[bytes, int, int, List[bytes]]:
+) -> Tuple[bytes, int, int, List[bytes], List[bytes]]:
     """Assemble a recipe for one chunk.
 
     Returns (wire_bytes, n_ref_segments, n_literal_bytes_pre_codec,
-    new_fingerprints as [(fp, size), ...]). The index is NOT mutated here: the
-    caller must commit ``new_fingerprints`` via ``index.add(fp, size)`` only
-    after the frame is successfully delivered (acked) — otherwise a failed send would poison the index
-    and later retries would emit REFs the receiver cannot resolve.
+    new_fingerprints as [(fp, size), ...], ref_fingerprints as [fp, ...]).
+    The index is NOT mutated here: the caller must commit
+    ``new_fingerprints`` via ``index.add(fp, size)`` only after the frame is
+    successfully delivered (acked) — otherwise a failed send would poison the
+    index and later retries would emit REFs the receiver cannot resolve.
+    ``ref_fingerprints`` lets the caller *discard* those entries if the
+    receiver nacks an unresolvable REF, so the retry resends literals.
     Repeats *within* this chunk are still deduped (they travel in the same
     frame, so in-order resolution is guaranteed).
     """
     entries = bytearray()
     lit_parts: List[bytes] = []
-    n_ref = 0
     emitted_here: set = set()
     new_fps: List[bytes] = []
+    ref_fps: List[bytes] = []
     for fp, seg in segments:
         if fp in index or fp in emitted_here:
             entries += _ENTRY.pack(KIND_REF, fp, len(seg))
-            n_ref += 1
+            ref_fps.append(fp)
         else:
             entries += _ENTRY.pack(KIND_LIT, fp, len(seg))
             lit_parts.append(seg)
@@ -189,7 +218,7 @@ def build_recipe(
             new_fps.append((fp, len(seg)))
     lit_blob = encode_blob(b"".join(lit_parts))
     head = MAGIC + struct.pack("<BI", VERSION, len(segments))
-    return head + bytes(entries) + lit_blob, n_ref, sum(len(p) for p in lit_parts), new_fps
+    return head + bytes(entries) + lit_blob, len(ref_fps), sum(len(p) for p in lit_parts), new_fps, ref_fps
 
 
 def parse_recipe(

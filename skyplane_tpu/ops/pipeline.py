@@ -91,6 +91,7 @@ class ProcessedPayload:
     n_ref_segments: int = 0
     literal_bytes: int = 0  # pre-codec literal bytes shipped (dedup mode)
     new_fingerprints: list = field(default_factory=list)  # commit to index AFTER delivery
+    ref_fingerprints: list = field(default_factory=list)  # discard from index on unresolvable-ref nack
 
 
 @dataclass
@@ -246,7 +247,7 @@ class DataPathProcessor:
             ends, seg_fps = self._cdc_and_fps(arr)
             starts = np.concatenate([[0], ends[:-1]])
             segments = [(seg_fps[i], data[starts[i] : ends[i]]) for i in range(len(ends))]
-            wire, n_ref, lit_bytes, new_fps = build_recipe(segments, index, self.codec.encode)
+            wire, n_ref, lit_bytes, new_fps, ref_fps = build_recipe(segments, index, self.codec.encode)
             payload = ProcessedPayload(
                 wire_bytes=wire,
                 codec=self.codec.codec_id,
@@ -258,6 +259,7 @@ class DataPathProcessor:
                 n_ref_segments=n_ref,
                 literal_bytes=lit_bytes,
                 new_fingerprints=new_fps,
+                ref_fingerprints=ref_fps,
             )
         else:
             wire = self.codec.encode(data)

@@ -24,7 +24,7 @@ def test_recipe_roundtrip_and_dedup():
     store = SegmentStore()
     s1, s2 = _seg(), _seg()
     segments = [s1, s2, s1]  # in-chunk repeat -> 1 REF
-    wire, n_ref, lit_bytes, new_fps = build_recipe(segments, index, ident)
+    wire, n_ref, lit_bytes, new_fps, ref_fps = build_recipe(segments, index, ident)
     assert n_ref == 1 and len(new_fps) == 2
     assert len(index) == 0, "build_recipe must not mutate the index before delivery"
     out = parse_recipe(wire, store, ident, verify_literals=True)
@@ -32,7 +32,7 @@ def test_recipe_roundtrip_and_dedup():
     # commit, then second chunk refs everything
     for fp, size in new_fps:
         index.add(fp, size)
-    wire2, n_ref2, lit2, new2 = build_recipe([s1, s2], index, ident)
+    wire2, n_ref2, lit2, new2, refs2 = build_recipe([s1, s2], index, ident)
     assert n_ref2 == 2 and lit2 == 0 and not new2
     assert parse_recipe(wire2, store, ident) == s1[1] + s2[1]
     assert len(wire2) < 100  # refs only: ~25B/entry

@@ -1,0 +1,260 @@
+"""Hostile-input hardening tests (round-2 advisor findings).
+
+Covers: E2EE flag-bypass rejection, wire-header allocation caps, codec
+container caps, multipart upload-id race, receiver-dominant dedup eviction,
+unresolvable-REF nack, and control-API chunk_id path validation.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import uuid
+
+import pytest
+
+from skyplane_tpu.chunk import (
+    MAX_CHUNK_BYTES,
+    Chunk,
+    ChunkFlags,
+    ChunkRequest,
+    Codec,
+    WireProtocolHeader,
+)
+from skyplane_tpu.exceptions import CodecException, DedupIntegrityException, SkyplaneTpuException
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.crypto import ChunkCipher, generate_key
+from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, GatewayReceiver
+from skyplane_tpu.ops import dedup as dedup_mod
+from skyplane_tpu.ops.codecs import get_codec
+from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex
+
+
+# ---------- wire header / allocation caps ----------
+
+
+def _mk_header(**kw) -> WireProtocolHeader:
+    defaults = dict(chunk_id=uuid.uuid4().hex, data_len=10, raw_data_len=10)
+    defaults.update(kw)
+    return WireProtocolHeader(**defaults)
+
+
+def test_header_rejects_oversized_data_len():
+    h = _mk_header(data_len=MAX_CHUNK_BYTES + 1)
+    with pytest.raises(SkyplaneTpuException, match="cap"):
+        WireProtocolHeader.from_bytes(h.to_bytes())
+
+
+def test_header_rejects_oversized_raw_data_len():
+    h = _mk_header(raw_data_len=1 << 62)
+    with pytest.raises(SkyplaneTpuException, match="cap"):
+        WireProtocolHeader.from_bytes(h.to_bytes())
+
+
+def test_header_accepts_max_sizes():
+    h = _mk_header(data_len=MAX_CHUNK_BYTES, raw_data_len=MAX_CHUNK_BYTES)
+    rt = WireProtocolHeader.from_bytes(h.to_bytes())
+    assert rt.data_len == MAX_CHUNK_BYTES
+
+
+def test_native_lz_container_caps_claimed_raw_len():
+    from skyplane_tpu.native import lz
+
+    bogus = b"SL" + bytes([1]) + (1 << 62).to_bytes(8, "little") + b"x" * 16
+    with pytest.raises(CodecException, match="cap"):
+        lz.decompress(bogus)
+
+
+def test_zstd_decode_caps_claimed_content_size():
+    import zstandard
+
+    # an honest tiny frame decodes fine through the capped path
+    codec = get_codec("zstd")
+    assert codec.decode(codec.encode(b"hello")) == b"hello"
+    # a forged frame header claiming 2^62 content bytes is rejected BEFORE the
+    # decompressor allocates: magic + descriptor(8-byte FCS) + window + FCS
+    forged = b"\x28\xb5\x2f\xfd" + bytes([0xC0, 0x00]) + (1 << 62).to_bytes(8, "little")
+    with pytest.raises(CodecException, match="cap"):
+        codec.decode(forged)
+    # a streamed frame WITHOUT a declared content size is rejected outright:
+    # decoding one would allocate max_output_size for an arbitrarily tiny
+    # hostile frame, and our encoder always embeds the size
+    cobj = zstandard.ZstdCompressor().compressobj()
+    unknown = cobj.compress(b"z" * 1000) + cobj.flush()
+    with pytest.raises(CodecException, match="content size"):
+        codec.decode(unknown)
+
+
+# ---------- chunk_id path validation ----------
+
+
+def test_chunk_request_rejects_traversal_chunk_id():
+    d = ChunkRequest(chunk=Chunk(src_key="s", dest_key="d", chunk_id=uuid.uuid4().hex, chunk_length_bytes=1)).as_dict()
+    d["chunk"]["chunk_id"] = "../../etc/passwd"
+    with pytest.raises(SkyplaneTpuException, match="chunk_id"):
+        ChunkRequest.from_dict(d)
+
+
+def test_chunk_request_rejects_non_hex_chunk_id():
+    d = ChunkRequest(chunk=Chunk(src_key="s", dest_key="d", chunk_id=uuid.uuid4().hex, chunk_length_bytes=1)).as_dict()
+    d["chunk"]["chunk_id"] = "Z" * 32
+    with pytest.raises(SkyplaneTpuException, match="chunk_id"):
+        ChunkRequest.from_dict(d)
+
+
+def test_chunk_request_accepts_canonical_chunk_id():
+    d = ChunkRequest(chunk=Chunk(src_key="s", dest_key="d", chunk_id=uuid.uuid4().hex, chunk_length_bytes=1)).as_dict()
+    assert ChunkRequest.from_dict(d).chunk.chunk_id == d["chunk"]["chunk_id"]
+
+
+# ---------- multipart upload-id race ----------
+
+
+def test_multipart_chunk_without_upload_id_requeues(tmp_path):
+    from skyplane_tpu.gateway.operators.gateway_operator import GatewayObjStoreWriteOperator
+
+    store = ChunkStore(str(tmp_path / "chunks"))
+    op = GatewayObjStoreWriteOperator(
+        "write",
+        "local:local",
+        GatewayQueue(),
+        None,
+        threading.Event(),
+        queue.Queue(),
+        store,
+        bucket_name="bkt",
+        bucket_region="local:local",
+        upload_id_map={},
+    )
+    chunk = Chunk(src_key="s", dest_key="d", chunk_id=uuid.uuid4().hex, chunk_length_bytes=1, multi_part=True, part_number=3)
+    req = ChunkRequest(chunk=chunk)
+    assert op.process(req, 0) is False  # re-queued, NOT silently uploaded whole
+
+
+# ---------- dedup eviction coherence ----------
+
+
+def test_segment_store_get_promotes_and_lru_spill_eviction(tmp_path):
+    seg = lambda c: bytes([c]) * 60  # noqa: E731
+    store = SegmentStore(max_bytes=100, spill_dir=tmp_path / "spill", spill_max_bytes=150)
+    fpA, fpB, fpC, fpD = (bytes([i]) * 16 for i in range(4))
+    store.put(fpA, seg(0))
+    store.put(fpB, seg(1))  # A evicted to spill
+    assert store.get(fpA) == seg(0)  # spill hit: promotes A, refreshes recency
+    store.put(fpC, seg(2))
+    store.put(fpD, seg(3))  # spill over budget: LRU (cold B), not hot A, is dropped
+    assert store.get(fpA, wait_timeout=0) == seg(0)
+    with pytest.raises(DedupIntegrityException):
+        store.get(fpB, wait_timeout=0)
+
+
+def test_sender_index_discard():
+    idx = SenderDedupIndex()
+    fp = b"\x01" * 16
+    idx.add(fp, 100)
+    assert fp in idx
+    idx.discard(fp)
+    assert fp not in idx
+    idx.discard(fp)  # idempotent
+
+
+# ---------- live receiver: E2EE enforcement + NACK ----------
+
+
+def _mk_receiver(tmp_path, **kw):
+    store = ChunkStore(str(tmp_path / "rx_chunks"))
+    ev, eq = threading.Event(), queue.Queue()
+    r = GatewayReceiver(
+        "local:local", store, ev, eq, use_tls=False, bind_host="127.0.0.1", ref_wait_timeout=0.2, **kw
+    )
+    port = r.start_server()
+    return r, store, ev, eq, port
+
+
+def _send_frame(port: int, header: WireProtocolHeader, payload: bytes) -> bytes:
+    """Send one frame and return the 1-byte response (b'' if connection dropped)."""
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    sock.settimeout(5)
+    try:
+        header.to_socket(sock)
+        sock.sendall(payload)
+        try:
+            return sock.recv(1)
+        except (socket.timeout, ConnectionError):
+            return b""
+    finally:
+        sock.close()
+
+
+def test_receiver_rejects_plaintext_frame_when_e2ee_enabled(tmp_path):
+    key = generate_key()
+    r, store, ev, eq, port = _mk_receiver(tmp_path, e2ee_key=key)
+    try:
+        chunk_id = uuid.uuid4().hex
+        payload = b"forged plaintext"
+        # ENCRYPTED flag deliberately cleared — must NOT bypass decryption
+        header = WireProtocolHeader(chunk_id=chunk_id, data_len=len(payload), raw_data_len=len(payload))
+        resp = _send_frame(port, header, payload)
+        assert resp != ACK_BYTE  # connection dropped, no ack
+        assert not store.chunk_path(chunk_id).exists(), "forged plaintext chunk must not land"
+        assert not ev.is_set(), "a hostile frame must not kill the daemon"
+    finally:
+        r.stop_all()
+
+
+def test_receiver_accepts_properly_encrypted_frame(tmp_path):
+    key = generate_key()
+    r, store, ev, eq, port = _mk_receiver(tmp_path, e2ee_key=key)
+    try:
+        chunk_id = uuid.uuid4().hex
+        raw = b"legit bytes"
+        sealed = ChunkCipher(key).seal(raw)
+        header = WireProtocolHeader(
+            chunk_id=chunk_id, data_len=len(sealed), raw_data_len=len(raw), flags=int(ChunkFlags.ENCRYPTED)
+        )
+        resp = _send_frame(port, header, sealed)
+        assert resp == ACK_BYTE
+        assert store.chunk_path(chunk_id).read_bytes() == raw
+    finally:
+        r.stop_all()
+
+
+def test_receiver_rejects_garbage_ciphertext(tmp_path):
+    key = generate_key()
+    r, store, ev, eq, port = _mk_receiver(tmp_path, e2ee_key=key)
+    try:
+        chunk_id = uuid.uuid4().hex
+        payload = b"\x00" * 64  # flag set but not actually sealed with the key
+        header = WireProtocolHeader(
+            chunk_id=chunk_id, data_len=len(payload), raw_data_len=64, flags=int(ChunkFlags.ENCRYPTED)
+        )
+        resp = _send_frame(port, header, payload)
+        assert resp != ACK_BYTE
+        assert not store.chunk_path(chunk_id).exists()
+        assert not ev.is_set()
+    finally:
+        r.stop_all()
+
+
+def test_receiver_nacks_unresolvable_ref(tmp_path):
+    r, store, ev, eq, port = _mk_receiver(tmp_path, dedup=True)
+    try:
+        chunk_id = uuid.uuid4().hex
+        unknown_fp = b"\xab" * 16
+        wire = (
+            dedup_mod.MAGIC
+            + struct.pack("<BI", dedup_mod.VERSION, 1)
+            + dedup_mod._ENTRY.pack(dedup_mod.KIND_REF, unknown_fp, 8)
+        )  # empty literal blob (codec none)
+        header = WireProtocolHeader(
+            chunk_id=chunk_id, data_len=len(wire), raw_data_len=8, flags=int(ChunkFlags.RECIPE)
+        )
+        resp = _send_frame(port, header, wire)
+        assert resp == NACK_UNRESOLVED
+        assert not store.chunk_path(chunk_id).exists()
+        assert not ev.is_set(), "an unresolvable ref must degrade, not kill the daemon"
+    finally:
+        r.stop_all()
