@@ -304,6 +304,7 @@ class GatewayDaemon:
                 e2ee_key=self.e2ee_key if op.get("encrypt") else None,
                 use_tls=self.use_tls,
                 batch_runner=self.batch_runner,
+                window=int(os.environ.get("SKYPLANE_TPU_SENDER_WINDOW", op.get("window", 16))),
             )
         raise ValueError(f"unknown operator type {op_type!r}")
 

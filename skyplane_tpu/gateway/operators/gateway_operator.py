@@ -80,38 +80,53 @@ class GatewayOperator:
             t.join(timeout=timeout)
 
     def worker_loop(self, worker_id: int) -> None:
+        """One loop serves both per-chunk and windowed operators: the batch
+        size is whatever ``_drain_batch`` returns (1 for base operators; the
+        sender overrides it to fill a send window)."""
         try:
             self.worker_setup(worker_id)
             while not self.exit_flag.is_set() and not self.error_event.is_set():
-                try:
-                    chunk_req = self.input_queue.pop(self.handle, timeout=0.25)
-                except queue.Empty:
+                batch = self._drain_batch()
+                if not batch:
                     continue
-                try:
-                    if self.log_in_progress:
+                if self.log_in_progress:
+                    for chunk_req in batch:
                         self.chunk_store.log_chunk_state(chunk_req, ChunkState.in_progress, self.handle, worker_id)
-                    succeeded = self.process(chunk_req, worker_id)
+                try:
+                    results = self.process_batch(batch, worker_id)
                 except Exception as e:  # noqa: BLE001 — per-chunk failure path
-                    logger.fs.error(f"[{self.handle}:{worker_id}] chunk {chunk_req.chunk.chunk_id} failed: {e}")
-                    self.chunk_store.log_chunk_state(chunk_req, ChunkState.failed, self.handle, worker_id)
+                    ids = ",".join(r.chunk.chunk_id for r in batch)
+                    logger.fs.error(f"[{self.handle}:{worker_id}] chunk(s) {ids} failed: {e}")
+                    for chunk_req in batch:
+                        self.chunk_store.log_chunk_state(chunk_req, ChunkState.failed, self.handle, worker_id)
                     raise
-                if succeeded:
-                    self.chunk_store.log_chunk_state(chunk_req, ChunkState.complete, self.handle, worker_id)
-                    if self.output_queue is not None:
-                        self.output_queue.put(chunk_req)
-                else:
-                    # transient / not-ready: silently re-queue for another pass
-                    # (reference :104-106; state stays in_progress to avoid log spam
-                    # from poll-style operators like WaitReceiver). Returned to THIS
-                    # handle only — a plain put on a mux_and queue would duplicate
-                    # the chunk to every sibling branch.
-                    self.input_queue.put_for_handle(self.handle, chunk_req)
+                for chunk_req, succeeded in zip(batch, results):
+                    if succeeded:
+                        self.chunk_store.log_chunk_state(chunk_req, ChunkState.complete, self.handle, worker_id)
+                        if self.output_queue is not None:
+                            self.output_queue.put(chunk_req)
+                    else:
+                        # transient / not-ready: silently re-queue for another pass
+                        # (reference :104-106; state stays in_progress to avoid log spam
+                        # from poll-style operators like WaitReceiver). Returned to THIS
+                        # handle only — a plain put on a mux_and queue would duplicate
+                        # the chunk to every sibling branch.
+                        self.input_queue.put_for_handle(self.handle, chunk_req)
             self.worker_teardown(worker_id)
         except Exception:  # noqa: BLE001 — fatal: stop the daemon
             tb = traceback.format_exc()
             logger.fs.error(f"[{self.handle}:{worker_id}] fatal: {tb}")
             self.error_queue.put(tb)
             self.error_event.set()
+
+    def _drain_batch(self) -> List[ChunkRequest]:
+        try:
+            return [self.input_queue.pop(self.handle, timeout=0.25)]
+        except queue.Empty:
+            return []
+
+    def process_batch(self, batch: List[ChunkRequest], worker_id: int) -> List[bool]:
+        return [self.process(chunk_req, worker_id) for chunk_req in batch]
 
     # hooks
     def worker_setup(self, worker_id: int) -> None: ...
@@ -280,13 +295,38 @@ class GatewayObjStoreWriteOperator(_ObjStoreOperator):
         return True
 
 
+class _WindowFpView:
+    """Dedup-index view for one in-flight send window.
+
+    Fingerprints whose literals were framed EARLIER ON THE SAME SOCKET (but
+    not yet acked) are REF-safe for later chunks in the window: the receiver
+    stores literals in frame order before resolving later refs (dedup.py
+    consistency contract). The view is discarded if the window fails, so
+    nothing uncommitted ever leaks into the durable index.
+    """
+
+    def __init__(self, index: SenderDedupIndex):
+        self.index = index
+        self.pending: set = set()
+
+    def __contains__(self, fp: bytes) -> bool:
+        return fp in self.pending or fp in self.index
+
+
 class GatewaySenderOperator(GatewayOperator):
     """Pushes chunks to a remote gateway over framed TCP(+TLS).
 
     Per-worker persistent socket (reference opens one socket per sender
-    process, :248-262). Protocol per chunk: HTTPS pre-register on the target's
-    control API, then header+payload on the data socket. The payload runs
-    through DataPathProcessor (codec + dedup) and optional AES-GCM seal.
+    process, :248-262). Unlike round 1's stop-and-wait (one chunk, one ack,
+    one RTT), each worker drains up to ``window`` chunks from its queue,
+    pre-registers them in ONE control POST, streams all frames back-to-back,
+    then collects the per-chunk acks cumulatively — so a full window is in
+    flight per RTT (reference streams with no app-level ack at all,
+    chunk.py:96-155 n_chunks_left; we keep the ack for the dedup
+    commit-after-delivery contract and pipeline it instead).
+
+    The payload runs through DataPathProcessor (codec + dedup) and optional
+    AES-GCM seal.
     """
 
     def __init__(
@@ -301,6 +341,8 @@ class GatewaySenderOperator(GatewayOperator):
         e2ee_key: Optional[bytes] = None,
         use_tls: bool = True,
         batch_runner=None,
+        window: int = 16,
+        window_bytes: int = 256 << 20,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -313,6 +355,8 @@ class GatewaySenderOperator(GatewayOperator):
         )
         self.dedup_index = SenderDedupIndex() if dedup else None
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
+        self.window = max(1, int(window))
+        self.window_bytes = int(window_bytes)
         self._local = threading.local()
         self._session = requests.Session()
         self._session.verify = False
@@ -353,46 +397,70 @@ class GatewaySenderOperator(GatewayOperator):
     def worker_teardown(self, worker_id: int) -> None:
         self._reset_sock()
 
-    def process(self, chunk_req: ChunkRequest, worker_id: int) -> bool:
+    def _drain_batch(self) -> List[ChunkRequest]:
+        """One blocking pop, then opportunistically fill the window — bounded
+        by chunk count AND total staged bytes, so a window of default-sized
+        64 MiB chunks cannot multiply per-worker memory by the window size."""
+        try:
+            batch = [self.input_queue.pop(self.handle, timeout=0.25)]
+        except queue.Empty:
+            return []
+        total = batch[0].chunk.chunk_length_bytes
+        while len(batch) < self.window and total < self.window_bytes:
+            try:
+                req = self.input_queue.get_nowait(self.handle)
+            except queue.Empty:
+                break
+            batch.append(req)
+            total += req.chunk.chunk_length_bytes
+        return batch
+
+    def _frame_chunk(self, chunk_req: ChunkRequest, view: Optional[_WindowFpView], n_left: int):
+        """Build (payload, wire, header) for one chunk. payload is None on the
+        relay path (opaque staged bytes re-framed with their original header)."""
         chunk = chunk_req.chunk
         fpath = self.chunk_store.chunk_path(chunk.chunk_id)
         hdr_sidecar = fpath.with_suffix(".hdr")
         if hdr_sidecar.exists():
-            # relay forward: the staged bytes are an opaque wire payload landed
-            # by a raw_forward receiver — re-frame with the original header
             meta = json.loads(hdr_sidecar.read_text())
             wire = fpath.read_bytes()
-            payload = None
-            header = WireProtocolHeader(
+            return None, wire, WireProtocolHeader(
                 chunk_id=chunk.chunk_id,
                 data_len=len(wire),
                 raw_data_len=meta["raw_data_len"],
                 codec=meta["codec"],
                 flags=meta["flags"],
                 fingerprint=meta["fingerprint"],
-                n_chunks_left_on_socket=1,
+                n_chunks_left_on_socket=n_left,
             )
-        else:
-            data = fpath.read_bytes()
-            payload = self.processor.process(data, self.dedup_index)
-            wire = payload.wire_bytes
-            if self.cipher is not None:
-                wire = self.cipher.seal(wire)
-            chunk.fingerprint = payload.fingerprint
-            header = chunk.to_wire_header(
-                n_chunks_left_on_socket=1,  # persistent socket: receiver loops until closed
-                wire_length=len(wire),
-                raw_wire_length=payload.raw_len,
-                codec=payload.codec,
-                is_compressed=payload.is_compressed,
-                is_encrypted=self.cipher is not None,
-                is_recipe=payload.is_recipe,
-            )
-        # pre-register the chunk at the destination (reference :277-319)
-        reg = chunk_req.as_dict()
+        data = fpath.read_bytes()
+        payload = self.processor.process(data, view if view is not None else self.dedup_index)
+        if view is not None:
+            # later chunks in this window may REF these (in-order socket)
+            view.pending.update(fp for fp, _ in payload.new_fingerprints)
+        wire = payload.wire_bytes
+        if self.cipher is not None:
+            wire = self.cipher.seal(wire)
+        chunk.fingerprint = payload.fingerprint
+        header = chunk.to_wire_header(
+            n_chunks_left_on_socket=n_left,
+            wire_length=len(wire),
+            raw_wire_length=payload.raw_len,
+            codec=payload.codec,
+            is_compressed=payload.is_compressed,
+            is_encrypted=self.cipher is not None,
+            is_recipe=payload.is_recipe,
+        )
+        return payload, wire, header
+
+    def process_batch(self, batch: List[ChunkRequest], worker_id: int) -> List[bool]:
+        # pre-register the whole window at the destination in ONE control POST
+        # (reference pre-registers per chunk, :277-319). Must precede the data
+        # frames so completion accounting never sees an unregistered chunk.
+        regs = [req.as_dict() for req in batch]
         for attempt in range(3):
             try:
-                resp = self._session.post(f"{self._control_base}/chunk_requests", json=[reg], timeout=30)
+                resp = self._session.post(f"{self._control_base}/chunk_requests", json=regs, timeout=30)
                 resp.raise_for_status()
                 break
             except requests.RequestException as e:
@@ -400,44 +468,59 @@ class GatewaySenderOperator(GatewayOperator):
                     raise
                 logger.fs.warning(f"[{self.handle}] chunk pre-register retry: {e}")
                 time.sleep(0.5 * (attempt + 1))
-        # framed send with socket-recreate retries (reference :375-402)
-        for attempt in range(3):
-            try:
-                sock = self._sock()
+        view = _WindowFpView(self.dedup_index) if self.dedup_index is not None else None
+        results = [False] * len(batch)
+        sent = []  # (req, payload) for acked-frame bookkeeping only
+        try:
+            sock = self._sock()
+            # frame-and-stream: each chunk's wire bytes are released as soon
+            # as they hit the socket, so worker memory holds ONE chunk at a
+            # time (plus ack bookkeeping), not the whole window
+            for i, req in enumerate(batch):
+                payload, wire, header = self._frame_chunk(req, view, n_left=len(batch) - i - 1)
                 header.to_socket(sock)
                 sock.sendall(wire)
-                # wait for the receiver's application-level ack: sendall only
-                # proves the bytes reached the local TCP buffer. The ack means
-                # the chunk (and its dedup literals) is durably landed, so the
-                # fingerprint commit and 'complete' below are truthful.
+                del wire
+                sent.append((req, payload))
+            # cumulative ack collection: acks arrive in frame order (the
+            # receiver's per-connection loop is sequential). sendall only
+            # proves bytes reached the local TCP buffer; the ack means the
+            # chunk (and its dedup literals) is durably landed, so the
+            # fingerprint commits below are truthful.
+            for i, (req, payload) in enumerate(sent):
                 ack = sock.recv(1)
-                if ack == NACK_UNRESOLVED:
+                if ack == ACK_BYTE:
                     if self.dedup_index is not None and payload is not None:
-                        # receiver no longer holds a segment this recipe REF'd:
-                        # forget those fingerprints so the retry resends
-                        # literals instead of replaying the same recipe
+                        for fp, size in payload.new_fingerprints:
+                            self.dedup_index.add(fp, size)
+                    results[i] = True
+                elif ack == NACK_UNRESOLVED:
+                    if self.dedup_index is not None and payload is not None:
+                        # receiver no longer holds a segment this recipe
+                        # REF'd: forget those fps (durable index AND window
+                        # view) so the retry resends literals
                         for fp in payload.ref_fingerprints:
                             self.dedup_index.discard(fp)
+                            if view is not None:
+                                view.pending.discard(fp)
                         logger.fs.warning(
-                            f"[{self.handle}:{worker_id}] receiver nacked chunk {chunk.chunk_id}; "
+                            f"[{self.handle}:{worker_id}] receiver nacked chunk {req.chunk.chunk_id}; "
                             f"dropped {len(payload.ref_fingerprints)} fps, will resend literals"
                         )
-                        return False  # re-queue: re-process builds a literal-heavy recipe
-                    # relay path (payload is None): the staged bytes are opaque —
-                    # we CANNOT rebuild the recipe, and re-queueing would replay
-                    # the identical unresolvable frame forever. Fail fast (this
-                    # escapes the OSError socket-retry handling below on purpose).
-                    raise SkyplaneTpuException(
-                        f"downstream receiver nacked relayed chunk {chunk.chunk_id} "
-                        "(unresolvable dedup ref; relay cannot rebuild the recipe)"
-                    )
-                if ack != ACK_BYTE:
+                    else:
+                        # relay path: the staged bytes are opaque — we CANNOT
+                        # rebuild the recipe, and re-queueing would replay the
+                        # identical unresolvable frame forever. Fail fast.
+                        raise SkyplaneTpuException(
+                            f"downstream receiver nacked relayed chunk {req.chunk.chunk_id} "
+                            "(unresolvable dedup ref; relay cannot rebuild the recipe)"
+                        )
+                else:
                     raise OSError(f"bad/missing chunk ack ({ack!r})")
-                if self.dedup_index is not None and payload is not None:
-                    for fp, size in payload.new_fingerprints:
-                        self.dedup_index.add(fp, size)
-                return True
-            except (OSError, ssl.SSLError) as e:
-                logger.fs.warning(f"[{self.handle}:{worker_id}] socket error (attempt {attempt + 1}): {e}")
-                self._reset_sock()
-        return False  # transient: chunk is re-queued
+        except (OSError, ssl.SSLError) as e:
+            # un-acked chunks stay False and are re-queued by the caller;
+            # nothing uncommitted leaked into the dedup index (window view)
+            logger.fs.warning(f"[{self.handle}:{worker_id}] socket error mid-window: {e}")
+            self._reset_sock()
+            time.sleep(0.2)
+        return results

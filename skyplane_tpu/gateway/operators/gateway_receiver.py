@@ -49,7 +49,7 @@ class GatewayReceiver:
         bind_host: str = "0.0.0.0",
         raw_forward: bool = False,
         cdc_params=None,
-        ref_wait_timeout: float = 60.0,
+        ref_wait_timeout: float = 10.0,
     ):
         self.region = region
         self.chunk_store = chunk_store
@@ -72,7 +72,11 @@ class GatewayReceiver:
             paranoid_verify=os.environ.get("SKYPLANE_TPU_PARANOID_VERIFY") == "1",
         )
         self.bind_host = bind_host
-        # how long a REF may wait for its in-flight LITERAL before nacking
+        # how long a REF may wait for its in-flight LITERAL before nacking.
+        # MUST stay well below the sender's 30 s data-socket timeout: a
+        # blocking wait in this sequential conn loop stalls every later frame
+        # on the socket, and past the sender timeout the whole window is
+        # reset+resent instead of the cheap in-band nack.
         self.ref_wait_timeout = ref_wait_timeout
         # relay mode: payloads stay opaque (no decrypt/decode); the wire header
         # is persisted beside the chunk so the forwarding sender can re-frame
