@@ -119,7 +119,7 @@ class TransferProgressTracker(threading.Thread):
 
         def poll(gw):
             try:
-                prof = requests.get(f"{gw.control_url()}/profile/compression", timeout=5).json()
+                prof = gw.control_session().get(f"{gw.control_url()}/profile/compression", timeout=5).json()
                 return prof if isinstance(prof, dict) else None
             except requests.RequestException:
                 return None
@@ -195,7 +195,7 @@ class TransferProgressTracker(threading.Thread):
 
     def _poll_gateway_status(self, gateway) -> Dict[str, str]:
         try:
-            r = requests.get(f"{gateway.control_url()}/chunk_status_log", timeout=10)
+            r = gateway.control_session().get(f"{gateway.control_url()}/chunk_status_log", timeout=10)
             r.raise_for_status()
             return r.json().get("chunk_status", {})
         except requests.RequestException as e:

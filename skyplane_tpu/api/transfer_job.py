@@ -320,7 +320,9 @@ class CopyJob(TransferJob):
 
         src_gateways = dataplane.source_gateways()
         sink_gateways = dataplane.sink_gateways()
-        session = requests.Session()
+        # all gateways of a dataplane share one bearer token; any bound
+        # gateway's session authenticates against all of them
+        session = src_gateways[0].control_session() if src_gateways else requests.Session()
 
         for batch in batch_generator(chunk_gen, self.DISPATCH_BATCH_SIZE):
             # flush any multipart upload-id mappings to every sink gateway first

@@ -17,8 +17,6 @@ import uuid
 from pathlib import Path
 from typing import Dict, List, Tuple
 
-import requests
-
 from skyplane_tpu.utils.logger import logger
 
 
@@ -38,12 +36,13 @@ def measure_pair(src_server, dst_server, probe_mb: int = 256, num_connections: i
         )
         reqs.append(ChunkRequest(chunk=chunk, src_type="gen_data", dst_type="local"))
     t0 = time.time()
-    resp = requests.post(f"{src_server.control_url()}/chunk_requests", json=[r.as_dict() for r in reqs], timeout=60)
+    src_session, dst_session = src_server.control_session(), dst_server.control_session()
+    resp = src_session.post(f"{src_server.control_url()}/chunk_requests", json=[r.as_dict() for r in reqs], timeout=60)
     resp.raise_for_status()
     ids = {r.chunk.chunk_id for r in reqs}
     deadline = time.time() + timeout
     while time.time() < deadline:
-        status = requests.get(f"{dst_server.control_url()}/chunk_status_log", timeout=30).json()["chunk_status"]
+        status = dst_session.get(f"{dst_server.control_url()}/chunk_status_log", timeout=30).json()["chunk_status"]
         if all(status.get(cid) == "complete" for cid in ids):
             elapsed = time.time() - t0
             return probe_mb * 8 / 1000 / elapsed

@@ -58,6 +58,7 @@ class LocalServer(Server):
         use_tls: bool = True,
         use_bbr: bool = True,
     ) -> None:
+        self._record_control_credentials(gateway_info, use_tls)
         # re-starting with a new program (e.g. throughput probes) replaces the
         # old daemon — two processes cannot share the control port
         if self.proc is not None:

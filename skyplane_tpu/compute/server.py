@@ -39,6 +39,10 @@ class Server:
         self.region_tag = region_tag
         self.instance_id = instance_id
         self.control_port = 8081
+        # control-plane credentials/transport, set by start_gateway
+        self.api_token: Optional[str] = None
+        self.control_tls: bool = False
+        self._control_session = None
 
     # ---- addressing ----
     def public_ip(self) -> str:
@@ -68,12 +72,25 @@ class Server:
 
     # ---- gateway lifecycle (reference: server.py:300-429) ----
     def control_url(self) -> str:
-        return f"http://{self.public_ip()}:{self.control_port}/api/v1"
+        scheme = "https" if self.control_tls else "http"
+        return f"{scheme}://{self.public_ip()}:{self.control_port}/api/v1"
+
+    def control_session(self) -> requests.Session:
+        """Authenticated session for this gateway's control API — cached, so
+        pollers (tracker ticks, queue_depth in the dispatch loop) reuse one
+        connection pool instead of a fresh TCP+TLS handshake per call."""
+        if self._control_session is None:
+            from skyplane_tpu.gateway.control_auth import control_session
+
+            self._control_session = control_session(self.api_token)
+        return self._control_session
 
     def wait_for_gateway_ready(self, timeout: float = 120.0) -> None:
+        session = self.control_session()
+
         def check() -> bool:
             try:
-                r = requests.get(f"{self.control_url()}/status", timeout=5)
+                r = session.get(f"{self.control_url()}/status", timeout=5)
                 return r.status_code == 200
             except requests.RequestException:
                 return False
@@ -82,6 +99,16 @@ class Server:
             wait_for(check, timeout=timeout, interval=1.0, desc=f"gateway {self.instance_id} status")
         except TimeoutError as e:
             raise GatewayContainerStartException(f"gateway on {self.instance_id} did not become ready") from e
+
+    def _record_control_credentials(self, gateway_info: Dict[str, dict], use_tls: bool) -> None:
+        """Mirror the dataplane-wide control credentials (ridden in the info
+        file's _meta entry) onto this server so client-side calls authenticate."""
+        from skyplane_tpu.gateway.control_auth import INFO_META_KEY
+
+        meta = gateway_info.get(INFO_META_KEY) or {}
+        self.api_token = meta.get("api_token")
+        self.control_tls = bool(meta.get("control_tls", use_tls))
+        self._control_session = None  # credentials changed: drop cached session
 
     def start_gateway(
         self,
@@ -188,6 +215,7 @@ class SSHServer(Server):
         use_tls: bool = True,
         use_bbr: bool = True,
     ) -> None:
+        self._record_control_credentials(gateway_info, use_tls)
         self.tune_network(use_bbr)
         # replace any daemon from a previous start_gateway (program reconfig):
         # bracket pattern self-excludes the remote shell; wait for exit so the

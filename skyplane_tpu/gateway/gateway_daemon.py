@@ -78,6 +78,15 @@ class GatewayDaemon:
         self.error_queue: "queue.Queue[str]" = queue.Queue()
         self.e2ee_key = e2ee_key
         self.use_tls = use_tls
+        # dataplane-wide control-plane credentials ride in the info file's
+        # reserved _meta entry (written by Dataplane.provision); the same
+        # token authenticates inbound requests AND our calls to peer gateways
+        from skyplane_tpu.gateway.control_auth import INFO_META_KEY
+
+        meta = gateway_info.get(INFO_META_KEY) or {}
+        self.api_token: Optional[str] = meta.get("api_token")
+        # control API rides TLS whenever the data sockets do
+        self.control_tls = bool(meta.get("control_tls", use_tls))
 
         dedup_receive = any(
             op.get("op_type") == "receive" and op.get("dedup")
@@ -140,6 +149,18 @@ class GatewayDaemon:
         self._or_counter = 0
         self._build_operators(gateway_program)
 
+        ssl_ctx = None
+        if self.control_tls:
+            import ssl as _ssl
+
+            from skyplane_tpu.gateway.cert import generate_self_signed_certificate
+
+            cert_dir = Path(chunk_dir) / "certs"
+            cert, key = generate_self_signed_certificate(
+                "skyplane-tpu-control", cert_dir / "api_cert.pem", cert_dir / "api_key.pem"
+            )
+            ssl_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(certfile=str(cert), keyfile=str(key))
         self.api = GatewayDaemonAPI(
             chunk_store=self.chunk_store,
             receiver=self.receiver,
@@ -152,6 +173,8 @@ class GatewayDaemon:
             host=bind_host,
             port=control_port,
             compression_stats_fn=self._compression_stats,
+            api_token=self.api_token,
+            ssl_ctx=ssl_ctx,
         )
         self.api.upload_id_map_update = self._update_upload_ids
 
@@ -305,6 +328,8 @@ class GatewayDaemon:
                 use_tls=self.use_tls,
                 batch_runner=self.batch_runner,
                 window=int(os.environ.get("SKYPLANE_TPU_SENDER_WINDOW", op.get("window", 16))),
+                api_token=self.api_token,
+                control_tls=self.control_tls,
             )
         raise ValueError(f"unknown operator type {op_type!r}")
 

@@ -55,6 +55,8 @@ class GatewayDaemonAPI:
         host: str = "0.0.0.0",
         port: int = 8081,
         compression_stats_fn=None,
+        api_token: Optional[str] = None,
+        ssl_ctx=None,
     ):
         self.chunk_store = chunk_store
         self.receiver = receiver
@@ -65,6 +67,10 @@ class GatewayDaemonAPI:
         self.region = region
         self.gateway_id = gateway_id
         self.compression_stats_fn = compression_stats_fn or (lambda: {})
+        # bearer token required on every route except GET /status (liveness
+        # probes predate token distribution during provisioning). None =
+        # auth disabled (local in-process harness).
+        self.api_token = api_token
 
         self._lock = threading.Lock()
         self.chunk_requests: Dict[str, dict] = {}  # chunk_id -> chunk request dict
@@ -95,9 +101,27 @@ class GatewayDaemonAPI:
                 raw = self.rfile.read(length) if length else b"{}"
                 return json.loads(raw or b"{}")
 
+            def _authorized(self, method: str) -> bool:
+                if api.api_token is None:
+                    return True
+                path, _ = GatewayDaemonAPI._split_route(self)
+                if method == "GET" and path == "/api/v1/status":
+                    return True  # open liveness probe (leaks region/id only)
+                from skyplane_tpu.gateway.control_auth import token_matches
+
+                if token_matches(self.headers.get("Authorization"), api.api_token):
+                    return True
+                # drain the body so HTTP/1.1 keep-alive framing stays intact
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                if length:
+                    self.rfile.read(length)
+                self._send(401, {"error": "missing or invalid bearer token"})
+                return False
+
             def do_GET(self):
                 try:
-                    api._handle_get(self)
+                    if self._authorized("GET"):
+                        api._handle_get(self)
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # noqa: BLE001
@@ -106,7 +130,8 @@ class GatewayDaemonAPI:
 
             def do_POST(self):
                 try:
-                    api._handle_post(self)
+                    if self._authorized("POST"):
+                        api._handle_post(self)
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # noqa: BLE001
@@ -115,11 +140,37 @@ class GatewayDaemonAPI:
 
             def do_DELETE(self):
                 try:
-                    api._handle_delete(self)
+                    if self._authorized("DELETE"):
+                        api._handle_delete(self)
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"error": str(e)})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # TLS for the control plane (reference analog: stunnel in front of
+        # Flask, Dockerfile:24-35); cert shares the receiver's machinery.
+        # The handshake MUST happen in the per-connection handler thread, not
+        # on the listener: wrapping the listening socket makes SSLSocket
+        # .accept() handshake synchronously in the single accept thread with
+        # no timeout, so one idle TCP connect would wedge the whole API.
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            handshake_timeout = 10.0
+
+            def finish_request(self_srv, request, client_address):
+                if ssl_ctx is not None:
+                    try:
+                        request.settimeout(self_srv.handshake_timeout)
+                        request = ssl_ctx.wrap_socket(request, server_side=True)
+                        request.settimeout(None)
+                    except (OSError, TimeoutError) as e:  # covers ssl.SSLError
+                        logger.fs.warning(f"[api] TLS handshake failed from {client_address}: {e}")
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                ThreadingHTTPServer.finish_request(self_srv, request, client_address)
+
+        self._httpd = _Server((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

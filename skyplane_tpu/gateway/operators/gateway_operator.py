@@ -39,6 +39,18 @@ from skyplane_tpu.utils.logger import logger
 from skyplane_tpu.utils.retry import retry_backoff
 
 
+class BatchPartialFailure(Exception):
+    """A windowed batch died mid-flight, but some chunks had ALREADY been
+    acked (delivered + fingerprints committed). Carries per-chunk outcomes so
+    the worker loop can report the truth: acked chunks complete, the rest
+    failed — instead of smearing 'failed' across delivered chunks."""
+
+    def __init__(self, cause: BaseException, results: List[Optional[bool]]):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.results = results
+
+
 class GatewayOperator:
     """Base operator: thread pool + worker loop (reference :32-122)."""
 
@@ -94,6 +106,18 @@ class GatewayOperator:
                         self.chunk_store.log_chunk_state(chunk_req, ChunkState.in_progress, self.handle, worker_id)
                 try:
                     results = self.process_batch(batch, worker_id)
+                except BatchPartialFailure as bf:
+                    # account the already-delivered chunks truthfully, fail
+                    # the rest, then escalate the underlying cause
+                    for chunk_req, ok in zip(batch, bf.results):
+                        if ok:
+                            self.chunk_store.log_chunk_state(chunk_req, ChunkState.complete, self.handle, worker_id)
+                            if self.output_queue is not None:
+                                self.output_queue.put(chunk_req)
+                        else:
+                            self.chunk_store.log_chunk_state(chunk_req, ChunkState.failed, self.handle, worker_id)
+                    logger.fs.error(f"[{self.handle}:{worker_id}] batch failed mid-flight: {bf.cause}")
+                    raise bf.cause
                 except Exception as e:  # noqa: BLE001 — per-chunk failure path
                     ids = ",".join(r.chunk.chunk_id for r in batch)
                     logger.fs.error(f"[{self.handle}:{worker_id}] chunk(s) {ids} failed: {e}")
@@ -343,6 +367,8 @@ class GatewaySenderOperator(GatewayOperator):
         batch_runner=None,
         window: int = 16,
         window_bytes: int = 256 << 20,
+        api_token: Optional[str] = None,
+        control_tls: bool = False,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -357,13 +383,16 @@ class GatewaySenderOperator(GatewayOperator):
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
         self.window = max(1, int(window))
         self.window_bytes = int(window_bytes)
+        self.control_tls = control_tls
         self._local = threading.local()
-        self._session = requests.Session()
-        self._session.verify = False
+        from skyplane_tpu.gateway.control_auth import control_session
+
+        self._session = control_session(api_token)
 
     @property
     def _control_base(self) -> str:
-        return f"http://{self.target_host}:{self.target_control_port}/api/v1"
+        scheme = "https" if self.control_tls else "http"
+        return f"{scheme}://{self.target_host}:{self.target_control_port}/api/v1"
 
     def _make_socket(self) -> socket.socket:
         # ask the remote gateway for an ephemeral data port (reference :225-246)
@@ -481,6 +510,11 @@ class GatewaySenderOperator(GatewayOperator):
                 header.to_socket(sock)
                 sock.sendall(wire)
                 del wire
+                if payload is not None:
+                    # only the fingerprint lists are needed for ack
+                    # bookkeeping — keeping wire_bytes alive in `sent` would
+                    # pin up to window_bytes per worker until acks complete
+                    payload.wire_bytes = b""
                 sent.append((req, payload))
             # cumulative ack collection: acks arrive in frame order (the
             # receiver's per-connection loop is sequential). sendall only
@@ -510,10 +544,14 @@ class GatewaySenderOperator(GatewayOperator):
                     else:
                         # relay path: the staged bytes are opaque — we CANNOT
                         # rebuild the recipe, and re-queueing would replay the
-                        # identical unresolvable frame forever. Fail fast.
-                        raise SkyplaneTpuException(
-                            f"downstream receiver nacked relayed chunk {req.chunk.chunk_id} "
-                            "(unresolvable dedup ref; relay cannot rebuild the recipe)"
+                        # identical unresolvable frame forever. Fail fast,
+                        # carrying the outcomes of chunks already acked.
+                        raise BatchPartialFailure(
+                            SkyplaneTpuException(
+                                f"downstream receiver nacked relayed chunk {req.chunk.chunk_id} "
+                                "(unresolvable dedup ref; relay cannot rebuild the recipe)"
+                            ),
+                            results,
                         )
                 else:
                     raise OSError(f"bad/missing chunk ack ({ack!r})")

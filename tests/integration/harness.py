@@ -19,8 +19,11 @@ from typing import Dict, List, Optional
 import requests
 
 from skyplane_tpu.chunk import Chunk, ChunkRequest
+from skyplane_tpu.gateway.control_auth import control_session, suppress_insecure_warnings
 from skyplane_tpu.gateway.gateway_daemon import GatewayDaemon
 from skyplane_tpu.gateway.crypto import generate_key
+
+suppress_insecure_warnings()
 
 
 @dataclass
@@ -33,7 +36,17 @@ class LocalGateway:
         return self.daemon.api.port
 
     def url(self, route: str) -> str:
-        return f"http://127.0.0.1:{self.control_port}/api/v1/{route}"
+        scheme = "https" if self.daemon.control_tls else "http"
+        return f"{scheme}://127.0.0.1:{self.control_port}/api/v1/{route}"
+
+    def session(self) -> requests.Session:
+        return control_session(self.daemon.api_token)
+
+    def get(self, route: str, **kw) -> requests.Response:
+        return self.session().get(self.url(route), **kw)
+
+    def post(self, route: str, **kw) -> requests.Response:
+        return self.session().post(self.url(route), **kw)
 
     def stop(self):
         self.daemon.stop()
@@ -53,14 +66,15 @@ def start_gateway(program: dict, info: Dict[str, dict], gateway_id: str, chunk_d
     )
     t = threading.Thread(target=daemon.run, name=f"daemon-{gateway_id}", daemon=True)
     t.start()
+    gw = LocalGateway(daemon=daemon, thread=t)
     # wait for the control API to answer
     for _ in range(100):
         try:
-            requests.get(f"http://127.0.0.1:{daemon.api.port}/api/v1/status", timeout=1)
+            gw.get("status", timeout=1)
             break
         except requests.RequestException:
             time.sleep(0.05)
-    return LocalGateway(daemon=daemon, thread=t)
+    return gw
 
 
 def make_pair(
@@ -70,9 +84,11 @@ def make_pair(
     encrypt: bool = True,
     use_tls: bool = True,
     num_connections: int = 4,
+    api_token: Optional[str] = None,
 ):
     """Start (src, dst) daemons wired src --send--> dst; returns (src, dst)."""
     key = generate_key() if encrypt else None
+    meta = {"api_token": api_token, "control_tls": use_tls} if api_token else None
     # ids chosen before ports are known; info is patched after dst starts
     dst_program = {
         "plan": [
@@ -90,8 +106,11 @@ def make_pair(
             }
         ]
     }
-    dst = start_gateway(dst_program, {}, "gw_dst", str(tmp / "dst_chunks"), e2ee_key=key, use_tls=use_tls)
+    dst_info = {"_meta": meta} if meta else {}
+    dst = start_gateway(dst_program, dst_info, "gw_dst", str(tmp / "dst_chunks"), e2ee_key=key, use_tls=use_tls)
     info = {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}}
+    if meta:
+        info["_meta"] = meta
     src_program = {
         "plan": [
             {
@@ -141,7 +160,7 @@ def dispatch_file(src: LocalGateway, src_path: Path, dst_path: Path, chunk_bytes
         offset += length
         if size == 0:
             break
-    resp = requests.post(src.url("chunk_requests"), json=[r.as_dict() for r in reqs], timeout=30)
+    resp = src.post("chunk_requests", json=[r.as_dict() for r in reqs], timeout=30)
     resp.raise_for_status()
     return [r.chunk.chunk_id for r in reqs]
 
@@ -150,8 +169,8 @@ def wait_complete(gw: LocalGateway, chunk_ids: List[str], timeout: float = 60.0)
     deadline = time.time() + timeout
     pending = set(chunk_ids)
     while time.time() < deadline:
-        status = requests.get(gw.url("chunk_status_log"), timeout=10).json()["chunk_status"]
-        errs = requests.get(gw.url("errors"), timeout=10).json()["errors"]
+        status = gw.get("chunk_status_log", timeout=10).json()["chunk_status"]
+        errs = gw.get("errors", timeout=10).json()["errors"]
         if errs:
             raise RuntimeError(f"gateway {gw.daemon.gateway_id} errors: {errs[0][:2000]}")
         pending = {c for c in chunk_ids if status.get(c) != "complete"}

@@ -70,7 +70,6 @@ def test_dedup_transfer(pair_dirs):
 
 @pytest.mark.slow
 def test_dedup_stats_show_refs(pair_dirs, tmp_path):
-    import requests
 
     from tests.integration.harness import make_pair, dispatch_file, wait_complete
 
@@ -86,7 +85,7 @@ def test_dedup_stats_show_refs(pair_dirs, tmp_path):
         wait_complete(dst, ids, timeout=120)
         ids2 = dispatch_file(src, f2, pair_dirs / "out" / "b.bin")
         wait_complete(dst, ids2, timeout=120)
-        stats = requests.get(src.url("profile/compression"), timeout=10).json()
+        stats = src.get("profile/compression", timeout=10).json()
         assert stats["ref_segments"] > 0, f"no dedup refs recorded: {stats}"
         assert (pair_dirs / "out" / "b.bin").read_bytes() == payload
     finally:
@@ -100,7 +99,6 @@ def test_multicast_with_dedup_everything_on(tmp_path):
     TPU codec, TLS, and E2EE all enabled. Each destination edge keeps its own
     fingerprint index/store (replicated chunks must dedup independently and
     correctly at BOTH destinations)."""
-    import requests
 
     from skyplane_tpu.gateway.crypto import generate_key
     from tests.integration.harness import dispatch_file, start_gateway, wait_complete
@@ -179,7 +177,7 @@ def test_multicast_with_dedup_everything_on(tmp_path):
             wait_complete(gw, ids, timeout=180)
         got = (tmp_path / "out" / "data.bin").read_bytes()
         assert hashlib.md5(got).hexdigest() == hashlib.md5(payload).hexdigest()
-        stats = requests.get(src.url("profile/compression"), timeout=5).json()
+        stats = src.get("profile/compression", timeout=5).json()
         assert stats["ref_segments"] > 0, f"dedup refs expected on redundant multicast: {stats}"
     finally:
         src.stop()

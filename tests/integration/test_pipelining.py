@@ -18,7 +18,7 @@ from pathlib import Path
 
 import pytest
 
-from integration.harness import dispatch_file, make_pair, wait_complete
+from tests.integration.harness import dispatch_file, make_pair, wait_complete
 
 
 class DelayProxy:

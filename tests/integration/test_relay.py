@@ -9,7 +9,6 @@ import hashlib
 
 import numpy as np
 import pytest
-import requests
 
 from skyplane_tpu.gateway.crypto import generate_key
 from tests.integration.harness import LocalGateway, dispatch_file, start_gateway, wait_complete
@@ -125,7 +124,7 @@ def test_three_hop_relay_encrypted(tmp_path):
         got = fdst.read_bytes()
         assert hashlib.md5(got).hexdigest() == hashlib.md5(payload).hexdigest()
         # relay really forwarded ciphertext: its chunk dir must contain no plaintext
-        stats = requests.get(relay.url("profile/compression"), timeout=5).json()
+        stats = relay.get("profile/compression", timeout=5).json()
         assert stats["chunks"] == 0 or stats["raw_bytes"] == 0  # no DataPathProcessor work at relay
     finally:
         src.stop()
