@@ -40,6 +40,12 @@ class TransferConfig:
     gcp_use_spot_instances: bool = False
     gcp_use_premium_network: bool = True
     autoshutdown_minutes: int = 15
+    # container path for gateway bootstrap (reference: SKYPLANE_DOCKER_IMAGE);
+    # None = venv bootstrap from a source bundle (no registry required)
+    gateway_docker_image: Optional[str] = None
+    # docker mode stages chunks on a tmpfs of this size (reference mounts a
+    # tmpfs at half the VM's RAM); size for the in-flight chunk working set
+    gateway_tmpfs_gb: int = 8
 
     def cdc_params(self) -> CDCParams:
         return CDCParams(self.cdc_min_bytes, self.cdc_avg_bytes, self.cdc_max_bytes)

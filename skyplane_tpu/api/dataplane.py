@@ -133,6 +133,8 @@ class Dataplane:
                 e2ee_key=self._e2ee_key,
                 use_tls=self.transfer_config.encrypt_socket_tls,
                 use_bbr=self.transfer_config.use_bbr,
+                docker_image=self.transfer_config.gateway_docker_image,
+                tmpfs_gb=self.transfer_config.gateway_tmpfs_gb,
             )
 
         do_parallel(start, list(self.bound_gateways.values()), n=16, desc="starting gateways", spinner=spinner)

@@ -47,6 +47,7 @@ class LocalServer(Server):
 
     def run_command(self, command: str, timeout: int = 120) -> Tuple[str, str]:
         proc = subprocess.run(command, shell=True, capture_output=True, text=True, timeout=timeout)
+        self.last_rc = proc.returncode
         return proc.stdout, proc.stderr
 
     def start_gateway(
@@ -57,6 +58,8 @@ class LocalServer(Server):
         e2ee_key: Optional[bytes] = None,
         use_tls: bool = True,
         use_bbr: bool = True,
+        docker_image: Optional[str] = None,  # local daemons run in-place
+        tmpfs_gb: int = 8,
     ) -> None:
         self._record_control_credentials(gateway_info, use_tls)
         # re-starting with a new program (e.g. throughput probes) replaces the

@@ -10,6 +10,7 @@ the zero-cloud path.
 from __future__ import annotations
 
 import json
+import os
 import shlex
 import subprocess
 import time
@@ -57,6 +58,18 @@ class Server:
     # ---- execution ----
     def run_command(self, command: str, timeout: int = 120) -> Tuple[str, str]:
         raise NotImplementedError
+
+    def run_checked(self, command: str, timeout: int = 120) -> Tuple[str, str]:
+        """run_command that raises (with stderr) on a nonzero exit status, for
+        bootstrap steps whose failure would otherwise surface only as a
+        generic readiness timeout much later."""
+        out, err = self.run_command(command, timeout=timeout)
+        rc = getattr(self, "last_rc", 0)
+        if rc not in (0, None):
+            raise GatewayContainerStartException(
+                f"command failed on {self.instance_id} (rc={rc}): {command!r}\n{err[-2000:]}"
+            )
+        return out, err
 
     def upload_file(self, local_path, remote_path) -> None:
         raise NotImplementedError
@@ -118,6 +131,8 @@ class Server:
         e2ee_key: Optional[bytes] = None,
         use_tls: bool = True,
         use_bbr: bool = True,
+        docker_image: Optional[str] = None,
+        tmpfs_gb: int = 8,
     ) -> None:
         raise NotImplementedError
 
@@ -161,6 +176,7 @@ class SSHServer(Server):
     def run_command(self, command: str, timeout: int = 120) -> Tuple[str, str]:
         proc = subprocess.run(self._ssh_base() + [command], capture_output=True, text=True, timeout=timeout)
         logger.fs.debug(f"[ssh {self.host}] {command!r} -> rc={proc.returncode}")
+        self.last_rc = proc.returncode  # ssh propagates the remote exit status
         return proc.stdout, proc.stderr
 
     def upload_file(self, local_path, remote_path) -> None:
@@ -214,8 +230,13 @@ class SSHServer(Server):
         e2ee_key: Optional[bytes] = None,
         use_tls: bool = True,
         use_bbr: bool = True,
+        docker_image: Optional[str] = None,
+        tmpfs_gb: int = 8,
     ) -> None:
+        from skyplane_tpu.compute import bootstrap
+
         self._record_control_credentials(gateway_info, use_tls)
+        docker_image = docker_image or os.environ.get("SKYPLANE_TPU_DOCKER_IMAGE") or None
         self.tune_network(use_bbr)
         # replace any daemon from a previous start_gateway (program reconfig):
         # bracket pattern self-excludes the remote shell; wait for exit so the
@@ -228,22 +249,63 @@ class SSHServer(Server):
             # /status for the OLD program — force it dead before starting anew
             "pkill -9 -f '[s]kyplane_tpu.gateway.gateway_daemon' || true; sleep 0.5"
         )
-        self.run_command("mkdir -p /tmp/skyplane_tpu")
-        self.write_file(json.dumps(gateway_program).encode(), "/tmp/skyplane_tpu/program.json")
-        self.write_file(json.dumps(gateway_info).encode(), "/tmp/skyplane_tpu/info.json")
+        root = bootstrap.REMOTE_ROOT
+        self.run_command(f"mkdir -p {root}")
+        self.write_file(json.dumps(gateway_program).encode(), f"{root}/program.json")
+        self.write_file(json.dumps(gateway_info).encode(), f"{root}/info.json")
         if e2ee_key:
-            self.write_file(e2ee_key, "/tmp/skyplane_tpu/e2ee.key")
+            self.write_file(e2ee_key, f"{root}/e2ee.key")
         args = (
-            f"--region {self.region_tag} --chunk-dir /tmp/skyplane_tpu/chunks "
-            f"--program-file /tmp/skyplane_tpu/program.json --info-file /tmp/skyplane_tpu/info.json "
+            f"--region {self.region_tag} --chunk-dir {root}/chunks "
+            f"--program-file {root}/program.json --info-file {root}/info.json "
             f"--gateway-id {gateway_id} --control-port {self.control_port}"
         )
         if e2ee_key:
-            args += " --e2ee-key-file /tmp/skyplane_tpu/e2ee.key"
+            args += f" --e2ee-key-file {root}/e2ee.key"
         if not use_tls:
             args += " --disable-tls"
-        self.run_command(
-            f"nohup python3 -m skyplane_tpu.gateway.gateway_daemon {args} "
-            f"> /tmp/skyplane_tpu/daemon.log 2>&1 & echo started"
-        )
+        if docker_image:
+            # reference-parity container path (Dockerfile builds the image;
+            # skyplane/compute/server.py:300-429). Checked execution: a
+            # failed pull/run must raise with its stderr now, not surface as
+            # a generic readiness timeout two minutes later.
+            for cmd in bootstrap.docker_bootstrap_commands(docker_image):
+                self.run_checked(cmd, timeout=600)
+            self.run_checked(bootstrap.docker_run_command(docker_image, args, tmpfs_gb=tmpfs_gb))
+        else:
+            # venv bootstrap: ship the client's own package to the bare VM
+            self._bootstrap_venv()
+            self.run_command(
+                f"nohup {bootstrap.REMOTE_PY} -m skyplane_tpu.gateway.gateway_daemon {args} "
+                f"> {root}/daemon.log 2>&1 & echo started"
+            )
         self.wait_for_gateway_ready()
+
+    def _bootstrap_venv(self) -> None:
+        """Install the package into {REMOTE_VENV} on the VM, idempotently.
+
+        The skip probe keys on the WHEEL's sha256 (not the package version,
+        which rarely changes during development): a reused VM re-installs
+        whenever the client's code differs, byte for byte."""
+        from skyplane_tpu.compute import bootstrap
+
+        wheel = bootstrap.remote_wheel_path()
+        want_sha = bootstrap.wheel_sha256()
+        probe_cmd = (
+            f"sha256sum {wheel} 2>/dev/null | cut -d' ' -f1; "
+            f"{bootstrap.REMOTE_PY} -c 'import skyplane_tpu' 2>/dev/null && echo IMPORT_OK"
+        )
+        out, _ = self.run_command(probe_cmd)
+        if want_sha in out.split() and "IMPORT_OK" in out.split():
+            logger.fs.info(f"[bootstrap {self.host}] wheel {want_sha[:12]} already installed")
+            return
+        self.write_file(bootstrap.make_bundle_bytes(), wheel)
+        pip_args = os.environ.get("SKYPLANE_TPU_BOOTSTRAP_PIP_ARGS", "")
+        for cmd in bootstrap.venv_bootstrap_commands(self.region_tag, pip_args):
+            out, err = self.run_checked(cmd, timeout=600)
+            logger.fs.debug(f"[bootstrap {self.host}] {cmd!r}: {out[-500:]} {err[-500:]}")
+        out, _ = self.run_command(probe_cmd)
+        if want_sha not in out.split() or "IMPORT_OK" not in out.split():
+            raise GatewayContainerStartException(
+                f"venv bootstrap on {self.host} failed verification: probe returned {out.strip()!r}"
+            )
