@@ -126,7 +126,12 @@ def venv_bootstrap_commands(region_tag: str, pip_args: str = "") -> list:
         f"python3 -m venv --system-site-packages {REMOTE_VENV} || "
         f"(sudo apt-get update -qq && sudo apt-get install -y -qq python3-venv python3-pip "
         f"&& python3 -m venv --system-site-packages {REMOTE_VENV})",
+        # first install resolves dependencies/extras ...
         f"{REMOTE_PIP} install --quiet {pip_args} '{requirement}'",
+        # ... then force the package bits themselves: pip skips a same-version
+        # wheel ("already installed"), which would silently keep stale code on
+        # a reused VM whenever the dev-loop version number didn't change
+        f"{REMOTE_PIP} install --quiet --force-reinstall --no-deps '{wheel}'",
     ]
 
 

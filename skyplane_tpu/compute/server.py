@@ -44,6 +44,7 @@ class Server:
         self.api_token: Optional[str] = None
         self.control_tls: bool = False
         self._control_session = None
+        self.last_rc: Optional[int] = None  # exit status of the last run_command
 
     # ---- addressing ----
     def public_ip(self) -> str:
@@ -62,10 +63,18 @@ class Server:
     def run_checked(self, command: str, timeout: int = 120) -> Tuple[str, str]:
         """run_command that raises (with stderr) on a nonzero exit status, for
         bootstrap steps whose failure would otherwise surface only as a
-        generic readiness timeout much later."""
+        generic readiness timeout much later. Implementations must set
+        self.last_rc; a missing rc is treated as unverifiable, not success
+        (and last_rc is cleared first so a stale value can't pass)."""
+        self.last_rc = None
         out, err = self.run_command(command, timeout=timeout)
-        rc = getattr(self, "last_rc", 0)
-        if rc not in (0, None):
+        rc = self.last_rc
+        if rc is None:
+            raise GatewayContainerStartException(
+                f"{type(self).__name__}.run_command did not record an exit status for {command!r}; "
+                "run_checked needs last_rc to verify bootstrap steps"
+            )
+        if rc != 0:
             raise GatewayContainerStartException(
                 f"command failed on {self.instance_id} (rc={rc}): {command!r}\n{err[-2000:]}"
             )
