@@ -163,10 +163,21 @@ def bench_ours(chunks) -> dict:
     cdc = CDCParams()
     batch_runner = None
     if on_accelerator() and N_WORKERS > 1:
-        # mirror the gateway: workers share a micro-batching device runner
+        # mirror the gateway: workers share a micro-batching device runner,
+        # sharded over a mesh when multiple chips are attached (the
+        # production configuration on TPU slices)
+        import jax
+
         from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
 
-        batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=min(8, N_WORKERS))
+        mesh = None
+        n_dev = len(jax.devices())
+        if n_dev > 1 and (n_dev & (n_dev - 1)) == 0:
+            from skyplane_tpu.parallel.datapath_spmd import default_mesh
+
+            mesh = default_mesh()
+            log(f"batch runner sharded over {n_dev}-device mesh")
+        batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=min(8, N_WORKERS), mesh=mesh)
     proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
     index = SenderDedupIndex()
     # warm-up: compile all shape buckets (separate corpus so the index stays
