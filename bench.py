@@ -166,17 +166,12 @@ def bench_ours(chunks) -> dict:
         # mirror the gateway: workers share a micro-batching device runner,
         # sharded over a mesh when multiple chips are attached (the
         # production configuration on TPU slices)
-        import jax
-
         from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
+        from skyplane_tpu.parallel.datapath_spmd import maybe_default_mesh
 
-        mesh = None
-        n_dev = len(jax.devices())
-        if n_dev > 1 and (n_dev & (n_dev - 1)) == 0:
-            from skyplane_tpu.parallel.datapath_spmd import default_mesh
-
-            mesh = default_mesh()
-            log(f"batch runner sharded over {n_dev}-device mesh")
+        mesh = maybe_default_mesh()
+        if mesh is not None:
+            log(f"batch runner sharded over mesh {dict(mesh.shape)}")
         batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=min(8, N_WORKERS), mesh=mesh)
     proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
     index = SenderDedupIndex()

@@ -125,12 +125,27 @@ class Dataplane:
                 "Use encrypt_socket_tls=True for any non-localhost transfer."
             )
 
+        def _needs_e2ee_key(bound: BoundGateway) -> bool:
+            """Relays forward opaque ciphertext and must never hold key
+            material (reference relay semantics): only gateways whose program
+            actually encrypts or decrypts get the key."""
+
+            def walk(ops) -> bool:
+                for op in ops:
+                    if op.get("encrypt") or op.get("decrypt"):
+                        return True
+                    if walk(op.get("children", [])):
+                        return True
+                return False
+
+            return walk(bound.plan_gateway.program_ops())
+
         def start(bound: BoundGateway) -> None:
             bound.server.start_gateway(
                 gateway_program=bound.plan_gateway.gateway_program.to_dict(),
                 gateway_info=gateway_info,
                 gateway_id=bound.gateway_id,
-                e2ee_key=self._e2ee_key,
+                e2ee_key=self._e2ee_key if _needs_e2ee_key(bound) else None,
                 use_tls=self.transfer_config.encrypt_socket_tls,
                 use_bbr=self.transfer_config.use_bbr,
                 docker_image=self.transfer_config.gateway_docker_image,

@@ -48,7 +48,12 @@ class Pipeline:
     # ---- planning / execution ----
 
     def planner(self):
-        return get_planner(self.planning_algorithm, self.transfer_config, n_instances=self.max_instances)
+        kw = {}
+        if self.planning_algorithm in ("ron", "ilp"):
+            from skyplane_tpu.config_paths import throughput_grid_path
+
+            kw["profile_path"] = str(throughput_grid_path)
+        return get_planner(self.planning_algorithm, self.transfer_config, n_instances=self.max_instances, **kw)
 
     def create_dataplane(self, debug: bool = False) -> Dataplane:
         if not self.jobs_to_dispatch:

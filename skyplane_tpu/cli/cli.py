@@ -27,7 +27,7 @@ def main():
 @click.option("-r", "--recursive", is_flag=True, help="copy a prefix tree")
 @click.option("-y", "--yes", is_flag=True, help="skip confirmation")
 @click.option("--max-instances", default=None, type=int, help="gateway VMs per region")
-@click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided"]))
+@click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided", "ron", "ilp"]))
 @click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz"]))
 @click.option("--dedup/--no-dedup", default=None, help="content-defined dedup on the TPU path")
 @click.option("--debug", is_flag=True, help="collect gateway logs on exit")
@@ -44,7 +44,7 @@ def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, debug):
 @click.argument("dst", nargs=-1, required=True)
 @click.option("-y", "--yes", is_flag=True)
 @click.option("--max-instances", default=None, type=int)
-@click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided"]))
+@click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided", "ron", "ilp"]))
 @click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz"]))
 @click.option("--dedup/--no-dedup", default=None)
 @click.option("--debug", is_flag=True)

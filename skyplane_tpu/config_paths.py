@@ -20,6 +20,9 @@ gcp_config_path = config_root / "gcp_config"
 gcp_quota_path = config_root / "gcp_quota"
 
 key_root = config_root / "keys"
+# measured region-pair throughput grid (written by `experiments
+# throughput-grid`, consumed by the ron/ilp overlay planners)
+throughput_grid_path = config_root / "throughput_grid.csv"
 tmp_log_dir = Path("/tmp/skyplane_tpu")
 
 host_uuid_path = config_root / "host_uuid"

@@ -143,17 +143,9 @@ class GatewayDaemon:
             # TPU-slice gateways: shard the batched kernels over ALL chips via
             # a (data, seq) mesh — the same SPMD path dryrun_multichip
             # validates — instead of running everything on chip 0
-            mesh = None
-            try:
-                import jax
+            from skyplane_tpu.parallel.datapath_spmd import maybe_default_mesh
 
-                n_dev = len(jax.devices())
-                if n_dev > 1 and (n_dev & (n_dev - 1)) == 0:  # power-of-two meshes only
-                    from skyplane_tpu.parallel.datapath_spmd import default_mesh
-
-                    mesh = default_mesh()
-            except Exception as e:  # noqa: BLE001 — mesh is an optimization, not a requirement
-                logger.fs.warning(f"multi-device mesh unavailable ({e}); running single-device")
+            mesh = maybe_default_mesh()
             self.batch_runner = DeviceBatchRunner(cdc_params=self.cdc_params, max_batch=tpu_batch, mesh=mesh)
             if mesh is not None:
                 logger.fs.info(f"[daemon {gateway_id}] batch runner sharded over mesh {dict(mesh.shape)}")

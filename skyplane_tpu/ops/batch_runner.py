@@ -118,8 +118,12 @@ class DeviceBatchRunner:
         self._sharded_candidates = None
         self._sharded_segment_fp = None
         if mesh is not None:
-            if (1 << 16) % mesh.shape["seq"]:
-                raise ValueError(f"mesh seq axis ({mesh.shape['seq']}) must be a power of two to divide chunk buckets")
+            from skyplane_tpu.ops.pipeline import MIN_BUCKET
+
+            if MIN_BUCKET % mesh.shape["seq"]:
+                raise ValueError(
+                    f"mesh seq axis ({mesh.shape['seq']}) must divide the minimum chunk bucket ({MIN_BUCKET})"
+                )
             data_ax = mesh.shape["data"]
             if self.max_batch % data_ax:
                 # batch rows pad to max_batch, which must split over the data

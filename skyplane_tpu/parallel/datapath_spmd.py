@@ -31,6 +31,19 @@ from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
 from skyplane_tpu.ops.gear import GEAR_TABLE, GEAR_WINDOW, boundary_candidate_mask
 
 
+def maybe_default_mesh() -> Optional[Mesh]:
+    """A (data, seq) mesh over the attached devices when sharding is viable
+    (more than one device, power-of-two count), else None. Never raises —
+    a mesh is an optimization, not a requirement."""
+    try:
+        n = len(jax.devices())
+        if n > 1 and (n & (n - 1)) == 0:
+            return default_mesh()
+    except Exception:  # noqa: BLE001 — no usable backend => unsharded
+        pass
+    return None
+
+
 def default_mesh(devices=None, data_parallel: Optional[int] = None) -> Mesh:
     """Build a (data, seq) mesh over the available devices."""
     devices = devices if devices is not None else jax.devices()

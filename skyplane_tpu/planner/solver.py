@@ -207,14 +207,49 @@ class ThroughputSolverILP(ThroughputSolver):
         )
 
 
-def solution_to_topology(sol: ThroughputSolution, jobs: List, transfer_config) -> "TopologyPlan":
-    """Convert an overlay solution into per-gateway programs.
+def _topological_regions(src: str, dst: str, edges: Dict[Tuple[str, str], float]) -> List[str]:
+    """Order the flow DAG's regions src-first; reject cycles (an LP min-cost
+    flow over positive-cost edges never produces one, but a hand-built
+    solution could)."""
+    regions = {src, dst}
+    for a, b in edges:
+        regions.update((a, b))
+    out_edges: Dict[str, List[str]] = {r: [] for r in regions}
+    in_deg: Dict[str, int] = {r: 0 for r in regions}
+    for a, b in edges:
+        out_edges[a].append(b)
+        in_deg[b] += 1
+    order, frontier = [], [r for r in regions if in_deg[r] == 0]
+    while frontier:
+        r = frontier.pop()
+        order.append(r)
+        for nxt in out_edges[r]:
+            in_deg[nxt] -= 1
+            if in_deg[nxt] == 0:
+                frontier.append(nxt)
+    if len(order) != len(regions):
+        raise ValueError("overlay flow graph contains a cycle")
+    return order
+
+
+def solution_to_topology(
+    sol: ThroughputSolution,
+    jobs: List,
+    transfer_config,
+    planner=None,
+) -> "TopologyPlan":
+    """Convert an overlay solution (path or general flow DAG) into per-gateway
+    programs with multi-instance scaling.
 
     Rebuilt against the new TopologyPlan (the reference's
     ``to_replication_topology`` was bit-rotted, SURVEY §2.4). Relay gateways
-    forward without decode: receive -> send preserves wire payloads.
+    forward without decode: receive -> send preserves wire payloads, so E2EE
+    stays end-to-end and dedup recipes resolve only at the destination. When
+    a region has multiple outgoing edges (ILP flow split), chunks distribute
+    across the branches via a MuxOr with connections proportional to flow.
     """
     from skyplane_tpu.gateway.gateway_program import (
+        GatewayMuxOr,
         GatewayReadObjectStore,
         GatewayReceive,
         GatewaySend,
@@ -222,45 +257,92 @@ def solution_to_topology(sol: ThroughputSolution, jobs: List, transfer_config) -
     )
     from skyplane_tpu.planner.topology import TopologyPlan
 
-    if not sol.path:
-        raise ValueError("solution has no explicit path; only path-form solutions convert to topologies")
     p = sol.problem
-    plan = TopologyPlan(p.src, [p.dst])
     cfg = transfer_config
-    job = jobs[0]
-    # one gateway per region on the path (instance scaling handled by planner count)
-    gws = {region: plan.add_gateway(region) for region in sol.path}
-    for i, region in enumerate(sol.path):
-        program = gws[region].gateway_program
-        is_first = i == 0
-        is_last = i == len(sol.path) - 1
-        if is_first:
-            parent = program.add_operator(
-                GatewayReadObjectStore(
-                    bucket_name=job.src_iface.bucket(), bucket_region=p.src, num_connections=cfg.num_connections
-                )
-            )
+    edges = dict(sol.edge_flow_gbits)
+    if not edges:
+        if not sol.path:
+            raise ValueError("solution has neither edge flows nor a path")
+        edges = {e: 1.0 for e in zip(sol.path[:-1], sol.path[1:])}
+    order = _topological_regions(p.src, p.dst, edges)
+    plan = TopologyPlan(p.src, [p.dst])
+
+    # instance scaling: the solver's per-region instance counts, capped by the
+    # planner's quota-aware ladder (round 1 emitted exactly 1 gw/region)
+    gws: Dict[str, List] = {}
+    vm_types: Dict[str, Optional[str]] = {}
+    for region in order:
+        want = max(1, sol.instances_per_region.get(region, 1))
+        if planner is not None:
+            vm, fit = planner._calculate_vm_types(region)
+            vm_types[region] = vm
+            want = min(want, max(1, fit))
         else:
-            parent = program.add_operator(GatewayReceive(decrypt=cfg.encrypt_e2e and is_last, dedup=cfg.dedup and is_last))
-        if is_last:
-            program.add_operator(
-                GatewayWriteObjectStore(
-                    bucket_name=job.dst_ifaces[0].bucket(), bucket_region=p.dst, num_connections=cfg.num_connections
-                ),
-                parent_handle=parent,
-            )
-        else:
-            nxt = sol.path[i + 1]
-            program.add_operator(
-                GatewaySend(
-                    target_gateway_id=gws[nxt].gateway_id,
-                    region=nxt,
-                    num_connections=cfg.num_connections,
-                    compress=cfg.compress if is_first else "none",  # relays forward as-is
-                    encrypt=cfg.encrypt_e2e and is_first,
-                    dedup=cfg.dedup and is_first,
-                ),
-                parent_handle=parent,
-            )
-    plan.cost_per_gb = sum(get_egress_cost_per_gb(a, b) for a, b in zip(sol.path[:-1], sol.path[1:]))
+            vm_types[region] = None
+            want = min(want, p.instance_limit)
+        gws[region] = [plan.add_gateway(region) for _ in range(want)]
+
+    for job in jobs:
+        partition = job.uuid
+        for region in order:
+            outgoing = [(b, f) for (a, b), f in edges.items() if a == region]
+            incoming = [(a, f) for (a, b), f in edges.items() if b == region]
+            is_src = region == p.src
+            is_dst = region == p.dst
+            total_out = sum(f for _, f in outgoing) or 1.0
+            for gw in gws[region]:
+                program = gw.gateway_program
+                if is_src:
+                    parent = program.add_operator(
+                        GatewayReadObjectStore(
+                            bucket_name=job.src_iface.bucket(), bucket_region=p.src, num_connections=cfg.num_connections
+                        ),
+                        partition_id=partition,
+                    )
+                else:
+                    assert incoming, f"non-source region {region} has no incoming flow"
+                    parent = program.add_operator(
+                        GatewayReceive(decrypt=cfg.encrypt_e2e and is_dst, dedup=cfg.dedup and is_dst),
+                        partition_id=partition,
+                    )
+                if is_dst:
+                    program.add_operator(
+                        GatewayWriteObjectStore(
+                            bucket_name=job.dst_ifaces[0].bucket(), bucket_region=p.dst, num_connections=cfg.num_connections
+                        ),
+                        parent_handle=parent,
+                        partition_id=partition,
+                    )
+                    continue
+                # fan out over (branch regions x their gateways); a single
+                # next-hop gateway keeps the flat send (no mux indirection)
+                n_branch_targets = sum(len(gws[b]) for b, _ in outgoing)
+                send_parent = parent
+                if n_branch_targets > 1:
+                    send_parent = program.add_operator(GatewayMuxOr(), parent_handle=parent, partition_id=partition)
+                for nxt, flow in outgoing:
+                    share = flow / total_out
+                    conns_edge = max(1, int(round(cfg.num_connections * share)))
+                    conns = max(1, conns_edge // max(1, len(gws[nxt])))
+                    for target in gws[nxt]:
+                        program.add_operator(
+                            GatewaySend(
+                                target_gateway_id=target.gateway_id,
+                                region=nxt,
+                                num_connections=conns,
+                                # only the first hop runs the TPU data path;
+                                # relays forward opaque wire payloads
+                                compress=cfg.compress if is_src else "none",
+                                encrypt=cfg.encrypt_e2e and is_src,
+                                dedup=cfg.dedup and is_src,
+                            ),
+                            parent_handle=send_parent,
+                            partition_id=partition,
+                        )
+    for gw in plan.gateways.values():
+        gw.vm_type = vm_types.get(gw.region_tag)
+    # $/GB of logical data: egress per edge weighted by the fraction of the
+    # flow crossing it
+    total_flow = sum(f for (a, _), f in edges.items() if a == p.src) or 1.0
+    plan.cost_per_gb = sum(get_egress_cost_per_gb(a, b) * (f / total_flow) for (a, b), f in edges.items())
     return plan

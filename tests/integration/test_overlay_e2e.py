@@ -1,0 +1,74 @@
+"""Solver-driven overlay relay, end to end through the USER path.
+
+VERDICT r1 missing #4: the relay data plane worked but only via hand-written
+gateway programs (test_relay.py). Here the 3-hop topology comes out of
+``--solver ron``: a measured throughput grid showing the direct path is slow
+drives Pipeline -> OverlayPlanner -> solution_to_topology -> local
+provisioner -> daemons -> transfer -> verify, with E2EE on (the relay daemon
+receives no key and forwards opaque ciphertext).
+"""
+
+from __future__ import annotations
+
+import csv
+import hashlib
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.pipeline import Pipeline
+from skyplane_tpu.api.transfer_job import CopyJob
+from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+
+rng = np.random.default_rng(41)
+
+
+@pytest.mark.slow
+def test_relay_topology_from_solver_e2e(tmp_path, monkeypatch):
+    # measured grid: direct A->B is slow, A->C->B is fast -> RON must relay
+    profile = tmp_path / "throughput_grid.csv"
+    with profile.open("w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["src_region", "dst_region", "gbps"])
+        w.writerow(["local:siteA", "local:siteB", "0.2"])
+        w.writerow(["local:siteA", "local:siteC", "8.0"])
+        w.writerow(["local:siteC", "local:siteB", "8.0"])
+
+    src_root = tmp_path / "siteA"
+    dst_root = tmp_path / "siteB"
+    src_root.mkdir()
+    dst_root.mkdir()
+    payload = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes() + bytes(1 << 20)
+    (src_root / "data.bin").write_bytes(payload)
+
+    job = CopyJob("local:///data.bin", ["local:///data.bin"])
+    job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+
+    cfg = TransferConfig(compress="zstd", dedup=False, encrypt_e2e=True, multipart_threshold_mb=1024, num_connections=4)
+    pipe = Pipeline(planning_algorithm="ron", transfer_config=cfg)
+    # point the pipeline's planner at the measured grid
+    monkeypatch.setattr("skyplane_tpu.config_paths.throughput_grid_path", profile)
+    pipe.jobs_to_dispatch.append(job)
+
+    topology = pipe.planner().plan([job])
+    relay_gws = topology.get_region_gateways("local:siteC")
+    assert relay_gws, "solver must choose the relay given the measured grid"
+    relay = relay_gws[0]
+    assert relay._has_op("receive") and relay._has_op("send") and not relay._has_op("write_object_store")
+
+    dp = pipe.create_dataplane()
+    with dp.auto_deprovision():
+        dp.provision()
+        dp.run([job])
+        # the relay daemon must have no E2EE key material on disk; the
+        # endpoint gateways must (local servers stage the key in workdir)
+        for b in dp.bound_gateways.values():
+            key_file = b.server.workdir / "e2ee.key"
+            if b.region_tag == "local:siteC":
+                assert not key_file.exists(), "relay must never receive the E2EE key"
+            else:
+                assert key_file.exists()
+    got = (dst_root / "data.bin").read_bytes()
+    assert hashlib.md5(got).hexdigest() == hashlib.md5(payload).hexdigest()

@@ -82,3 +82,124 @@ def test_solution_to_topology_relay_chain(tmp_path):
     relay = plan.get_region_gateways("aws:c")[0]
     # relay receives and forwards without writing
     assert relay._has_op("receive") and relay._has_op("send") and not relay._has_op("write_object_store")
+
+
+def _mk_job(tmp_path, src_region="aws:a", dst_region="aws:b"):
+    from skyplane_tpu.api.transfer_job import CopyJob
+    from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+
+    (tmp_path / "src").mkdir(exist_ok=True)
+    (tmp_path / "src" / "x").write_bytes(b"d")
+    job = CopyJob("local:///x", ["local:///x"])
+    job._src_iface = POSIXInterface(str(tmp_path / "src"), region_tag=src_region)
+    job._dst_ifaces = [POSIXInterface(str(tmp_path / "dst"), region_tag=dst_region)]
+    return job
+
+
+def test_solution_to_topology_scales_instances(tmp_path):
+    """ILP-style solutions with per-region instance counts produce that many
+    gateways per region, each sender fanning out to every next-hop gateway
+    (round 1 emitted exactly one gateway per region)."""
+    from skyplane_tpu.planner.solver import ThroughputSolution
+
+    job = _mk_job(tmp_path)
+    sol = ThroughputSolution(
+        problem=ThroughputProblem("aws:a", "aws:b", 10.0, instance_limit=4),
+        is_feasible=True,
+        throughput_achieved_gbits=10.0,
+        edge_flow_gbits={("aws:a", "aws:c"): 10.0, ("aws:c", "aws:b"): 10.0},
+        instances_per_region={"aws:a": 2, "aws:c": 2, "aws:b": 1},
+    )
+    plan = solution_to_topology(sol, [job], TransferConfig())
+    assert len(plan.get_region_gateways("aws:a")) == 2
+    assert len(plan.get_region_gateways("aws:c")) == 2
+    assert len(plan.get_region_gateways("aws:b")) == 1
+    # every source gateway targets BOTH relay gateways
+    relay_ids = {g.gateway_id for g in plan.get_region_gateways("aws:c")}
+    for src_gw in plan.get_region_gateways("aws:a"):
+        assert set(plan.get_outgoing_paths(src_gw.gateway_id)) == relay_ids
+
+
+def test_solution_to_topology_flow_split_dag(tmp_path):
+    """An ILP flow split (direct + relay) becomes a MuxOr fan-out with
+    connections proportional to each branch's flow."""
+    from skyplane_tpu.planner.solver import ThroughputSolution
+
+    job = _mk_job(tmp_path)
+    sol = ThroughputSolution(
+        problem=ThroughputProblem("aws:a", "aws:b", 8.0, instance_limit=2),
+        is_feasible=True,
+        throughput_achieved_gbits=8.0,
+        edge_flow_gbits={("aws:a", "aws:b"): 6.0, ("aws:a", "aws:c"): 2.0, ("aws:c", "aws:b"): 2.0},
+        instances_per_region={"aws:a": 1, "aws:c": 1, "aws:b": 1},
+    )
+    cfg = TransferConfig(num_connections=32)
+    plan = solution_to_topology(sol, [job], cfg)
+    src_gw = plan.get_region_gateways("aws:a")[0]
+    out = plan.get_outgoing_paths(src_gw.gateway_id)
+    assert len(out) == 2  # direct branch + relay branch
+    dst_id = plan.get_region_gateways("aws:b")[0].gateway_id
+    relay_id = plan.get_region_gateways("aws:c")[0].gateway_id
+    assert out[dst_id] == 24  # 6/8 of 32 connections
+    assert out[relay_id] == 8  # 2/8 of 32
+
+
+def test_overlay_planner_picks_relay_and_falls_back(tmp_path):
+    import csv as _csv
+
+    from skyplane_tpu.planner.planner import OverlayPlanner
+
+    profile = tmp_path / "grid.csv"
+    with profile.open("w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(["src_region", "dst_region", "gbps"])
+        w.writerow(["aws:a", "aws:b", "0.5"])
+        w.writerow(["aws:a", "aws:c", "6.0"])
+        w.writerow(["aws:c", "aws:b", "5.0"])
+    job = _mk_job(tmp_path)
+    planner = OverlayPlanner(TransferConfig(), solver="ron", profile_path=str(profile))
+    plan = planner.plan([job])
+    assert plan.get_region_gateways("aws:c"), "profile shows the relay is 10x faster; solver must take it"
+
+    # a profile where the direct path wins falls back to the direct planner
+    with profile.open("w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(["src_region", "dst_region", "gbps"])
+        w.writerow(["aws:a", "aws:b", "9.0"])
+        w.writerow(["aws:a", "aws:c", "1.0"])
+        w.writerow(["aws:c", "aws:b", "1.0"])
+    planner2 = OverlayPlanner(TransferConfig(), solver="ron", profile_path=str(profile))
+    plan2 = planner2.plan([job])
+    assert not plan2.get_region_gateways("aws:c")
+
+    # no profile at all: direct fallback, not a crash
+    planner3 = OverlayPlanner(TransferConfig(), solver="ron", profile_path=None)
+    plan3 = planner3.plan([job])
+    assert len(plan3.gateways) == 2
+
+
+def test_topological_cycle_rejected():
+    from skyplane_tpu.planner.solver import _topological_regions
+
+    with pytest.raises(ValueError, match="cycle"):
+        _topological_regions("a", "d", {("a", "b"): 1.0, ("b", "c"): 1.0, ("c", "b"): 1.0, ("c", "d"): 1.0})
+
+
+def test_overlay_planner_ilp_relays_when_direct_is_slow(tmp_path):
+    """The ILP minimizes cost subject to the throughput demand; the default
+    demand must be high enough that a slow direct edge forces relay flow."""
+    import csv as _csv
+
+    from skyplane_tpu.planner.planner import OverlayPlanner
+
+    profile = tmp_path / "grid.csv"
+    with profile.open("w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(["src_region", "dst_region", "gbps"])
+        w.writerow(["aws:a", "aws:b", "0.5"])
+        w.writerow(["aws:a", "aws:c", "6.0"])
+        w.writerow(["aws:c", "aws:b", "5.0"])
+    job = _mk_job(tmp_path)
+    planner = OverlayPlanner(TransferConfig(), solver="ilp", profile_path=str(profile))
+    plan = planner.plan([job])
+    assert plan.get_region_gateways("aws:c"), "ilp must route through the 10x-faster relay"
