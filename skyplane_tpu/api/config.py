@@ -18,6 +18,9 @@ class TransferConfig:
     # data path
     compress: str = "tpu_zstd"  # none | zstd | tpu | tpu_zstd | native_lz
     dedup: bool = True
+    # planner may sample-compress the source corpus and disable codec/dedup
+    # per edge when ratio x egress-price x bandwidth says raw bytes win
+    auto_codec_decision: bool = True
     encrypt_e2e: bool = True
     encrypt_socket_tls: bool = True
     verify_checksums: bool = True

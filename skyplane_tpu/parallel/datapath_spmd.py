@@ -39,8 +39,10 @@ def maybe_default_mesh() -> Optional[Mesh]:
         n = len(jax.devices())
         if n > 1 and (n & (n - 1)) == 0:
             return default_mesh()
-    except Exception:  # noqa: BLE001 — no usable backend => unsharded
-        pass
+    except Exception as e:  # noqa: BLE001 — no usable backend => unsharded
+        from skyplane_tpu.utils.logger import logger
+
+        logger.fs.warning(f"multi-device mesh unavailable ({e}); running single-device")
     return None
 
 

@@ -45,6 +45,7 @@ class Planner:
         self.n_instances = n_instances
         self.quota_limits_file = quota_limits_file
         self.quota_limits: Dict[str, int] = {}
+        self.codec_decisions: Dict[Tuple[str, str], dict] = {}  # edge -> decision (plan log)
         if quota_limits_file and Path(quota_limits_file).exists():
             self.quota_limits = json.loads(Path(quota_limits_file).read_text())
 
@@ -99,16 +100,48 @@ class Planner:
             n_instances = min(n_instances, n)
         return vm_types, n_instances
 
-    def _edge_codec(self, src_region: str, dst_region: str) -> Tuple[str, bool]:
+    def _estimate_corpus(self, jobs: List):
+        """Sample the source corpus once per plan (BASELINE.json north star);
+        None when sampling is disabled or fails."""
+        if not self.transfer_config.auto_codec_decision:
+            return None
+        from skyplane_tpu.planner.estimator import estimate_corpus
+
+        job = jobs[0]
+        return estimate_corpus(job.src_iface, prefix=getattr(job, "src_prefix", "") or "")
+
+    def _edge_codec(self, src_region: str, dst_region: str, estimate=None) -> Tuple[str, bool]:
         """Decide (codec, dedup) for a WAN edge: enable the TPU path when the
-        expected ratio x egress price beats shipping raw bytes."""
+        measured ratio x egress price x bandwidth beats shipping raw bytes
+        (decision model in planner/estimator.py). The decision is recorded in
+        ``self.codec_decisions`` for the plan log."""
+        from skyplane_tpu.planner.estimator import decide_edge_codec
+        from skyplane_tpu.planner.solver import ThroughputSolver
+        from skyplane_tpu.utils.logger import logger
+
         cfg = self.transfer_config
-        if cfg.compress == "none":
-            return "none", False
-        egress = get_egress_cost_per_gb(src_region, dst_region)
-        if egress == 0.0 and src_region == dst_region:
+        if src_region == dst_region:
             return "none", False  # same region: no egress cost, bandwidth is LAN
-        return cfg.compress, cfg.dedup
+        cached = self.codec_decisions.get((src_region, dst_region))
+        if cached is not None:
+            # deterministic per edge: multi-gateway/multi-job plans call this
+            # many times, so decide (and log) once
+            return cached["codec"], cached["dedup"]
+        egress = get_egress_cost_per_gb(src_region, dst_region)
+        # bandwidth from the MEASURED grid when one exists (falls back to the
+        # NIC-limit model inside the solver)
+        profile = getattr(self, "profile_path", None)
+        if profile is None:
+            from skyplane_tpu.config_paths import throughput_grid_path
+
+            profile = str(throughput_grid_path)
+        bw = ThroughputSolver(profile).get_path_throughput(src_region, dst_region)
+        decision = decide_edge_codec(cfg.compress, cfg.dedup, estimate, egress, bw)
+        self.codec_decisions[(src_region, dst_region)] = decision.as_dict()
+        logger.fs.info(
+            f"edge {src_region}->{dst_region}: codec={decision.codec} dedup={decision.dedup} ({decision.reason})"
+        )
+        return decision.codec, decision.dedup
 
     @staticmethod
     def _validate_jobs(jobs: List):
@@ -133,6 +166,7 @@ class MulticastDirectPlanner(Planner):
 
     def plan(self, jobs: List) -> TopologyPlan:
         src_region, dst_regions = self._validate_jobs(jobs)
+        self.codec_decisions = {}  # fresh per plan: no stale edges in the log
         plan = TopologyPlan(src_region, dst_regions)
         vm_types, n_instances = self._get_vm_type_and_instances([src_region] + [r for r in dst_regions if r != src_region])
 
@@ -144,6 +178,7 @@ class MulticastDirectPlanner(Planner):
             dst_gateways[region] = [plan.add_gateway(region) for _ in range(n_instances)]
 
         cfg = self.transfer_config
+        estimate = self._estimate_corpus(jobs)
         for job in jobs:
             partition = job.uuid
             src_bucket = job.src_iface.bucket()
@@ -172,7 +207,7 @@ class MulticastDirectPlanner(Planner):
                         continue
                     targets = dst_gateways[region]
                     conns = max(1, cfg.num_connections // max(1, len(targets)))
-                    codec, dedup = self._edge_codec(src_region, region)
+                    codec, dedup = self._edge_codec(src_region, region, estimate)
                     parent = parent_for_dests
                     if len(targets) > 1:
                         mux_or = GatewayMuxOr()
@@ -195,7 +230,7 @@ class MulticastDirectPlanner(Planner):
             for iface, region in zip(dst_ifaces, dst_regions):
                 if region == src_region:
                     continue
-                codec, dedup = self._edge_codec(src_region, region)
+                codec, dedup = self._edge_codec(src_region, region, estimate)
                 for gw in dst_gateways[region]:
                     program = gw.gateway_program
                     recv = GatewayReceive(decrypt=cfg.encrypt_e2e, dedup=dedup)
@@ -212,6 +247,7 @@ class MulticastDirectPlanner(Planner):
         # $/GB of logical data: one egress charge per distinct WAN edge (a
         # multicast pays egress once per destination region)
         plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
+        plan.codec_decisions = dict(getattr(self, "codec_decisions", {}))  # plan log (north-star decision)
         return plan
 
 
@@ -322,6 +358,7 @@ class OverlayPlanner(Planner):
         from skyplane_tpu.utils.logger import logger
 
         src_region, dst_regions = self._validate_jobs(jobs)
+        self.codec_decisions = {}  # fresh per plan
         direct = MulticastDirectPlanner(
             self.transfer_config, quota_limits_file=self.quota_limits_file, n_instances=self.n_instances
         )

@@ -267,6 +267,15 @@ def solution_to_topology(
     order = _topological_regions(p.src, p.dst, edges)
     plan = TopologyPlan(p.src, [p.dst])
 
+    # first-hop codec/dedup: the same ratio-aware north-star decision the
+    # direct planner makes, judged on the logical src->dst edge (the data
+    # path runs once at the source; relays forward opaque payloads)
+    if planner is not None:
+        estimate = planner._estimate_corpus(jobs)
+        src_codec, src_dedup = planner._edge_codec(p.src, p.dst, estimate)
+    else:
+        src_codec, src_dedup = cfg.compress, cfg.dedup
+
     # instance scaling: the solver's per-region instance counts, capped by the
     # planner's quota-aware ladder (round 1 emitted exactly 1 gw/region)
     gws: Dict[str, List] = {}
@@ -302,7 +311,7 @@ def solution_to_topology(
                 else:
                     assert incoming, f"non-source region {region} has no incoming flow"
                     parent = program.add_operator(
-                        GatewayReceive(decrypt=cfg.encrypt_e2e and is_dst, dedup=cfg.dedup and is_dst),
+                        GatewayReceive(decrypt=cfg.encrypt_e2e and is_dst, dedup=src_dedup and is_dst),
                         partition_id=partition,
                     )
                 if is_dst:
@@ -332,9 +341,9 @@ def solution_to_topology(
                                 num_connections=conns,
                                 # only the first hop runs the TPU data path;
                                 # relays forward opaque wire payloads
-                                compress=cfg.compress if is_src else "none",
+                                compress=src_codec if is_src else "none",
                                 encrypt=cfg.encrypt_e2e and is_src,
-                                dedup=cfg.dedup and is_src,
+                                dedup=src_dedup and is_src,
                             ),
                             parent_handle=send_parent,
                             partition_id=partition,
@@ -345,4 +354,6 @@ def solution_to_topology(
     # flow crossing it
     total_flow = sum(f for (a, _), f in edges.items() if a == p.src) or 1.0
     plan.cost_per_gb = sum(get_egress_cost_per_gb(a, b) * (f / total_flow) for (a, b), f in edges.items())
+    if planner is not None:
+        plan.codec_decisions = dict(planner.codec_decisions)
     return plan
