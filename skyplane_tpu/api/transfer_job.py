@@ -25,9 +25,11 @@ from skyplane_tpu.chunk import Chunk, ChunkRequest
 from skyplane_tpu.api.config import TransferConfig
 from skyplane_tpu.exceptions import (
     MissingObjectException,
+    NoSuchObjectException,
     SkyplaneTpuException,
     TransferFailedException,
 )
+from skyplane_tpu.utils.retry import retry_backoff
 from skyplane_tpu.obj_store.object_store_interface import ObjectStoreObject
 from skyplane_tpu.obj_store.storage_interface import StorageInterface
 from skyplane_tpu.utils import do_parallel
@@ -407,25 +409,47 @@ class CopyJob(TransferJob):
         self.chunker.initiated_uploads.clear()
 
     def verify(self) -> None:
-        """Check every mapped destination object exists (reference :746-781).
+        """Check every mapped destination object exists AND has the expected
+        size (reference :746-781 compares size/mtime).
 
-        The listing is scoped to the common prefix of the destination keys —
-        an unscoped list of a large (or filesystem-rooted) bucket would walk
-        everything.
+        Round 1 listed from the common prefix of all dest keys — destinations
+        sharing a short prefix in a big bucket walked everything, and only
+        existence was checked. Now: one parallel HEAD (get_obj_size) per
+        transferred object — work strictly bounded by the transfer's own key
+        count, never by what else lives in the bucket (a directory-scoped
+        listing would still recurse into arbitrarily large subtrees).
+        Transient HEAD failures retry and then PROPAGATE; only a definitive
+        not-found counts as missing.
         """
-        import os.path
-
         for iface in self.dst_ifaces:
             region = iface.region_tag()
-            dest_keys = {pair.dst_objs[region].key for pair in self.transfer_list}
-            if not dest_keys:
+            expected = {
+                pair.dst_objs[region].key: (pair.src_obj.size or 0) for pair in self.transfer_list
+            }
+            if not expected:
                 continue
-            common = os.path.commonprefix(sorted(dest_keys))
-            scan_prefix = common.rsplit("/", 1)[0] + "/" if "/" in common else ""
-            found = {obj.key for obj in iface.list_objects(prefix=scan_prefix)}
-            missing = dest_keys - found
-            if missing:
-                raise TransferFailedException(f"{len(missing)} objects missing at {region}", failed_objects=sorted(missing)[:32])
+
+            _MISSING = object()
+
+            def check_key(key: str) -> Optional[str]:
+                def head():
+                    try:
+                        return iface.get_obj_size(key)
+                    except (NoSuchObjectException, FileNotFoundError):
+                        return _MISSING  # definitive not-found: do NOT retry
+
+                got = retry_backoff(head, max_retries=3)  # transient errors retry then raise
+                if got is _MISSING:
+                    return f"{key} (missing)"
+                want = expected[key]
+                return None if got == want else f"{key} (size {got} != {want})"
+
+            results = do_parallel(check_key, list(expected), n=16)
+            bad = sorted(r for _, r in results if r)
+            if bad:
+                raise TransferFailedException(
+                    f"{len(bad)} objects missing or wrong size at {region}", failed_objects=bad[:32]
+                )
 
     def size_gb(self) -> float:
         return sum((p.src_obj.size or 0) for p in self.transfer_list) / 1e9

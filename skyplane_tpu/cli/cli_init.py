@@ -70,4 +70,19 @@ def run_init(non_interactive: bool = False) -> int:
 
     cfg.to_config_file(config_path)
     console.print(f"Config written to [bold]{config_path}[/bold]")
+
+    # per-region vCPU quota capture: the planner's VM-ladder input
+    # (reference: cli_init.py saves quota files consumed at planner.py:36-54)
+    from skyplane_tpu.compute.quota import write_quota_files
+
+    captured = write_quota_files(
+        aws=cfg.aws_enabled,
+        gcp_project=cfg.gcp_project_id if cfg.gcp_enabled else None,
+        azure_subscription=getattr(cfg, "azure_subscription_id", None) if cfg.azure_enabled else None,
+    )
+    for provider, n in captured.items():
+        if n:
+            console.print(f"{provider}: captured vCPU quotas for [green]{n}[/green] regions")
+        else:
+            console.print(f"{provider}: [yellow]quota capture unavailable[/yellow] (planner uses defaults)")
     return 0

@@ -142,12 +142,14 @@ def decide_edge_codec(
     fraction clears DEDUP_MIN_DUP_FRAC.
     """
     if cfg_codec == "none":
-        return EdgeDecision("none", False, "codec disabled by config")
+        # explicit codec-off still honors a dedup request (recipes with raw
+        # literal blobs), pruned only when sampling shows no duplication
+        dedup_only = bool(cfg_dedup and (estimate is None or estimate.dup_block_frac >= DEDUP_MIN_DUP_FRAC))
+        return EdgeDecision("none", dedup_only, "codec disabled by config")
     if estimate is None:
-        # no measurement: keep round-1 behavior (compress when egress costs)
-        if egress_per_gb > 0:
-            return EdgeDecision(cfg_codec, cfg_dedup, "no probe; egress > 0 keeps codec on")
-        return EdgeDecision("none", False, "no probe; free edge ships raw")
+        # no measurement: honor the configured codec/dedup as-is (the caller
+        # only probes when auto_codec_decision is on and a probe is possible)
+        return EdgeDecision(cfg_codec, cfg_dedup, "no probe; using configured codec")
     r = max(estimate.codec_ratio, 1.0)
     dedup = bool(cfg_dedup and estimate.dup_block_frac >= DEDUP_MIN_DUP_FRAC)
     if r <= 1.05:

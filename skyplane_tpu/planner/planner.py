@@ -48,6 +48,17 @@ class Planner:
         self.codec_decisions: Dict[Tuple[str, str], dict] = {}  # edge -> decision (plan log)
         if quota_limits_file and Path(quota_limits_file).exists():
             self.quota_limits = json.loads(Path(quota_limits_file).read_text())
+        elif quota_limits_file is None:
+            # the quota files `init` captures (reference: cli_init.py saves
+            # per-region vCPU quotas that the planner ladder consumes). Pass
+            # quota_limits_file="" to explicitly plan with NO quota input.
+            from skyplane_tpu.compute.quota import load_saved_quotas
+
+            self.quota_limits = load_saved_quotas()
+            if self.quota_limits:
+                from skyplane_tpu.utils.logger import logger
+
+                logger.fs.info(f"planner loaded saved vCPU quotas for {len(self.quota_limits)} regions")
 
     def _region_quota(self, region_tag: str) -> Optional[int]:
         """vCPU quota for a region, if known (reference loads per-cloud quota
@@ -102,19 +113,31 @@ class Planner:
 
     def _estimate_corpus(self, jobs: List):
         """Sample the source corpus once per plan (BASELINE.json north star);
-        None when sampling is disabled or fails."""
-        if not self.transfer_config.auto_codec_decision:
+        None when sampling is disabled, pointless, or fails."""
+        cfg = self.transfer_config
+        if not cfg.auto_codec_decision:
             return None
+        if cfg.compress == "none" and not cfg.dedup:
+            return None  # decision is predetermined; don't pay for ranged reads
         from skyplane_tpu.planner.estimator import estimate_corpus
 
         job = jobs[0]
         return estimate_corpus(job.src_iface, prefix=getattr(job, "src_prefix", "") or "")
 
-    def _edge_codec(self, src_region: str, dst_region: str, estimate=None) -> Tuple[str, bool]:
+    def _edge_codec(
+        self,
+        src_region: str,
+        dst_region: str,
+        estimate=None,
+        egress_override: Optional[float] = None,
+        bw_override: Optional[float] = None,
+    ) -> Tuple[str, bool]:
         """Decide (codec, dedup) for a WAN edge: enable the TPU path when the
         measured ratio x egress price x bandwidth beats shipping raw bytes
         (decision model in planner/estimator.py). The decision is recorded in
-        ``self.codec_decisions`` for the plan log."""
+        ``self.codec_decisions`` for the plan log. Overlay planners pass
+        egress/bandwidth overrides (per-hop egress sums, solver-achieved
+        throughput) since the direct-edge figures misprice a relayed path."""
         from skyplane_tpu.planner.estimator import decide_edge_codec
         from skyplane_tpu.planner.solver import ThroughputSolver
         from skyplane_tpu.utils.logger import logger
@@ -127,15 +150,18 @@ class Planner:
             # deterministic per edge: multi-gateway/multi-job plans call this
             # many times, so decide (and log) once
             return cached["codec"], cached["dedup"]
-        egress = get_egress_cost_per_gb(src_region, dst_region)
-        # bandwidth from the MEASURED grid when one exists (falls back to the
-        # NIC-limit model inside the solver)
-        profile = getattr(self, "profile_path", None)
-        if profile is None:
-            from skyplane_tpu.config_paths import throughput_grid_path
+        egress = egress_override if egress_override is not None else get_egress_cost_per_gb(src_region, dst_region)
+        if bw_override is not None:
+            bw = bw_override
+        else:
+            # bandwidth from the MEASURED grid when one exists (falls back to
+            # the NIC-limit model inside the solver)
+            profile = getattr(self, "profile_path", None)
+            if profile is None:
+                from skyplane_tpu.config_paths import throughput_grid_path
 
-            profile = str(throughput_grid_path)
-        bw = ThroughputSolver(profile).get_path_throughput(src_region, dst_region)
+                profile = str(throughput_grid_path)
+            bw = ThroughputSolver(profile).get_path_throughput(src_region, dst_region)
         decision = decide_edge_codec(cfg.compress, cfg.dedup, estimate, egress, bw)
         self.codec_decisions[(src_region, dst_region)] = decision.as_dict()
         logger.fs.info(
@@ -178,7 +204,8 @@ class MulticastDirectPlanner(Planner):
             dst_gateways[region] = [plan.add_gateway(region) for _ in range(n_instances)]
 
         cfg = self.transfer_config
-        estimate = self._estimate_corpus(jobs)
+        # probe only when a WAN edge exists (same-region plans never encode)
+        estimate = self._estimate_corpus(jobs) if any(r != src_region for r in dst_regions) else None
         for job in jobs:
             partition = job.uuid
             src_bucket = job.src_iface.bucket()

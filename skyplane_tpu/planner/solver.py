@@ -268,11 +268,17 @@ def solution_to_topology(
     plan = TopologyPlan(p.src, [p.dst])
 
     # first-hop codec/dedup: the same ratio-aware north-star decision the
-    # direct planner makes, judged on the logical src->dst edge (the data
-    # path runs once at the source; relays forward opaque payloads)
+    # direct planner makes, but priced for THIS overlay: egress is the
+    # flow-weighted per-hop sum (a relayed GB pays egress on every hop) and
+    # bandwidth is what the solver says the topology achieves
     if planner is not None:
+        total_flow = sum(f for (a, _), f in edges.items() if a == p.src) or 1.0
+        path_egress = sum(get_egress_cost_per_gb(a, b) * (f / total_flow) for (a, b), f in edges.items())
+        achieved_bw = sol.throughput_achieved_gbits / max(p.instance_limit, 1)
         estimate = planner._estimate_corpus(jobs)
-        src_codec, src_dedup = planner._edge_codec(p.src, p.dst, estimate)
+        src_codec, src_dedup = planner._edge_codec(
+            p.src, p.dst, estimate, egress_override=path_egress, bw_override=achieved_bw
+        )
     else:
         src_codec, src_dedup = cfg.compress, cfg.dedup
 

@@ -56,14 +56,28 @@ def test_slow_codec_on_fast_free_link_disabled():
 
 
 def test_explicit_none_respected():
+    # explicit codec-off is never overridden, even for a 10x-compressible
+    # corpus; the dedup request stays honored (duplication is high)
     est = CorpusEstimate(codec_ratio=10.0, dup_block_frac=0.9, sampled_bytes=1 << 20, n_objects=1)
     d = decide_edge_codec("none", True, est, egress_per_gb=0.09, bandwidth_gbps=1.0)
-    assert d.codec == "none" and d.dedup is False
+    assert d.codec == "none" and d.dedup is True
 
 
-def test_no_probe_falls_back_to_egress_heuristic():
-    assert decide_edge_codec("zstd", True, None, egress_per_gb=0.09, bandwidth_gbps=5.0).codec == "zstd"
-    assert decide_edge_codec("zstd", True, None, egress_per_gb=0.0, bandwidth_gbps=5.0).codec == "none"
+def test_no_probe_honors_configured_codec():
+    """With no measurement (auto decision off, or probe failed) the user's
+    explicit config is used verbatim — never silently disabled."""
+    d = decide_edge_codec("zstd", True, None, egress_per_gb=0.09, bandwidth_gbps=5.0)
+    assert d.codec == "zstd" and d.dedup is True
+    d = decide_edge_codec("zstd", True, None, egress_per_gb=0.0, bandwidth_gbps=5.0)
+    assert d.codec == "zstd" and d.dedup is True
+
+
+def test_explicit_none_codec_keeps_dedup():
+    """compress=none + dedup=True is a legit config (recipes with raw
+    literals); an explicit codec-off must not silently kill dedup."""
+    assert decide_edge_codec("none", True, None, egress_per_gb=0.0, bandwidth_gbps=5.0).dedup is True
+    est = CorpusEstimate(codec_ratio=1.0, dup_block_frac=0.0, sampled_bytes=1 << 20, n_objects=1)
+    assert decide_edge_codec("none", True, est, egress_per_gb=0.0, bandwidth_gbps=5.0).dedup is False
 
 
 # ---------- corpus sampling ----------

@@ -1,0 +1,150 @@
+"""init quota capture feeding the planner ladder, and size-aware verify().
+
+VERDICT r1 missing #5 (quota files only ever injected by tests) and weak #6
+(verify over-listed from a common prefix and checked existence only).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.transfer_job import CopyJob
+from skyplane_tpu.exceptions import TransferFailedException
+from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+from skyplane_tpu.planner.planner import MulticastDirectPlanner
+
+rng = np.random.default_rng(77)
+
+
+# ---------- quota files -> planner ladder (no injection) ----------
+
+
+@pytest.fixture()
+def saved_aws_quota():
+    from skyplane_tpu.config_paths import aws_quota_path
+
+    aws_quota_path.parent.mkdir(parents=True, exist_ok=True)
+    aws_quota_path.write_text(json.dumps({"aws:us-east-1": 16}))
+    yield aws_quota_path
+    aws_quota_path.unlink(missing_ok=True)
+
+
+def _mk_job(tmp_path, src_region, dst_region):
+    (tmp_path / "src").mkdir(exist_ok=True)
+    (tmp_path / "src" / "x").write_bytes(b"data")
+    job = CopyJob("local:///x", ["local:///x"])
+    job._src_iface = POSIXInterface(str(tmp_path / "src"), region_tag=src_region)
+    job._dst_ifaces = [POSIXInterface(str(tmp_path / "dst"), region_tag=dst_region)]
+    return job
+
+
+def test_planner_consumes_saved_quota_files(tmp_path, saved_aws_quota):
+    """A 16-vCPU saved quota forces the ladder below the preferred 32-vCPU
+    class — with NO quota_limits_file injected."""
+    job = _mk_job(tmp_path, "aws:us-east-1", "gcp:us-central1")
+    planner = MulticastDirectPlanner(TransferConfig(auto_codec_decision=False))
+    plan = planner.plan([job])
+    src_gw = plan.get_region_gateways("aws:us-east-1")[0]
+    assert src_gw.vm_type == "m5.4xlarge"  # 16 vCPUs fits; m5.8xlarge (32) does not
+
+
+def test_init_noninteractive_writes_quota_files(monkeypatch, tmp_path):
+    """run_init captures quotas for enabled providers and writes the files
+    the planner reads (cloud APIs stubbed)."""
+    import skyplane_tpu.compute.quota as quota_mod
+    from skyplane_tpu.cli.cli_init import run_init
+    from skyplane_tpu.config_paths import aws_quota_path
+
+    monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_aws", lambda: True)
+    monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_gcp", lambda: None)
+    monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_azure", lambda: False)
+    monkeypatch.setattr(quota_mod, "capture_aws_quotas", lambda regions=None: {"aws:us-east-1": 640})
+    try:
+        assert run_init(non_interactive=True) == 0
+        assert json.loads(aws_quota_path.read_text()) == {"aws:us-east-1": 640}
+        assert quota_mod.load_saved_quotas()["aws:us-east-1"] == 640
+    finally:
+        aws_quota_path.unlink(missing_ok=True)
+
+
+def test_init_without_credentials_captures_nothing(monkeypatch):
+    from skyplane_tpu.cli.cli_init import run_init
+    from skyplane_tpu.config_paths import aws_quota_path
+
+    monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_aws", lambda: False)
+    monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_gcp", lambda: None)
+    monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_azure", lambda: False)
+    assert run_init(non_interactive=True) == 0
+    assert not aws_quota_path.exists()
+
+
+def test_quota_capture_functions_degrade_without_sdks():
+    from skyplane_tpu.compute.quota import capture_aws_quotas, capture_azure_quotas, capture_gcp_quotas
+
+    assert capture_aws_quotas() == {}
+    assert capture_gcp_quotas("proj") == {}
+    assert capture_azure_quotas("sub") == {}
+
+
+# ---------- verify(): per-key existence + size ----------
+
+
+def _verifiable_job(tmp_path, names_sizes: dict):
+    src_root = tmp_path / "vsrc"
+    dst_root = tmp_path / "vdst"
+    src_root.mkdir(exist_ok=True)
+    dst_root.mkdir(exist_ok=True)
+    for name, size in names_sizes.items():
+        (src_root / name).parent.mkdir(parents=True, exist_ok=True)
+        (src_root / name).write_bytes(bytes(size))
+    job = CopyJob("local:///", ["local:///"], recursive=True)
+    job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+    # populate transfer_list the way dispatch would
+    from skyplane_tpu.api.transfer_job import Chunker
+
+    job.chunker = Chunker(job.src_iface, job.dst_ifaces, TransferConfig(), partition_id=job.uuid)
+    job.transfer_list = list(job.chunker.transfer_pair_generator("", [""], True))
+    return job, dst_root
+
+
+def test_verify_passes_on_complete_sizes(tmp_path):
+    job, dst_root = _verifiable_job(tmp_path, {"a.bin": 100, "sub/b.bin": 200})
+    for pair in job.transfer_list:
+        key = pair.dst_objs["local:siteB"].key
+        (dst_root / key).parent.mkdir(parents=True, exist_ok=True)
+        (dst_root / key).write_bytes(bytes(pair.src_obj.size))
+    job.verify()
+
+
+def test_verify_catches_missing_object(tmp_path):
+    job, dst_root = _verifiable_job(tmp_path, {"a.bin": 100, "b.bin": 50})
+    (dst_root / "a.bin").write_bytes(bytes(100))  # b.bin never lands
+    with pytest.raises(TransferFailedException, match="missing"):
+        job.verify()
+
+
+def test_verify_catches_size_mismatch(tmp_path):
+    """Round 1's existence-only check passed truncated objects (e.g. a lost
+    multipart part); size comparison must fail them."""
+    job, dst_root = _verifiable_job(tmp_path, {"a.bin": 100})
+    (dst_root / "a.bin").write_bytes(bytes(37))  # truncated
+    with pytest.raises(TransferFailedException, match="size"):
+        job.verify()
+
+
+def test_verify_uses_directory_listing_for_big_groups(tmp_path):
+    names = {f"dir/f{i}.bin": 10 for i in range(20)}  # > VERIFY_HEAD_THRESHOLD
+    job, dst_root = _verifiable_job(tmp_path, names)
+    for pair in job.transfer_list:
+        key = pair.dst_objs["local:siteB"].key
+        (dst_root / key).parent.mkdir(parents=True, exist_ok=True)
+        (dst_root / key).write_bytes(bytes(10))
+    job.verify()
+    (dst_root / "dir" / "f3.bin").write_bytes(bytes(5))
+    with pytest.raises(TransferFailedException, match="size"):
+        job.verify()
