@@ -408,18 +408,27 @@ class CopyJob(TransferJob):
         logger.fs.info(f"aborted {len(self.chunker.initiated_uploads)} multipart uploads for job {self.uuid}")
         self.chunker.initiated_uploads.clear()
 
+    # per-directory groups up to this size verify with parallel HEADs; larger
+    # groups try one scoped listing first (cheaper than N HEADs when the
+    # directory mostly contains the transfer's own keys)
+    VERIFY_HEAD_THRESHOLD = 8
+    # a scoped listing aborts (falls back to HEADs) after scanning this many
+    # entries per expected key without finishing — so a directory with a huge
+    # unrelated subtree can never be walked end to end
+    VERIFY_LIST_BUDGET_FACTOR = 4
+
     def verify(self) -> None:
         """Check every mapped destination object exists AND has the expected
         size (reference :746-781 compares size/mtime).
 
         Round 1 listed from the common prefix of all dest keys — destinations
         sharing a short prefix in a big bucket walked everything, and only
-        existence was checked. Now: one parallel HEAD (get_obj_size) per
-        transferred object — work strictly bounded by the transfer's own key
-        count, never by what else lives in the bucket (a directory-scoped
-        listing would still recurse into arbitrarily large subtrees).
-        Transient HEAD failures retry and then PROPAGATE; only a definitive
-        not-found counts as missing.
+        existence was checked. Now keys are grouped per directory: small
+        groups use parallel per-key HEADs, larger groups one scoped listing
+        with a scan budget (aborting to HEADs when unrelated entries
+        dominate), so the work is bounded by the transfer's own key count
+        either way. Transient HEAD failures retry then PROPAGATE; only a
+        definitive not-found counts as missing.
         """
         for iface in self.dst_ifaces:
             region = iface.region_tag()
@@ -444,11 +453,50 @@ class CopyJob(TransferJob):
                 want = expected[key]
                 return None if got == want else f"{key} (size {got} != {want})"
 
-            results = do_parallel(check_key, list(expected), n=16)
-            bad = sorted(r for _, r in results if r)
+            def check_dir_by_listing(d: str, keys: List[str]) -> Optional[List[str]]:
+                """One scoped listing; None = budget blown, caller HEADs."""
+                want = set(keys)
+                found: Dict[str, int] = {}
+                budget = self.VERIFY_LIST_BUDGET_FACTOR * len(want)
+                scanned = 0
+                for obj in iface.list_objects(prefix=d):
+                    scanned += 1
+                    if obj.key in want:
+                        found[obj.key] = obj.size or 0
+                        if len(found) == len(want):
+                            break
+                    if scanned >= budget and len(found) < len(want):
+                        return None  # unrelated subtree dominates this prefix
+                bad = []
+                for key in keys:
+                    if key not in found:
+                        bad.append(f"{key} (missing)")
+                    elif found[key] != expected[key]:
+                        bad.append(f"{key} (size {found[key]} != {expected[key]})")
+                return bad
+
+            by_dir: Dict[str, List[str]] = {}
+            for key in expected:
+                d = key.rsplit("/", 1)[0] + "/" if "/" in key else ""
+                by_dir.setdefault(d, []).append(key)
+            bad: List[str] = []
+            head_keys: List[str] = []
+            for d, keys in by_dir.items():
+                if d == "" or len(keys) <= self.VERIFY_HEAD_THRESHOLD:
+                    # bucket-root groups always HEAD: prefix="" lists the world
+                    head_keys.extend(keys)
+                    continue
+                listed = check_dir_by_listing(d, keys)
+                if listed is None:
+                    head_keys.extend(keys)
+                else:
+                    bad.extend(listed)
+            if head_keys:
+                results = do_parallel(check_key, head_keys, n=16)
+                bad.extend(r for _, r in results if r)
             if bad:
                 raise TransferFailedException(
-                    f"{len(bad)} objects missing or wrong size at {region}", failed_objects=bad[:32]
+                    f"{len(bad)} objects missing or wrong size at {region}", failed_objects=sorted(bad)[:32]
                 )
 
     def size_gb(self) -> float:

@@ -75,14 +75,17 @@ def run_init(non_interactive: bool = False) -> int:
     # (reference: cli_init.py saves quota files consumed at planner.py:36-54)
     from skyplane_tpu.compute.quota import write_quota_files
 
+    azure_sub = getattr(cfg, "azure_subscription_id", None) if cfg.azure_enabled else None
     captured = write_quota_files(
         aws=cfg.aws_enabled,
         gcp_project=cfg.gcp_project_id if cfg.gcp_enabled else None,
-        azure_subscription=getattr(cfg, "azure_subscription_id", None) if cfg.azure_enabled else None,
+        azure_subscription=azure_sub,
     )
     for provider, n in captured.items():
         if n:
             console.print(f"{provider}: captured vCPU quotas for [green]{n}[/green] regions")
         else:
             console.print(f"{provider}: [yellow]quota capture unavailable[/yellow] (planner uses defaults)")
+    if cfg.azure_enabled and not azure_sub:
+        console.print("azure: [yellow]set azure_subscription_id in the config to capture quotas[/yellow]")
     return 0

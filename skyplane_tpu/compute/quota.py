@@ -26,18 +26,24 @@ def capture_aws_quotas(regions: Optional[list] = None) -> Dict[str, int]:
     try:
         import boto3
 
-        out: Dict[str, int] = {}
+        from skyplane_tpu.utils.fn import do_parallel
+
         if regions is None:
             ec2 = boto3.client("ec2", region_name="us-east-1")
             regions = [r["RegionName"] for r in ec2.describe_regions()["Regions"]]
-        for region in regions:
+
+        def one(region: str):
             try:
                 sq = boto3.client("service-quotas", region_name=region)
                 q = sq.get_service_quota(ServiceCode="ec2", QuotaCode=AWS_STANDARD_VCPU_QUOTA_CODE)
-                out[f"aws:{region}"] = int(q["Quota"]["Value"])
+                return int(q["Quota"]["Value"])
             except Exception as e:  # noqa: BLE001 — one region must not kill the sweep
                 logger.fs.debug(f"aws quota capture failed for {region}: {e}")
-        return out
+                return None
+
+        # ~25 regions x ~1s serial would stall init; fan out
+        results = do_parallel(one, list(regions), n=16)
+        return {f"aws:{region}": v for region, v in results if v is not None}
     except Exception as e:  # noqa: BLE001
         logger.fs.debug(f"aws quota capture unavailable: {e}")
         return {}
@@ -76,17 +82,21 @@ def capture_azure_quotas(subscription_id: str, locations: Optional[list] = None)
         from azure.identity import DefaultAzureCredential
         from azure.mgmt.compute import ComputeManagementClient
 
+        from skyplane_tpu.utils.fn import do_parallel
+
         client = ComputeManagementClient(DefaultAzureCredential(), subscription_id)
-        out: Dict[str, int] = {}
-        for location in locations or AZURE_DEFAULT_LOCATIONS:
+
+        def one(location: str):
             try:
                 for usage in client.usage.list(location):
                     if usage.name.value == "cores":
-                        out[f"azure:{location}"] = int(usage.limit)
-                        break
+                        return int(usage.limit)
             except Exception as e:  # noqa: BLE001 — one location must not kill the sweep
                 logger.fs.debug(f"azure quota capture failed for {location}: {e}")
-        return out
+            return None
+
+        results = do_parallel(one, list(locations or AZURE_DEFAULT_LOCATIONS), n=8)
+        return {f"azure:{loc}": v for loc, v in results if v is not None}
     except Exception as e:  # noqa: BLE001
         logger.fs.debug(f"azure quota capture unavailable: {e}")
         return {}

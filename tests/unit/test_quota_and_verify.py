@@ -24,13 +24,18 @@ rng = np.random.default_rng(77)
 
 
 @pytest.fixture()
-def saved_aws_quota():
-    from skyplane_tpu.config_paths import aws_quota_path
+def saved_aws_quota(tmp_path, monkeypatch):
+    """Quota file in an ISOLATED config dir: Planner loads saved files by
+    default, so writing the shared config root would leak a 16-vCPU cap into
+    every other test that builds a planner."""
+    import skyplane_tpu.config_paths as cp
 
-    aws_quota_path.parent.mkdir(parents=True, exist_ok=True)
-    aws_quota_path.write_text(json.dumps({"aws:us-east-1": 16}))
-    yield aws_quota_path
-    aws_quota_path.unlink(missing_ok=True)
+    p = tmp_path / "aws_quota"
+    p.write_text(json.dumps({"aws:us-east-1": 16}))
+    monkeypatch.setattr(cp, "aws_quota_path", p)
+    monkeypatch.setattr(cp, "gcp_quota_path", tmp_path / "gcp_quota")
+    monkeypatch.setattr(cp, "azure_quota_path", tmp_path / "azure_quota")
+    yield p
 
 
 def _mk_job(tmp_path, src_region, dst_region):
@@ -54,35 +59,44 @@ def test_planner_consumes_saved_quota_files(tmp_path, saved_aws_quota):
 
 def test_init_noninteractive_writes_quota_files(monkeypatch, tmp_path):
     """run_init captures quotas for enabled providers and writes the files
-    the planner reads (cloud APIs stubbed)."""
+    the planner reads (cloud APIs stubbed, config paths isolated)."""
     import skyplane_tpu.compute.quota as quota_mod
+    import skyplane_tpu.config_paths as cp
     from skyplane_tpu.cli.cli_init import run_init
-    from skyplane_tpu.config_paths import aws_quota_path
 
+    aws_path = tmp_path / "aws_quota"
+    monkeypatch.setattr(cp, "aws_quota_path", aws_path)
+    monkeypatch.setattr(cp, "gcp_quota_path", tmp_path / "gcp_quota")
+    monkeypatch.setattr(cp, "azure_quota_path", tmp_path / "azure_quota")
     monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_aws", lambda: True)
     monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_gcp", lambda: None)
     monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_azure", lambda: False)
     monkeypatch.setattr(quota_mod, "capture_aws_quotas", lambda regions=None: {"aws:us-east-1": 640})
-    try:
-        assert run_init(non_interactive=True) == 0
-        assert json.loads(aws_quota_path.read_text()) == {"aws:us-east-1": 640}
-        assert quota_mod.load_saved_quotas()["aws:us-east-1"] == 640
-    finally:
-        aws_quota_path.unlink(missing_ok=True)
+    assert run_init(non_interactive=True) == 0
+    assert json.loads(aws_path.read_text()) == {"aws:us-east-1": 640}
+    assert quota_mod.load_saved_quotas()["aws:us-east-1"] == 640
 
 
-def test_init_without_credentials_captures_nothing(monkeypatch):
+def test_init_without_credentials_captures_nothing(monkeypatch, tmp_path):
+    import skyplane_tpu.config_paths as cp
     from skyplane_tpu.cli.cli_init import run_init
-    from skyplane_tpu.config_paths import aws_quota_path
 
+    aws_path = tmp_path / "aws_quota"
+    monkeypatch.setattr(cp, "aws_quota_path", aws_path)
     monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_aws", lambda: False)
     monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_gcp", lambda: None)
     monkeypatch.setattr("skyplane_tpu.cli.cli_init._detect_azure", lambda: False)
     assert run_init(non_interactive=True) == 0
-    assert not aws_quota_path.exists()
+    assert not aws_path.exists()
 
 
-def test_quota_capture_functions_degrade_without_sdks():
+def test_quota_capture_functions_degrade_without_sdks(monkeypatch):
+    """SDK import failure (forced — the dev image may or may not carry cloud
+    SDKs) must yield an empty map, never an exception or a network call."""
+    import sys
+
+    for mod in ("boto3", "googleapiclient", "googleapiclient.discovery", "azure", "azure.identity", "azure.mgmt.compute"):
+        monkeypatch.setitem(sys.modules, mod, None)  # None entry => ImportError
     from skyplane_tpu.compute.quota import capture_aws_quotas, capture_azure_quotas, capture_gcp_quotas
 
     assert capture_aws_quotas() == {}
