@@ -29,7 +29,14 @@ class Pipeline:
         self.planning_algorithm = planning_algorithm
         self.max_instances = max_instances
         self.transfer_config = transfer_config or TransferConfig()
-        self.provisioner = provisioner or Provisioner(autoshutdown_minutes=self.transfer_config.autoshutdown_minutes)
+        cfg = self.transfer_config
+        self.provisioner = provisioner or Provisioner(
+            autoshutdown_minutes=cfg.autoshutdown_minutes,
+            # per-provider knobs (spot, network tier) ride the TransferConfig
+            aws={"use_spot": cfg.aws_use_spot_instances},
+            gcp={"use_spot": cfg.gcp_use_spot_instances, "premium_network": cfg.gcp_use_premium_network},
+            azure={"use_spot": cfg.azure_use_spot_instances},
+        )
         self.debug = debug
         self.jobs_to_dispatch: List[TransferJob] = []
 

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Tuple
 from skyplane_tpu.compute.cloud_provider import CloudProvider, get_cloud_provider
 from skyplane_tpu.compute.server import Server
 from skyplane_tpu.utils import do_parallel
+from skyplane_tpu.utils.logger import logger
 
 
 @dataclass
@@ -33,6 +34,8 @@ class Provisioner:
         self.pending_tasks: List[ProvisionerTask] = []
         self.provisioned: Dict[str, Server] = {}  # task uuid -> server
         self._providers: Dict[str, CloudProvider] = {}
+        # (provider, region, ips) firewall authorizations to revoke on teardown
+        self._fw_authorized: List[Tuple[str, str, List[str]]] = []
 
     def provider(self, name: str) -> CloudProvider:
         if name not in self._providers:
@@ -66,16 +69,42 @@ class Provisioner:
         results = do_parallel(lambda t: provision_task(t), self.pending_tasks, n=16)
         for _, (task_uuid, server) in results:
             self.provisioned[task_uuid] = server
+
+        # cross-cloud firewall pass (reference: provisioner.py:272-311):
+        # every region's firewall admits every gateway's public IP, so
+        # cross-cloud data/control sockets can connect. Best-effort per
+        # region — a failed authorization surfaces as a connect timeout with
+        # this warning as the breadcrumb.
+        ips = sorted({s.public_ip() for s in self.provisioned.values() if s.public_ip()})
+        if ips:
+
+            def authorize(pr: Tuple[str, str]) -> None:
+                provider_name, region_tag = pr
+                region = region_tag.split(":", 1)[-1]
+                try:
+                    self.provider(provider_name).authorize_gateway_ips(region, ips)
+                    self._fw_authorized.append((provider_name, region, ips))
+                except Exception as e:  # noqa: BLE001
+                    logger.fs.warning(f"firewall authorization failed for {provider_name}:{region}: {e}")
+
+            do_parallel(authorize, list(regions), n=8)
         self.pending_tasks.clear()
         return dict(self.provisioned)
 
     def deprovision(self) -> None:
-        """Tear down every provisioned server (reference :318-387)."""
+        """Tear down every provisioned server + revoke firewall authorizations
+        (reference :318-387)."""
         servers = list(self.provisioned.values())
         if not servers:
             return
         do_parallel(lambda s: s.terminate_instance(), servers, n=16)
         self.provisioned.clear()
+        for provider_name, region, ips in self._fw_authorized:
+            try:
+                self.provider(provider_name).deauthorize_gateway_ips(region, ips)
+            except Exception as e:  # noqa: BLE001
+                logger.fs.warning(f"firewall deauthorization failed for {provider_name}:{region}: {e}")
+        self._fw_authorized.clear()
         for p in self._providers.values():
             try:
                 p.teardown_global()

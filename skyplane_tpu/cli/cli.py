@@ -152,6 +152,25 @@ def experiments_throughput_grid(region_pairs, output, probe_mb, no_resume):
         click.echo(f"{src} -> {dst}: {gbps:.2f} Gbps")
 
 
+@experiments.command("latency-grid")
+@click.argument("region_pairs", nargs=-1, required=True)
+@click.option("--output", default="latency_grid.csv", help="RTT matrix CSV")
+@click.option("--no-resume", is_flag=True)
+def experiments_latency_grid(region_pairs, output, no_resume):
+    """Measure pairwise gateway RTT: PAIRS like aws:us-east-1,gcp:us-central1"""
+    from skyplane_tpu.cli.experiments.latency_grid import run_latency_grid
+
+    pairs = []
+    for spec in region_pairs:
+        src, _, dst = spec.partition(",")
+        if not dst:
+            raise click.ClickException(f"pair must be 'src_region,dst_region', got {spec!r}")
+        pairs.append((src, dst))
+    results = run_latency_grid(pairs, output, resume=not no_resume)
+    for (src, dst), rtt in sorted(results.items()):
+        click.echo(f"{src} -> {dst}: {rtt:.1f} ms")
+
+
 @main.group()
 def config():
     """Get or set configuration flags."""

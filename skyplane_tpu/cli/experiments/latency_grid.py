@@ -1,0 +1,102 @@
+"""Latency-grid profiling: pairwise gateway RTT matrix.
+
+Reference parity: skyplane/cli/experiments `util_grid` latency experiment.
+Instead of shelling out to ping (ICMP is blocked between many cloud
+networks), the probe measures application-level round trips against the
+peer gateway's control API /status — the same path control traffic takes,
+so the number reflects what chunk pre-registration actually pays.
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from skyplane_tpu.utils.logger import logger
+
+
+def measure_rtt(src_server, dst_server, samples: int = 7) -> float:
+    """Median gateway-to-gateway RTT in ms, measured FROM the source VM.
+
+    One TCP connect = one round trip; timing it on the src VM against the
+    dst gateway's control port measures the actual inter-region path (a
+    client-side probe would measure client->dst instead).
+    """
+    import base64
+    import json as _json
+
+    host = dst_server.public_ip()
+    port = dst_server.control_port
+    script = (
+        "import socket,time,json\n"
+        "ts=[]\n"
+        f"for _ in range({samples}):\n"
+        "    t0=time.perf_counter()\n"
+        f"    s=socket.create_connection(({host!r}, {port}), timeout=10)\n"
+        "    ts.append((time.perf_counter()-t0)*1000.0)\n"
+        "    s.close()\n"
+        "ts.sort()\n"
+        "print(json.dumps({'median_ms': ts[len(ts)//2]}))\n"
+    )
+    # base64 dodges all remote shell quoting
+    b64 = base64.b64encode(script.encode()).decode()
+    out, err = src_server.run_command(f'python3 -c "import base64;exec(base64.b64decode(\'{b64}\').decode())"', timeout=120)
+    try:
+        return float(_json.loads(out.strip().splitlines()[-1])["median_ms"])
+    except (ValueError, IndexError, KeyError) as e:
+        raise RuntimeError(f"latency probe failed on {src_server.instance_id}: {err[-500:]}") from e
+
+
+def run_latency_grid(
+    region_pairs: List[Tuple[str, str]],
+    output_csv: str,
+    resume: bool = True,
+) -> Dict[Tuple[str, str], float]:
+    """Provision one gateway per distinct region, measure every pair's RTT,
+    write ``src_region,dst_region,rtt_ms`` rows (resume keeps existing rows,
+    like the throughput grid)."""
+    from skyplane_tpu.api.provisioner import Provisioner
+    from skyplane_tpu.gateway.gateway_program import GatewayProgram, GatewayReceive, GatewayWriteLocal
+
+    out_path = Path(output_csv)
+    results: Dict[Tuple[str, str], float] = {}
+    if resume and out_path.exists():
+        with out_path.open() as f:
+            for row in csv.DictReader(f):
+                results[(row["src_region"], row["dst_region"])] = float(row["rtt_ms"])
+
+    regions = sorted({r for pair in region_pairs for r in pair})
+    provisioner = Provisioner()
+    tasks = {region: provisioner.add_task(region.split(":")[0], region) for region in regions}
+    provisioner.init_global()
+    servers = provisioner.provision()
+    by_region = {region: servers[tid] for region, tid in tasks.items()}
+    try:
+        # a minimal standing program so the daemon boots; RTT probes only
+        # touch the control API
+        for region, server in by_region.items():
+            program = GatewayProgram()
+            recv = program.add_operator(GatewayReceive())
+            program.add_operator(GatewayWriteLocal(), parent_handle=recv)
+            server.start_gateway(program.to_dict(), {}, f"lat_{region}")
+        for src_region, dst_region in region_pairs:
+            if (src_region, dst_region) in results:
+                continue
+            rtt = measure_rtt(by_region[src_region], by_region[dst_region])
+            results[(src_region, dst_region)] = rtt
+            logger.fs.info(f"rtt {src_region}->{dst_region}: {rtt:.1f} ms")
+            _write_csv(out_path, results)
+    finally:
+        provisioner.deprovision()
+    return results
+
+
+def _write_csv(path: Path, results: Dict[Tuple[str, str], float]) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["src_region", "dst_region", "rtt_ms"])
+        for (src, dst), rtt in sorted(results.items()):
+            writer.writerow([src, dst, f"{rtt:.2f}"])

@@ -88,6 +88,20 @@ class AWSCloudProvider(CloudProvider):
         self.ensure_keypair(region)
         AWSNetwork(self.auth, region).ensure_security_group()
 
+    # data-socket ports only: SSH and the (TLS + bearer-token) control API are
+    # baseline rules; peer gateways get no SSH grant they don't need
+    _PEER_PORTS = [(1024, 65535)]
+
+    def authorize_gateway_ips(self, region: str, ips: list) -> None:
+        """Admit peer-gateway IPs to the DATA ports in this region's security
+        group (reference: provisioner.py:272-311 firewall pass)."""
+        net = AWSNetwork(self.auth, region)
+        net.authorize_ips(net.ensure_security_group(), [f"{ip}/32" for ip in ips], ports=self._PEER_PORTS)
+
+    def deauthorize_gateway_ips(self, region: str, ips: list) -> None:
+        net = AWSNetwork(self.auth, region)
+        net.revoke_ips(net.ensure_security_group(), [f"{ip}/32" for ip in ips], ports=self._PEER_PORTS)
+
     def _resolve_ami(self, region: str) -> str:
         ssm = self.auth.get_boto3_client("ssm", region)
         return ssm.get_parameter(Name=_SSM_AMI)["Parameter"]["Value"]

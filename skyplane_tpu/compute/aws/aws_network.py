@@ -66,9 +66,9 @@ class AWSNetwork:
                 if "InvalidPermission.Duplicate" not in str(e):
                     raise
 
-    def revoke_ips(self, sg_id: str, cidrs: List[str]) -> None:
+    def revoke_ips(self, sg_id: str, cidrs: List[str], ports=None) -> None:
         ec2 = self._ec2()
-        for low, high in GATEWAY_PORTS:
+        for low, high in ports or GATEWAY_PORTS:
             try:
                 ec2.revoke_security_group_ingress(
                     GroupId=sg_id,

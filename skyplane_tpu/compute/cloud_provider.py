@@ -38,6 +38,14 @@ class CloudProvider:
     def teardown_global(self) -> None:
         raise NotImplementedError
 
+    # ---- cross-cloud firewall authorization (reference: provisioner.py:272-311) ----
+    # the gateways of a dataplane span clouds: each region's firewall must
+    # admit every OTHER gateway's public IP on the data/control ports before
+    # cross-cloud sockets can connect. Default no-op (local/test providers).
+    def authorize_gateway_ips(self, region: str, ips: List[str]) -> None: ...
+
+    def deauthorize_gateway_ips(self, region: str, ips: List[str]) -> None: ...
+
 
 def get_cloud_provider(provider: str, **kw) -> CloudProvider:
     if provider == "local" or provider == "test":

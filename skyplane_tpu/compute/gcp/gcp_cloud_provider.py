@@ -108,11 +108,14 @@ class GCPCloudProvider(CloudProvider):
                 json={"name": NETWORK_NAME, "autoCreateSubnetworks": True},
             ).json()
             self._wait_op(op["selfLink"])
-        for rule, ports in (("ssh", ["22"]), ("gateway", ["8081", "1024-65535"])):
+        # standing rules: SSH and the control API (which authenticates every
+        # request with TLS + a bearer token). DATA ports open per-dataplane to
+        # the actual peer-gateway IPs (authorize_gateway_ips), not 0.0.0.0/0.
+        for rule, ports in (("ssh", ["22"]), ("control", ["8081"])):
             name = f"{NETWORK_NAME}-{rule}"
             r = session.get(f"{COMPUTE}/projects/{project}/global/firewalls/{name}")
             if r.status_code == 404:
-                session.post(
+                op = session.post(
                     f"{COMPUTE}/projects/{project}/global/firewalls",
                     json={
                         "name": name,
@@ -121,9 +124,54 @@ class GCPCloudProvider(CloudProvider):
                         "sourceRanges": ["0.0.0.0/0"],
                     },
                 )
+                op.raise_for_status()
+                self._wait_op(op.json()["selfLink"])
+        # upgrade path: delete the legacy world-open data-port rule earlier
+        # versions created, or the per-IP scoping below is a no-op
+        legacy = f"{NETWORK_NAME}-gateway"
+        r = session.get(f"{COMPUTE}/projects/{project}/global/firewalls/{legacy}")
+        if r.status_code == 200:
+            session.delete(f"{COMPUTE}/projects/{project}/global/firewalls/{legacy}").raise_for_status()
 
     def setup_region(self, region: str) -> None:
         self.ensure_keypair()
+
+    @staticmethod
+    def _gw_rule_name(ips: list) -> str:
+        import hashlib
+
+        digest = hashlib.blake2b(",".join(sorted(ips)).encode(), digest_size=6).hexdigest()
+        return f"{NETWORK_NAME}-gw-{digest}"
+
+    def authorize_gateway_ips(self, region: str, ips: list) -> None:
+        """Per-dataplane firewall rule admitting the peer gateways on the
+        DATA ports (reference: provisioner.py:272-311; per-transfer GCP
+        firewall rules in gcp_network.py). Checked + awaited: a failed or
+        still-propagating rule would otherwise surface only as mysterious
+        cross-region connect timeouts."""
+        session = self.auth.session()
+        project = self.auth.project_id
+        name = self._gw_rule_name(ips)
+        r = session.get(f"{COMPUTE}/projects/{project}/global/firewalls/{name}")
+        if r.status_code == 404:
+            op = session.post(
+                f"{COMPUTE}/projects/{project}/global/firewalls",
+                json={
+                    "name": name,
+                    "network": f"projects/{project}/global/networks/{NETWORK_NAME}",
+                    "allowed": [{"IPProtocol": "tcp", "ports": ["1024-65535"]}],
+                    "sourceRanges": [f"{ip}/32" for ip in ips],
+                },
+            )
+            op.raise_for_status()
+            self._wait_op(op.json()["selfLink"])
+
+    def deauthorize_gateway_ips(self, region: str, ips: list) -> None:
+        session = self.auth.session()
+        project = self.auth.project_id
+        r = session.delete(f"{COMPUTE}/projects/{project}/global/firewalls/{self._gw_rule_name(ips)}")
+        if r.status_code not in (200, 404):  # 404 = already gone
+            r.raise_for_status()
 
     # ---- instances ----
 

@@ -21,30 +21,36 @@ from skyplane_tpu.utils.logger import logger
 AWS_STANDARD_VCPU_QUOTA_CODE = "L-1216C47A"
 
 
+from skyplane_tpu.utils.imports import inject
+
+
+@inject("boto3")
+def _capture_aws_quotas(boto3, regions: Optional[list] = None) -> Dict[str, int]:
+    from skyplane_tpu.utils.fn import do_parallel
+
+    if regions is None:
+        ec2 = boto3.client("ec2", region_name="us-east-1")
+        regions = [r["RegionName"] for r in ec2.describe_regions()["Regions"]]
+
+    def one(region: str):
+        try:
+            sq = boto3.client("service-quotas", region_name=region)
+            q = sq.get_service_quota(ServiceCode="ec2", QuotaCode=AWS_STANDARD_VCPU_QUOTA_CODE)
+            return int(q["Quota"]["Value"])
+        except Exception as e:  # noqa: BLE001 — one region must not kill the sweep
+            logger.fs.debug(f"aws quota capture failed for {region}: {e}")
+            return None
+
+    # ~25 regions x ~1s serial would stall init; fan out
+    results = do_parallel(one, list(regions), n=16)
+    return {f"aws:{region}": v for region, v in results if v is not None}
+
+
 def capture_aws_quotas(regions: Optional[list] = None) -> Dict[str, int]:
     """Standard on-demand vCPU quota per AWS region (empty on any failure)."""
     try:
-        import boto3
-
-        from skyplane_tpu.utils.fn import do_parallel
-
-        if regions is None:
-            ec2 = boto3.client("ec2", region_name="us-east-1")
-            regions = [r["RegionName"] for r in ec2.describe_regions()["Regions"]]
-
-        def one(region: str):
-            try:
-                sq = boto3.client("service-quotas", region_name=region)
-                q = sq.get_service_quota(ServiceCode="ec2", QuotaCode=AWS_STANDARD_VCPU_QUOTA_CODE)
-                return int(q["Quota"]["Value"])
-            except Exception as e:  # noqa: BLE001 — one region must not kill the sweep
-                logger.fs.debug(f"aws quota capture failed for {region}: {e}")
-                return None
-
-        # ~25 regions x ~1s serial would stall init; fan out
-        results = do_parallel(one, list(regions), n=16)
-        return {f"aws:{region}": v for region, v in results if v is not None}
-    except Exception as e:  # noqa: BLE001
+        return _capture_aws_quotas(regions)
+    except Exception as e:  # noqa: BLE001 — incl. MissingDependencyException
         logger.fs.debug(f"aws quota capture unavailable: {e}")
         return {}
 

@@ -1,0 +1,48 @@
+"""Lazy optional-dependency injection.
+
+Reference parity: skyplane/utils/imports.py:5-36 — ``@inject("boto3")``
+imports the module at CALL time and passes it as the first argument(s), so
+cloud-SDK imports never run at module import and a missing SDK fails with an
+actionable message only when the feature is actually used.
+
+    @inject("boto3", "botocore.exceptions")
+    def head(boto3, botocore_exceptions, bucket, key): ...
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Callable, TypeVar
+
+from skyplane_tpu.exceptions import MissingDependencyException
+
+F = TypeVar("F", bound=Callable)
+
+_PIP_HINTS = {
+    "boto3": "pip install boto3",
+    "botocore": "pip install boto3",
+    "google": "pip install google-api-python-client google-cloud-storage",
+    "googleapiclient": "pip install google-api-python-client",
+    "azure": "pip install azure-identity azure-mgmt-compute azure-storage-blob",
+}
+
+
+def inject(*module_names: str) -> Callable[[F], F]:
+    def decorator(fn: F) -> F:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            mods = []
+            for name in module_names:
+                try:
+                    mods.append(importlib.import_module(name))
+                except ImportError as e:
+                    hint = _PIP_HINTS.get(name.split(".")[0], f"pip install {name.split('.')[0]}")
+                    raise MissingDependencyException(
+                        f"{fn.__qualname__} requires the optional dependency {name!r} ({hint})"
+                    ) from e
+            return fn(*mods, *args, **kwargs)
+
+        return wrapper  # type: ignore[return-value]
+
+    return decorator

@@ -1,0 +1,49 @@
+"""Host networking helpers: public-IP lookup + cloud detection.
+
+Reference parity: skyplane/utils/networking_tools.py (public-IP services)
+and skyplane/compute/const_cmds.py query_which_cloud (metadata endpoints).
+Everything here degrades to None offline — these are best-effort hints, not
+requirements.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import requests
+
+PUBLIC_IP_SERVICES = [
+    "https://checkip.amazonaws.com",
+    "https://api.ipify.org",
+    "https://ifconfig.me/ip",
+]
+
+
+def get_public_ip(timeout: float = 3.0) -> Optional[str]:
+    """This host's public IP, or None when unreachable/offline."""
+    for url in PUBLIC_IP_SERVICES:
+        try:
+            r = requests.get(url, timeout=timeout)
+            if r.status_code == 200 and r.text.strip():
+                return r.text.strip()
+        except requests.RequestException:
+            continue
+    return None
+
+
+def query_which_cloud(timeout: float = 1.0) -> Optional[str]:
+    """Which cloud this host runs in, via metadata endpoints (reference:
+    const_cmds.py query_which_cloud); None for on-prem/unknown."""
+    probes = [
+        ("gcp", "http://metadata.google.internal/computeMetadata/v1/", {"Metadata-Flavor": "Google"}),
+        ("aws", "http://169.254.169.254/latest/meta-data/", {}),
+        ("azure", "http://169.254.169.254/metadata/instance?api-version=2021-02-01", {"Metadata": "true"}),
+    ]
+    for provider, url, headers in probes:
+        try:
+            r = requests.get(url, headers=headers, timeout=timeout)
+            if r.status_code == 200:
+                return provider
+        except requests.RequestException:
+            continue
+    return None

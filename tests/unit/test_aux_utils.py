@@ -1,0 +1,135 @@
+"""Aux parity pieces: lazy-dep injection, networking helpers, latency grid,
+firewall authorization pass.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import pytest
+
+from skyplane_tpu.exceptions import MissingDependencyException
+from skyplane_tpu.utils.imports import inject
+
+
+def test_inject_passes_module_and_args():
+    @inject("json", "os.path")
+    def fn(json_mod, os_path, x):
+        return json_mod.dumps(x), os_path.basename("/a/b")
+
+    assert fn({"k": 1}) == ('{"k": 1}', "b")
+
+
+def test_inject_missing_dependency_raises_actionable():
+    @inject("definitely_not_a_module_xyz")
+    def fn(mod):
+        return mod
+
+    with pytest.raises(MissingDependencyException, match="pip install"):
+        fn()
+
+
+def test_inject_imports_lazily(monkeypatch):
+    """The import happens at CALL time, not decoration time."""
+    calls = []
+
+    @inject("json")
+    def fn(json_mod):
+        calls.append(json_mod)
+        return True
+
+    assert not calls  # decorating must not import/call
+    assert fn() is True
+    assert calls
+
+
+def test_networking_helpers_degrade_offline(monkeypatch):
+    """Zero-egress environment: helpers return None, never raise."""
+    import requests as req_mod
+
+    from skyplane_tpu.utils import networking
+
+    def boom(*a, **kw):
+        raise req_mod.ConnectionError("no egress")
+
+    monkeypatch.setattr(networking.requests, "get", boom)
+    assert networking.get_public_ip() is None
+    assert networking.query_which_cloud() is None
+
+
+@pytest.mark.slow
+def test_latency_grid_local_pair(tmp_path):
+    """Full latency grid against the local provider: one daemon per 'region',
+    probe FROM the src VM, CSV written with resume support."""
+    import csv
+
+    from skyplane_tpu.cli.experiments.latency_grid import run_latency_grid
+
+    out = tmp_path / "lat.csv"
+    results = run_latency_grid([("local:siteA", "local:siteB")], str(out))
+    assert ("local:siteA", "local:siteB") in results
+    assert 0.0 < results[("local:siteA", "local:siteB")] < 1000.0  # localhost ~sub-ms
+    with out.open() as f:
+        rows = list(csv.DictReader(f))
+    assert rows[0]["src_region"] == "local:siteA"
+    # resume: a second run keeps the measured row (CSV rounds to 0.01 ms)
+    results2 = run_latency_grid([("local:siteA", "local:siteB")], str(out))
+    assert results2[("local:siteA", "local:siteB")] == pytest.approx(
+        results[("local:siteA", "local:siteB")], abs=0.01
+    )
+
+
+def test_provisioner_firewall_pass_records_and_revokes(monkeypatch):
+    """The cross-cloud firewall pass authorizes every gateway IP in every
+    region, and deprovision revokes exactly what was authorized."""
+    from skyplane_tpu.api.provisioner import Provisioner
+    from skyplane_tpu.compute.cloud_provider import CloudProvider
+    from skyplane_tpu.compute.server import Server
+
+    events = []
+
+    class FakeServer(Server):
+        def __init__(self, ip):
+            super().__init__("fake:r1", f"i-{ip}")
+            self._ip = ip
+
+        def public_ip(self):
+            return self._ip
+
+        def terminate_instance(self):
+            events.append(("terminate", self._ip))
+
+    class FakeProvider(CloudProvider):
+        provider_name = "fake"
+
+        def setup_global(self):
+            pass
+
+        def setup_region(self, region):
+            pass
+
+        def provision_instance(self, region_tag, vm_type=None, tags=None):
+            ip = f"10.0.0.{len(events) + 1}"
+            events.append(("provision", ip))
+            return FakeServer(ip)
+
+        def authorize_gateway_ips(self, region, ips):
+            events.append(("authorize", region, tuple(ips)))
+
+        def deauthorize_gateway_ips(self, region, ips):
+            events.append(("deauthorize", region, tuple(ips)))
+
+        def teardown_global(self):
+            pass
+
+    prov = Provisioner()
+    prov._providers["fake"] = FakeProvider()
+    prov.add_task("fake", "fake:r1")
+    prov.add_task("fake", "fake:r2")
+    prov.provision()
+    auths = [e for e in events if e[0] == "authorize"]
+    assert {e[1] for e in auths} == {"r1", "r2"}
+    assert all(len(e[2]) == 2 for e in auths), "every region admits BOTH gateway IPs"
+    prov.deprovision()
+    deauths = [e for e in events if e[0] == "deauthorize"]
+    assert {e[1] for e in deauths} == {"r1", "r2"}
