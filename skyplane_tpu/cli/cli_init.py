@@ -52,11 +52,14 @@ def _detect_azure() -> bool:
 def run_init(non_interactive: bool = False) -> int:
     cfg = cloud_config.reload() if config_path.exists() else SkyplaneConfig.default_config()
 
-    from skyplane_tpu.utils.networking import query_which_cloud
+    from skyplane_tpu.utils.networking import get_public_ip, query_which_cloud
 
     host_cloud = query_which_cloud()
     if host_cloud:
         console.print(f"Running inside [bold]{host_cloud}[/bold] (metadata endpoint detected)")
+    public_ip = get_public_ip()
+    if public_ip:
+        console.print(f"Client public IP: [bold]{public_ip}[/bold]")
 
     aws = _detect_aws()
     gcp_project = _detect_gcp()

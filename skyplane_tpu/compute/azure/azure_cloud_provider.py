@@ -15,6 +15,7 @@ from skyplane_tpu.compute.azure.azure_auth import AzureAuthentication
 from skyplane_tpu.compute.cloud_provider import CloudProvider
 from skyplane_tpu.compute.server import SSHServer, ServerState
 from skyplane_tpu.config_paths import key_root
+from skyplane_tpu.utils.logger import logger
 
 RESOURCE_GROUP = "skyplane-tpu"
 TAG = "skyplane_tpu"
@@ -94,6 +95,9 @@ class AzureCloudProvider(CloudProvider):
                     "subnets": [{"name": "default", "address_prefix": "10.10.0.0/24"}],
                 },
             ).result()
+            # standing rules: SSH + the (TLS + bearer-token) control API.
+            # DATA ports open per-dataplane to peer-gateway IPs only
+            # (authorize_gateway_ips), matching the AWS/GCP policy.
             nc.network_security_groups.begin_create_or_update(
                 RESOURCE_GROUP,
                 f"skyplane-nsg-{region}",
@@ -101,7 +105,7 @@ class AzureCloudProvider(CloudProvider):
                     "location": region,
                     "security_rules": [
                         {
-                            "name": "gateway-ports",
+                            "name": "ssh-control",
                             "priority": 100,
                             "direction": "Inbound",
                             "access": "Allow",
@@ -109,7 +113,7 @@ class AzureCloudProvider(CloudProvider):
                             "source_address_prefix": "*",
                             "source_port_range": "*",
                             "destination_address_prefix": "*",
-                            "destination_port_ranges": ["22", "8081", "1024-65535"],
+                            "destination_port_ranges": ["22", "8081"],
                         }
                     ],
                 },
@@ -169,6 +173,41 @@ class AzureCloudProvider(CloudProvider):
             vm_params["eviction_policy"] = "Delete"
         compute.virtual_machines.begin_create_or_update(RESOURCE_GROUP, name, vm_params).result()
         return AzureServer(self.auth, region, name, ip.ip_address, nic.ip_configurations[0].private_ip_address, str(key_path))
+
+    @staticmethod
+    def _peer_rule_name(ips: list) -> str:
+        import hashlib
+
+        return "skyplane-peers-" + hashlib.blake2b(",".join(sorted(ips)).encode(), digest_size=6).hexdigest()
+
+    def authorize_gateway_ips(self, region: str, ips: list) -> None:
+        """Per-dataplane NSG rule admitting peer gateways on the DATA ports
+        (reference: provisioner.py:272-311 firewall pass)."""
+        nc = self.auth.network_client()
+        nc.security_rules.begin_create_or_update(
+            RESOURCE_GROUP,
+            f"skyplane-nsg-{region}",
+            self._peer_rule_name(ips),
+            {
+                "priority": 200,
+                "direction": "Inbound",
+                "access": "Allow",
+                "protocol": "Tcp",
+                "source_address_prefixes": [f"{ip}/32" for ip in ips],
+                "source_port_range": "*",
+                "destination_address_prefix": "*",
+                "destination_port_range": "1024-65535",
+            },
+        ).result()
+
+    def deauthorize_gateway_ips(self, region: str, ips: list) -> None:
+        nc = self.auth.network_client()
+        try:
+            nc.security_rules.begin_delete(
+                RESOURCE_GROUP, f"skyplane-nsg-{region}", self._peer_rule_name(ips)
+            ).result()
+        except Exception as e:  # noqa: BLE001 — already gone is fine
+            logger.fs.debug(f"azure peer-rule delete ({region}): {e}")
 
     def get_matching_instances(self, tags: Optional[dict] = None, **kw) -> List[AzureServer]:
         compute = self.auth.compute_client()

@@ -131,7 +131,9 @@ class GCPCloudProvider(CloudProvider):
         legacy = f"{NETWORK_NAME}-gateway"
         r = session.get(f"{COMPUTE}/projects/{project}/global/firewalls/{legacy}")
         if r.status_code == 200:
-            session.delete(f"{COMPUTE}/projects/{project}/global/firewalls/{legacy}").raise_for_status()
+            d = session.delete(f"{COMPUTE}/projects/{project}/global/firewalls/{legacy}")
+            if d.status_code not in (200, 404):  # 404 = concurrent client won the race
+                d.raise_for_status()
 
     def setup_region(self, region: str) -> None:
         self.ensure_keypair()
@@ -163,6 +165,8 @@ class GCPCloudProvider(CloudProvider):
                     "sourceRanges": [f"{ip}/32" for ip in ips],
                 },
             )
+            if op.status_code == 409:
+                return  # concurrent region authorized the same IP set (shared global rule)
             op.raise_for_status()
             self._wait_op(op.json()["selfLink"])
 

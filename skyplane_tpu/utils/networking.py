@@ -31,12 +31,27 @@ def get_public_ip(timeout: float = 3.0) -> Optional[str]:
     return None
 
 
+def _probe_aws_imds(timeout: float) -> bool:
+    """IMDSv2-aware AWS probe: new EC2 launches default to HttpTokens=required,
+    where an untokened GET 401s — fetch a session token first."""
+    try:
+        tok = requests.put(
+            "http://169.254.169.254/latest/api/token",
+            headers={"X-aws-ec2-metadata-token-ttl-seconds": "60"},
+            timeout=timeout,
+        )
+        headers = {"X-aws-ec2-metadata-token": tok.text} if tok.status_code == 200 else {}
+        r = requests.get("http://169.254.169.254/latest/meta-data/", headers=headers, timeout=timeout)
+        return r.status_code == 200
+    except requests.RequestException:
+        return False
+
+
 def query_which_cloud(timeout: float = 1.0) -> Optional[str]:
     """Which cloud this host runs in, via metadata endpoints (reference:
     const_cmds.py query_which_cloud); None for on-prem/unknown."""
     probes = [
         ("gcp", "http://metadata.google.internal/computeMetadata/v1/", {"Metadata-Flavor": "Google"}),
-        ("aws", "http://169.254.169.254/latest/meta-data/", {}),
         ("azure", "http://169.254.169.254/metadata/instance?api-version=2021-02-01", {"Metadata": "true"}),
     ]
     for provider, url, headers in probes:
@@ -46,4 +61,6 @@ def query_which_cloud(timeout: float = 1.0) -> Optional[str]:
                 return provider
         except requests.RequestException:
             continue
+    if _probe_aws_imds(timeout):
+        return "aws"
     return None

@@ -53,6 +53,7 @@ def test_networking_helpers_degrade_offline(monkeypatch):
         raise req_mod.ConnectionError("no egress")
 
     monkeypatch.setattr(networking.requests, "get", boom)
+    monkeypatch.setattr(networking.requests, "put", boom)  # IMDSv2 token fetch
     assert networking.get_public_ip() is None
     assert networking.query_which_cloud() is None
 
@@ -86,7 +87,10 @@ def test_provisioner_firewall_pass_records_and_revokes(monkeypatch):
     from skyplane_tpu.compute.cloud_provider import CloudProvider
     from skyplane_tpu.compute.server import Server
 
+    import itertools
+
     events = []
+    ip_counter = itertools.count(1)  # thread-safe under the GIL (single bytecode)
 
     class FakeServer(Server):
         def __init__(self, ip):
@@ -109,7 +113,7 @@ def test_provisioner_firewall_pass_records_and_revokes(monkeypatch):
             pass
 
         def provision_instance(self, region_tag, vm_type=None, tags=None):
-            ip = f"10.0.0.{len(events) + 1}"
+            ip = f"10.0.0.{next(ip_counter)}"
             events.append(("provision", ip))
             return FakeServer(ip)
 

