@@ -121,7 +121,7 @@ class GatewayDaemon:
             use_tls=use_tls,
             e2ee_key=e2ee_key,
             dedup=dedup_receive,
-            segment_store=SegmentStore(spill_dir=Path(chunk_dir) / "segments") if dedup_receive else None,
+            segment_store=self._make_segment_store(chunk_dir) if dedup_receive else None,
             bind_host=bind_host,
             raw_forward=raw_forward,
             cdc_params=self.cdc_params,
@@ -187,6 +187,27 @@ class GatewayDaemon:
         self.api.upload_id_map_update = self._update_upload_ids
 
     # ---- construction ----
+
+    @staticmethod
+    def _make_segment_store(chunk_dir: str) -> SegmentStore:
+        """Receiver segment store, sized by env for small-RAM gateways and
+        eviction-pressure tests (defaults: 4 GiB memory + 32 GiB spill)."""
+
+        def _mb(var: str, default_mb: int) -> int:
+            try:
+                val = int(os.environ.get(var, str(default_mb)))
+                if val <= 0:
+                    raise ValueError(f"{val} <= 0")  # 0/negative would evict every segment on insert
+                return val << 20
+            except ValueError:
+                logger.fs.warning(f"ignoring invalid {var}; using {default_mb} MB")
+                return default_mb << 20
+
+        return SegmentStore(
+            max_bytes=_mb("SKYPLANE_TPU_SEGSTORE_MB", 4 << 10),
+            spill_dir=Path(chunk_dir) / "segments",
+            spill_max_bytes=_mb("SKYPLANE_TPU_SEGSTORE_SPILL_MB", 32 << 10),
+        )
 
     def _update_upload_ids(self, body: Dict[str, str]) -> None:
         self.upload_id_map.update(body)

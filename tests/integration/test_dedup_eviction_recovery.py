@@ -1,0 +1,53 @@
+"""Dedup under receiver eviction pressure: the NACK path end to end.
+
+The sender's fingerprint index (16 GiB LRU) can outlive the receiver's
+segment store; a REF to an evicted segment must surface as an in-band NACK
+that makes the sender drop those fingerprints and resend literals — NOT a
+livelock or a failed transfer (ADVICE r1 medium #4, fixed in round 2).
+This test shrinks the receiver store to a few MB so eviction is guaranteed,
+then pushes a highly duplicated corpus through the full data plane.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from tests.integration.harness import dispatch_file, make_pair, wait_complete
+
+rng = np.random.default_rng(67)
+
+
+@pytest.mark.slow
+def test_transfer_survives_segment_store_eviction(tmp_path, monkeypatch):
+    # receiver retains ~3 MB memory + 4 MB spill of segments; the corpus
+    # carries far more distinct segment bytes, so REFs to evicted segments
+    # WILL happen once the sender index (default 16 GiB) outlives the store
+    monkeypatch.setenv("SKYPLANE_TPU_SEGSTORE_MB", "3")
+    monkeypatch.setenv("SKYPLANE_TPU_SEGSTORE_SPILL_MB", "4")
+    monkeypatch.setenv("SKYPLANE_TPU_SENDER_WINDOW", "4")
+
+    # corpus: 24 MB of distinct blocks, then the SAME blocks replayed — by
+    # replay time the receiver has evicted the early segments
+    distinct = rng.integers(0, 256, 24 << 20, dtype=np.uint8).tobytes()
+    payload = distinct + distinct
+    src_file = tmp_path / "src.bin"
+    src_file.write_bytes(payload)
+    dst_file = tmp_path / "out" / "dst.bin"
+
+    src, dst = make_pair(tmp_path, compress="zstd", dedup=True, encrypt=True, use_tls=False, num_connections=2)
+    try:
+        ids = dispatch_file(src, src_file, dst_file, chunk_bytes=2 << 20)
+        wait_complete(src, ids, timeout=300)
+        wait_complete(dst, ids, timeout=300)
+        got = dst_file.read_bytes()
+        assert hashlib.md5(got).hexdigest() == hashlib.md5(payload).hexdigest()
+        # the receiver error surface must be clean: nacks are recoverable
+        errs = dst.get("errors", timeout=5).json()["errors"]
+        assert not errs, f"eviction nacks must not escalate to daemon errors: {errs[:1]}"
+    finally:
+        src.stop()
+        dst.stop()
