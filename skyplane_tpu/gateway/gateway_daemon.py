@@ -181,6 +181,7 @@ class GatewayDaemon:
             host=bind_host,
             port=control_port,
             compression_stats_fn=self._compression_stats,
+            sender_profile_fn=self._sender_socket_events,
             api_token=self.api_token,
             ssl_ctx=ssl_ctx,
         )
@@ -211,6 +212,19 @@ class GatewayDaemon:
 
     def _update_upload_ids(self, body: Dict[str, str]) -> None:
         self.upload_id_map.update(body)
+
+    def _sender_socket_events(self) -> list:
+        """Drain per-window send profile events from every sender operator
+        (sender-side analog of the receiver socket profiler)."""
+        events = []
+        for op in self.operators:
+            if isinstance(op, GatewaySenderOperator):
+                while True:
+                    try:
+                        events.append(op.socket_profile_events.get_nowait())
+                    except queue.Empty:
+                        break
+        return events
 
     def _compression_stats(self) -> dict:
         agg = {"chunks": 0, "raw_bytes": 0, "wire_bytes": 0, "segments": 0, "ref_segments": 0}

@@ -18,6 +18,7 @@ directly:
   POST /api/v1/upload_id_maps              dest_key -> multipart upload id
   GET  /api/v1/errors                      operator tracebacks
   GET  /api/v1/profile/socket/receiver     per-recv socket profile events
+  GET  /api/v1/profile/socket/sender       per-send-window profile events
   GET  /api/v1/profile/compression         TPU data-path stats (ratio, dedup)
 
 Completion accounting (the reference's most bug-prone logic, SURVEY §7 #6):
@@ -55,6 +56,7 @@ class GatewayDaemonAPI:
         host: str = "0.0.0.0",
         port: int = 8081,
         compression_stats_fn=None,
+        sender_profile_fn=None,
         api_token: Optional[str] = None,
         ssl_ctx=None,
     ):
@@ -67,6 +69,7 @@ class GatewayDaemonAPI:
         self.region = region
         self.gateway_id = gateway_id
         self.compression_stats_fn = compression_stats_fn or (lambda: {})
+        self.sender_profile_fn = sender_profile_fn or (lambda: [])
         # bearer token required on every route except GET /status (liveness
         # probes predate token distribution during provisioning). None =
         # auth disabled (local in-process harness).
@@ -297,6 +300,8 @@ class GatewayDaemonAPI:
                 except queue.Empty:
                     break
             req._send(200, {"events": events})
+        elif path == "/api/v1/profile/socket/sender":
+            req._send(200, {"events": self.sender_profile_fn()})
         elif path == "/api/v1/profile/compression":
             req._send(200, self.compression_stats_fn())
         elif path == "/api/v1/logs":

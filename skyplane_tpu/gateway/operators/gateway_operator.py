@@ -384,6 +384,11 @@ class GatewaySenderOperator(GatewayOperator):
         self.window = max(1, int(window))
         self.window_bytes = int(window_bytes)
         self.control_tls = control_tls
+        # per-window send profile events (drained by /profile/socket/sender,
+        # the sender-side analog of the receiver's socket profiler). Bounded:
+        # with nothing polling the endpoint, a long-lived daemon must not
+        # accumulate one dict per window forever
+        self.socket_profile_events: "queue.Queue[dict]" = queue.Queue(maxsize=4096)
         self._local = threading.local()
         from skyplane_tpu.gateway.control_auth import control_session
 
@@ -500,6 +505,8 @@ class GatewaySenderOperator(GatewayOperator):
         view = _WindowFpView(self.dedup_index) if self.dedup_index is not None else None
         results = [False] * len(batch)
         sent = []  # (req, payload) for acked-frame bookkeeping only
+        window_wire = 0
+        t_window = time.perf_counter()
         try:
             sock = self._sock()
             # frame-and-stream: each chunk's wire bytes are released as soon
@@ -509,6 +516,7 @@ class GatewaySenderOperator(GatewayOperator):
                 payload, wire, header = self._frame_chunk(req, view, n_left=len(batch) - i - 1)
                 header.to_socket(sock)
                 sock.sendall(wire)
+                window_wire += len(wire)
                 del wire
                 if payload is not None:
                     # only the fingerprint lists are needed for ack
@@ -561,4 +569,25 @@ class GatewaySenderOperator(GatewayOperator):
             logger.fs.warning(f"[{self.handle}:{worker_id}] socket error mid-window: {e}")
             self._reset_sock()
             time.sleep(0.2)
+        event = {
+            "handle": self.handle,
+            "worker_id": worker_id,
+            "target": self.target_gateway_id,
+            "n_chunks": len(batch),
+            "n_acked": sum(results),
+            "wire_bytes": window_wire,
+            "seconds": round(time.perf_counter() - t_window, 6),
+        }
+        try:
+            self.socket_profile_events.put_nowait(event)
+        except queue.Full:
+            # drop-oldest so a quiet endpoint keeps the freshest windows
+            try:
+                self.socket_profile_events.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self.socket_profile_events.put_nowait(event)
+            except queue.Full:
+                pass
         return results

@@ -90,12 +90,13 @@ class GatewayReceiver:
         # frame must not be a gateway DoS. Persistent corruption escalates.
         self._payload_error_count = 0
         self.max_payload_errors = 20
+        # bounded: a daemon nobody profiles must not accumulate events forever
+        self.socket_profile_events: "queue.Queue[dict]" = queue.Queue(maxsize=4096)
         # unresolvable-REF nacks are an EXPECTED, recoverable condition (the
         # sender discards fps and resends literals) — budget them separately
         # from corruption, with a higher cap, also reset on any success
         self._nack_count = 0
         self.max_nacks = 200
-        self.socket_profile_events: "queue.Queue[dict]" = queue.Queue()
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if use_tls:
             cert_dir = Path(chunk_store.chunk_dir) / "certs"
@@ -169,9 +170,19 @@ class GatewayReceiver:
                     # before retrying) — drop the partial chunk, it will be re-sent
                     logger.fs.warning(f"[receiver:{port}] connection lost mid-chunk {header.chunk_id}: {e}")
                     return
-                self.socket_profile_events.put(
-                    {"port": port, "chunk_id": header.chunk_id, "bytes": header.data_len, "time_s": time.time() - t0}
-                )
+                event = {"port": port, "chunk_id": header.chunk_id, "bytes": header.data_len, "time_s": time.time() - t0}
+                try:
+                    self.socket_profile_events.put_nowait(event)
+                except queue.Full:
+                    # drop-oldest: a quiet profile endpoint keeps fresh events
+                    try:
+                        self.socket_profile_events.get_nowait()
+                    except queue.Empty:
+                        pass
+                    try:
+                        self.socket_profile_events.put_nowait(event)
+                    except queue.Full:
+                        pass
                 fpath = self.chunk_store.chunk_path(header.chunk_id)
                 if self.raw_forward:
                     fpath.write_bytes(payload)

@@ -34,28 +34,44 @@ def load_library() -> ctypes.CDLL:
     with _BUILD_LOCK:
         if _lib is not None:
             return _lib
-        src = _SRC_DIR / "fastlz.cpp"
+        sources = [_SRC_DIR / "fastlz.cpp", _SRC_DIR / "datapath.cpp"]
         out = _build_dir() / "libskyfastlz.so"
-        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+        # the library is built with -march=native and MUST NOT travel between
+        # hosts (an AVX-512 build SIGILLs elsewhere): a host-tag sidecar forces
+        # a rebuild whenever the .so was produced on a different machine
+        import platform
+
+        host_tag = f"{platform.machine()}-{platform.node()}"
+        tag_file = _build_dir() / "libskyfastlz.hosttag"
+        stale_host = not tag_file.exists() or tag_file.read_text() != host_tag
+        if not out.exists() or stale_host or any(out.stat().st_mtime < s.stat().st_mtime for s in sources):
             out.parent.mkdir(parents=True, exist_ok=True)
-            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", str(src), "-o", str(out)]
+            src_args = [str(s) for s in sources]
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", *src_args, "-o", str(out)]
             try:
                 proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
             except FileNotFoundError as e:
                 raise MissingDependencyException("native codec requires g++ in PATH") from e
             if proc.returncode != 0:
                 # -march=native can fail in emulated environments; retry portable
-                cmd = ["g++", "-O3", "-shared", "-fPIC", str(src), "-o", str(out)]
+                cmd = ["g++", "-O3", "-shared", "-fPIC", *src_args, "-o", str(out)]
                 proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
                 if proc.returncode != 0:
                     raise MissingDependencyException(f"native codec build failed: {proc.stderr[-2000:]}")
+            tag_file.write_text(host_tag)
         lib = ctypes.CDLL(str(out))
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
         for name, restype, argtypes in (
             ("skyfastlz_max_compressed_size", ctypes.c_uint64, [ctypes.c_uint64]),
             ("skyfastlz_compress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
             ("skyfastlz_decompressed_size", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64]),
             ("skyfastlz_decompress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
             ("skyfastlz_checksum64", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]),
+            ("skydp_gear_candidates", None, [u8p, ctypes.c_uint64, u32p, ctypes.c_uint32, u8p]),
+            ("skydp_segment_fp", None, [u8p, ctypes.c_uint64, i64p, ctypes.c_uint64, u32p, u32p]),
+            ("skydp_blockpack_encode", ctypes.c_uint64, [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p, u8p]),
         ):
             fn = getattr(lib, name)
             fn.restype = restype

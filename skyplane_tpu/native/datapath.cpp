@@ -1,0 +1,124 @@
+// Native CPU data-path kernels for gateways without an accelerator.
+//
+// The numpy fallbacks (ops/host_fallback.py) are memory-bound multi-pass
+// array programs (~16 MB/s gear, ~28 MB/s fingerprints on one core); these
+// single-pass loops run at memory speed and are bit-identical:
+//
+//  * gear+candidates: h_t = (h_{t-1} << 1) + G[b_t] in uint32 — the natural
+//    wraparound makes this EXACTLY the 32-byte windowed sum the device
+//    kernel computes (terms shifted >= 32 vanish), so boundaries agree with
+//    both the numpy and the TPU paths.
+//  * segment fingerprints: Horner form F = (F*r + b) mod (2^31-1) per lane
+//    equals sum b_i * r^(L-1-i) — no power tables, no second pass.
+
+#include <cstdint>
+#include <cstddef>
+
+static const uint32_t M31 = 0x7FFFFFFFu;
+
+static inline uint32_t fold31(uint64_t x) {
+    x = (x >> 31) + (x & M31);
+    x = (x >> 31) + (x & M31);
+    uint32_t r = (uint32_t)x;
+    return r >= M31 ? r - M31 : r;
+}
+
+extern "C" {
+
+// out_mask[i] = 1 iff the top mask_bits of the rolling gear hash at i are 0.
+// mask_bits must be in [1, 31] (the Python wrapper validates).
+void skydp_gear_candidates(const uint8_t* data, uint64_t n, const uint32_t* table,
+                           uint32_t mask_bits, uint8_t* out_mask) {
+    uint32_t h = 0;
+    const uint32_t shift = 32 - mask_bits;
+    for (uint64_t i = 0; i < n; i++) {
+        h = (h << 1) + table[data[i]];
+        out_mask[i] = (h >> shift) == 0 ? 1 : 0;
+    }
+}
+
+// 8-lane polynomial segment fingerprints over GF(2^31-1), Horner form with
+// a stride-4 inner loop: F_{i+4} = F_i*r^4 + b_i*r^3 + b_{i+1}*r^2 +
+// b_{i+2}*r + b_{i+3} (mod M31) — the four byte terms are independent, so
+// the per-step critical path is ONE mulmod per lane per 4 bytes instead of 4.
+// ends: n_ends segment end offsets (last == n); out_lanes: [n_ends][8] u32.
+void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
+                      uint64_t n_ends, const uint32_t* bases, uint32_t* out_lanes) {
+    (void)n;
+    uint32_t r1[8], r2[8], r3[8], r4[8];
+    for (int l = 0; l < 8; l++) {
+        r1[l] = bases[l] >= M31 ? bases[l] - M31 : bases[l];
+        r2[l] = fold31((uint64_t)r1[l] * r1[l]);
+        r3[l] = fold31((uint64_t)r2[l] * r1[l]);
+        r4[l] = fold31((uint64_t)r3[l] * r1[l]);
+    }
+    int64_t start = 0;
+    for (uint64_t s = 0; s < n_ends; s++) {
+        const int64_t end = ends[s];
+        uint32_t f[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+        // Horner runs first-to-last: peel the length remainder at the HEAD so
+        // the strided loop covers an exact multiple of 4
+        int64_t i = start;
+        const int64_t head_end = start + ((end - start) & 3);
+        for (; i < head_end; i++) {
+            const uint64_t b = data[i];
+            for (int l = 0; l < 8; l++) f[l] = fold31((uint64_t)f[l] * r1[l] + b);
+        }
+        for (; i + 4 <= end; i += 4) {
+            const uint64_t b0 = data[i], b1 = data[i + 1], b2 = data[i + 2], b3 = data[i + 3];
+            for (int l = 0; l < 8; l++) {
+                // f*r4 < 2^62; byte terms < 3*2^39 + 2^8: sum fits u64
+                const uint64_t acc = (uint64_t)f[l] * r4[l] + (uint64_t)r3[l] * b0 +
+                                     (uint64_t)r2[l] * b1 + (uint64_t)r1[l] * b2 + b3;
+                f[l] = fold31(acc);
+            }
+        }
+        uint32_t* out = out_lanes + s * 8;
+        for (int l = 0; l < 8; l++) out[l] = f[l];
+        start = end;
+    }
+}
+
+// Blockpack encode: per block_bytes block emit tag (0=zero, 1=const, 2=
+// literal) and the compacted literal stream (1 byte per const block, the
+// whole block for literals). data length must be a multiple of block_bytes
+// (callers pad). Returns the literal byte count.
+uint64_t skydp_blockpack_encode(const uint8_t* data, uint64_t n, uint64_t block_bytes,
+                                uint8_t* tags_out, uint8_t* lits_out) {
+    const uint64_t nb = n / block_bytes;
+    uint64_t lit = 0;
+    for (uint64_t b = 0; b < nb; b++) {
+        const uint8_t* block = data + b * block_bytes;
+        const uint8_t first = block[0];
+        bool is_const = true;
+        // word-at-a-time constant check
+        uint64_t pattern;
+        __builtin_memset(&pattern, first, 8);
+        uint64_t i = 0;
+        for (; i + 8 <= block_bytes; i += 8) {
+            uint64_t w;
+            __builtin_memcpy(&w, block + i, 8);
+            if (w != pattern) { is_const = false; break; }
+        }
+        if (is_const) {
+            for (; i < block_bytes; i++) {
+                if (block[i] != first) { is_const = false; break; }
+            }
+        }
+        if (is_const) {
+            if (first == 0) {
+                tags_out[b] = 0;  // TAG_ZERO
+            } else {
+                tags_out[b] = 1;  // TAG_CONST
+                lits_out[lit++] = first;
+            }
+        } else {
+            tags_out[b] = 2;  // TAG_LITERAL
+            __builtin_memcpy(lits_out + lit, block, block_bytes);
+            lit += block_bytes;
+        }
+    }
+    return lit;
+}
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+"""ctypes bindings for the native CPU data-path kernels (datapath.cpp).
+
+Bit-identical to both the numpy fallbacks and the device kernels (tested);
+used by the host paths in ops/cdc.py and ops/fingerprint.py when the native
+library is available (opt out with SKYPLANE_TPU_NATIVE_DATAPATH=0).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from skyplane_tpu.native import load_library
+
+_available: Optional[bool] = None
+
+
+def available() -> bool:
+    """True when the native library builds/loads and the opt-out is not set."""
+    global _available
+    if _available is None:
+        if os.environ.get("SKYPLANE_TPU_NATIVE_DATAPATH", "1").strip().lower() in ("0", "false", "off"):
+            _available = False
+        else:
+            try:
+                load_library()
+                _available = True
+            except Exception:  # noqa: BLE001 — no g++ etc.: numpy fallbacks serve
+                _available = False
+    return _available
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _u32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def gear_candidates(data: np.ndarray, mask_bits: int) -> np.ndarray:
+    """[N] uint8 -> [N] bool boundary-candidate mask (gear hash + top-bits
+    test in ONE pass)."""
+    if not 1 <= mask_bits <= 31:
+        raise ValueError(f"mask_bits must be in [1, 31], got {mask_bits}")
+    from skyplane_tpu.ops.gear import GEAR_TABLE
+
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    table = np.ascontiguousarray(GEAR_TABLE, dtype=np.uint32)
+    out = np.empty(len(data), np.uint8)
+    load_library().skydp_gear_candidates(_u8p(data), len(data), _u32p(table), mask_bits, _u8p(out))
+    return out.view(bool)
+
+
+def blockpack_encode(data: np.ndarray, block_bytes: int):
+    """[N] uint8 (N % block_bytes == 0) -> (tags [NB] uint8, literals, n_lit),
+    same contract as host_fallback.blockpack_encode_host."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    nb = len(data) // block_bytes
+    tags = np.empty(nb, np.uint8)
+    lits = np.empty(len(data), np.uint8)  # worst case: everything literal
+    n_lit = load_library().skydp_blockpack_encode(_u8p(data), len(data), block_bytes, _u8p(tags), _u8p(lits))
+    return tags, lits[:n_lit], int(n_lit)
+
+
+def segment_fp_lanes(data: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """[N] uint8 + segment ends -> [n_segments, 8] uint32 fingerprint lanes."""
+    from skyplane_tpu.ops.fingerprint import LANE_BASES
+
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    ends = np.ascontiguousarray(ends, dtype=np.int64)
+    bases = np.ascontiguousarray(LANE_BASES, dtype=np.uint32)
+    out = np.empty((len(ends), 8), np.uint32)
+    load_library().skydp_segment_fp(
+        _u8p(data),
+        len(data),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(ends),
+        _u32p(bases),
+        _u32p(out),
+    )
+    return out

@@ -124,9 +124,14 @@ def encode_container(data: bytes, block_bytes: int = DEFAULT_BLOCK_BYTES) -> byt
         n_lit = int(n_lit)
         lit_np = np.asarray(literals[:n_lit]) if n_lit else np.empty(0, np.uint8)
     else:
-        from skyplane_tpu.ops.host_fallback import blockpack_encode_host
+        from skyplane_tpu.native import datapath as native_dp
 
-        tags_np, lit_np, n_lit = blockpack_encode_host(arr, block_bytes)
+        if native_dp.available():
+            tags_np, lit_np, n_lit = native_dp.blockpack_encode(arr, block_bytes)
+        else:
+            from skyplane_tpu.ops.host_fallback import blockpack_encode_host
+
+            tags_np, lit_np, n_lit = blockpack_encode_host(arr, block_bytes)
     header = MAGIC + struct.pack("<BBQQ", VERSION, block_log2, n_raw, n_lit)
     return header + _pack_tags(tags_np) + lit_np.tobytes()
 

@@ -11,7 +11,6 @@ then pushes a highly duplicated corpus through the full data plane.
 from __future__ import annotations
 
 import hashlib
-import os
 
 import numpy as np
 import pytest

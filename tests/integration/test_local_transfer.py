@@ -87,6 +87,9 @@ def test_dedup_stats_show_refs(pair_dirs, tmp_path):
         wait_complete(dst, ids2, timeout=120)
         stats = src.get("profile/compression", timeout=10).json()
         assert stats["ref_segments"] > 0, f"no dedup refs recorded: {stats}"
+        # sender-side socket profiler: per-window events with real byte counts
+        events = src.get("profile/socket/sender", timeout=10).json()["events"]
+        assert events and all(e["wire_bytes"] > 0 and e["n_acked"] >= 1 for e in events)
         assert (pair_dirs / "out" / "b.bin").read_bytes() == payload
     finally:
         src.stop()

@@ -1,0 +1,116 @@
+"""Native C++ data-path kernels: bit-identity against the numpy fallbacks.
+
+The CPU host path routes through datapath.cpp when g++ is available (~60x
+gear, ~7x fingerprints, ~5x blockpack vs numpy); these tests pin exact
+equivalence on structured and adversarial inputs, plus the env opt-out.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.native import datapath as ndp
+from skyplane_tpu.ops.fingerprint import M31, _power_tables
+from skyplane_tpu.ops.host_fallback import (
+    blockpack_encode_host,
+    boundary_candidates_host,
+    gear_hash_host,
+)
+
+pytestmark = pytest.mark.skipif(not ndp.available(), reason="native library unavailable (no g++)")
+
+rng = np.random.default_rng(13)
+
+
+def _corpora():
+    yield rng.integers(0, 256, 1 << 16, dtype=np.uint8)
+    z = rng.integers(0, 256, 1 << 18, dtype=np.uint8)
+    z[: 1 << 17] = 0  # zero extent
+    yield z
+    pat = np.tile(rng.integers(0, 256, 512, dtype=np.uint8), 64)  # repetitive
+    yield pat
+    yield np.zeros(4096, np.uint8)
+    yield np.full(4096, 255, np.uint8)
+    yield rng.integers(0, 256, 3, dtype=np.uint8)  # tiny
+
+
+def test_gear_candidates_bit_identical():
+    for data in _corpora():
+        for mb in (1, 10, 16, 31):
+            want = boundary_candidates_host(gear_hash_host(data), mb)
+            got = ndp.gear_candidates(data, mb)
+            np.testing.assert_array_equal(want, got)
+
+
+def test_gear_candidates_rejects_bad_mask_bits():
+    with pytest.raises(ValueError):
+        ndp.gear_candidates(np.zeros(8, np.uint8), 0)
+    with pytest.raises(ValueError):
+        ndp.gear_candidates(np.zeros(8, np.uint8), 32)
+
+
+def test_segment_fp_lanes_match_definition():
+    t64 = _power_tables().astype(np.uint64)
+    for data in _corpora():
+        n = len(data)
+        cuts = sorted(set(rng.integers(1, n, 4).tolist())) if n > 8 else []
+        ends = np.asarray(cuts + [n], np.int64)
+        lanes = ndp.segment_fp_lanes(data, ends)
+        starts = np.concatenate([[0], ends[:-1]])
+        for si, (s, e) in enumerate(zip(starts, ends)):
+            d = data[s:e].astype(np.uint64)
+            L = int(e - s)
+            for li in range(8):
+                want = int((d * t64[li, :L][::-1] % np.uint64(M31)).sum()) % M31
+                assert lanes[si, li] == want
+
+
+def test_segment_fp_matches_host_digests():
+    """Through the public digest API: native and numpy produce identical
+    16-byte fingerprints (the wire/dedup identity)."""
+    import skyplane_tpu.native.datapath as dp_mod
+    from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
+
+    data = rng.integers(0, 256, 1 << 18, dtype=np.uint8)
+    ends = np.asarray([40000, 100001, 1 << 18], np.int64)
+    native = segment_fingerprints_host_batch(data, ends)
+    old = dp_mod._available
+    dp_mod._available = False  # force the numpy path
+    try:
+        fallback = segment_fingerprints_host_batch(data, ends)
+    finally:
+        dp_mod._available = old
+    assert native == fallback
+
+
+def test_blockpack_bit_identical():
+    for data in _corpora():
+        for bb in (256, 512):
+            n = len(data) - (len(data) % bb)
+            if n == 0:
+                continue
+            chunk = data[:n]
+            t1, l1, c1 = blockpack_encode_host(chunk, bb)
+            t2, l2, c2 = ndp.blockpack_encode(chunk, bb)
+            np.testing.assert_array_equal(t1, t2)
+            assert c1 == c2
+            np.testing.assert_array_equal(l1[:c1], l2)
+
+
+def test_blockpack_container_roundtrip_via_native():
+    from skyplane_tpu.ops.blockpack import decode_container, encode_container
+
+    data = bytes(rng.integers(0, 256, 300000, dtype=np.uint8)) + bytes(100000)
+    assert decode_container(encode_container(data)) == data
+
+
+def test_env_opt_out(monkeypatch):
+    import skyplane_tpu.native.datapath as dp_mod
+
+    monkeypatch.setenv("SKYPLANE_TPU_NATIVE_DATAPATH", "0")
+    monkeypatch.setattr(dp_mod, "_available", None)
+    assert dp_mod.available() is False
+    monkeypatch.setattr(dp_mod, "_available", None)  # cache reset for other tests
+    monkeypatch.setenv("SKYPLANE_TPU_NATIVE_DATAPATH", "1")
+    assert dp_mod.available() is True
