@@ -45,32 +45,35 @@ void skydp_gear_candidates(const uint8_t* data, uint64_t n, const uint32_t* tabl
 void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
                       uint64_t n_ends, const uint32_t* bases, uint32_t* out_lanes) {
     (void)n;
-    uint32_t r1[8], r2[8], r3[8], r4[8];
+    uint32_t rp[8][8];  // rp[k][l] = r_l^(k+1) mod M31
     for (int l = 0; l < 8; l++) {
-        r1[l] = bases[l] >= M31 ? bases[l] - M31 : bases[l];
-        r2[l] = fold31((uint64_t)r1[l] * r1[l]);
-        r3[l] = fold31((uint64_t)r2[l] * r1[l]);
-        r4[l] = fold31((uint64_t)r3[l] * r1[l]);
+        rp[0][l] = bases[l] >= M31 ? bases[l] - M31 : bases[l];
+        for (int k = 1; k < 8; k++) rp[k][l] = fold31((uint64_t)rp[k - 1][l] * rp[0][l]);
     }
     int64_t start = 0;
     for (uint64_t s = 0; s < n_ends; s++) {
         const int64_t end = ends[s];
         uint32_t f[8] = {0, 0, 0, 0, 0, 0, 0, 0};
         // Horner runs first-to-last: peel the length remainder at the HEAD so
-        // the strided loop covers an exact multiple of 4
+        // the strided loop covers an exact multiple of 8
         int64_t i = start;
-        const int64_t head_end = start + ((end - start) & 3);
+        const int64_t head_end = start + ((end - start) & 7);
         for (; i < head_end; i++) {
             const uint64_t b = data[i];
-            for (int l = 0; l < 8; l++) f[l] = fold31((uint64_t)f[l] * r1[l] + b);
+            for (int l = 0; l < 8; l++) f[l] = fold31((uint64_t)f[l] * rp[0][l] + b);
         }
-        for (; i + 4 <= end; i += 4) {
+        for (; i + 8 <= end; i += 8) {
             const uint64_t b0 = data[i], b1 = data[i + 1], b2 = data[i + 2], b3 = data[i + 3];
+            const uint64_t b4 = data[i + 4], b5 = data[i + 5], b6 = data[i + 6], b7 = data[i + 7];
             for (int l = 0; l < 8; l++) {
-                // f*r4 < 2^62; byte terms < 3*2^39 + 2^8: sum fits u64
-                const uint64_t acc = (uint64_t)f[l] * r4[l] + (uint64_t)r3[l] * b0 +
-                                     (uint64_t)r2[l] * b1 + (uint64_t)r1[l] * b2 + b3;
-                f[l] = fold31(acc);
+                // partial folds keep every sum below 2^63: f*r8 < 2^62 and
+                // each byte-term < 2^39
+                uint64_t hi = (uint64_t)f[l] * rp[7][l] + (uint64_t)rp[6][l] * b0 +
+                              (uint64_t)rp[5][l] * b1;
+                uint64_t lo = (uint64_t)rp[4][l] * b2 + (uint64_t)rp[3][l] * b3 +
+                              (uint64_t)rp[2][l] * b4 + (uint64_t)rp[1][l] * b5 +
+                              (uint64_t)rp[0][l] * b6 + b7;
+                f[l] = fold31((uint64_t)fold31(hi) + fold31(lo));
             }
         }
         uint32_t* out = out_lanes + s * 8;
