@@ -92,7 +92,8 @@ def fingerprint_bytes_host(data: bytes) -> str:
 
 
 def segment_fingerprint_host(seg: bytes) -> bytes:
-    """Host (vectorized numpy) recompute of one segment's wire fingerprint.
+    """Host recompute of one segment's wire fingerprint (native kernel when
+    available, numpy otherwise).
 
     Used by receivers to verify dedup literals before admitting them to the
     SegmentStore — a corrupted literal stored under a healthy fingerprint
@@ -101,6 +102,11 @@ def segment_fingerprint_host(seg: bytes) -> bytes:
     L = len(seg)
     if L > MAX_SEGMENT_BYTES:
         raise ValueError(f"segment length {L} exceeds MAX_SEGMENT_BYTES {MAX_SEGMENT_BYTES}")
+    from skyplane_tpu.native import datapath as native_dp
+
+    if L and native_dp.available():
+        lanes = native_dp.segment_fp_lanes(np.frombuffer(seg, np.uint8), np.asarray([L], np.int64))[0]
+        return bytes.fromhex(finalize_fingerprint(lanes, L))
     arr = np.frombuffer(seg, np.uint8).astype(np.uint64)
     tables = _power_tables()
     lanes = np.empty(N_LANES, np.uint32)
