@@ -21,6 +21,10 @@ class TransferConfig:
     # planner may sample-compress the source corpus and disable codec/dedup
     # per edge when ratio x egress-price x bandwidth says raw bytes win
     auto_codec_decision: bool = True
+    # chunk-level resume: journal dispatch/completion per route and, on
+    # re-run, skip landed objects and re-send only missing multipart parts
+    # (beyond reference capability — it restarts killed transfers)
+    resume: bool = False
     encrypt_e2e: bool = True
     encrypt_socket_tls: bool = True
     verify_checksums: bool = True

@@ -1,0 +1,231 @@
+"""Chunk-level transfer resume journal.
+
+The reference has NO transfer resume (a killed transfer restarts; `sync`
+gives object-level delta-copy). This journal adds chunk-level resume on top:
+with ``TransferConfig.resume=True`` (CLI ``--resume``) each job appends
+dispatch/completion records to an append-only JSONL file keyed by the
+(src, dst...) route, and a re-run
+
+  * skips source objects already fully landed AND finalized (validated
+    against size+mtime AND the chunking layout, so a changed source or a
+    changed part size re-transfers),
+  * reuses recorded multipart upload ids and re-sends ONLY the missing
+    parts (the completed parts persist server-side under the upload id),
+  * skips the failure-path multipart abort (an abort would destroy the
+    resumable state).
+
+Safety properties:
+  * a newer 'object' record that contradicts an older one invalidates ALL
+    derived state for that key (finalized/done parts/upload ids) — both at
+    replay and live, so stale uploads are never reused,
+  * verify() failures append 'invalidate' records for the failed keys, so
+    the next resume re-transfers them instead of looping on the skip,
+  * the journal holds an exclusive flock for the run: two concurrent
+    transfers of one route cannot interleave appends or unlink each other's
+    state.
+
+The journal deletes itself when the transfer completes and verifies.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import json
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.utils.logger import logger
+
+
+def journal_path_for(src_path: str, dst_paths: List[str]) -> Path:
+    """Stable per-route journal location under the config root."""
+    from skyplane_tpu.config_paths import config_root
+
+    digest = hashlib.blake2b("\x00".join([src_path, *sorted(dst_paths)]).encode(), digest_size=8).hexdigest()
+    return config_root / "journals" / f"transfer_{digest}.jsonl"
+
+
+class TransferJournal:
+    """Append-only JSONL of per-chunk transfer state.
+
+    Record types (``key`` is always the SOURCE object key):
+      {"type": "object",    "key", "size", "mtime", "part_size"}        object entered dispatch
+      {"type": "upload_id", "key", "region", "dest_key", "upload_id"}   multipart initiated
+      {"type": "chunk",     "chunk_id", "key", "offset"}                chunk dispatched
+      {"type": "chunk_done","chunk_id"}                                 landed at every destination
+      {"type": "finalized", "key"}                                      multipart completed
+      {"type": "invalidate","key"}                                      verify failed: forget the key
+    """
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._flock_fh = None
+        # replayed prior state; object value = (size, mtime, part_size)
+        self.objects: Dict[str, Tuple[int, Optional[str], int]] = {}
+        # (region, src_key) -> (upload_id, dest_key)
+        self.upload_ids: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._chunk_meta: Dict[str, Tuple[str, int]] = {}  # chunk_id -> (key, offset)
+        self.done_offsets: Dict[str, Set[int]] = {}  # key -> completed chunk offsets
+        self.finalized: Set[str] = set()
+        self._acquire_flock()
+        if self.path.exists():
+            self._replay()
+
+    def _acquire_flock(self) -> None:
+        """One run per route: concurrent writers would interleave records and
+        a finishing run's discard() would unlink the other's journal."""
+        lock_path = self.path.with_suffix(".lock")
+        lock_path.parent.mkdir(parents=True, exist_ok=True)
+        self._flock_fh = lock_path.open("w")
+        try:
+            fcntl.flock(self._flock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            self._flock_fh.close()
+            self._flock_fh = None
+            raise SkyplaneTpuException(
+                f"another resumable transfer of this route is already running (journal lock {lock_path})"
+            ) from e
+
+    def _drop_key_state(self, key: str) -> None:
+        """Forget every derived record for a key (object changed / invalidated)."""
+        self.finalized.discard(key)
+        self.done_offsets.pop(key, None)
+        for rk in [rk for rk in self.upload_ids if rk[1] == key]:
+            del self.upload_ids[rk]
+        self._chunk_meta = {cid: km for cid, km in self._chunk_meta.items() if km[0] != key}
+
+    def _replay(self) -> None:
+        try:
+            with self.path.open() as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail write from a killed run
+                    t = rec.get("type")
+                    if t == "object":
+                        new = (rec.get("size", 0), rec.get("mtime"), rec.get("part_size", 0))
+                        old = self.objects.get(rec["key"])
+                        if old is not None and old != new:
+                            # the source (or layout) changed between runs:
+                            # run-1 state must not survive under the new identity
+                            self._drop_key_state(rec["key"])
+                        self.objects[rec["key"]] = new
+                    elif t == "upload_id":
+                        self.upload_ids[(rec["region"], rec["key"])] = (rec["upload_id"], rec.get("dest_key", rec["key"]))
+                    elif t == "chunk":
+                        self._chunk_meta[rec["chunk_id"]] = (rec["key"], rec.get("offset") or 0)
+                    elif t == "chunk_done":
+                        key_off = self._chunk_meta.get(rec["chunk_id"])
+                        if key_off:
+                            self.done_offsets.setdefault(key_off[0], set()).add(key_off[1])
+                    elif t == "finalized":
+                        self.finalized.add(rec["key"])
+                    elif t == "invalidate":
+                        self._drop_key_state(rec["key"])
+        except OSError as e:
+            logger.fs.warning(f"journal replay failed ({e}); resuming from scratch")
+
+    # ---- queries (prior-run state) ----
+
+    def object_matches(self, key: str, size: int, mtime, part_size: int) -> bool:
+        """The journal's record still describes the source AND the chunking
+        layout is unchanged (a different part size would renumber parts under
+        a reused upload id)."""
+        rec = self.objects.get(key)
+        return rec == (size or 0, str(mtime) if mtime is not None else None, part_size)
+
+    def object_complete(self, key: str, size: int, mtime, part_size: int, was_multipart: bool) -> bool:
+        """Fully landed in a prior run (so a resume may skip it)."""
+        if not self.object_matches(key, size, mtime, part_size):
+            return False
+        if was_multipart:
+            return key in self.finalized
+        return bool(self.done_offsets.get(key))
+
+    def part_done(self, key: str, offset: int) -> bool:
+        return offset in self.done_offsets.get(key, ())
+
+    def reusable_upload_id(self, region: str, src_key: str) -> Optional[str]:
+        entry = self.upload_ids.get((region, src_key))
+        return entry[0] if entry else None
+
+    def stale_upload_ids(self, src_key: str) -> List[Tuple[str, str, str]]:
+        """(region, dest_key, upload_id) entries recorded for a source key
+        whose identity no longer matches — the caller should abort these
+        before re-initiating, or their staged parts bill forever."""
+        return [(region, dest_key, uid) for (region, k), (uid, dest_key) in self.upload_ids.items() if k == src_key]
+
+    # ---- appends (current run) ----
+
+    def _append(self, rec: dict) -> None:
+        with self._lock:
+            if self._fh is None:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                self._fh = self.path.open("a")
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+
+    def record_object(self, key: str, size: int, mtime, part_size: int) -> None:
+        if not self.object_matches(key, size, mtime, part_size):
+            # contradicting record: live state must drop the old identity's
+            # derived records exactly like replay does
+            self._drop_key_state(key)
+            mt = str(mtime) if mtime is not None else None
+            self.objects[key] = (size or 0, mt, part_size)
+            self._append({"type": "object", "key": key, "size": size or 0, "mtime": mt, "part_size": part_size})
+
+    def record_upload_id(self, region: str, src_key: str, dest_key: str, upload_id: str) -> None:
+        self.upload_ids[(region, src_key)] = (upload_id, dest_key)
+        self._append(
+            {"type": "upload_id", "key": src_key, "region": region, "dest_key": dest_key, "upload_id": upload_id}
+        )
+
+    def record_chunk(self, chunk_id: str, key: str, offset: int) -> None:
+        self._chunk_meta[chunk_id] = (key, offset)
+        self._append({"type": "chunk", "chunk_id": chunk_id, "key": key, "offset": offset})
+
+    def record_chunk_done(self, chunk_id: str) -> None:
+        if chunk_id in self._chunk_meta:
+            self._append({"type": "chunk_done", "chunk_id": chunk_id})
+
+    def record_finalized(self, key: str) -> None:
+        self.finalized.add(key)
+        self._append({"type": "finalized", "key": key})
+
+    def record_invalidate(self, key: str) -> None:
+        """Verification failed for this key: the next resume must NOT skip it."""
+        self._drop_key_state(key)
+        self._append({"type": "invalidate", "key": key})
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        """Flush and release handles, KEEPING the journal (failure path)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            if self._flock_fh is not None:
+                try:
+                    fcntl.flock(self._flock_fh, fcntl.LOCK_UN)
+                except OSError:
+                    pass
+                self._flock_fh.close()
+                self._flock_fh = None
+
+    def discard(self) -> None:
+        """Transfer fully done and verified: the journal has served its purpose."""
+        try:
+            self.path.unlink(missing_ok=True)
+        except OSError as e:
+            logger.fs.warning(f"could not remove completed journal {self.path}: {e}")
+        self.close()

@@ -95,6 +95,9 @@ class TransferProgressTracker(threading.Thread):
                 job.finalize()
             for job in self.jobs:
                 job.verify()
+            for job in self.jobs:
+                if hasattr(job, "journal_complete"):
+                    job.journal_complete()  # verified: drop resumable state
             try:
                 self.transfer_stats = self._collect_transfer_stats(time.time() - t0)
             except Exception as e:  # noqa: BLE001 - stats must never fail a delivered transfer
@@ -104,6 +107,9 @@ class TransferProgressTracker(threading.Thread):
         except Exception as e:  # noqa: BLE001
             self.error = e
             logger.fs.error(f"[tracker] transfer failed: {e}")
+            for job in self.jobs:
+                if hasattr(job, "journal_suspend"):
+                    job.journal_suspend()  # keep resumable state, release handles
             self.hooks.on_transfer_error(e)
             self._report_usage(time.time() - t0, error=e)
             # NOTE: multipart-upload abort happens in Dataplane.deprovision,
@@ -287,6 +293,9 @@ class TransferProgressTracker(threading.Thread):
                 target = set(self.dispatched_chunk_ids)
             if newly:
                 self.hooks.on_chunk_completed([cid for cid in newly])
+                for job in self.jobs:
+                    if hasattr(job, "journal_mark_done"):
+                        job.journal_mark_done(newly)  # resume journal (no-op when off)
                 reported_complete |= newly
             if target and target <= all_complete:
                 return

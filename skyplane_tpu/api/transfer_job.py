@@ -149,13 +149,18 @@ class Chunker:
         dst_ifaces: List[StorageInterface],
         transfer_config: TransferConfig,
         partition_id: str = "default",
+        journal=None,  # TransferJournal for chunk-level resume (optional)
     ):
         self.src_iface = src_iface
         self.dst_ifaces = dst_ifaces
         self.transfer_config = transfer_config
         self.partition_id = partition_id
+        self.journal = journal
         self.multipart_upload_queue: "queue.Queue[GatewayMessage]" = queue.Queue()
         self.initiated_uploads: List[Tuple[StorageInterface, str, str]] = []  # (iface, dest_key, upload_id)
+        self.reused_upload_ids: set = set()  # upload ids carried over from a prior run
+        self.expected_sizes: Dict[str, int] = {}  # dest_key -> src size (finalize sanity)
+        self.dest_to_src: Dict[str, str] = {}  # dest_key -> src key (journal records use src keys)
 
     def transfer_pair_generator(
         self,
@@ -200,11 +205,29 @@ class Chunker:
         for pair in pairs:
             size = pair.src_obj.size or 0
             dest_keys = {rt: obj.key for rt, obj in pair.dst_objs.items()}
-            if multipart and size > threshold:
-                yield from self._chunk_multipart(pair, size, part_size, cfg.multipart_max_chunks, self.partition_id)
+            is_multipart = multipart and size > threshold
+            # the EFFECTIVE part size (after the max-parts resize) is part of
+            # the resume identity: a reused upload id with a different part
+            # grid would renumber parts over the prior run's
+            eff_part = 0
+            if is_multipart:
+                n_parts = math.ceil(size / part_size)
+                eff_part = math.ceil(size / cfg.multipart_max_chunks) if n_parts > cfg.multipart_max_chunks else part_size
+            if self.journal is not None:
+                key, mtime = pair.src_obj.key, pair.src_obj.last_modified
+                if self.journal.object_complete(key, size, mtime, eff_part, is_multipart):
+                    logger.fs.info(f"[resume] skipping fully-landed object {key}")
+                    continue
+                if not self.journal.object_matches(key, size, mtime, eff_part):
+                    # changed source/layout: the prior run's uploads are
+                    # unusable — abort them now or their parts bill forever
+                    self._abort_stale_uploads(key)
+                self.journal.record_object(key, size, mtime, eff_part)
+            if is_multipart:
+                yield from self._chunk_multipart(pair, size, eff_part, self.partition_id)
             else:
                 sample_dst = next(iter(pair.dst_objs.values()))
-                yield Chunk(
+                chunk = Chunk(
                     src_key=pair.src_obj.key,
                     dest_key=sample_dst.key,
                     dest_keys=dest_keys,
@@ -213,26 +236,57 @@ class Chunker:
                     partition_id=self.partition_id,
                     mime_type=pair.src_obj.mime_type,
                 )
+                if self.journal is not None:
+                    self.journal.record_chunk(chunk.chunk_id, pair.src_obj.key, 0)
+                yield chunk
 
-    def _chunk_multipart(self, pair: TransferPair, size: int, part_size: int, max_parts: int, partition_id: str):
+    def _abort_stale_uploads(self, src_key: str) -> None:
+        """Abort prior-run uploads whose source/layout changed (best effort);
+        record_object will drop them from the journal's live state next."""
+        by_region = {iface.region_tag(): iface for iface in self.dst_ifaces}
+        for region, dest_key, upload_id in self.journal.stale_upload_ids(src_key):
+            iface = by_region.get(region)
+            if iface is None:
+                continue
+            try:
+                iface.abort_multipart_upload(dest_key, upload_id)
+                logger.fs.info(f"[resume] aborted stale upload {upload_id} for changed source {src_key}")
+            except Exception as e:  # noqa: BLE001 — best effort
+                logger.fs.warning(f"[resume] could not abort stale upload for {dest_key}: {e}")
+
+    def _chunk_multipart(self, pair: TransferPair, size: int, part_size: int, partition_id: str):
         n_parts = math.ceil(size / part_size)
-        if n_parts > max_parts:
-            part_size = math.ceil(size / max_parts)
-            n_parts = math.ceil(size / part_size)
         sample_dst = next(iter(pair.dst_objs.values()))
-        # initiate one multipart upload per destination, announce to sink gateways
+        # initiate one multipart upload per destination (or reuse a prior
+        # run's journaled upload id — its completed parts persist server-side)
+        # and announce the map to sink gateways either way (fresh daemons
+        # start with empty maps)
+        resumable = self.journal is not None and self.journal.object_matches(
+            pair.src_obj.key, size, pair.src_obj.last_modified, part_size
+        )
         mapping: Dict[str, Dict[str, str]] = {}
         for iface in self.dst_ifaces:
             dst_obj = pair.dst_objs[iface.region_tag()]
-            upload_id = iface.initiate_multipart_upload(dst_obj.key, mime_type=pair.src_obj.mime_type)
+            upload_id = self.journal.reusable_upload_id(iface.region_tag(), pair.src_obj.key) if resumable else None
+            if upload_id is not None:
+                self.reused_upload_ids.add(upload_id)
+            else:
+                upload_id = iface.initiate_multipart_upload(dst_obj.key, mime_type=pair.src_obj.mime_type)
+                if self.journal is not None:
+                    self.journal.record_upload_id(iface.region_tag(), pair.src_obj.key, dst_obj.key, upload_id)
             mapping.setdefault(iface.region_tag(), {})[dst_obj.key] = upload_id
             self.initiated_uploads.append((iface, dst_obj.key, upload_id))
+            self.expected_sizes[dst_obj.key] = size
+            self.dest_to_src[dst_obj.key] = pair.src_obj.key
         self.multipart_upload_queue.put(GatewayMessage(upload_id_mapping=mapping))
         dest_keys = {rt: obj.key for rt, obj in pair.dst_objs.items()}
         offset = 0
         for part in range(1, n_parts + 1):
             length = min(part_size, size - offset)
-            yield Chunk(
+            if resumable and self.journal.part_done(pair.src_obj.key, offset):
+                offset += length
+                continue  # this part landed in a prior run
+            chunk = Chunk(
                 src_key=pair.src_obj.key,
                 dest_key=sample_dst.key,
                 dest_keys=dest_keys,
@@ -244,6 +298,9 @@ class Chunker:
                 multi_part=True,
                 mime_type=pair.src_obj.mime_type,
             )
+            if self.journal is not None:
+                self.journal.record_chunk(chunk.chunk_id, pair.src_obj.key, offset)
+            yield chunk
             offset += length
 
 
@@ -304,15 +361,42 @@ class CopyJob(TransferJob):
         super().__init__(*args, **kwargs)
         self.chunker: Optional[Chunker] = None
         self._dispatched_chunks: List[Chunk] = []
+        self.journal = None  # TransferJournal when transfer_config.resume
 
     def _post_filter_fn(self, obj: ObjectStoreObject) -> bool:
         return True
 
+    # ---- resume journaling (no-ops when resume is off) ----
+
+    def journal_mark_done(self, chunk_ids) -> None:
+        """Called by the tracker as chunks land at every destination."""
+        if self.journal is not None:
+            for cid in chunk_ids:
+                self.journal.record_chunk_done(cid)
+
+    def journal_complete(self) -> None:
+        """Transfer finalized AND verified: resumable state no longer needed."""
+        if self.journal is not None:
+            self.journal.discard()
+            self.journal = None
+
+    def journal_suspend(self) -> None:
+        """Transfer failed: flush and release the journal handles, KEEPING the
+        file so a later --resume run can pick the state up."""
+        if self.journal is not None:
+            self.journal.close()
+
     def dispatch(self, dataplane, transfer_config: TransferConfig) -> Generator[Chunk, None, None]:
+        if transfer_config.resume and self.journal is None:
+            from skyplane_tpu.api.journal import TransferJournal, journal_path_for
+
+            self.journal = TransferJournal(journal_path_for(self.src_path, self.dst_paths))
         # chunks are tagged with this job's uuid so multi-job dataplanes route
         # each job's chunks to ITS operator DAG (reference: partition_id = job
         # uuid, planner.py:283-383)
-        self.chunker = Chunker(self.src_iface, self.dst_ifaces, transfer_config, partition_id=self.uuid)
+        self.chunker = Chunker(
+            self.src_iface, self.dst_ifaces, transfer_config, partition_id=self.uuid, journal=self.journal
+        )
         pairs = self.chunker.transfer_pair_generator(
             self.src_prefix, self.dst_prefixes, self.recursive, post_filter_fn=self._post_filter_fn
         )
@@ -381,11 +465,36 @@ class CopyJob(TransferJob):
         """Complete all multipart uploads in parallel (reference :719-744)."""
         if self.chunker is None or not self.chunker.initiated_uploads:
             return
-        do_parallel(
-            lambda entry: entry[0].complete_multipart_upload(entry[1], entry[2]),
-            self.chunker.initiated_uploads,
-            n=16,
-        )
+
+        def complete(entry):
+            iface, key, upload_id = entry
+            try:
+                iface.complete_multipart_upload(key, upload_id)
+            except Exception:
+                # resume edge: a prior run may have completed this REUSED
+                # upload id but died before journaling it. Only a reused id
+                # can be in that state, and only a destination object of
+                # exactly the expected size proves it — a pre-existing object
+                # at the key must NOT mask a genuine completion failure.
+                if (
+                    self.journal is not None
+                    and self.chunker is not None
+                    and upload_id in self.chunker.reused_upload_ids
+                ):
+                    try:
+                        got = iface.get_obj_size(key)
+                    except Exception:  # noqa: BLE001 — keep the completion error primary
+                        got = None
+                    if got == self.chunker.expected_sizes.get(key):
+                        logger.fs.info(f"[resume] multipart {key} was already completed by a prior run")
+                        return
+                raise
+
+        do_parallel(complete, self.chunker.initiated_uploads, n=16)
+        if self.journal is not None:
+            for _, dest_key, _ in self.chunker.initiated_uploads:
+                # journal records are keyed by SOURCE key
+                self.journal.record_finalized(self.chunker.dest_to_src.get(dest_key, dest_key))
         self.chunker.initiated_uploads.clear()  # completed: nothing to abort
 
     def abort(self) -> None:
@@ -393,7 +502,13 @@ class CopyJob(TransferJob):
         open uploads otherwise bill for their staged parts indefinitely
         (S3/GCS) or leave stray part files (POSIX/HDFS). Call only after the
         gateways are stopped: an abort racing an in-flight UploadPart orphans
-        that part permanently."""
+        that part permanently. With resume journaling on, aborting would
+        destroy exactly the state a re-run needs — keep it."""
+        if self.journal is not None and self.chunker is not None and self.chunker.initiated_uploads:
+            logger.fs.info(
+                f"[resume] keeping {len(self.chunker.initiated_uploads)} open multipart uploads for resume"
+            )
+            return
         if self.chunker is None or not self.chunker.initiated_uploads:
             return
 
@@ -495,6 +610,17 @@ class CopyJob(TransferJob):
                 results = do_parallel(check_key, head_keys, n=16)
                 bad.extend(r for _, r in results if r)
             if bad:
+                if self.journal is not None:
+                    # the next resume must RE-TRANSFER these keys, not skip
+                    # them again on the strength of stale journal records
+                    dst_to_src = {
+                        pair.dst_objs[region].key: pair.src_obj.key for pair in self.transfer_list
+                    }
+                    for entry in bad:
+                        dst_key = entry.rsplit(" (", 1)[0]
+                        src_key = dst_to_src.get(dst_key)
+                        if src_key is not None:
+                            self.journal.record_invalidate(src_key)
                 raise TransferFailedException(
                     f"{len(bad)} objects missing or wrong size at {region}", failed_objects=sorted(bad)[:32]
                 )

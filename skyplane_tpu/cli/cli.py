@@ -30,13 +30,15 @@ def main():
 @click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided", "ron", "ilp"]))
 @click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz"]))
 @click.option("--dedup/--no-dedup", default=None, help="content-defined dedup on the TPU path")
+@click.option("--resume", is_flag=True, help="journal chunk progress; re-run continues where a killed transfer stopped")
 @click.option("--debug", is_flag=True, help="collect gateway logs on exit")
-def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, debug):
+def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, resume, debug):
     """Copy objects between clouds: skyplane-tpu cp s3://a/ gs://b/ [-r]."""
     from skyplane_tpu.cli.cli_transfer import run_transfer
 
     sys.exit(run_transfer(src, list(dst), recursive=recursive, sync=False, yes=yes,
-                          max_instances=max_instances, solver=solver, compress=compress, dedup=dedup, debug=debug))
+                          max_instances=max_instances, solver=solver, compress=compress, dedup=dedup,
+                          resume=resume, debug=debug))
 
 
 @main.command()

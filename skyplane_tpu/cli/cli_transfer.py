@@ -21,13 +21,15 @@ from skyplane_tpu.utils.path import parse_path
 console = Console()
 
 
-def _build_transfer_config(compress: Optional[str], dedup: Optional[bool]) -> TransferConfig:
+def _build_transfer_config(compress: Optional[str], dedup: Optional[bool], resume: bool = False) -> TransferConfig:
     cfg = TransferConfig.from_cloud_config(cloud_config)
     overrides = {}
     if compress is not None:
         overrides["compress"] = compress
     if dedup is not None:
         overrides["dedup"] = dedup
+    if resume:
+        overrides["resume"] = True
     if overrides:
         from dataclasses import replace
 
@@ -56,6 +58,7 @@ def run_transfer(
     solver: str,
     compress: Optional[str],
     dedup: Optional[bool],
+    resume: bool = False,
     debug: bool = False,
 ) -> int:
     try:
@@ -65,7 +68,7 @@ def run_transfer(
         console.print(e.pretty_print_str())
         return 1
 
-    transfer_config = _build_transfer_config(compress, dedup)
+    transfer_config = _build_transfer_config(compress, dedup, resume)
     max_instances = max_instances or cloud_config.get_flag("max_instances")
     solver = _pick_solver(solver, src_provider, [p for p, _, _ in dst_parsed])
 

@@ -1,0 +1,145 @@
+"""Chunk-level transfer resume (beyond reference capability).
+
+A killed transfer leaves a journal; the re-run skips fully-landed objects,
+reuses multipart upload ids, and re-sends only the missing parts. These
+tests seed journals exactly as a crashed run would have written them and
+assert the resume run's dispatch behavior plus final byte-identity.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.journal import TransferJournal
+from skyplane_tpu.api.pipeline import Pipeline
+from skyplane_tpu.api.transfer_job import CopyJob
+from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+
+rng = np.random.default_rng(83)
+
+
+def _mk_job(tmp_path, journal_path):
+    src_root = tmp_path / "siteA"
+    dst_root = tmp_path / "siteB"
+    src_root.mkdir(exist_ok=True)
+    dst_root.mkdir(exist_ok=True)
+    job = CopyJob("local:///", ["local:///"], recursive=True)
+    job._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+    job.journal = TransferJournal(journal_path)
+    return job, src_root, dst_root
+
+
+def _run(job, cfg):
+    pipe = Pipeline(transfer_config=cfg)
+    pipe.jobs_to_dispatch.append(job)
+    dp = pipe.create_dataplane()
+    with dp.auto_deprovision():
+        dp.provision()
+        dp.run([job])
+    return dp
+
+
+@pytest.mark.slow
+def test_resume_skips_landed_objects_and_cleans_journal(tmp_path):
+    cfg = TransferConfig(
+        compress="zstd", dedup=False, multipart_threshold_mb=1024, num_connections=2, resume=True,
+        auto_codec_decision=False,
+    )
+    journal_path = tmp_path / "journal.jsonl"
+    job, src_root, dst_root = _mk_job(tmp_path, journal_path)
+    a = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    b = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    (src_root / "a.bin").write_bytes(a)
+    (src_root / "b.bin").write_bytes(b)
+
+    # simulate the prior run: a.bin landed and was journaled done, b.bin never made it
+    (dst_root / "a.bin").write_bytes(a)
+    prior = job.journal
+    src_obj = next(o for o in job.src_iface.list_objects() if o.key == "a.bin")
+    prior.record_object("a.bin", len(a), src_obj.last_modified, part_size=0)
+    prior.record_chunk("prior-chunk-id", "a.bin", 0)
+    prior.record_chunk_done("prior-chunk-id")
+    prior.close()
+
+    # mark a.bin's dst mtime so we can prove the resume run didn't rewrite it
+    before = (dst_root / "a.bin").stat().st_mtime_ns
+
+    job.journal = TransferJournal(journal_path)  # fresh replay, like a new process
+    _run(job, cfg)
+
+    assert (dst_root / "b.bin").read_bytes() == b
+    assert (dst_root / "a.bin").stat().st_mtime_ns == before, "landed object must not be re-transferred"
+    # only b.bin was dispatched
+    assert {c.src_key for c in job._dispatched_chunks} == {"b.bin"}
+    # verified completion discards the journal
+    assert not journal_path.exists()
+
+
+@pytest.mark.slow
+def test_resume_reuses_multipart_upload_and_sends_missing_parts(tmp_path):
+    cfg = TransferConfig(
+        compress="zstd", dedup=False, multipart_threshold_mb=1, multipart_chunk_size_mb=1,
+        num_connections=2, resume=True, auto_codec_decision=False,
+    )
+    journal_path = tmp_path / "journal.jsonl"
+    job, src_root, dst_root = _mk_job(tmp_path, journal_path)
+    payload = rng.integers(0, 256, 4 << 20, dtype=np.uint8).tobytes()  # 4 parts
+    (src_root / "big.bin").write_bytes(payload)
+
+    # simulate the prior run: upload initiated, part 1 (offset 0) uploaded+done
+    dst_iface = job.dst_ifaces[0]
+    upload_id = dst_iface.initiate_multipart_upload("big.bin")
+    part1 = tmp_path / "part1.tmp"
+    part1.write_bytes(payload[: 1 << 20])
+    dst_iface.upload_object(part1, "big.bin", part_number=1, upload_id=upload_id)
+    src_obj = next(o for o in job.src_iface.list_objects() if o.key == "big.bin")
+    prior = job.journal
+    prior.record_object("big.bin", len(payload), src_obj.last_modified, part_size=1 << 20)
+    prior.record_upload_id("local:siteB", "big.bin", "big.bin", upload_id)
+    prior.record_chunk("prior-part-1", "big.bin", 0)
+    prior.record_chunk_done("prior-part-1")
+    prior.close()
+
+    job.journal = TransferJournal(journal_path)
+    _run(job, cfg)
+
+    assert (dst_root / "big.bin").read_bytes() == payload
+    # the resume run dispatched only parts 2..4 (offsets 1,2,3 MiB)
+    offsets = sorted(c.file_offset_bytes for c in job._dispatched_chunks)
+    assert offsets == [1 << 20, 2 << 20, 3 << 20]
+    # and reused the prior upload id rather than initiating a new one
+    assert job.chunker is not None
+    assert [uid for _, _, uid in job.chunker.initiated_uploads] in ([], [upload_id])
+    assert not journal_path.exists()
+
+
+@pytest.mark.slow
+def test_changed_source_invalidates_journal_entry(tmp_path):
+    cfg = TransferConfig(
+        compress="zstd", dedup=False, multipart_threshold_mb=1024, num_connections=2, resume=True,
+        auto_codec_decision=False,
+    )
+    journal_path = tmp_path / "journal.jsonl"
+    job, src_root, dst_root = _mk_job(tmp_path, journal_path)
+    old = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    (src_root / "a.bin").write_bytes(old)
+    (dst_root / "a.bin").write_bytes(old)
+    prior = job.journal
+    # journal describes the OLD object (different size than what we write next)
+    prior.record_object("a.bin", len(old), "stale-mtime", part_size=0)
+    prior.record_chunk("prior-chunk", "a.bin", 0)
+    prior.record_chunk_done("prior-chunk")
+    prior.close()
+
+    new = rng.integers(0, 256, 2 << 20, dtype=np.uint8).tobytes()
+    (src_root / "a.bin").write_bytes(new)
+
+    job.journal = TransferJournal(journal_path)
+    _run(job, cfg)
+    assert (dst_root / "a.bin").read_bytes() == new, "changed source must be re-transferred"
+    assert {c.src_key for c in job._dispatched_chunks} == {"a.bin"}
