@@ -1,0 +1,121 @@
+"""TransferJournal safety properties (resume correctness hinges on these)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from skyplane_tpu.api.journal import TransferJournal
+from skyplane_tpu.exceptions import SkyplaneTpuException
+
+
+def test_basic_roundtrip(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("a", 100, "t1", part_size=0)
+    j.record_chunk("c1", "a", 0)
+    j.record_chunk_done("c1")
+    j.close()
+    j2 = TransferJournal(p)
+    assert j2.object_complete("a", 100, "t1", 0, was_multipart=False)
+    assert not j2.object_complete("a", 100, "t2", 0, was_multipart=False)  # mtime changed
+    assert not j2.object_complete("a", 101, "t1", 0, was_multipart=False)  # size changed
+    j2.close()
+
+
+def test_superseding_object_record_invalidates_derived_state(tmp_path):
+    """Run 1 finalizes under identity A; run 2 re-records identity B and dies;
+    run 3's replay must NOT resurrect run 1's finalized/done state."""
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("x", 100, "old", part_size=10)
+    j.record_upload_id("r1", "x", "dst/x", "upload-A")
+    j.record_chunk("c1", "x", 0)
+    j.record_chunk_done("c1")
+    j.record_finalized("x")
+    # run 2: source changed (new mtime), re-recorded, then the run died
+    j.record_object("x", 100, "new", part_size=10)
+    j.close()
+    j3 = TransferJournal(p)
+    assert not j3.object_complete("x", 100, "new", 10, was_multipart=True), "old finalized must not survive"
+    assert j3.reusable_upload_id("r1", "x") is None, "old upload id must not be reused"
+    assert not j3.part_done("x", 0)
+    j3.close()
+
+
+def test_live_record_object_drops_stale_state(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("x", 100, "old", part_size=10)
+    j.record_upload_id("r1", "x", "dst/x", "upload-A")
+    assert j.stale_upload_ids("x") == [("r1", "dst/x", "upload-A")]
+    j.record_object("x", 200, "new", part_size=10)  # changed: drops upload-A
+    assert j.reusable_upload_id("r1", "x") is None
+    j.close()
+
+
+def test_invalidate_record_clears_key_across_replays(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("x", 100, "t", part_size=0)
+    j.record_chunk("c1", "x", 0)
+    j.record_chunk_done("c1")
+    j.record_invalidate("x")  # verify failed for x
+    j.close()
+    j2 = TransferJournal(p)
+    assert not j2.object_complete("x", 100, "t", 0, was_multipart=False)
+    j2.close()
+
+
+def test_layout_change_is_not_resumable(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("x", 100, "t", part_size=10)
+    j.record_chunk("c1", "x", 0)
+    j.record_chunk_done("c1")
+    # same bytes, different part grid: offsets mean different parts now
+    assert not j.object_matches("x", 100, "t", 20)
+    assert j.object_matches("x", 100, "t", 10)
+    j.close()
+
+
+def test_torn_tail_line_tolerated(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("a", 1, "t", part_size=0)
+    j.close()
+    with p.open("a") as f:
+        f.write('{"type": "chunk", "chunk_id": "c9", "ke')  # killed mid-write
+    j2 = TransferJournal(p)
+    assert "a" in j2.objects
+    j2.close()
+
+
+def test_concurrent_run_lock_conflict(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j1 = TransferJournal(p)
+    with pytest.raises(SkyplaneTpuException, match="already running"):
+        TransferJournal(p)
+    j1.close()
+    j2 = TransferJournal(p)  # lock released: fine
+    j2.close()
+
+
+def test_discard_removes_file(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("a", 1, "t", part_size=0)
+    assert p.exists()
+    j.discard()
+    assert not p.exists()
+
+
+def test_records_are_jsonl(tmp_path):
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("a", 5, "t", part_size=0)
+    j.record_upload_id("r", "a", "d/a", "u1")
+    j.close()
+    lines = [json.loads(line) for line in p.read_text().splitlines()]
+    assert lines[0]["type"] == "object" and lines[1]["dest_key"] == "d/a"
