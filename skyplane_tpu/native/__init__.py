@@ -72,6 +72,7 @@ def load_library() -> ctypes.CDLL:
             ("skydp_gear_candidates", None, [u8p, ctypes.c_uint64, u32p, ctypes.c_uint32, u8p]),
             ("skydp_segment_fp", None, [u8p, ctypes.c_uint64, i64p, ctypes.c_uint64, u32p, u32p]),
             ("skydp_blockpack_encode", ctypes.c_uint64, [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p, u8p]),
+            ("skydp_blockpack_decode", ctypes.c_int, [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, ctypes.c_uint64, u8p]),
         ):
             fn = getattr(lib, name)
             fn.restype = restype

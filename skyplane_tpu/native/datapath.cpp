@@ -126,4 +126,31 @@ uint64_t skydp_blockpack_encode(const uint8_t* data, uint64_t n, uint64_t block_
     return lit;
 }
 
+// Blockpack decode: tags + compacted literal stream -> raw blocks.
+// out must hold nb*block_bytes bytes. Returns 0 on success, 1 when the tags
+// demand more literal bytes than were shipped (corrupt container).
+int skydp_blockpack_decode(const uint8_t* tags, uint64_t nb, const uint8_t* lits,
+                           uint64_t n_lit, uint64_t block_bytes, uint8_t* out) {
+    uint64_t lit = 0;
+    for (uint64_t b = 0; b < nb; b++) {
+        uint8_t* block = out + b * block_bytes;
+        switch (tags[b]) {
+            case 0:  // TAG_ZERO
+                __builtin_memset(block, 0, block_bytes);
+                break;
+            case 1:  // TAG_CONST
+                if (lit + 1 > n_lit) return 1;
+                __builtin_memset(block, lits[lit], block_bytes);
+                lit += 1;
+                break;
+            default:  // TAG_LITERAL
+                if (lit + block_bytes > n_lit) return 1;
+                __builtin_memcpy(block, lits + lit, block_bytes);
+                lit += block_bytes;
+                break;
+        }
+    }
+    return 0;
+}
+
 }  // extern "C"

@@ -66,6 +66,22 @@ def blockpack_encode(data: np.ndarray, block_bytes: int):
     return tags, lits[:n_lit], int(n_lit)
 
 
+def blockpack_decode(tags: np.ndarray, literals: np.ndarray, block_bytes: int) -> np.ndarray:
+    """(tags [NB], literals, block_bytes) -> [NB*block_bytes] uint8; raises
+    CodecException on a tag/literal length mismatch (corrupt container)."""
+    from skyplane_tpu.exceptions import CodecException
+
+    tags = np.ascontiguousarray(tags, dtype=np.uint8)
+    literals = np.ascontiguousarray(literals, dtype=np.uint8)
+    out = np.empty(len(tags) * block_bytes, np.uint8)
+    rc = load_library().skydp_blockpack_decode(
+        _u8p(tags), len(tags), _u8p(literals), len(literals), block_bytes, _u8p(out)
+    )
+    if rc != 0:
+        raise CodecException("blockpack container corrupt: tag/literal length mismatch")
+    return out
+
+
 def segment_fp_lanes(data: np.ndarray, ends: np.ndarray) -> np.ndarray:
     """[N] uint8 + segment ends -> [n_segments, 8] uint32 fingerprint lanes."""
     from skyplane_tpu.ops.fingerprint import LANE_BASES

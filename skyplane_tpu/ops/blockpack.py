@@ -169,7 +169,12 @@ def decode_container(buf: bytes) -> bytes:
         lit_padded[:n_lit] = literals
         out = np.asarray(decode_device(jnp.asarray(tags), jnp.asarray(lit_padded), block_bytes=block_bytes))
     else:
-        from skyplane_tpu.ops.host_fallback import blockpack_decode_host
+        from skyplane_tpu.native import datapath as native_dp
 
-        out = blockpack_decode_host(tags, literals, block_bytes)
+        if native_dp.available():
+            out = native_dp.blockpack_decode(tags, literals, block_bytes)
+        else:
+            from skyplane_tpu.ops.host_fallback import blockpack_decode_host
+
+            out = blockpack_decode_host(tags, literals, block_bytes)
     return out[:n_raw].tobytes()

@@ -114,3 +114,29 @@ def test_env_opt_out(monkeypatch):
     monkeypatch.setattr(dp_mod, "_available", None)  # cache reset for other tests
     monkeypatch.setenv("SKYPLANE_TPU_NATIVE_DATAPATH", "1")
     assert dp_mod.available() is True
+
+
+def test_blockpack_decode_bit_identical_and_corruption():
+    from skyplane_tpu.exceptions import CodecException
+    from skyplane_tpu.ops.host_fallback import blockpack_decode_host
+
+    for data in _corpora():
+        for bb in (256, 512):
+            n = len(data) - (len(data) % bb)
+            if n == 0:
+                continue
+            tags, lits, n_lit = ndp.blockpack_encode(data[:n], bb)
+            want = blockpack_decode_host(tags, lits, bb)
+            got = ndp.blockpack_decode(tags, lits, bb)
+            np.testing.assert_array_equal(want, got)
+    # corrupt: tags demand more literal bytes than shipped
+    tags = np.array([2, 2], np.uint8)  # two literal blocks
+    with pytest.raises(CodecException, match="corrupt"):
+        ndp.blockpack_decode(tags, np.zeros(256, np.uint8), 256)
+
+
+def test_blockpack_container_roundtrip_native_decode():
+    from skyplane_tpu.ops.blockpack import decode_container, encode_container
+
+    data = bytes(rng.integers(0, 256, 123456, dtype=np.uint8)) + bytes(70000) + bytes([9]) * 4096
+    assert decode_container(encode_container(data)) == data
