@@ -143,10 +143,14 @@ int skydp_blockpack_decode(const uint8_t* tags, uint64_t nb, const uint8_t* lits
                 __builtin_memset(block, lits[lit], block_bytes);
                 lit += 1;
                 break;
-            default:  // TAG_LITERAL
+            case 2:  // TAG_LITERAL
                 if (lit + block_bytes > n_lit) return 1;
                 __builtin_memcpy(block, lits + lit, block_bytes);
                 lit += block_bytes;
+                break;
+            default:  // invalid tag 3 (corrupt tag bits): match the numpy
+                      // fallback — zero block, consume no literals
+                __builtin_memset(block, 0, block_bytes);
                 break;
         }
     }

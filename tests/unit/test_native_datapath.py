@@ -140,3 +140,15 @@ def test_blockpack_container_roundtrip_native_decode():
 
     data = bytes(rng.integers(0, 256, 123456, dtype=np.uint8)) + bytes(70000) + bytes([9]) * 4096
     assert decode_container(encode_container(data)) == data
+
+
+def test_blockpack_decode_invalid_tag_matches_fallback():
+    """Tag value 3 (corrupt tag bits) must decode identically on both host
+    paths: zero block, no literal consumption."""
+    from skyplane_tpu.ops.host_fallback import blockpack_decode_host
+
+    tags = np.array([3, 2], np.uint8)  # invalid, then a literal block
+    lits = rng.integers(1, 255, 256, dtype=np.uint8)
+    want = blockpack_decode_host(tags, lits, 256)
+    got = ndp.blockpack_decode(tags, lits, 256)
+    np.testing.assert_array_equal(want, got)

@@ -1,0 +1,136 @@
+"""SCP + IBM provider logic against stubbed transports (completes the
+provider stub-test coverage: all five cloud providers now exercise their
+request shapes without credentials).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import sys
+import types
+
+import pytest
+
+
+# ---------- SCP (HMAC-signed REST over requests) ----------
+
+
+@pytest.fixture()
+def scp(monkeypatch):
+    monkeypatch.setenv("SCP_ACCESS_KEY", "AK")
+    monkeypatch.setenv("SCP_SECRET_KEY", "SK")
+    monkeypatch.setenv("SCP_PROJECT_ID", "P1")
+    monkeypatch.setenv("SCP_IMAGE_ID", "IMG-1")
+
+    from skyplane_tpu.compute.scp import scp_cloud_provider as mod
+
+    calls = []
+
+    class FakeResponse:
+        def __init__(self, body):
+            self._body = body
+            self.content = b"{}"
+
+        def raise_for_status(self):
+            pass
+
+        def json(self):
+            return self._body
+
+    state = {"poll": 0}
+
+    def fake_request(method, url, headers=None, json=None, timeout=None):
+        calls.append((method, url, headers, json))
+        if method == "POST" and url.endswith("/virtual-servers"):
+            return FakeResponse({"resourceId": "vs-1"})
+        if method == "GET" and url.endswith("/virtual-servers/vs-1"):
+            state["poll"] += 1
+            if state["poll"] < 2:
+                return FakeResponse({"virtualServerState": "CREATING"})
+            return FakeResponse(
+                {"virtualServerState": "RUNNING", "natIpAddress": "8.8.4.4", "ipAddress": "10.2.0.9"}
+            )
+        if method == "GET" and url.endswith("/virtual-servers"):
+            return FakeResponse(
+                {
+                    "contents": [
+                        {
+                            "virtualServerName": "skyplane-tpu-abc",
+                            "virtualServerState": "RUNNING",
+                            "virtualServerId": "vs-9",
+                            "serviceZoneId": "kr-west-1",
+                            "natIpAddress": "8.8.8.8",
+                            "ipAddress": "10.0.0.9",
+                        },
+                        {"virtualServerName": "other", "virtualServerState": "RUNNING"},
+                    ]
+                }
+            )
+        return FakeResponse({})
+
+    monkeypatch.setattr(mod.requests, "request", fake_request)
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    return mod, calls
+
+
+def test_scp_request_signing(scp):
+    mod, calls = scp
+    client = mod.SCPClient()
+    client.request("GET", "/x")
+    method, url, headers, _ = calls[0]
+    # signature = HMAC-SHA256(secret, method+url+ts+access_key+project)
+    msg = method + url + headers["X-Cmp-Timestamp"] + "AK" + "P1"
+    want = base64.b64encode(hmac.new(b"SK", msg.encode(), hashlib.sha256).digest()).decode()
+    assert headers["X-Cmp-Signature"] == want
+    assert headers["X-Cmp-AccessKey"] == "AK" and headers["X-Cmp-ProjectId"] == "P1"
+
+
+def test_scp_provision_waits_for_running(scp):
+    mod, calls = scp
+    provider = mod.SCPCloudProvider()
+    server = provider.provision_instance("scp:kr-west-1", vm_type="s1v4m8")
+    create = next(j for m, u, h, j in calls if m == "POST")
+    assert create["serverType"] == "s1v4m8"
+    assert create["serviceZoneId"] == "kr-west-1"
+    assert create["imageId"] == "IMG-1"
+    assert {"tagKey": "skyplane-tpu", "tagValue": "true"} in create["tags"]
+    assert server.instance_id == "vs-1"
+    assert server.public_ip() == "8.8.4.4"
+    assert server.private_ip() == "10.2.0.9"
+
+
+def test_scp_matching_instances_filters_by_name_prefix(scp):
+    mod, calls = scp
+    provider = mod.SCPCloudProvider()
+    servers = provider.get_matching_instances()
+    assert [s.instance_id for s in servers] == ["vs-9"]
+    servers[0].terminate_instance()
+    assert any(m == "DELETE" and u.endswith("/virtual-servers/vs-9") for m, u, _, _ in calls)
+
+
+def test_scp_requires_credentials(monkeypatch):
+    for var in ("SCP_ACCESS_KEY", "SCP_SECRET_KEY", "SCP_PROJECT_ID"):
+        monkeypatch.delenv(var, raising=False)
+    from skyplane_tpu.compute.scp import scp_cloud_provider as mod
+
+    with pytest.raises(RuntimeError, match="SCP_ACCESS_KEY"):
+        mod.SCPClient()
+
+
+# ---------- IBM (ibm_vpc SDK, stubbed) ----------
+
+
+def test_ibm_provider_sdk_and_credential_gating(monkeypatch):
+    """Construction is SDK-free (lazy imports); the gates fire on first use:
+    missing credentials -> actionable RuntimeError, missing SDK -> ImportError."""
+    import skyplane_tpu.compute.ibmcloud.ibm_cloud_provider as mod
+
+    provider = mod.IBMCloudProvider()  # must not import ibm_vpc
+    monkeypatch.delenv("IBM_API_KEY", raising=False)
+    monkeypatch.setitem(sys.modules, "ibm_cloud_sdk_core", None)
+    monkeypatch.setitem(sys.modules, "ibm_cloud_sdk_core.authenticators", None)
+    monkeypatch.setitem(sys.modules, "ibm_vpc", None)
+    with pytest.raises((RuntimeError, ImportError)):
+        provider.vpc_client("us-south")
