@@ -30,9 +30,9 @@ class TransferConfig:
     verify_checksums: bool = True
     use_bbr: bool = True
     num_connections: int = 32
-    cdc_min_bytes: int = 16 * 1024
-    cdc_avg_bytes: int = 64 * 1024
-    cdc_max_bytes: int = 256 * 1024
+    cdc_min_bytes: int = 4 * 1024
+    cdc_avg_bytes: int = 16 * 1024
+    cdc_max_bytes: int = 64 * 1024
     # chunking
     multipart_enabled: bool = True
     multipart_threshold_mb: int = 128

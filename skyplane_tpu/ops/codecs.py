@@ -37,7 +37,11 @@ def _zstd():
 
 
 def _encode_zstd(data: bytes) -> bytes:
-    return _zstd().ZstdCompressor(level=3).compress(data)
+    # threads=-1 = one worker per core: multi-core gateways compress big
+    # chunks in parallel (single-core hosts: plain path, no overhead). The
+    # frame stays standard and keeps the embedded content size the decoder
+    # cap requires.
+    return _zstd().ZstdCompressor(level=3, threads=-1).compress(data)
 
 
 def _decode_zstd(buf: bytes) -> bytes:
