@@ -84,18 +84,31 @@ def maybe_enable_pallas() -> bool:
     try:
         import jax.numpy as jnp
 
+        from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
         from skyplane_tpu.ops.gear import _windowed_sum_doubling
-        from skyplane_tpu.ops.pallas_kernels import TILE, gear_windowed_sum_pallas
+        from skyplane_tpu.ops.pallas_kernels import TILE, gear_windowed_sum_pallas, segment_fp_fixed_pallas
 
         rng = np_.random.default_rng(7)
         data = jnp.asarray(rng.integers(0, 2**32, size=2 * TILE, dtype=np_.uint32))
         want = np_.asarray(_windowed_sum_doubling(data))
         got = np_.asarray(gear_windowed_sum_pallas(data))
-        if np_.array_equal(want, got):
+        gear_ok = np_.array_equal(want, got)
+        # fingerprint kernel: compare against the XLA limb path on device at
+        # the PRODUCTION tile size (datapath_step default) — a smaller tile
+        # would validate a different Mosaic lowering than the one that runs
+        S = 1 << 16
+        fp_data = jnp.asarray(rng.integers(0, 256, size=4 * S, dtype=np_.uint8))
+        pos = np_.arange(4 * S, dtype=np_.int32)
+        fp_want = np_.asarray(
+            segment_fingerprint_device(fp_data, jnp.asarray(pos // S), jnp.asarray(S - 1 - (pos % S)), n_segments=4)
+        )
+        fp_got = np_.asarray(segment_fp_fixed_pallas(fp_data, S))
+        fp_ok = np_.array_equal(fp_want, fp_got)
+        if gear_ok and fp_ok:
             os.environ["SKYPLANE_TPU_USE_PALLAS"] = "1"
-            log("pallas gear kernel validated on device: enabled")
+            log("pallas gear + fingerprint kernels validated on device: enabled")
             return True
-        log("WARN: pallas kernel output mismatch on device; staying on XLA path")
+        log(f"WARN: pallas kernel mismatch on device (gear_ok={gear_ok} fp_ok={fp_ok}); staying on XLA path")
     except Exception as e:  # noqa: BLE001 — pallas failure must not kill the bench
         log(f"WARN: pallas validation failed ({e}); staying on XLA path")
     # validation failed: make sure a pre-exported =1 cannot silently run the

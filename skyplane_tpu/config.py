@@ -68,9 +68,9 @@ _DEFAULT_FLAGS: Dict[str, Any] = {
     "multipart_max_chunks": 9990,
     "tpu_batch_chunks": 8,
     "tpu_block_bytes": 512,
-    "cdc_min_bytes": 16 * 1024,
-    "cdc_avg_bytes": 64 * 1024,
-    "cdc_max_bytes": 256 * 1024,
+    "cdc_min_bytes": 4 * 1024,
+    "cdc_avg_bytes": 16 * 1024,
+    "cdc_max_bytes": 64 * 1024,
     "aws_instance_class": "m5.8xlarge",
     "azure_instance_class": "Standard_D32_v5",
     "gcp_instance_class": "n2-standard-32",

@@ -17,6 +17,7 @@ in the wire header (chunk.py Codec), and include the TPU block-suppress path:
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, NamedTuple
 
 from skyplane_tpu.chunk import Codec
@@ -36,12 +37,21 @@ def _zstd():
     return zstandard
 
 
+_codec_local = threading.local()
+
+
 def _encode_zstd(data: bytes) -> bytes:
     # threads=-1 = one worker per core: multi-core gateways compress big
     # chunks in parallel (single-core hosts: plain path, no overhead). The
     # frame stays standard and keeps the embedded content size the decoder
-    # cap requires.
-    return _zstd().ZstdCompressor(level=3, threads=-1).compress(data)
+    # cap requires. The compressor is cached per worker thread — building a
+    # multithreaded ZSTDMT context per chunk would churn a thread pool on
+    # every call.
+    comp = getattr(_codec_local, "zstd_compressor", None)
+    if comp is None:
+        comp = _zstd().ZstdCompressor(level=3, threads=-1)
+        _codec_local.zstd_compressor = comp
+    return comp.compress(data)
 
 
 def _decode_zstd(buf: bytes) -> bytes:

@@ -80,6 +80,34 @@ def segment_fingerprint_device(data: jax.Array, seg_ids: jax.Array, rev_pos: jax
     return jax.vmap(lane)(tables).T  # [n_segments, LANES]
 
 
+def fixed_stride_lanes(chunk, fp_seg_bytes: int, pallas=None):
+    """[N] uint8 -> [N/fp_seg_bytes, LANES] uint32 for FIXED-stride segments,
+    dispatching to the Pallas kernel when enabled (shared by datapath_step
+    and the SPMD datapath so the dispatch cannot drift between them).
+
+    ``pallas=None`` resolves the env flag + backend at trace time; callers
+    that jit should resolve it OUTSIDE the trace and pass the bool through a
+    static argument, or the flag gets frozen into the compiled program.
+    """
+    n = chunk.shape[0]
+    n_segments = n // fp_seg_bytes
+    if pallas is None:
+        from skyplane_tpu.ops.backend import on_accelerator
+        from skyplane_tpu.ops.pallas_kernels import use_pallas
+
+        pallas = use_pallas() and on_accelerator()
+    if pallas:
+        from skyplane_tpu.ops.pallas_kernels import FP_MAX_TILE, segment_fp_fixed_pallas
+
+        if fp_seg_bytes <= FP_MAX_TILE:
+            # one VMEM pass per segment instead of per-lane HBM term arrays
+            return segment_fp_fixed_pallas(chunk, fp_seg_bytes)
+    pos = jax.lax.iota(jnp.int32, n)
+    seg_ids = pos // fp_seg_bytes
+    rev_pos = fp_seg_bytes - 1 - (pos % fp_seg_bytes)
+    return segment_fingerprint_device(chunk, seg_ids, rev_pos, n_segments=n_segments)
+
+
 def finalize_fingerprint(lanes: np.ndarray, length: int) -> str:
     """Mix one segment's 8 uint32 lanes + length into the 128-bit hex wire fingerprint."""
     h = hashlib.blake2b(np.asarray(lanes, dtype="<u4").tobytes() + int(length).to_bytes(8, "little"), digest_size=16)

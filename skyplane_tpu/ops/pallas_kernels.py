@@ -70,6 +70,60 @@ def use_pallas() -> bool:
     return os.environ.get("SKYPLANE_TPU_USE_PALLAS", "0").strip() in ("1", "true", "on")
 
 
+# ---- fixed-stride segment fingerprints ----
+
+FP_MAX_TILE = 1 << 16  # limb sums must stay < 2^24: S * 255 <= 2^24 for S <= 2^16
+
+
+def _segment_fp_kernel(data_ref, powers_ref, out_ref):
+    """One tile = one fixed-stride segment: 8-lane polynomial hash in VMEM.
+
+    data_ref: [S] uint8; powers_ref: [LANES, S] uint32 (r^(S-1-i), identical
+    for every segment, so the block index is constant); out_ref: [1, LANES].
+    All arithmetic is the same u32 limb math the XLA kernel uses
+    (ops/u32.py) — TPUs have no 64-bit integer lanes.
+    """
+    from skyplane_tpu.ops.fingerprint import N_LANES
+    from skyplane_tpu.ops.u32 import M31, addmod31, fold31, mulmod31
+
+    b = data_ref[:].astype(jnp.uint32)
+    terms = mulmod31(b[None, :], powers_ref[:, :])  # [LANES, S] < 2^31
+    acc = jnp.zeros((N_LANES,), jnp.uint32)
+    for k in range(4):
+        limb = (terms >> np.uint32(8 * k)) & np.uint32(0xFF)
+        s = jnp.sum(limb, axis=1)  # < S * 255 <= 2^24
+        acc = addmod31(acc, mulmod31(fold31(s.astype(jnp.uint32)), jnp.uint32((1 << (8 * k)) % M31)))
+    out_ref[0, :] = acc
+
+
+@partial(jax.jit, static_argnames=("fp_seg_bytes", "interpret"))
+def segment_fp_fixed_pallas(chunk: jax.Array, fp_seg_bytes: int, interpret: bool = False) -> jax.Array:
+    """[N] uint8 -> [N/fp_seg_bytes, 8] uint32 lane values, one VMEM pass per
+    segment (the XLA path materializes the [N]-sized term array to HBM per
+    lane). Bit-identical to segment_fingerprint_device on fixed strides."""
+    from skyplane_tpu.ops.fingerprint import N_LANES, _power_tables
+
+    n = chunk.shape[0]
+    if n % fp_seg_bytes:
+        raise ValueError(f"N={n} must be a multiple of fp_seg_bytes={fp_seg_bytes}")
+    if fp_seg_bytes > FP_MAX_TILE:
+        raise ValueError(f"fp_seg_bytes={fp_seg_bytes} exceeds the limb-sum-safe tile {FP_MAX_TILE}")
+    n_segments = n // fp_seg_bytes
+    # r^(S-1-i) for i in [0, S): the same slice serves every segment
+    powers = jnp.asarray(np.ascontiguousarray(_power_tables()[:, :fp_seg_bytes][:, ::-1]))
+    return pl.pallas_call(
+        _segment_fp_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_segments, N_LANES), jnp.uint32),
+        grid=(n_segments,),
+        in_specs=[
+            pl.BlockSpec((fp_seg_bytes,), lambda i: (i,)),
+            pl.BlockSpec((N_LANES, fp_seg_bytes), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, N_LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(chunk, powers)
+
+
 def gear_hash_pallas(data_u8: jax.Array, interpret: bool = False) -> jax.Array:
     """Full gear hash with the table gather in XLA and the windowed sum in
     Pallas. Requires len % TILE == 0 (the data path pads chunks to power-of-

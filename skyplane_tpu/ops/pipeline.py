@@ -34,6 +34,7 @@ from skyplane_tpu.ops.codecs import CodecSpec, get_codec, get_codec_by_id
 from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex, build_recipe, parse_recipe
 from skyplane_tpu.ops.fingerprint import (
     finalize_fingerprint,
+    fixed_stride_lanes,
     segment_fingerprint_device,
 )
 from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
@@ -48,7 +49,22 @@ def _bucket_size(n: int) -> int:
     return b
 
 
-@partial(jax.jit, static_argnames=("block_bytes", "fp_seg_bytes", "mask_bits"))
+@partial(jax.jit, static_argnames=("block_bytes", "fp_seg_bytes", "mask_bits", "_pallas"))
+def _datapath_step_impl(batch: jax.Array, block_bytes: int, fp_seg_bytes: int, mask_bits: int, _pallas: bool):
+    n = batch.shape[-1]
+    if n % fp_seg_bytes or n % block_bytes:
+        raise ValueError(f"N={n} must be divisible by fp_seg_bytes and block_bytes")
+
+    def one(chunk):
+        h = gear_hash(chunk)
+        candidates = boundary_candidate_mask(h, mask_bits)
+        tags, literals, n_lit = blockpack.encode_device(chunk, block_bytes=block_bytes)
+        fp_lanes = fixed_stride_lanes(chunk, fp_seg_bytes, pallas=_pallas)
+        return dict(candidates=candidates, tags=tags, literals=literals, n_lit=n_lit, fp_lanes=fp_lanes)
+
+    return jax.vmap(one)(batch)
+
+
 def datapath_step(batch: jax.Array, block_bytes: int = 512, fp_seg_bytes: int = 1 << 16, mask_bits: int = 16):
     """Fused per-batch device step. batch: [B, N] uint8, N % fp_seg_bytes == 0.
 
@@ -58,23 +74,21 @@ def datapath_step(batch: jax.Array, block_bytes: int = 512, fp_seg_bytes: int = 
       literals   [B, N] uint8 — compacted literal bytes (dense prefix)
       n_lit      [B] int32 — valid literal byte count
       fp_lanes   [B, N/fp_seg_bytes, 8] uint32 — fixed-stride segment fingerprints
+
+    The Pallas flag is resolved HERE (per call) and passed as a static arg:
+    resolving it inside the trace would freeze the env flag into the first
+    compiled program and silently ignore later flips.
     """
-    n = batch.shape[-1]
-    if n % fp_seg_bytes or n % block_bytes:
-        raise ValueError(f"N={n} must be divisible by fp_seg_bytes and block_bytes")
-    n_segments = n // fp_seg_bytes
+    from skyplane_tpu.ops.backend import on_accelerator
+    from skyplane_tpu.ops.pallas_kernels import use_pallas
 
-    def one(chunk):
-        h = gear_hash(chunk)
-        candidates = boundary_candidate_mask(h, mask_bits)
-        tags, literals, n_lit = blockpack.encode_device(chunk, block_bytes=block_bytes)
-        pos = jax.lax.iota(jnp.int32, n)
-        seg_ids = pos // fp_seg_bytes
-        rev_pos = fp_seg_bytes - 1 - (pos % fp_seg_bytes)
-        fp_lanes = segment_fingerprint_device(chunk, seg_ids, rev_pos, n_segments=n_segments)
-        return dict(candidates=candidates, tags=tags, literals=literals, n_lit=n_lit, fp_lanes=fp_lanes)
-
-    return jax.vmap(one)(batch)
+    return _datapath_step_impl(
+        batch,
+        block_bytes=block_bytes,
+        fp_seg_bytes=fp_seg_bytes,
+        mask_bits=mask_bits,
+        _pallas=bool(use_pallas() and on_accelerator()),
+    )
 
 
 @dataclass

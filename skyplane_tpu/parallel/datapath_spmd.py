@@ -108,16 +108,21 @@ def make_spmd_datapath(
     if batch_chunks % mesh.shape["data"]:
         raise ValueError(f"batch_chunks={batch_chunks} must divide over data={mesh.shape['data']}")
 
+    # resolve the Pallas flag OUTSIDE the traced function (it becomes part of
+    # the returned closure; re-call make_spmd_datapath after flipping the env)
+    from skyplane_tpu.ops.backend import on_accelerator
+    from skyplane_tpu.ops.fingerprint import fixed_stride_lanes
+    from skyplane_tpu.ops.pallas_kernels import use_pallas
+
+    pallas = bool(use_pallas() and on_accelerator())
+
     def per_shard(batch_local: jax.Array):
         # batch_local: [B/data, n_local] uint8
         def one(chunk_local):
             h = _gear_hash_halo(chunk_local, "seq")
             candidates = boundary_candidate_mask(h, mask_bits)
             tags, literals, n_lit = blockpack.encode_device(chunk_local, block_bytes=block_bytes)
-            pos = jax.lax.iota(jnp.int32, n_local)
-            seg_ids = pos // fp_seg_bytes
-            rev_pos = fp_seg_bytes - 1 - (pos % fp_seg_bytes)
-            fp = segment_fingerprint_device(chunk_local, seg_ids, rev_pos, n_segments=n_local // fp_seg_bytes)
+            fp = fixed_stride_lanes(chunk_local, fp_seg_bytes, pallas=pallas)
             return candidates, tags, literals, n_lit[None], fp
 
         return jax.vmap(one)(batch_local)

@@ -32,3 +32,51 @@ def test_pallas_gear_matches_xla_path_across_tile_boundary():
 def test_pallas_gear_rejects_unaligned():
     with pytest.raises(ValueError):
         gear_hash_pallas(jnp.zeros(TILE + 1, jnp.uint8), interpret=True)
+
+
+def test_pallas_segment_fp_matches_xla_kernel():
+    from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
+    from skyplane_tpu.ops.pallas_kernels import segment_fp_fixed_pallas
+
+    S = 4096
+    for trial in range(3):
+        data = rng.integers(0, 256, 8 * S, dtype=np.uint8)
+        if trial == 1:
+            data[: 4 * S] = 0
+        if trial == 2:
+            data[:] = 255
+        got = np.asarray(segment_fp_fixed_pallas(jnp.asarray(data), S, interpret=True))
+        pos = np.arange(len(data), dtype=np.int32)
+        want = np.asarray(
+            segment_fingerprint_device(
+                jnp.asarray(data),
+                jnp.asarray(pos // S),
+                jnp.asarray(S - 1 - (pos % S)),
+                n_segments=len(data) // S,
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_segment_fp_matches_host_digest_path():
+    """Through finalize: the wire fingerprints must agree with the host path
+    (the dedup identity contract)."""
+    from skyplane_tpu.ops.fingerprint import finalize_fingerprint, segment_fingerprints_host_batch
+    from skyplane_tpu.ops.pallas_kernels import segment_fp_fixed_pallas
+
+    S = 2048
+    data = rng.integers(0, 256, 4 * S, dtype=np.uint8)
+    lanes = np.asarray(segment_fp_fixed_pallas(jnp.asarray(data), S, interpret=True))
+    ends = np.arange(S, len(data) + 1, S, dtype=np.int64)
+    want = segment_fingerprints_host_batch(data, ends)
+    got = [bytes.fromhex(finalize_fingerprint(lanes[i], S)) for i in range(len(ends))]
+    assert got == want
+
+
+def test_pallas_segment_fp_rejects_bad_shapes():
+    from skyplane_tpu.ops.pallas_kernels import FP_MAX_TILE, segment_fp_fixed_pallas
+
+    with pytest.raises(ValueError):
+        segment_fp_fixed_pallas(jnp.zeros(100, jnp.uint8), 64, interpret=True)
+    with pytest.raises(ValueError):
+        segment_fp_fixed_pallas(jnp.zeros(FP_MAX_TILE * 4, jnp.uint8), FP_MAX_TILE * 2, interpret=True)
