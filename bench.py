@@ -24,6 +24,12 @@ import subprocess
 import sys
 import time
 
+# persistent XLA compile cache: bucket shapes repeat across bench runs, so a
+# rerun skips the (tunnel-slow) compiles entirely. Must be set before jax
+# initializes a backend.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 import numpy as np
 
 CHUNK_MB = 8
@@ -157,8 +163,16 @@ def make_corpus(seed: int = 0):
     return chunks
 
 
-# gateway sender worker pool; matches cores (threads don't help on 1-core hosts)
-N_WORKERS = int(os.environ.get("SKYPLANE_BENCH_WORKERS", str(min(8, os.cpu_count() or 1))))
+def n_workers() -> int:
+    """Gateway sender pool size. On an accelerator the workers mostly wait on
+    device round trips (dispatch latency dominates, esp. through a tunnel),
+    so the pool exceeds the core count to keep batches in flight; on pure
+    CPU extra threads just fight over cores."""
+    if os.environ.get("SKYPLANE_BENCH_WORKERS"):
+        return int(os.environ["SKYPLANE_BENCH_WORKERS"])
+    from skyplane_tpu.ops.backend import on_accelerator
+
+    return 16 if on_accelerator() else min(8, os.cpu_count() or 1)
 
 
 def bench_ours(chunks) -> dict:
@@ -173,34 +187,36 @@ def bench_ours(chunks) -> dict:
 
     from skyplane_tpu.ops.backend import on_accelerator
 
+    workers = n_workers()
     cdc = CDCParams()
     batch_runner = None
-    if on_accelerator() and N_WORKERS > 1:
+    if on_accelerator():
         # mirror the gateway: workers share a micro-batching device runner,
         # sharded over a mesh when multiple chips are attached (the
-        # production configuration on TPU slices)
+        # production configuration on TPU slices). workers > max_batch keeps
+        # a second window forming while the first is in flight.
         from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
         from skyplane_tpu.parallel.datapath_spmd import maybe_default_mesh
 
         mesh = maybe_default_mesh()
         if mesh is not None:
             log(f"batch runner sharded over mesh {dict(mesh.shape)}")
-        batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=min(8, N_WORKERS), mesh=mesh)
+        batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=min(8, workers), mesh=mesh)
     proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
     index = SenderDedupIndex()
     # warm-up: compile all shape buckets (separate corpus so the index stays
     # cold). With a batch runner, submit concurrently so the BATCHED kernel
     # shapes compile now rather than inside the timed region.
     warm_rng = np.random.default_rng(99)
+    t_warm = time.perf_counter()
     if batch_runner is not None:
-        from concurrent.futures import ThreadPoolExecutor
-
-        warm_chunks = [warm_rng.integers(0, 256, CHUNK_MB << 20, dtype=np.uint8).tobytes() for _ in range(N_WORKERS)]
-        with ThreadPoolExecutor(max_workers=N_WORKERS) as pool:
+        warm_chunks = [warm_rng.integers(0, 256, CHUNK_MB << 20, dtype=np.uint8).tobytes() for _ in range(workers)]
+        with ThreadPoolExecutor(max_workers=workers) as pool:
             list(pool.map(lambda c: proc.process(c, SenderDedupIndex()), warm_chunks))
     else:
         warm = warm_rng.integers(0, 256, CHUNK_MB << 20, dtype=np.uint8).tobytes()
         proc.process(warm, SenderDedupIndex())
+    log(f"warm-up done in {time.perf_counter() - t_warm:.1f}s ({workers} workers)")
 
     def one(c: bytes) -> int:
         p = proc.process(c, index)
@@ -209,7 +225,7 @@ def bench_ours(chunks) -> dict:
         return len(p.wire_bytes)
 
     t0 = time.perf_counter()
-    with ThreadPoolExecutor(max_workers=N_WORKERS) as pool:
+    with ThreadPoolExecutor(max_workers=workers) as pool:
         wire = sum(pool.map(one, chunks))
     dt = time.perf_counter() - t0
     raw = sum(len(c) for c in chunks)
@@ -217,17 +233,19 @@ def bench_ours(chunks) -> dict:
 
 
 def bench_baseline(chunks) -> dict:
-    """CPU reference path with the same worker parallelism."""
+    """CPU reference path with full core-level worker parallelism."""
     from concurrent.futures import ThreadPoolExecutor
 
     import zstandard
+
+    workers = min(8, os.cpu_count() or 1)
 
     def one(c: bytes) -> int:
         return len(zstandard.ZstdCompressor(level=3).compress(c))
 
     one(chunks[0])  # warm
     t0 = time.perf_counter()
-    with ThreadPoolExecutor(max_workers=N_WORKERS) as pool:
+    with ThreadPoolExecutor(max_workers=workers) as pool:
         wire = sum(pool.map(one, chunks))
     dt = time.perf_counter() - t0
     return {"seconds": dt, "raw_bytes": sum(len(c) for c in chunks), "wire_bytes": wire}
@@ -247,8 +265,11 @@ def main() -> None:
     pallas_on = maybe_enable_pallas()
 
     chunks = make_corpus()
+    log("corpus ready")
     base = bench_baseline(chunks)
+    log(f"baseline done: {base['seconds']:.2f}s")
     ours = bench_ours(chunks)
+    log(f"ours done: {ours['seconds']:.2f}s stats={ours['stats']}")
 
     gbits = ours["raw_bytes"] * 8 / 1e9
     ours_gbps = gbits / ours["seconds"]

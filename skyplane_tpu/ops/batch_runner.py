@@ -1,91 +1,39 @@
 """Micro-batching of CDC + fingerprint device work across sender workers.
 
 A gateway runs 16-32 sender workers, each processing one chunk at a time.
-On an accelerator, per-chunk device calls waste H2D round trips and run
+On an accelerator, per-chunk device calls waste dispatch round trips and run
 undersized kernels; this runner groups concurrent same-size submissions into
 one [B, N] batch (SURVEY §7 hard part #2: batching with BOUNDED latency —
 small transfers must not wait for a full batch).
 
+The batched work itself is the fused single-dispatch kernel
+(ops/fused_cdc.py): gear hash, boundary selection, and segment fingerprints
+run as ONE compiled program per batch with one small packed readback —
+critical when the accelerator sits behind a narrow readback link (tunnel /
+PCIe), and strictly fewer HBM round trips even with fast interconnect.
+
 Leader-based protocol (no dedicated thread): the first worker to open a
 batch window waits ``max_wait_ms`` for peers, then executes the batched
 kernels for everyone and distributes results. Workers arriving later join
-the open window; a full window flushes immediately.
+the open window; a full window flushes immediately. Because the leader pops
+its window before running, the next window opens (and can dispatch) while
+the previous batch is still in flight — device pipelining comes free.
 
 Enabled by DataPathProcessor when running on an accelerator with
 ``tpu_batch_chunks > 1``; pure CPU gateways keep the (faster for them)
-numpy host path.
+numpy/native host path.
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Dict, List, Optional, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
-from skyplane_tpu.ops.cdc import CDCParams, segment_ids_and_rev_pos, select_boundaries
-from skyplane_tpu.ops.fingerprint import MAX_SEGMENT_BYTES, finalize_fingerprint
-from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
-
-
-@partial(jax.jit, static_argnames=("mask_bits",))
-def _batched_candidates(batch: jax.Array, mask_bits: int) -> jax.Array:
-    """[B, N] uint8 -> [B, N] bool boundary candidates."""
-    return jax.vmap(lambda c: boundary_candidate_mask(gear_hash(c), mask_bits))(batch)
-
-
-@partial(jax.jit, static_argnames=("n_segments",))
-def _batched_segment_fp(batch: jax.Array, seg_ids: jax.Array, rev_pos: jax.Array, n_segments: int) -> jax.Array:
-    """[B, N] x per-chunk ids -> [B, n_segments, 8] uint32 lanes."""
-    from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
-
-    return jax.vmap(lambda c, s, r: segment_fingerprint_device(c, s, r, n_segments=n_segments))(batch, seg_ids, rev_pos)
-
-
-def _make_sharded_candidates(mesh, mask_bits: int):
-    """Candidate masks sharded over the gateway's device mesh: the batch dim
-    splits over ``data`` (chunk parallelism) and the byte dim over ``seq``
-    (intra-chunk parallelism) with the 31-byte gear halo exchanged via
-    ppermute over ICI — the same kernel dryrun_multichip validates."""
-    from skyplane_tpu.parallel.datapath_spmd import _gear_hash_halo
-
-    def per_shard(batch_local):
-        return jax.vmap(lambda c: boundary_candidate_mask(_gear_hash_halo(c, "seq"), mask_bits))(batch_local)
-
-    return jax.jit(
-        jax.shard_map(per_shard, mesh=mesh, in_specs=P("data", "seq"), out_specs=P("data", "seq"))
-    )
-
-
-def _make_sharded_segment_fp(mesh):
-    """Segment fingerprints sharded chunk-parallel over the ``data`` axis
-    only: seg_ids are content-defined (segments cross any fixed byte split),
-    so each device fingerprints whole chunks. Sharding over data alone keeps
-    the batch-size constraint small (max_batch % data, not % all devices —
-    a 32-chip slice must not silently inflate an 8-chunk window to 32); the
-    seq-axis replicas recompute redundantly, which is acceptable because the
-    fp kernel is a small fraction of the gear+blockpack step."""
-    from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
-
-    @partial(jax.jit, static_argnames=("n_segments",))
-    def fn(batch, seg_ids, rev_pos, n_segments: int):
-        def per_shard(b, s, r):
-            return jax.vmap(lambda c, si, rp: segment_fingerprint_device(c, si, rp, n_segments=n_segments))(b, s, r)
-
-        sm = jax.shard_map(
-            per_shard,
-            mesh=mesh,
-            in_specs=(P("data", None), P("data", None), P("data", None)),
-            out_specs=P("data", None, None),
-        )
-        return sm(batch, seg_ids, rev_pos)
-
-    return fn
+from skyplane_tpu.ops.cdc import CDCParams
+from skyplane_tpu.ops.fused_cdc import FusedCDCFP
 
 
 @dataclass(eq=False)  # identity semantics: dataclass __eq__ on ndarray fields
@@ -111,30 +59,49 @@ class DeviceBatchRunner:
         self.max_wait_s = max_wait_ms / 1000.0
         self._lock = threading.Lock()
         self._open: Dict[int, List[_Entry]] = {}  # bucket size -> entries of the open window
-        # multi-device gateway (TPU slice): run the batched kernels sharded
-        # over the mesh so ALL chips work the data path, not just chip 0
-        # (VERDICT r1 weak #4 — the SPMD path must be the production path)
+        # multi-device gateway (TPU slice): run the fused kernels sharded over
+        # the mesh so every chip works the data path, not just chip 0
+        # (VERDICT r1 weak #4 — the SPMD path must be the production path).
+        # Boundary selection is sequential per chunk, so chunks (the batch
+        # dim) are the parallel axis. Shard over ALL mesh axes when the
+        # device count fits the window; otherwise shard over the data axis
+        # only — never inflate the window by more than 2x (a 32-chip slice
+        # must not silently turn an 8-chunk window into 32 rows the 16
+        # sender workers can never fill).
         self.mesh = mesh
-        self._sharded_candidates = None
-        self._sharded_segment_fp = None
+        self.shard_axes = None
         if mesh is not None:
-            from skyplane_tpu.ops.pipeline import MIN_BUCKET
-
-            if MIN_BUCKET % mesh.shape["seq"]:
-                raise ValueError(
-                    f"mesh seq axis ({mesh.shape['seq']}) must divide the minimum chunk bucket ({MIN_BUCKET})"
+            sizes = dict(mesh.shape)
+            n_flat = int(np.prod(list(sizes.values())))
+            data_ax = sizes.get("data", n_flat)
+            if n_flat <= self.max_batch:
+                self.shard_axes = tuple(sizes.keys())
+                divisor = n_flat
+            elif data_ax <= self.max_batch:
+                self.shard_axes = ("data",)
+                divisor = data_ax
+                self._warn(
+                    f"mesh has {n_flat} devices but the batch window is {self.max_batch}: "
+                    f"sharding over the data axis only ({data_ax}); raise tpu_batch_chunks to use all chips"
                 )
-            data_ax = mesh.shape["data"]
-            if self.max_batch % data_ax:
-                # batch rows pad to max_batch, which must split over the data
-                # axis (candidates shard B over data; segment-fp likewise)
-                new_batch = ((self.max_batch + data_ax - 1) // data_ax) * data_ax
-                from skyplane_tpu.utils.logger import logger
-
-                logger.fs.warning(f"rounding max_batch {self.max_batch} -> {new_batch} to divide mesh data axis {data_ax}")
+            else:
+                self.mesh = None
+                divisor = 1
+                self._warn(
+                    f"mesh axes {sizes} exceed the {self.max_batch}-chunk batch window; running unsharded "
+                    f"— raise tpu_batch_chunks to at least the data-axis size to shard the data path"
+                )
+            if self.max_batch % divisor:
+                new_batch = ((self.max_batch + divisor - 1) // divisor) * divisor
+                self._warn(f"rounding max_batch {self.max_batch} -> {new_batch} to divide {divisor} mesh shards")
                 self.max_batch = new_batch
-            self._sharded_candidates = _make_sharded_candidates(mesh, cdc_params.mask_bits)
-            self._sharded_segment_fp = _make_sharded_segment_fp(mesh)
+        self._fused = FusedCDCFP(cdc_params, mesh=self.mesh, shard_axes=self.shard_axes)
+
+    @staticmethod
+    def _warn(msg: str) -> None:
+        from skyplane_tpu.utils.logger import logger
+
+        logger.fs.warning(msg)
 
     # ---- public API ----
 
@@ -184,53 +151,19 @@ class DeviceBatchRunner:
         try:
             # pad the batch dimension to max_batch with zero rows so XLA sees
             # ONE batch shape per bucket instead of max_batch variants (each
-            # distinct B would otherwise pay a fresh multi-second compile)
+            # distinct B would otherwise pay a fresh multi-second compile);
+            # pad rows carry n=0 and are dropped before unpacking
             rows = [e.arr for e in entries]
+            lens = [e.n for e in entries]
             n_pad_rows = self.max_batch - len(rows)
             if n_pad_rows > 0:
                 zero_row = np.zeros_like(rows[0])
                 rows = rows + [zero_row] * n_pad_rows
-            batch = jnp.asarray(np.stack(rows))  # one H2D
-            if self._sharded_candidates is not None:
-                masks = np.asarray(self._sharded_candidates(batch))
-            else:
-                masks = np.asarray(_batched_candidates(batch, self.cdc_params.mask_bits))
-            all_ends_dev: List[np.ndarray] = []
-            seg_ids_list: List[np.ndarray] = []
-            rev_pos_list: List[np.ndarray] = []
-            n_bucket = entries[0].arr.shape[0]
-            max_slots = 1
-            for e, mask in zip(entries, masks):
-                ends = select_boundaries(np.flatnonzero(mask[: e.n]), e.n, self.cdc_params)
+                lens = lens + [0] * n_pad_rows
+            results = self._fused(np.stack(rows), lens)
+            for e, (ends, fps) in zip(entries, results):
                 e.ends = ends
-                ends_dev = ends if e.n == n_bucket else np.concatenate([ends, [n_bucket]])
-                all_ends_dev.append(ends_dev)
-                while max_slots < len(ends_dev):
-                    max_slots <<= 1
-            for ends_dev in all_ends_dev:
-                seg_ids, rev_pos = segment_ids_and_rev_pos(ends_dev, n_bucket)
-                seg_ids_list.append(seg_ids)
-                rev_pos_list.append(np.minimum(rev_pos, MAX_SEGMENT_BYTES - 1))
-            for _ in range(n_pad_rows):  # pad rows: one garbage slot each
-                seg_ids_list.append(np.zeros(n_bucket, np.int32))
-                rev_pos_list.append(np.zeros(n_bucket, np.int32))
-            # slot count quantizes to a pow2 >= actual (few distinct compiles)
-            segfp = self._sharded_segment_fp if self._sharded_segment_fp is not None else _batched_segment_fp
-            lanes = np.asarray(
-                segfp(
-                    batch,
-                    jnp.asarray(np.stack(seg_ids_list)),
-                    jnp.asarray(np.stack(rev_pos_list)),
-                    n_segments=max_slots,
-                )
-            )
-            for i, e in enumerate(entries):
-                ends = e.ends
-                starts = np.concatenate([[0], ends[:-1]])
-                e.fps = [
-                    bytes.fromhex(finalize_fingerprint(lanes[i][j], int(ends[j] - starts[j])))
-                    for j in range(len(ends))
-                ]
+                e.fps = fps
         except BaseException as err:  # noqa: BLE001 — every waiter must wake
             for e in entries:
                 e.error = err

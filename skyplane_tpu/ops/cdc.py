@@ -16,10 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Tuple
 
-import jax.numpy as jnp
 import numpy as np
-
-from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
 
 
 @dataclass(frozen=True)
@@ -75,37 +72,27 @@ def select_boundaries(candidates: np.ndarray, n: int, params: CDCParams) -> np.n
     return np.asarray(ends, dtype=np.int64)
 
 
-def cdc_segment_ends(
-    data: bytes | np.ndarray, params: CDCParams = CDCParams(), device_chunk=None
-) -> np.ndarray:
-    """Full CDC for one chunk: returns segment end offsets (last == len(data)).
+def cdc_segment_ends(data: bytes | np.ndarray, params: CDCParams = CDCParams()) -> np.ndarray:
+    """Full CDC for one chunk on HOST kernels: returns segment end offsets
+    (last == len(data)).
 
-    Device gear hash on accelerators; bit-identical numpy on CPU backends.
-    ``device_chunk``, if given, is the chunk already uploaded to the device
-    (possibly zero-padded past len(data)) — callers that also fingerprint on
-    device pass it to avoid a second H2D of the same bytes. Trailing padding
-    cannot change boundaries: the mask is truncated to len(data) and gear
-    positions only look backward.
+    Native single-pass C kernel when built (~60x the numpy fallback), numpy
+    otherwise; bit-identical to the device path (ops/fused_cdc.py), which
+    production accelerator callers use instead — it avoids this function's
+    full-chunk candidate-mask materialization.
     """
     arr = np.frombuffer(data, np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) else np.asarray(data, np.uint8)
     n = len(arr)
     if n == 0:
         return np.asarray([0], dtype=np.int64)
-    from skyplane_tpu.ops.backend import on_accelerator
+    from skyplane_tpu.native import datapath as native_dp
 
-    if device_chunk is not None or on_accelerator():
-        h = gear_hash(device_chunk if device_chunk is not None else jnp.asarray(arr))
-        mask = np.asarray(boundary_candidate_mask(h, params.mask_bits))[:n]
+    if native_dp.available():
+        mask = native_dp.gear_candidates(arr, params.mask_bits)
     else:
-        from skyplane_tpu.native import datapath as native_dp
+        from skyplane_tpu.ops.host_fallback import boundary_candidates_host, gear_hash_host
 
-        if native_dp.available():
-            # single-pass C kernel (~60x the numpy fallback); bit-identical
-            mask = native_dp.gear_candidates(arr, params.mask_bits)
-        else:
-            from skyplane_tpu.ops.host_fallback import boundary_candidates_host, gear_hash_host
-
-            mask = boundary_candidates_host(gear_hash_host(arr), params.mask_bits)
+        mask = boundary_candidates_host(gear_hash_host(arr), params.mask_bits)
     candidates = np.flatnonzero(mask)
     return select_boundaries(candidates, n, params)
 

@@ -65,8 +65,13 @@ def segment_fingerprint_device(data: jax.Array, seg_ids: jax.Array, rev_pos: jax
     tables = jnp.asarray(_power_tables())  # [LANES, MAX] uint32
     b = data.astype(jnp.uint32)
 
-    def lane(table):
-        powers = table[rev_pos]  # [N] uint32
+    # unrolled per-lane loop (NOT vmap over lanes): keeps every large
+    # intermediate 1-D [N], which TPU layouts tile without padding. A lane
+    # vmap tempts XLA into [N, LANES] intermediates whose minor dim pads
+    # 8 -> 128 — a 16x HBM inflation that OOMs real chips on big batches.
+    lanes = []
+    for li in range(N_LANES):
+        powers = tables[li][rev_pos]  # [N] uint32
         terms = mulmod31(b, powers)  # [N] < 2^31
         # limb-split segment sums: 4 x 8-bit limbs, uint32 accumulators
         acc = jnp.zeros((n_segments,), jnp.uint32)
@@ -75,9 +80,50 @@ def segment_fingerprint_device(data: jax.Array, seg_ids: jax.Array, rev_pos: jax
             s = jax.ops.segment_sum(limb, seg_ids, num_segments=n_segments)  # < 2^24 * 2^8 = 2^32
             # s * 2^(8k) mod M31  (s < 2^32 -> fold first, then mulmod)
             acc = addmod31(acc, mulmod31(fold31(s), jnp.uint32((1 << (8 * k)) % M31)))
-        return acc
+        lanes.append(acc)
+    return jnp.stack(lanes, axis=-1)  # [n_segments, LANES]
 
-    return jax.vmap(lane)(tables).T  # [n_segments, LANES]
+
+@partial(jax.jit, static_argnames=("n_segments",))
+def segment_fingerprint_cumsum(
+    data: jax.Array, rev_pos: jax.Array, seg_starts: jax.Array, seg_ends: jax.Array, n_segments: int
+):
+    """Per-segment 8-lane polynomial hash for CONTIGUOUS segments, scatter-free.
+
+    Because segments tile the byte range in order, per-segment sums are
+    differences of a running prefix sum — cumsum + two tiny gathers — instead
+    of ``segment_sum``'s scatter-add, which TPU compiles poorly (sort-based
+    expansion) at multi-MiB operand sizes. Bit-identical to
+    ``segment_fingerprint_device`` (tested).
+
+    Args:
+      data:       [N] uint8 chunk bytes.
+      rev_pos:    [N] int32 reversed position within segment (end-1-i).
+      seg_starts: [n_segments] int32 start offset per slot.
+      seg_ends:   [n_segments] int32 end offset per slot (== start for empty
+                  pad slots; both clamped to [0, N]).
+      n_segments: static slot count.
+
+    Exactness: limbs are 8-bit, so a segment's limb sum is < 2^18 * 255 <
+    2^26; prefix sums wrap mod 2^32 but differences of uint32 prefix values
+    recover the exact segment sum.
+
+    Returns [n_segments, N_LANES] uint32 lane values in canonical [0, M31).
+    """
+    tables = jnp.asarray(_power_tables())  # [LANES, MAX] uint32
+    b = data.astype(jnp.uint32)
+    lanes = []
+    for li in range(N_LANES):
+        powers = tables[li][rev_pos]  # [N] uint32
+        terms = mulmod31(b, powers)  # [N] < 2^31
+        acc = jnp.zeros((n_segments,), jnp.uint32)
+        for k in range(4):
+            limb = (terms >> np.uint32(8 * k)) & np.uint32(0xFF)
+            cs = jnp.concatenate([jnp.zeros((1,), jnp.uint32), jnp.cumsum(limb)])  # [N+1], wraps mod 2^32
+            s = cs[seg_ends] - cs[seg_starts]  # exact segment sums (< 2^26)
+            acc = addmod31(acc, mulmod31(fold31(s), jnp.uint32((1 << (8 * k)) % M31)))
+        lanes.append(acc)
+    return jnp.stack(lanes, axis=-1)  # [n_segments, LANES]
 
 
 def fixed_stride_lanes(chunk, fp_seg_bytes: int, pallas=None):

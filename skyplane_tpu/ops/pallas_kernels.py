@@ -73,34 +73,51 @@ def use_pallas() -> bool:
 # ---- fixed-stride segment fingerprints ----
 
 FP_MAX_TILE = 1 << 16  # limb sums must stay < 2^24: S * 255 <= 2^24 for S <= 2^16
+SEGS_PER_BLOCK = 8  # Mosaic needs the output sublane dim divisible by 8
 
 
 def _segment_fp_kernel(data_ref, powers_ref, out_ref):
-    """One tile = one fixed-stride segment: 8-lane polynomial hash in VMEM.
+    """One grid step = SEGS_PER_BLOCK fixed-stride segments: 8-lane
+    polynomial hash in VMEM.
 
-    data_ref: [S] uint8; powers_ref: [LANES, S] uint32 (r^(S-1-i), identical
-    for every segment, so the block index is constant); out_ref: [1, LANES].
-    All arithmetic is the same u32 limb math the XLA kernel uses
-    (ops/u32.py) — TPUs have no 64-bit integer lanes.
+    data_ref: [SEGS_PER_BLOCK, S] uint8 (one row per segment); powers_ref:
+    [LANES, S] uint32 (r^(S-1-i), identical for every segment, so the block
+    index is constant); out_ref: [SEGS_PER_BLOCK, LANES]. Real-TPU Mosaic
+    lowering requires the output block's sublane dim be a multiple of 8, so
+    segments are processed eight at a time — via fori_loop, NOT a python
+    unroll: unrolling stacks every iteration's [LANES, S] temporaries into
+    one scoped-VMEM frame and blows the 16 MB budget. All arithmetic is the
+    same u32 limb math the XLA kernel uses (ops/u32.py) — TPUs have no
+    64-bit integer lanes.
     """
     from skyplane_tpu.ops.fingerprint import N_LANES
     from skyplane_tpu.ops.u32 import M31, addmod31, fold31, mulmod31
 
-    b = data_ref[:].astype(jnp.uint32)
-    terms = mulmod31(b[None, :], powers_ref[:, :])  # [LANES, S] < 2^31
-    acc = jnp.zeros((N_LANES,), jnp.uint32)
-    for k in range(4):
-        limb = (terms >> np.uint32(8 * k)) & np.uint32(0xFF)
-        s = jnp.sum(limb, axis=1)  # < S * 255 <= 2^24
-        acc = addmod31(acc, mulmod31(fold31(s.astype(jnp.uint32)), jnp.uint32((1 << (8 * k)) % M31)))
-    out_ref[0, :] = acc
+    def body(si, _):
+        b = data_ref[pl.ds(si, 1), :].astype(jnp.uint32)  # [1, S]
+        terms = mulmod31(b, powers_ref[:, :])  # [LANES, S] < 2^31
+        acc = jnp.zeros((N_LANES,), jnp.uint32)
+        for k in range(4):
+            limb = (terms >> np.uint32(8 * k)) & np.uint32(0xFF)
+            # Mosaic has no unsigned reductions; sums stay < 2^24 so int32 is exact
+            s = jnp.sum(limb.astype(jnp.int32), axis=1)
+            acc = addmod31(acc, mulmod31(fold31(s.astype(jnp.uint32)), jnp.uint32((1 << (8 * k)) % M31)))
+        out_ref[pl.ds(si, 1), :] = acc[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, SEGS_PER_BLOCK, body, 0)
 
 
 @partial(jax.jit, static_argnames=("fp_seg_bytes", "interpret"))
 def segment_fp_fixed_pallas(chunk: jax.Array, fp_seg_bytes: int, interpret: bool = False) -> jax.Array:
     """[N] uint8 -> [N/fp_seg_bytes, 8] uint32 lane values, one VMEM pass per
     segment (the XLA path materializes the [N]-sized term array to HBM per
-    lane). Bit-identical to segment_fingerprint_device on fixed strides."""
+    lane). Bit-identical to segment_fingerprint_device on fixed strides.
+
+    The segment count is padded to a multiple of SEGS_PER_BLOCK with all-zero
+    segments (sliced off the result) so the output tiling stays legal for any
+    power-of-two chunk bucket down to one segment.
+    """
     from skyplane_tpu.ops.fingerprint import N_LANES, _power_tables
 
     n = chunk.shape[0]
@@ -109,19 +126,24 @@ def segment_fp_fixed_pallas(chunk: jax.Array, fp_seg_bytes: int, interpret: bool
     if fp_seg_bytes > FP_MAX_TILE:
         raise ValueError(f"fp_seg_bytes={fp_seg_bytes} exceeds the limb-sum-safe tile {FP_MAX_TILE}")
     n_segments = n // fp_seg_bytes
+    pad_segs = -n_segments % SEGS_PER_BLOCK
+    if pad_segs:
+        chunk = jnp.concatenate([chunk, jnp.zeros((pad_segs * fp_seg_bytes,), jnp.uint8)])
+    rows = chunk.reshape(n_segments + pad_segs, fp_seg_bytes)  # one row per segment
     # r^(S-1-i) for i in [0, S): the same slice serves every segment
     powers = jnp.asarray(np.ascontiguousarray(_power_tables()[:, :fp_seg_bytes][:, ::-1]))
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _segment_fp_kernel,
-        out_shape=jax.ShapeDtypeStruct((n_segments, N_LANES), jnp.uint32),
-        grid=(n_segments,),
+        out_shape=jax.ShapeDtypeStruct((n_segments + pad_segs, N_LANES), jnp.uint32),
+        grid=((n_segments + pad_segs) // SEGS_PER_BLOCK,),
         in_specs=[
-            pl.BlockSpec((fp_seg_bytes,), lambda i: (i,)),
+            pl.BlockSpec((SEGS_PER_BLOCK, fp_seg_bytes), lambda i: (i, 0)),
             pl.BlockSpec((N_LANES, fp_seg_bytes), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, N_LANES), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((SEGS_PER_BLOCK, N_LANES), lambda i: (i, 0)),
         interpret=interpret,
-    )(chunk, powers)
+    )(rows, powers)
+    return out[:n_segments] if pad_segs else out
 
 
 def gear_hash_pallas(data_u8: jax.Array, interpret: bool = False) -> jax.Array:

@@ -23,20 +23,15 @@ from functools import partial
 from typing import List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from skyplane_tpu.chunk import Codec, WireProtocolHeader
 from skyplane_tpu.exceptions import ChecksumMismatchException, CodecException
 from skyplane_tpu.ops import blockpack
-from skyplane_tpu.ops.cdc import CDCParams, cdc_segment_ends, segment_ids_and_rev_pos
+from skyplane_tpu.ops.cdc import CDCParams, cdc_segment_ends
 from skyplane_tpu.ops.codecs import CodecSpec, get_codec, get_codec_by_id
 from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex, build_recipe, parse_recipe
-from skyplane_tpu.ops.fingerprint import (
-    finalize_fingerprint,
-    fixed_stride_lanes,
-    segment_fingerprint_device,
-)
+from skyplane_tpu.ops.fingerprint import fixed_stride_lanes
 from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
 
 MIN_BUCKET = 1 << 16  # 64 KiB
@@ -177,6 +172,7 @@ class DataPathProcessor:
         # the end-to-end chunk fingerprint — catches even a poisoned segment
         # store or a fingerprint collision, at the cost of re-hashing
         self.paranoid_verify = paranoid_verify
+        self._fused = None  # lazy FusedCDCFP for the unbatched accelerator path
         self.stats = DataPathStats()
 
     # ---- fingerprints ----
@@ -187,45 +183,14 @@ class DataPathProcessor:
 
         return on_accelerator()
 
-    def _segment_fps(self, arr: np.ndarray, ends: np.ndarray, device_chunk=None) -> List[bytes]:
-        """8-lane segment fingerprints -> 16-byte digests.
+    def _segment_fps(self, arr: np.ndarray, ends: np.ndarray) -> List[bytes]:
+        """8-lane segment fingerprints -> 16-byte digests on HOST kernels
+        (native Horner when built, numpy otherwise). Accelerator callers go
+        through FusedCDCFP instead (_cdc_and_fps), which computes boundaries
+        and fingerprints in batched device dispatches."""
+        from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
 
-        Uses the device kernel on accelerators (``device_chunk``, when given,
-        is the already-uploaded padded chunk — sharing it with the CDC pass
-        halves H2D traffic); on a CPU jax backend the vectorized numpy host
-        path is ~4x faster than XLA-CPU's segment_sum. Both produce identical
-        digests (tested)."""
-        if not self._on_accelerator():
-            from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
-
-            return segment_fingerprints_host_batch(arr, ends)
-        n = len(arr)
-        bucket = _bucket_size(n)
-        if device_chunk is None:
-            device_chunk = jnp.asarray(self._pad_to_bucket(arr))
-        # padding becomes one trailing garbage segment slot
-        ends_dev = ends if n == bucket else np.concatenate([ends, [bucket]])
-        seg_ids, rev_pos = segment_ids_and_rev_pos(ends_dev, bucket)
-        n_slots = 1
-        while n_slots < len(ends_dev):
-            n_slots <<= 1
-        from skyplane_tpu.ops.fingerprint import MAX_SEGMENT_BYTES
-
-        # clamp is only ever active for the trailing garbage pad slot — real
-        # segments are bounded by CDCParams.max_bytes <= MAX_SEGMENT_BYTES
-        lanes = np.asarray(
-            segment_fingerprint_device(
-                device_chunk,
-                jnp.asarray(seg_ids),
-                jnp.asarray(np.minimum(rev_pos, MAX_SEGMENT_BYTES - 1)),
-                n_segments=n_slots,
-            )
-        )
-        starts = np.concatenate([[0], ends[:-1]])
-        return [
-            bytes.fromhex(finalize_fingerprint(lanes[i], int(ends[i] - starts[i])))
-            for i in range(len(ends))
-        ]
+        return segment_fingerprints_host_batch(arr, ends)
 
     @staticmethod
     def _pad_to_bucket(arr: np.ndarray) -> np.ndarray:
@@ -233,9 +198,8 @@ class DataPathProcessor:
         return arr if len(arr) == bucket else np.concatenate([arr, np.zeros(bucket - len(arr), np.uint8)])
 
     def _cdc_and_fps(self, arr: np.ndarray):
-        """CDC boundaries + segment fingerprints with ONE device upload on
-        accelerators (the gear pass and the fingerprint pass read the same
-        HBM-resident chunk)."""
+        """CDC boundaries + segment fingerprints with ONE device dispatch and
+        ONE small packed readback on accelerators (ops/fused_cdc.py)."""
         if not self._on_accelerator():
             ends = cdc_segment_ends(arr, self.cdc_params)
             return ends, self._segment_fps(arr, ends)
@@ -244,9 +208,11 @@ class DataPathProcessor:
             # same bytes would fingerprint differently depending on routing
             assert self.batch_runner.cdc_params == self.cdc_params, "batch runner CDC params diverge from processor"
             return self.batch_runner.cdc_and_fps(arr, self._pad_to_bucket(arr))
-        device_chunk = jnp.asarray(self._pad_to_bucket(arr))  # single H2D for both passes
-        ends = cdc_segment_ends(arr, self.cdc_params, device_chunk=device_chunk)
-        return ends, self._segment_fps(arr, ends, device_chunk=device_chunk)
+        if self._fused is None:
+            from skyplane_tpu.ops.fused_cdc import FusedCDCFP
+
+            self._fused = FusedCDCFP(self.cdc_params)
+        return self._fused(self._pad_to_bucket(arr)[None, :], [len(arr)])[0]
 
     def _chunk_fingerprint(self, seg_fps: List[bytes], raw_len: int) -> str:
         h = hashlib.blake2b(b"".join(seg_fps) + raw_len.to_bytes(8, "little"), digest_size=16)

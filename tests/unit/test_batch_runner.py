@@ -83,3 +83,25 @@ def test_error_wakes_all_waiters():
 
     with pytest.raises(BaseException):
         runner.cdc_and_fps(bad, np.zeros(4, np.uint8))
+
+def test_mesh_axis_selection_bounds_window_inflation():
+    """A mesh larger than the batch window must not inflate the window past
+    2x: the runner falls back to data-axis-only sharding, or unsharded."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()[:8])
+    mesh = Mesh(devs.reshape(2, 4), axis_names=("data", "seq"))
+    # window smaller than the 8-device flat count but >= data axis (2)
+    runner = DeviceBatchRunner(cdc_params=PARAMS, max_batch=3, max_wait_ms=5.0, mesh=mesh)
+    assert runner.shard_axes == ("data",)
+    assert runner.max_batch == 4  # rounded to the data axis, not to 8
+    chunk = _chunk(0, n=70_000)
+    ends, fps = runner.cdc_and_fps(chunk, _pad(chunk))
+    want_ends, want_fps = _expected(chunk)
+    np.testing.assert_array_equal(ends, want_ends)
+    assert fps == want_fps
+    # window smaller than every axis: mesh is dropped entirely
+    runner2 = DeviceBatchRunner(cdc_params=PARAMS, max_batch=1, max_wait_ms=5.0, mesh=mesh)
+    assert runner2.mesh is None and runner2.max_batch == 1
