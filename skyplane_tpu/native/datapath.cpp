@@ -14,6 +14,10 @@
 #include <cstdint>
 #include <cstddef>
 
+#if defined(__AVX2__) || defined(__AVX512F__)
+#include <immintrin.h>
+#endif
+
 static const uint32_t M31 = 0x7FFFFFFFu;
 
 static inline uint32_t fold31(uint64_t x) {
@@ -27,20 +31,83 @@ extern "C" {
 
 // out_mask[i] = 1 iff the top mask_bits of the rolling gear hash at i are 0.
 // mask_bits must be in [1, 31] (the Python wrapper validates).
+//
+// The recurrence h = (h << 1) + G[b] is a 2-cycle serial dependency chain, so
+// a single stream caps well below memory speed. h_t depends on only the last
+// 32 bytes (shifts past 31 vanish), so the array splits into eight streams
+// that each warm up over the 31 bytes before their range and then run
+// interleaved — eight independent chains fill the pipeline. Bit-identical to
+// the sequential loop for every position (the warm-up reproduces the full
+// window; stream 0 starts from the same implicit zero history).
 void skydp_gear_candidates(const uint8_t* data, uint64_t n, const uint32_t* table,
                            uint32_t mask_bits, uint8_t* out_mask) {
-    uint32_t h = 0;
     const uint32_t shift = 32 - mask_bits;
-    for (uint64_t i = 0; i < n; i++) {
-        h = (h << 1) + table[data[i]];
-        out_mask[i] = (h >> shift) == 0 ? 1 : 0;
+    if (n < 1024) {
+        uint32_t h = 0;
+        for (uint64_t i = 0; i < n; i++) {
+            h = (h << 1) + table[data[i]];
+            out_mask[i] = (h >> shift) == 0 ? 1 : 0;
+        }
+        return;
+    }
+    const int S = 8;
+    const uint64_t piece = n / S;
+    uint64_t start[S];
+    uint32_t h[S];
+    for (int k = 0; k < S; k++) {
+        start[k] = k * piece;
+        h[k] = 0;
+    }
+    for (int k = 1; k < S; k++) {  // 31-byte window warm-up per stream
+        for (uint64_t i = start[k] - 31; i < start[k]; i++) h[k] = (h[k] << 1) + table[data[i]];
+    }
+    // lockstep: S independent chains. novector: with AVX-512 enabled gcc
+    // auto-vectorizes the k-loop into vpgatherdd table loads, which measure
+    // ~3x SLOWER than the scalar interleave (gathers serialize in microcode)
+#pragma GCC novector
+    for (uint64_t j = 0; j < piece; j++) {
+#pragma GCC unroll 8
+        for (int k = 0; k < S; k++) {
+            const uint64_t i = start[k] + j;
+            h[k] = (h[k] << 1) + table[data[i]];
+            out_mask[i] = (h[k] >> shift) == 0 ? 1 : 0;
+        }
+    }
+    for (uint64_t i = (uint64_t)S * piece; i < n; i++) {  // n % S tail on the last stream
+        h[S - 1] = (h[S - 1] << 1) + table[data[i]];
+        out_mask[i] = (h[S - 1] >> shift) == 0 ? 1 : 0;
     }
 }
 
+#if defined(__AVX512F__)
+// fold a u64 vector (< 2^64) into canonical [0, M31): two fold steps then a
+// masked conditional subtract. One zmm covers all 8 lanes.
+static inline __m512i fold31_zvec(__m512i x) {
+    const __m512i m31 = _mm512_set1_epi64((long long)M31);
+    x = _mm512_add_epi64(_mm512_srli_epi64(x, 31), _mm512_and_si512(x, m31));
+    x = _mm512_add_epi64(_mm512_srli_epi64(x, 31), _mm512_and_si512(x, m31));
+    const __mmask8 ge = _mm512_cmpge_epu64_mask(x, m31);
+    return _mm512_mask_sub_epi64(x, ge, x, m31);
+}
+#elif defined(__AVX2__)
+// fold a u64 vector (< 2^64) into canonical [0, M31): two fold steps then a
+// conditional subtract. Values stay < 2^32 after the first step, so the
+// signed 64-bit compare is safe.
+static inline __m256i fold31_vec(__m256i x) {
+    const __m256i m31 = _mm256_set1_epi64x((long long)M31);
+    x = _mm256_add_epi64(_mm256_srli_epi64(x, 31), _mm256_and_si256(x, m31));
+    x = _mm256_add_epi64(_mm256_srli_epi64(x, 31), _mm256_and_si256(x, m31));
+    const __m256i ge = _mm256_cmpgt_epi64(x, _mm256_set1_epi64x((long long)M31 - 1));
+    return _mm256_sub_epi64(x, _mm256_and_si256(ge, m31));
+}
+#endif
+
 // 8-lane polynomial segment fingerprints over GF(2^31-1), Horner form with
-// a stride-8 inner loop: F_{i+8} = F_i*r^8 + b_i*r^7 + ... + b_{i+6}*r +
-// b_{i+7} (mod M31) — the eight byte terms are independent, so the per-step
-// critical path is ONE mulmod per lane per 8 bytes instead of 8.
+// a stride-16 inner loop: F_{i+16} = F_i*r^16 + sum_j b_{i+j}*r^(15-j)
+// (mod M31) — the byte terms are independent, so the per-step critical path
+// is ONE mulmod per lane per 16 bytes instead of 16. With AVX2 the eight
+// lanes run as two 4x-u64 vectors (vpmuludq multiplies the u32 halves);
+// without it, the scalar loop below computes the identical values.
 // ends: n_ends segment end offsets (last == n); out_lanes: [n_ends][8] u32.
 void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
                       uint64_t n_ends, const uint32_t* bases, uint32_t* out_lanes) {
@@ -50,6 +117,20 @@ void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
         rp[0][l] = bases[l] >= M31 ? bases[l] - M31 : bases[l];
         for (int k = 1; k < 16; k++) rp[k][l] = fold31((uint64_t)rp[k - 1][l] * rp[0][l]);
     }
+#if defined(__AVX512F__)
+    __m512i rpz[16];  // rp as u64 lanes: one zmm covers all 8 lanes
+    for (int k = 0; k < 16; k++) {
+        rpz[k] = _mm512_set_epi64(rp[k][7], rp[k][6], rp[k][5], rp[k][4],
+                                  rp[k][3], rp[k][2], rp[k][1], rp[k][0]);
+    }
+#elif defined(__AVX2__)
+    __m256i rpv[16][2];  // rp as u64 lanes: [k][0] = lanes 0-3, [k][1] = lanes 4-7
+    for (int k = 0; k < 16; k++) {
+        for (int v = 0; v < 2; v++) {
+            rpv[k][v] = _mm256_set_epi64x(rp[k][4 * v + 3], rp[k][4 * v + 2], rp[k][4 * v + 1], rp[k][4 * v]);
+        }
+    }
+#endif
     int64_t start = 0;
     for (uint64_t s = 0; s < n_ends; s++) {
         const int64_t end = ends[s];
@@ -62,6 +143,87 @@ void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
             const uint64_t b = data[i];
             for (int l = 0; l < 8; l++) f[l] = fold31((uint64_t)f[l] * rp[0][l] + b);
         }
+#if defined(__AVX512F__)
+        __m512i fz = _mm512_set_epi64(f[7], f[6], f[5], f[4], f[3], f[2], f[1], f[0]);
+        for (; i + 16 <= end; i += 16) {
+            // one zmm multiply covers all 8 lanes: 1 vpmuludq per byte term
+            __m512i hi = _mm512_add_epi64(
+                _mm512_mul_epu32(fz, rpz[15]),
+                _mm512_add_epi64(
+                    _mm512_mul_epu32(_mm512_set1_epi64(data[i + 0]), rpz[14]),
+                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 1]), rpz[13]),
+                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 2]), rpz[12]))));
+            __m512i mid = _mm512_add_epi64(
+                _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 3]), rpz[11]),
+                                 _mm512_mul_epu32(_mm512_set1_epi64(data[i + 4]), rpz[10])),
+                _mm512_add_epi64(
+                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 5]), rpz[9]),
+                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 6]), rpz[8])),
+                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 7]), rpz[7]),
+                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 8]), rpz[6]))));
+            __m512i lo = _mm512_add_epi64(
+                _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 9]), rpz[5]),
+                                 _mm512_mul_epu32(_mm512_set1_epi64(data[i + 10]), rpz[4])),
+                _mm512_add_epi64(
+                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 11]), rpz[3]),
+                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 12]), rpz[2])),
+                    _mm512_add_epi64(
+                        _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 13]), rpz[1]),
+                                         _mm512_mul_epu32(_mm512_set1_epi64(data[i + 14]), rpz[0])),
+                        _mm512_set1_epi64(data[i + 15]))));
+            fz = fold31_zvec(_mm512_add_epi64(
+                fold31_zvec(hi), _mm512_add_epi64(fold31_zvec(mid), fold31_zvec(lo))));
+        }
+        {
+            uint64_t tmp[8];
+            _mm512_storeu_si512((void*)tmp, fz);
+            for (int j = 0; j < 8; j++) f[j] = (uint32_t)tmp[j];
+        }
+#elif defined(__AVX2__)
+        __m256i fv[2];
+        for (int v = 0; v < 2; v++)
+            fv[v] = _mm256_set_epi64x(f[4 * v + 3], f[4 * v + 2], f[4 * v + 1], f[4 * v]);
+        for (; i + 16 <= end; i += 16) {
+            __m256i bb[15];
+            for (int j = 0; j < 15; j++) bb[j] = _mm256_set1_epi64x(data[i + j]);
+            const __m256i b15 = _mm256_set1_epi64x(data[i + 15]);
+            for (int v = 0; v < 2; v++) {
+                // hi carries the only f-dependent product (< 2^62 + 3*2^39);
+                // mid/lo sum byte products (< 2^39 each) — no u64 overflow
+                __m256i hi = _mm256_add_epi64(
+                    _mm256_mul_epu32(fv[v], rpv[15][v]),
+                    _mm256_add_epi64(
+                        _mm256_mul_epu32(bb[0], rpv[14][v]),
+                        _mm256_add_epi64(_mm256_mul_epu32(bb[1], rpv[13][v]),
+                                         _mm256_mul_epu32(bb[2], rpv[12][v]))));
+                __m256i mid = _mm256_add_epi64(
+                    _mm256_add_epi64(_mm256_mul_epu32(bb[3], rpv[11][v]),
+                                     _mm256_mul_epu32(bb[4], rpv[10][v])),
+                    _mm256_add_epi64(
+                        _mm256_add_epi64(_mm256_mul_epu32(bb[5], rpv[9][v]),
+                                         _mm256_mul_epu32(bb[6], rpv[8][v])),
+                        _mm256_add_epi64(_mm256_mul_epu32(bb[7], rpv[7][v]),
+                                         _mm256_mul_epu32(bb[8], rpv[6][v]))));
+                __m256i lo = _mm256_add_epi64(
+                    _mm256_add_epi64(_mm256_mul_epu32(bb[9], rpv[5][v]),
+                                     _mm256_mul_epu32(bb[10], rpv[4][v])),
+                    _mm256_add_epi64(
+                        _mm256_add_epi64(_mm256_mul_epu32(bb[11], rpv[3][v]),
+                                         _mm256_mul_epu32(bb[12], rpv[2][v])),
+                        _mm256_add_epi64(
+                            _mm256_add_epi64(_mm256_mul_epu32(bb[13], rpv[1][v]),
+                                             _mm256_mul_epu32(bb[14], rpv[0][v])),
+                            b15)));
+                fv[v] = fold31_vec(_mm256_add_epi64(
+                    fold31_vec(hi), _mm256_add_epi64(fold31_vec(mid), fold31_vec(lo))));
+            }
+        }
+        for (int v = 0; v < 2; v++) {
+            uint64_t tmp[4];
+            _mm256_storeu_si256((__m256i*)tmp, fv[v]);
+            for (int j = 0; j < 4; j++) f[4 * v + j] = (uint32_t)tmp[j];
+        }
+#else
         for (; i + 16 <= end; i += 16) {
             uint64_t b[16];
             for (int j = 0; j < 16; j++) b[j] = data[i + j];
@@ -81,6 +243,7 @@ void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
                 f[l] = fold31((uint64_t)fold31(hi) + fold31(mid) + fold31(lo));
             }
         }
+#endif
         uint32_t* out = out_lanes + s * 8;
         for (int l = 0; l < 8; l++) out[l] = f[l];
         start = end;

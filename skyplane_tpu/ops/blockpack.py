@@ -116,18 +116,23 @@ def encode_container(data: bytes, block_bytes: int = DEFAULT_BLOCK_BYTES) -> byt
     arr = np.frombuffer(data, np.uint8)
     if pad:
         arr = np.concatenate([arr, np.zeros(pad, np.uint8)])
-    from skyplane_tpu.ops.backend import on_accelerator
+    from skyplane_tpu.native import datapath as native_dp
 
-    if on_accelerator():
-        tags, literals, n_lit = encode_device(jnp.asarray(arr), block_bytes=block_bytes)
-        tags_np = np.asarray(tags)
-        n_lit = int(n_lit)
-        lit_np = np.asarray(literals[:n_lit]) if n_lit else np.empty(0, np.uint8)
+    if native_dp.available():
+        # the native single-pass kernel runs at memcpy speed; the device
+        # kernel would have to pull the (data-sized) literal stream back over
+        # the host link, which costs more than the whole host pass even on
+        # PCIe — and catastrophically more over a tunnel. The device kernel
+        # stays the path for device-resident consumers (datapath_step).
+        tags_np, lit_np, n_lit = native_dp.blockpack_encode(arr, block_bytes)
     else:
-        from skyplane_tpu.native import datapath as native_dp
+        from skyplane_tpu.ops.backend import on_accelerator
 
-        if native_dp.available():
-            tags_np, lit_np, n_lit = native_dp.blockpack_encode(arr, block_bytes)
+        if on_accelerator():
+            tags, literals, n_lit = encode_device(jnp.asarray(arr), block_bytes=block_bytes)
+            tags_np = np.asarray(tags)
+            n_lit = int(n_lit)
+            lit_np = np.asarray(literals[:n_lit]) if n_lit else np.empty(0, np.uint8)
         else:
             from skyplane_tpu.ops.host_fallback import blockpack_encode_host
 
@@ -161,18 +166,20 @@ def decode_container(buf: bytes) -> bytes:
     literals = np.frombuffer(buf[off + tag_bytes : off + tag_bytes + n_lit], np.uint8)
     if len(literals) != n_lit:
         raise CodecException("truncated blockpack container")
-    from skyplane_tpu.ops.backend import on_accelerator
+    from skyplane_tpu.native import datapath as native_dp
 
-    if on_accelerator():
-        # device gather expects a static-size literal buffer >= any index it reads
-        lit_padded = np.zeros(max(n_padded, 1), np.uint8)
-        lit_padded[:n_lit] = literals
-        out = np.asarray(decode_device(jnp.asarray(tags), jnp.asarray(lit_padded), block_bytes=block_bytes))
+    if native_dp.available():
+        # memcpy-speed host kernel; the device path would pull the whole
+        # decoded chunk back over the host link (see encode_container)
+        out = native_dp.blockpack_decode(tags, literals, block_bytes)
     else:
-        from skyplane_tpu.native import datapath as native_dp
+        from skyplane_tpu.ops.backend import on_accelerator
 
-        if native_dp.available():
-            out = native_dp.blockpack_decode(tags, literals, block_bytes)
+        if on_accelerator():
+            # device gather expects a static-size literal buffer >= any index it reads
+            lit_padded = np.zeros(max(n_padded, 1), np.uint8)
+            lit_padded[:n_lit] = literals
+            out = np.asarray(decode_device(jnp.asarray(tags), jnp.asarray(lit_padded), block_bytes=block_bytes))
         else:
             from skyplane_tpu.ops.host_fallback import blockpack_decode_host
 
