@@ -373,6 +373,7 @@ class GatewayDaemon:
                 window=int(os.environ.get("SKYPLANE_TPU_SENDER_WINDOW", op.get("window", 16))),
                 api_token=self.api_token,
                 control_tls=self.control_tls,
+                source_gateway_id=self.gateway_id,
             )
         raise ValueError(f"unknown operator type {op_type!r}")
 

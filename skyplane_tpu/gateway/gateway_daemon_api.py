@@ -76,6 +76,7 @@ class GatewayDaemonAPI:
         self.api_token = api_token
 
         self._lock = threading.Lock()
+        self._dedup_sources: set = set()  # distinct source gateway ids seen on /servers
         self.chunk_requests: Dict[str, dict] = {}  # chunk_id -> chunk request dict
         self.chunk_status: Dict[str, str] = {}  # chunk_id -> latest aggregate state
         self.chunk_status_log: List[dict] = []
@@ -333,8 +334,26 @@ class GatewayDaemonAPI:
             self.shutdown_requested.set()
             req._send(200, {"status": "shutting down"})
         elif path == "/api/v1/servers":
+            # body (optional): {"source_gateway_id": ...} — lets the receiver
+            # count distinct sources and advertise its dedup capacity so each
+            # sender bounds its fingerprint index to a fair share (several
+            # source gateways sharing one sink must not collectively believe
+            # more segments resident than the sink can retain)
+            try:
+                body = req._read_json()
+            except Exception:  # noqa: BLE001 — body is optional
+                body = None
+            src = (body or {}).get("source_gateway_id") if isinstance(body, dict) else None
+            with self._lock:
+                if src:
+                    self._dedup_sources.add(str(src))
+                n_sources = len(self._dedup_sources)
             port = self.receiver.start_server()
-            req._send(200, {"server_port": port})
+            resp = {"server_port": port, "n_sources": n_sources}
+            store = getattr(self.receiver, "segment_store", None)
+            if store is not None:
+                resp["dedup_capacity_bytes"] = store.capacity_bytes
+            req._send(200, resp)
         elif path == "/api/v1/chunk_requests":
             body = req._read_json()
             if not isinstance(body, list):

@@ -369,6 +369,7 @@ class GatewaySenderOperator(GatewayOperator):
         window_bytes: int = 256 << 20,
         api_token: Optional[str] = None,
         control_tls: bool = False,
+        source_gateway_id: Optional[str] = None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -380,6 +381,7 @@ class GatewaySenderOperator(GatewayOperator):
             codec_name=codec_name, dedup=dedup, cdc_params=cdc_params, batch_runner=batch_runner
         )
         self.dedup_index = SenderDedupIndex() if dedup else None
+        self.source_gateway_id = source_gateway_id
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
         self.window = max(1, int(window))
         self.window_bytes = int(window_bytes)
@@ -400,10 +402,17 @@ class GatewaySenderOperator(GatewayOperator):
         return f"{scheme}://{self.target_host}:{self.target_control_port}/api/v1"
 
     def _make_socket(self) -> socket.socket:
-        # ask the remote gateway for an ephemeral data port (reference :225-246)
-        resp = self._session.post(f"{self._control_base}/servers", timeout=30)
+        # ask the remote gateway for an ephemeral data port (reference :225-246),
+        # identifying this source so the sink can count distinct sources
+        resp = self._session.post(
+            f"{self._control_base}/servers",
+            json={"source_gateway_id": self.source_gateway_id} if self.source_gateway_id else None,
+            timeout=30,
+        )
         resp.raise_for_status()
-        port = resp.json()["server_port"]
+        info = resp.json()
+        port = info["server_port"]
+        self._apply_dedup_budget(info)
         sock = socket.create_connection((self.target_host, port), timeout=30)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if self.use_tls:
@@ -413,6 +422,21 @@ class GatewaySenderOperator(GatewayOperator):
             sock = ctx.wrap_socket(sock)
         self._local.port = port
         return sock
+
+    def _apply_dedup_budget(self, server_info: dict) -> None:
+        """Split the sink's advertised segment-store capacity fairly across
+        the distinct source gateways it has seen: k senders each believing
+        16 GiB resident against a 36 GiB sink would REF segments the sink
+        already evicted. Half the fair share leaves headroom for sources the
+        sink has not met yet and for eviction-order skew; re-applied on every
+        /servers call so late-joining sources shrink existing budgets."""
+        if self.dedup_index is None:
+            return
+        capacity = server_info.get("dedup_capacity_bytes")
+        if not capacity:
+            return
+        n_sources = max(1, int(server_info.get("n_sources", 1)))
+        self.dedup_index.set_max_bytes(max(1 << 20, capacity // (2 * n_sources)))
 
     def _sock(self) -> socket.socket:
         if getattr(self._local, "sock", None) is None:

@@ -82,6 +82,20 @@ class SenderDedupIndex:
             if size is not None:
                 self._bytes -= size
 
+    def set_max_bytes(self, max_bytes: int) -> None:
+        """Rebound the index (multi-source capacity split: each sender takes a
+        fair share of the receiver's advertised segment-store capacity).
+        Shrinking evicts oldest entries immediately."""
+        with self._lock:
+            self._max_bytes = max(1, int(max_bytes))
+            while self._bytes > self._max_bytes and self._lru:
+                _, old_size = self._lru.popitem(last=False)
+                self._bytes -= old_size
+
+    @property
+    def max_bytes(self) -> int:
+        return self._max_bytes
+
 
 class SegmentStore:
     """Receiver-side fingerprint -> segment bytes store.
@@ -182,6 +196,12 @@ class SegmentStore:
             return True
         p = self._spill_path(fp)
         return p is not None and p.exists()
+
+    @property
+    def capacity_bytes(self) -> int:
+        """Total retention capacity (memory + spill) — advertised to source
+        gateways so their SenderDedupIndex bounds split it fairly."""
+        return self._max_bytes + (self._spill_max_bytes if self._spill_dir else 0)
 
 
 def build_recipe(

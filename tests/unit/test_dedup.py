@@ -157,3 +157,41 @@ def test_paranoid_verify_catches_poisoned_store():
     lax = DataPathProcessor(codec_name="none", dedup=True, paranoid_verify=False)
     corrupted = lax.restore(p2.wire_bytes, hdr2, store=store)
     assert corrupted != data
+
+
+def test_sender_index_rebound_evicts():
+    from skyplane_tpu.ops.dedup import SenderDedupIndex
+
+    idx = SenderDedupIndex(max_bytes=1000)
+    for i in range(10):
+        idx.add(bytes([i]) * 16, 100)
+    assert len(idx) == 10
+    idx.set_max_bytes(350)  # shrink: oldest entries evicted immediately
+    assert len(idx) == 3
+    assert bytes([9]) * 16 in idx and bytes([0]) * 16 not in idx
+    assert idx.max_bytes == 350
+
+
+def test_segment_store_capacity_advertised(tmp_path):
+    from skyplane_tpu.ops.dedup import SegmentStore
+
+    assert SegmentStore(max_bytes=100).capacity_bytes == 100  # no spill dir
+    store = SegmentStore(max_bytes=100, spill_dir=tmp_path, spill_max_bytes=900)
+    assert store.capacity_bytes == 1000
+
+
+def test_multi_source_budget_split():
+    """Each sender's index shrinks to capacity/(2*n_sources) as the sink
+    reports more distinct sources."""
+    from skyplane_tpu.gateway.operators.gateway_operator import GatewaySenderOperator
+
+    op = GatewaySenderOperator.__new__(GatewaySenderOperator)  # no daemon wiring
+    from skyplane_tpu.ops.dedup import SenderDedupIndex
+
+    op.dedup_index = SenderDedupIndex(max_bytes=16 << 30)
+    op._apply_dedup_budget({"dedup_capacity_bytes": 36 << 30, "n_sources": 3})
+    assert op.dedup_index.max_bytes == 6 << 30
+    op._apply_dedup_budget({})  # no capacity info: budget unchanged
+    assert op.dedup_index.max_bytes == 6 << 30
+    op.dedup_index = None
+    op._apply_dedup_budget({"dedup_capacity_bytes": 1})  # dedup off: no-op
