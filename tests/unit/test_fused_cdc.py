@@ -78,3 +78,36 @@ class TestFusedMatchesHost:
         want_ends, want_fps = _expected(chunk, params)
         np.testing.assert_array_equal(ends, want_ends)
         assert fps == want_fps
+
+
+def test_fuzz_params_and_lengths():
+    """Seeded sweep over CDC params x lengths x content shapes: the fused
+    path must be bit-identical to the host path everywhere."""
+    r = np.random.default_rng(1234)
+    param_sets = [
+        CDCParams(min_bytes=512, avg_bytes=2048, max_bytes=8192),
+        CDCParams(min_bytes=4096, avg_bytes=16384, max_bytes=65536),
+        CDCParams(min_bytes=1024, avg_bytes=1024, max_bytes=4096),  # min == avg
+        CDCParams(min_bytes=2048, avg_bytes=8192, max_bytes=8192),  # avg == max
+    ]
+    for params in param_sets:
+        fused = FusedCDCFP(params, pallas=False)
+        lens = [int(x) for x in r.integers(1, 1 << 17, 4)] + [1 << 16, 5]
+        chunks = []
+        for i, n in enumerate(lens):
+            if i % 3 == 0:
+                c = r.integers(0, 256, n, dtype=np.uint8)
+            elif i % 3 == 1:
+                pat = r.integers(0, 256, max(1, n // 7), dtype=np.uint8)
+                c = np.tile(pat, 8)[:n].copy()
+            else:
+                c = np.zeros(n, np.uint8)
+                c[:: max(1, n // 50)] = r.integers(1, 256)
+            chunks.append(c)
+        bucket = 1 << 17
+        batch = np.stack([_pad(c, bucket) for c in chunks])
+        results = fused(batch, [len(c) for c in chunks])
+        for c, (ends, fps) in zip(chunks, results):
+            want_ends, want_fps = _expected(c, params)
+            np.testing.assert_array_equal(ends, want_ends)
+            assert fps == want_fps
