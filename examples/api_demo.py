@@ -4,6 +4,11 @@ Copies a prefix between object stores with the TPU data path enabled,
 reporting dedup/compression stats afterwards.
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # run from a checkout without installing
+
 from skyplane_tpu import SkyplaneClient, TransferConfig
 
 client = SkyplaneClient(

@@ -6,6 +6,11 @@ job starts; sync is idempotent delta-copy, so re-running costs nothing when
 the data is already current.
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # run from a checkout without installing
+
 import jax
 
 from skyplane_tpu import SkyplaneClient, TransferConfig
