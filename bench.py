@@ -77,28 +77,40 @@ def probe_device() -> str:
     return "cpu"
 
 
-def maybe_enable_pallas() -> bool:
-    """On a real accelerator, validate the Pallas gear kernel against the XLA
-    path on-device and enable it for the benchmark run if bit-identical."""
+def maybe_enable_pallas() -> dict:
+    """On a real accelerator, validate each Pallas kernel against the XLA
+    path on-device and enable it for the benchmark run if bit-identical.
+
+    Per-kernel: the gear and fingerprint kernels lower independently through
+    Mosaic, so one failing must not disable the other (round-2 finding: the
+    fp kernel's first formulation failed Mosaic while gear compiled fine)."""
     import jax
     import numpy as np_
 
+    enabled = {"gear": False, "fp": False}
     if jax.devices()[0].platform == "cpu":
-        return False
+        return enabled
     if os.environ.get("SKYPLANE_TPU_USE_PALLAS", "").strip().lower() in ("0", "false", "off"):
-        return False  # explicit opt-out wins (same normalization as use_pallas)
+        return enabled  # explicit opt-out wins (same normalization as use_pallas)
+    import jax.numpy as jnp
+
+    rng = np_.random.default_rng(7)
     try:
-        import jax.numpy as jnp
-
-        from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
         from skyplane_tpu.ops.gear import _windowed_sum_doubling
-        from skyplane_tpu.ops.pallas_kernels import TILE, gear_windowed_sum_pallas, segment_fp_fixed_pallas
+        from skyplane_tpu.ops.pallas_kernels import TILE, gear_windowed_sum_pallas
 
-        rng = np_.random.default_rng(7)
         data = jnp.asarray(rng.integers(0, 2**32, size=2 * TILE, dtype=np_.uint32))
         want = np_.asarray(_windowed_sum_doubling(data))
         got = np_.asarray(gear_windowed_sum_pallas(data))
-        gear_ok = np_.array_equal(want, got)
+        enabled["gear"] = np_.array_equal(want, got)
+        if not enabled["gear"]:
+            log("WARN: pallas gear kernel mismatch on device; gear stays on XLA path")
+    except Exception as e:  # noqa: BLE001 — pallas failure must not kill the bench
+        log(f"WARN: pallas gear validation failed ({e}); gear stays on XLA path")
+    try:
+        from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
+        from skyplane_tpu.ops.pallas_kernels import segment_fp_fixed_pallas
+
         # fingerprint kernel: compare against the XLA limb path on device at
         # the PRODUCTION tile size (datapath_step default) — a smaller tile
         # would validate a different Mosaic lowering than the one that runs
@@ -109,18 +121,17 @@ def maybe_enable_pallas() -> bool:
             segment_fingerprint_device(fp_data, jnp.asarray(pos // S), jnp.asarray(S - 1 - (pos % S)), n_segments=4)
         )
         fp_got = np_.asarray(segment_fp_fixed_pallas(fp_data, S))
-        fp_ok = np_.array_equal(fp_want, fp_got)
-        if gear_ok and fp_ok:
-            os.environ["SKYPLANE_TPU_USE_PALLAS"] = "1"
-            log("pallas gear + fingerprint kernels validated on device: enabled")
-            return True
-        log(f"WARN: pallas kernel mismatch on device (gear_ok={gear_ok} fp_ok={fp_ok}); staying on XLA path")
-    except Exception as e:  # noqa: BLE001 — pallas failure must not kill the bench
-        log(f"WARN: pallas validation failed ({e}); staying on XLA path")
-    # validation failed: make sure a pre-exported =1 cannot silently run the
-    # unvalidated kernel while the result reports pallas: false
-    os.environ["SKYPLANE_TPU_USE_PALLAS"] = "0"
-    return False
+        enabled["fp"] = np_.array_equal(fp_want, fp_got)
+        if not enabled["fp"]:
+            log("WARN: pallas fp kernel mismatch on device; fp stays on XLA path")
+    except Exception as e:  # noqa: BLE001
+        log(f"WARN: pallas fp validation failed ({e}); fp stays on XLA path")
+    # set BOTH per-kernel flags explicitly: a pre-exported master =1 must not
+    # silently run an unvalidated kernel while the result reports it off
+    for k, ok in enabled.items():
+        os.environ[f"SKYPLANE_TPU_USE_PALLAS_{k.upper()}"] = "1" if ok else "0"
+    log(f"pallas kernels validated on device: {enabled}")
+    return enabled
 
 
 WRITE_SITE_FRAC = 0.004  # clustered write sites between snapshots
@@ -281,7 +292,7 @@ def main() -> None:
         "vs_baseline": round(ours_gbps / base_gbps, 3),
         "baseline_gbps": round(base_gbps, 3),
         "platform": dev_platform,
-        "pallas": pallas_on,
+        "pallas": pallas_on,  # {"gear": bool, "fp": bool}
         "wire_reduction_ours": round(ours["raw_bytes"] / max(ours["wire_bytes"], 1), 2),
         "wire_reduction_baseline": round(base["raw_bytes"] / max(base["wire_bytes"], 1), 2),
     }

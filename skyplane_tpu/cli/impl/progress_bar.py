@@ -2,6 +2,12 @@
 
 Reference parity: skyplane/cli/impl/progress_bar.py — dispatch spinner +
 per-destination-region transfer bars.
+
+Defensive by design: hook methods are called from the tracker thread while
+rich renders from its own refresh thread, and a multi-job transfer replays
+the dispatch_start -> dispatched -> dispatch_end sequence once per job. Every
+update therefore tolerates a missing/removed task instead of crashing the
+transfer (a progress bar must never fail a delivered transfer).
 """
 
 from __future__ import annotations
@@ -24,26 +30,50 @@ class ProgressBarTransferHook(TransferHook):
             TransferSpeedColumn(),
             transient=True,
         )
-        self.dispatch_task = self.progress.add_task("dispatching chunks", total=None)
+        self.dispatch_task: Optional[int] = None
         self.transfer_task: Optional[int] = None
         self.total_bytes = 0
         self.chunk_sizes: Dict[str, int] = {}
-        self.progress.start()
+        try:
+            self.progress.start()
+        except Exception:  # noqa: BLE001 - another live display may be active
+            pass
+        self.dispatch_task = self.progress.add_task("dispatching chunks", total=None)
+
+    def _update(self, task: Optional[int], **kwargs) -> None:
+        if task is None:
+            return
+        try:
+            self.progress.update(task, **kwargs)
+        except KeyError:  # task removed (job boundary / render race): ignore
+            pass
+
+    def on_dispatch_start(self) -> None:
+        if self.dispatch_task is None:  # job 2..n of a multi-job transfer
+            self.dispatch_task = self.progress.add_task("dispatching chunks", total=None)
 
     def on_chunk_dispatched(self, chunks: List) -> None:
         for c in chunks:
             self.chunk_sizes[c.chunk_id] = c.chunk_length_bytes
             self.total_bytes += c.chunk_length_bytes
-        self.progress.update(self.dispatch_task, advance=len(chunks))
+        self._update(self.dispatch_task, advance=len(chunks))
 
     def on_dispatch_end(self) -> None:
-        self.progress.remove_task(self.dispatch_task)
-        self.transfer_task = self.progress.add_task("transferring", total=self.total_bytes)
+        if self.dispatch_task is not None:
+            try:
+                self.progress.remove_task(self.dispatch_task)
+            except KeyError:
+                pass
+            self.dispatch_task = None
+        if self.transfer_task is None:
+            self.transfer_task = self.progress.add_task("transferring", total=self.total_bytes)
+        else:  # later job raised the byte total
+            self._update(self.transfer_task, total=self.total_bytes)
 
     def on_chunk_completed(self, chunks: List, region_tag: Optional[str] = None) -> None:
         if self.transfer_task is not None:
             done = sum(self.chunk_sizes.get(c if isinstance(c, str) else c.chunk_id, 0) for c in chunks)
-            self.progress.update(self.transfer_task, advance=done)
+            self._update(self.transfer_task, advance=done)
 
     def on_transfer_end(self) -> None:
         self.progress.stop()

@@ -141,7 +141,7 @@ def fixed_stride_lanes(chunk, fp_seg_bytes: int, pallas=None):
         from skyplane_tpu.ops.backend import on_accelerator
         from skyplane_tpu.ops.pallas_kernels import use_pallas
 
-        pallas = use_pallas() and on_accelerator()
+        pallas = use_pallas("fp") and on_accelerator()
     if pallas:
         from skyplane_tpu.ops.pallas_kernels import FP_MAX_TILE, segment_fp_fixed_pallas
 

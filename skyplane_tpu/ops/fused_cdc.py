@@ -139,7 +139,7 @@ class FusedCDCFP:
             from skyplane_tpu.ops.backend import on_accelerator
             from skyplane_tpu.ops.pallas_kernels import use_pallas
 
-            pallas = bool(use_pallas() and on_accelerator())
+            pallas = bool(use_pallas("gear") and on_accelerator())
         self.pallas = bool(pallas)
         self.mesh = mesh
         self.shard_axes = tuple(shard_axes) if shard_axes else (tuple(mesh.shape.keys()) if mesh is not None else None)

@@ -70,7 +70,7 @@ def gear_hash(data_u8: jax.Array, pallas: bool | None = None) -> jax.Array:
         # pallas_call only lowers on real accelerators, so gate on backend
         from skyplane_tpu.ops.backend import on_accelerator
 
-        pallas = use_pallas() and on_accelerator()
+        pallas = use_pallas("gear") and on_accelerator()
     if pallas and g.shape[0] % TILE == 0:
         return gear_windowed_sum_pallas(g)
     return _windowed_sum_doubling(g)

@@ -66,46 +66,74 @@ def gear_windowed_sum_pallas(g: jax.Array, interpret: bool = False) -> jax.Array
     )(padded, padded)
 
 
-def use_pallas() -> bool:
-    return os.environ.get("SKYPLANE_TPU_USE_PALLAS", "0").strip() in ("1", "true", "on")
+def use_pallas(kernel: str = "") -> bool:
+    """Master flag SKYPLANE_TPU_USE_PALLAS, overridable per kernel with
+    SKYPLANE_TPU_USE_PALLAS_{GEAR,FP}: the kernels lower independently on
+    real Mosaic toolchains, so one failing validation must not disable the
+    other (bench.py validates and sets each on device)."""
+    if kernel:
+        v = os.environ.get(f"SKYPLANE_TPU_USE_PALLAS_{kernel.upper()}", "").strip().lower()
+        if v:
+            return v in ("1", "true", "on")
+    return os.environ.get("SKYPLANE_TPU_USE_PALLAS", "0").strip().lower() in ("1", "true", "on")
 
 
 # ---- fixed-stride segment fingerprints ----
 
-FP_MAX_TILE = 1 << 16  # limb sums must stay < 2^24: S * 255 <= 2^24 for S <= 2^16
+FP_MAX_TILE = 1 << 16  # powers-slice VMEM budget: [8, S] u32 = 2 MiB at 2^16 (limb sums are bounded per sub-tile now)
 SEGS_PER_BLOCK = 8  # Mosaic needs the output sublane dim divisible by 8
+FP_SUB_TILE = 1 << 13  # uint8 columns per grid step: bounds live VMEM temporaries
 
 
 def _segment_fp_kernel(data_ref, powers_ref, out_ref):
-    """One grid step = SEGS_PER_BLOCK fixed-stride segments: 8-lane
-    polynomial hash in VMEM.
+    """One grid step = SEGS_PER_BLOCK segments x FP_SUB_TILE byte columns of
+    the 8-lane polynomial hash, accumulated across the column grid axis.
 
-    data_ref: [SEGS_PER_BLOCK, S] uint8 (one row per segment); powers_ref:
-    [LANES, S] uint32 (r^(S-1-i), identical for every segment, so the block
-    index is constant); out_ref: [SEGS_PER_BLOCK, LANES]. Real-TPU Mosaic
-    lowering requires the output block's sublane dim be a multiple of 8, so
-    segments are processed eight at a time — via fori_loop, NOT a python
-    unroll: unrolling stacks every iteration's [LANES, S] temporaries into
-    one scoped-VMEM frame and blows the 16 MB budget. All arithmetic is the
-    same u32 limb math the XLA kernel uses (ops/u32.py) — TPUs have no
-    64-bit integer lanes.
+    data_ref: [SEGS_PER_BLOCK, SUB] uint8 (row = segment, cols = sub-range j
+    of the segment); powers_ref: [LANES, SUB] uint32 (r^(S-1-i) slice for
+    sub-range j — shared by every segment row); out_ref:
+    [SEGS_PER_BLOCK, LANES], revisited for every j (TPU grids iterate the
+    minor axis innermost, so accumulation is race-free).
+
+    Mosaic constraints shape the whole kernel: no dynamic sublane slicing
+    (lane rows are selected with an iota mask + cross-sublane sum), no
+    unsigned reductions (limb sums stay < 2^21 so int32 is exact), and all
+    block dims static multiples of (8, 128). Lanes run under a fori_loop so
+    only one [SEGS, SUB] term array is live at a time; the column grid axis
+    keeps that array at most ~256 KiB regardless of segment size. The u32
+    field arithmetic is the same limb math as the XLA kernel (ops/u32.py) —
+    TPUs have no 64-bit integer lanes. Per-column partial lane sums are
+    congruent mod M31 by distributivity, and fold31/addmod31 keep values
+    canonical, so results are bit-identical to segment_fingerprint_device.
     """
     from skyplane_tpu.ops.fingerprint import N_LANES
     from skyplane_tpu.ops.u32 import M31, addmod31, fold31, mulmod31
 
-    def body(si, _):
-        b = data_ref[pl.ds(si, 1), :].astype(jnp.uint32)  # [1, S]
-        terms = mulmod31(b, powers_ref[:, :])  # [LANES, S] < 2^31
-        acc = jnp.zeros((N_LANES,), jnp.uint32)
+    j = pl.program_id(1)
+    data = data_ref[:, :].astype(jnp.uint32)  # [SEGS, SUB]
+    # powers fit int31 so int32 masking/summing is exact (bit patterns equal)
+    powers = powers_ref[:, :].astype(jnp.int32)  # [LANES, SUB]
+    lane_row_iota = jax.lax.broadcasted_iota(jnp.int32, powers.shape, 0)
+    out_col_iota = jax.lax.broadcasted_iota(jnp.int32, (SEGS_PER_BLOCK, N_LANES), 1)
+
+    def lane_body(li, acc):
+        # select powers row li without sublane slicing: mask + sublane sum
+        row = jnp.sum(jnp.where(lane_row_iota == li, powers, 0), axis=0, keepdims=True)
+        terms = mulmod31(data, row.astype(jnp.uint32))  # [SEGS, SUB] < 2^31
+        lane_acc = jnp.zeros((SEGS_PER_BLOCK,), jnp.uint32)
         for k in range(4):
             limb = (terms >> np.uint32(8 * k)) & np.uint32(0xFF)
-            # Mosaic has no unsigned reductions; sums stay < 2^24 so int32 is exact
-            s = jnp.sum(limb.astype(jnp.int32), axis=1)
-            acc = addmod31(acc, mulmod31(fold31(s.astype(jnp.uint32)), jnp.uint32((1 << (8 * k)) % M31)))
-        out_ref[pl.ds(si, 1), :] = acc[None, :]
-        return 0
+            s = jnp.sum(limb.astype(jnp.int32), axis=1)  # < SUB * 255 < 2^21
+            lane_acc = addmod31(lane_acc, mulmod31(fold31(s.astype(jnp.uint32)), jnp.uint32((1 << (8 * k)) % M31)))
+        return jnp.where(out_col_iota == li, lane_acc[:, None], acc)
 
-    jax.lax.fori_loop(0, SEGS_PER_BLOCK, body, 0)
+    acc = jax.lax.fori_loop(0, N_LANES, lane_body, jnp.zeros((SEGS_PER_BLOCK, N_LANES), jnp.uint32))
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros((SEGS_PER_BLOCK, N_LANES), jnp.uint32)
+
+    out_ref[:, :] = addmod31(out_ref[:, :], acc)
 
 
 @partial(jax.jit, static_argnames=("fp_seg_bytes", "interpret"))
@@ -125,6 +153,9 @@ def segment_fp_fixed_pallas(chunk: jax.Array, fp_seg_bytes: int, interpret: bool
         raise ValueError(f"N={n} must be a multiple of fp_seg_bytes={fp_seg_bytes}")
     if fp_seg_bytes > FP_MAX_TILE:
         raise ValueError(f"fp_seg_bytes={fp_seg_bytes} exceeds the limb-sum-safe tile {FP_MAX_TILE}")
+    sub = min(fp_seg_bytes, FP_SUB_TILE)
+    if fp_seg_bytes % sub:  # column grid would floor-truncate: tail bytes would silently never be hashed
+        raise ValueError(f"fp_seg_bytes={fp_seg_bytes} must be a multiple of FP_SUB_TILE={FP_SUB_TILE} (or <= it)")
     n_segments = n // fp_seg_bytes
     pad_segs = -n_segments % SEGS_PER_BLOCK
     if pad_segs:
@@ -135,12 +166,12 @@ def segment_fp_fixed_pallas(chunk: jax.Array, fp_seg_bytes: int, interpret: bool
     out = pl.pallas_call(
         _segment_fp_kernel,
         out_shape=jax.ShapeDtypeStruct((n_segments + pad_segs, N_LANES), jnp.uint32),
-        grid=((n_segments + pad_segs) // SEGS_PER_BLOCK,),
+        grid=((n_segments + pad_segs) // SEGS_PER_BLOCK, fp_seg_bytes // sub),
         in_specs=[
-            pl.BlockSpec((SEGS_PER_BLOCK, fp_seg_bytes), lambda i: (i, 0)),
-            pl.BlockSpec((N_LANES, fp_seg_bytes), lambda i: (0, 0)),
+            pl.BlockSpec((SEGS_PER_BLOCK, sub), lambda i, j: (i, j)),
+            pl.BlockSpec((N_LANES, sub), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((SEGS_PER_BLOCK, N_LANES), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((SEGS_PER_BLOCK, N_LANES), lambda i, j: (i, 0)),
         interpret=interpret,
     )(rows, powers)
     return out[:n_segments] if pad_segs else out

@@ -44,17 +44,19 @@ def _bucket_size(n: int) -> int:
     return b
 
 
-@partial(jax.jit, static_argnames=("block_bytes", "fp_seg_bytes", "mask_bits", "_pallas"))
-def _datapath_step_impl(batch: jax.Array, block_bytes: int, fp_seg_bytes: int, mask_bits: int, _pallas: bool):
+@partial(jax.jit, static_argnames=("block_bytes", "fp_seg_bytes", "mask_bits", "_pallas_gear", "_pallas_fp"))
+def _datapath_step_impl(
+    batch: jax.Array, block_bytes: int, fp_seg_bytes: int, mask_bits: int, _pallas_gear: bool, _pallas_fp: bool
+):
     n = batch.shape[-1]
     if n % fp_seg_bytes or n % block_bytes:
         raise ValueError(f"N={n} must be divisible by fp_seg_bytes and block_bytes")
 
     def one(chunk):
-        h = gear_hash(chunk)
+        h = gear_hash(chunk, pallas=_pallas_gear)
         candidates = boundary_candidate_mask(h, mask_bits)
         tags, literals, n_lit = blockpack.encode_device(chunk, block_bytes=block_bytes)
-        fp_lanes = fixed_stride_lanes(chunk, fp_seg_bytes, pallas=_pallas)
+        fp_lanes = fixed_stride_lanes(chunk, fp_seg_bytes, pallas=_pallas_fp)
         return dict(candidates=candidates, tags=tags, literals=literals, n_lit=n_lit, fp_lanes=fp_lanes)
 
     return jax.vmap(one)(batch)
@@ -70,19 +72,21 @@ def datapath_step(batch: jax.Array, block_bytes: int = 512, fp_seg_bytes: int = 
       n_lit      [B] int32 — valid literal byte count
       fp_lanes   [B, N/fp_seg_bytes, 8] uint32 — fixed-stride segment fingerprints
 
-    The Pallas flag is resolved HERE (per call) and passed as a static arg:
-    resolving it inside the trace would freeze the env flag into the first
-    compiled program and silently ignore later flips.
+    The Pallas flags are resolved HERE (per call, per kernel) and passed as
+    static args: resolving them inside the trace would freeze the env flags
+    into the first compiled program and silently ignore later flips.
     """
     from skyplane_tpu.ops.backend import on_accelerator
     from skyplane_tpu.ops.pallas_kernels import use_pallas
 
+    acc = on_accelerator()
     return _datapath_step_impl(
         batch,
         block_bytes=block_bytes,
         fp_seg_bytes=fp_seg_bytes,
         mask_bits=mask_bits,
-        _pallas=bool(use_pallas() and on_accelerator()),
+        _pallas_gear=bool(use_pallas("gear") and acc),
+        _pallas_fp=bool(use_pallas("fp") and acc),
     )
 
 

@@ -114,7 +114,7 @@ def make_spmd_datapath(
     from skyplane_tpu.ops.fingerprint import fixed_stride_lanes
     from skyplane_tpu.ops.pallas_kernels import use_pallas
 
-    pallas = bool(use_pallas() and on_accelerator())
+    pallas = bool(use_pallas("fp") and on_accelerator())
 
     def per_shard(batch_local: jax.Array):
         # batch_local: [B/data, n_local] uint8
