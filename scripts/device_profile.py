@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Staged device-session profiler for the axon TPU tunnel.
+
+The tunnel tolerates exactly ONE client; a killed client wedges it for a
+long time (see .claude/skills/verify). This script is designed to be
+started once in the background and NEVER killed: it blocks on device
+acquisition for as long as it takes, then profiles the transfer link and
+the data-path kernels stage by stage (logging after every stage so a hang
+is attributable), and finally runs bench.py's measurement in-process.
+
+Usage: python scripts/device_profile.py [--skip-bench]
+Writes progress to stderr; one JSON line per stage to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def emit(stage: str, **kv) -> None:
+    print(json.dumps({"stage": stage, **kv}), flush=True)
+
+
+def main() -> None:
+    t0 = time.time()
+    log("stage 0: acquiring device (blocks until the tunnel is free)...")
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    log(f"devices: {devs} (+{time.time() - t0:.1f}s)")
+    emit("acquire", platform=devs[0].platform, seconds=round(time.time() - t0, 1))
+    if devs[0].platform == "cpu":
+        log("no accelerator; exiting")
+        return
+
+    # stage 1: transfer link
+    x = np.random.default_rng(0).integers(0, 256, 8 << 20, dtype=np.uint8)
+    t = time.perf_counter()
+    d = jax.device_put(x)
+    d.block_until_ready()
+    cold = time.perf_counter() - t
+    t = time.perf_counter()
+    for _ in range(3):
+        jax.device_put(x).block_until_ready()
+    h2d = (time.perf_counter() - t) / 3
+    t = time.perf_counter()
+    for _ in range(3):
+        np.asarray(d)
+    d2h = (time.perf_counter() - t) / 3
+    s = jnp.sum(d)
+    s.block_until_ready()
+    t = time.perf_counter()
+    for _ in range(10):
+        int(jnp.sum(d))
+    tiny = (time.perf_counter() - t) / 10
+    log(f"H2D 8MiB {h2d * 1e3:.0f} ms ({8 / 1024 / h2d:.2f} GiB/s), D2H {d2h * 1e3:.0f} ms "
+        f"({8 / 1024 / d2h:.2f} GiB/s), reduce+tiny-fetch {tiny * 1e3:.0f} ms")
+    emit("link", h2d_ms=round(h2d * 1e3, 1), d2h_ms=round(d2h * 1e3, 1),
+         h2d_gibps=round(8 / 1024 / h2d, 2), d2h_gibps=round(8 / 1024 / d2h, 2),
+         tiny_fetch_ms=round(tiny * 1e3, 1), h2d_cold_s=round(cold, 2))
+
+    # stage 2: fused-kernel compile + run timing per bucket
+    from skyplane_tpu.ops.cdc import CDCParams
+    from skyplane_tpu.ops.fused_cdc import FusedCDCFP
+
+    params = CDCParams()
+    for bucket_mb, B in ((1, 8), (8, 8)):
+        bucket = bucket_mb << 20
+        batch = np.random.default_rng(1).integers(0, 256, (B, bucket), dtype=np.uint8)
+        lens = [bucket] * B
+        fused = FusedCDCFP(params)
+        t = time.perf_counter()
+        fused(batch, lens)
+        compile_s = time.perf_counter() - t
+        t = time.perf_counter()
+        n_rep = 3
+        for _ in range(n_rep):
+            fused(batch, lens)
+        run_s = (time.perf_counter() - t) / n_rep
+        gbps = B * bucket * 8 / 1e9 / run_s
+        log(f"fused bucket {bucket_mb}MiB B={B}: first {compile_s:.1f}s, steady {run_s * 1e3:.0f} ms "
+            f"-> {gbps:.2f} Gbps")
+        emit("fused", bucket_mb=bucket_mb, batch=B, first_s=round(compile_s, 1),
+             steady_ms=round(run_s * 1e3, 1), gbps=round(gbps, 2))
+
+    # stage 3: pallas kernels on device
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    pallas = bench.maybe_enable_pallas()
+    emit("pallas", **pallas)
+    if pallas.get("gear"):
+        from skyplane_tpu.ops.gear import GEAR_TABLE  # noqa: F401 — table resident
+        from skyplane_tpu.ops.pallas_kernels import gear_windowed_sum_pallas
+
+        g = jnp.asarray(np.random.default_rng(2).integers(0, 2**32, 8 << 20, dtype=np.uint32))
+        gear_windowed_sum_pallas(g).block_until_ready()
+        t = time.perf_counter()
+        for _ in range(5):
+            gear_windowed_sum_pallas(g).block_until_ready()
+        dt = (time.perf_counter() - t) / 5
+        log(f"pallas gear 32Mi-elem: {dt * 1e3:.0f} ms ({32 / 1024 / dt:.1f} GiB/s u32)")
+        emit("gear_pallas", ms=round(dt * 1e3, 1))
+
+    if "--skip-bench" in sys.argv:
+        return
+    # stage 4: the real bench, in-process (no extra clients)
+    os.environ["SKYPLANE_BENCH_PLATFORM"] = "default"
+    log("running bench main()...")
+    bench.main()
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,15 @@ def run_init(non_interactive: bool = False) -> int:
     )
     console.print(f"Azure: {'[green]enabled[/green]' if cfg.azure_enabled else '[yellow]no credentials[/yellow]'}")
 
+    # Azure one-time setup (subscription + UMI + roles) — needs the az CLI;
+    # reference parity: skyplane/cli/cli_init.py azure wizard. Interactive
+    # runs always attempt it; non-interactive only when a subscription is
+    # already configured (setup is idempotent, so re-running is safe).
+    if cfg.azure_enabled and (not non_interactive or cfg.azure_subscription_id):
+        from skyplane_tpu.compute.azure.azure_setup import setup_azure
+
+        setup_azure(cfg, echo=lambda m: console.print(f"[dim]{m}[/dim]"))
+
     cfg.to_config_file(config_path)
     console.print(f"Config written to [bold]{config_path}[/bold]")
 

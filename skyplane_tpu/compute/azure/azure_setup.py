@@ -1,0 +1,162 @@
+"""Azure one-time setup: subscription pick, UMI creation, role assignment.
+
+Reference parity: skyplane/cli/cli_init.py:85-260 (the `az` CLI driven
+wizard that creates the ``skyplane_umi`` user-managed identity and grants it
+Contributor + storage roles over the subscription). Gateways then
+authenticate with that UMI instead of shipping client secrets to VMs.
+
+All commands run through an injectable ``run`` callable so the flow is unit
+testable without the Azure CLI (tests/unit/test_azure_setup.py) — the az CLI
+is the only sanctioned way to mint role assignments interactively, but
+nothing here imports Azure SDKs.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Callable, Dict, List, Optional, Tuple
+
+UMI_NAME = "skyplane_umi"
+RESOURCE_GROUP = "skyplane"
+RESOURCE_GROUP_REGION = "eastus"
+ROLES = ("Contributor", "Storage Blob Data Contributor", "Storage Account Contributor")
+
+# run(cmd: List[str]) -> (returncode, stdout, stderr)
+Runner = Callable[[List[str]], Tuple[int, str, str]]
+
+
+def default_runner(cmd: List[str]) -> Tuple[int, str, str]:
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def az_available(run: Runner = default_runner) -> bool:
+    try:
+        rc, _, _ = run(["az", "version"])
+        return rc == 0
+    except (FileNotFoundError, OSError, subprocess.SubprocessError):
+        return False
+
+
+def list_subscriptions(run: Runner = default_runner) -> Dict[str, str]:
+    """name -> id of enabled subscriptions for the logged-in account."""
+    rc, out, _ = run(["az", "account", "list", "-o", "json", "--all"])
+    if rc != 0:
+        return {}
+    try:
+        subs = json.loads(out)
+    except json.JSONDecodeError:
+        return {}
+    return {s["name"]: s["id"] for s in subs if s.get("state") == "Enabled"}
+
+
+def ensure_resource_group(
+    run: Runner, subscription_id: str, group: str = RESOURCE_GROUP, region: str = RESOURCE_GROUP_REGION
+) -> bool:
+    # --subscription on every command: the az default subscription may differ
+    # from the one being set up, and a group in the wrong sub makes the later
+    # identity create fail with ResourceGroupNotFound
+    rc, out, _ = run(["az", "group", "exists", "--name", group, "--subscription", subscription_id])
+    if rc == 0 and out.strip().lower() == "true":
+        return True
+    rc, _, _ = run(["az", "group", "create", "--name", group, "--location", region, "--subscription", subscription_id])
+    return rc == 0
+
+
+def ensure_umi(run: Runner, subscription_id: str, group: str = RESOURCE_GROUP, name: str = UMI_NAME) -> Optional[dict]:
+    """Create (or fetch) the user-managed identity; returns its show() json
+    (principalId / clientId) or None."""
+    rc, out, _ = run(
+        ["az", "identity", "show", "--name", name, "--resource-group", group, "--subscription", subscription_id]
+    )
+    if rc != 0:
+        rc, out, _ = run(
+            ["az", "identity", "create", "--name", name, "--resource-group", group, "--subscription", subscription_id]
+        )
+        if rc != 0:
+            return None
+    try:
+        return json.loads(out)
+    except json.JSONDecodeError:
+        return None
+
+
+def assign_roles(
+    run: Runner, principal_id: str, subscription_id: str, retries: int = 5, retry_delay_s: float = 5.0
+) -> List[str]:
+    """Grant the UMI the gateway roles over the subscription; returns roles
+    that could not be assigned (empty == success).
+
+    Retries each assignment: a freshly created identity's principal takes
+    several seconds to propagate through AAD, so the first attempt on the
+    fresh-install path routinely fails with PrincipalNotFound."""
+    import time
+
+    failed = []
+    for role in ROLES:
+        for attempt in range(retries):
+            rc, _, _ = run(
+                [
+                    "az", "role", "assignment", "create",
+                    "--role", role,
+                    "--assignee-object-id", principal_id,
+                    "--assignee-principal-type", "ServicePrincipal",
+                    "--subscription", subscription_id,
+                    "--scope", f"/subscriptions/{subscription_id}",
+                ]
+            )
+            if rc == 0:
+                break
+            if attempt + 1 < retries:
+                time.sleep(retry_delay_s)
+        else:
+            failed.append(role)
+    return failed
+
+
+def setup_azure(cfg, run: Runner = default_runner, echo=print, role_retry_delay_s: float = 5.0) -> bool:
+    """Full setup flow; mutates cfg (subscription/resource group/UMI fields)
+    and returns True when the UMI is ready for gateway use.
+
+    Idempotent: existing identity/group/role assignments are reused
+    (`az role assignment create` is a no-op for an existing assignment).
+    """
+    if not az_available(run):
+        echo("azure: `az` CLI not found — install it and `az login`, then re-run init")
+        return False
+    subs = list_subscriptions(run)
+    if not subs:
+        echo("azure: no enabled subscriptions visible to `az` (is `az login` done?)")
+        return False
+    sub_id = cfg.azure_subscription_id
+    if sub_id and sub_id not in subs.values():
+        # NEVER silently repoint the config at another subscription: the
+        # invisible-sub case is usually a wrong tenant / stale `az login`,
+        # and granting Contributor over an arbitrary sub is not recoverable
+        echo(
+            f"azure: configured subscription {sub_id} is not visible to `az` "
+            f"(visible: {sorted(subs.values())}) — fix `az login`/tenant or clear azure_subscription_id"
+        )
+        return False
+    if not sub_id:
+        if len(subs) > 1:
+            echo(f"azure: multiple subscriptions visible; using {next(iter(subs))!r} — set azure_subscription_id to override")
+        sub_id = next(iter(subs.values()))
+    cfg.azure_subscription_id = sub_id
+    if not ensure_resource_group(run, sub_id):
+        echo(f"azure: could not create resource group {RESOURCE_GROUP}")
+        return False
+    cfg.azure_resource_group = RESOURCE_GROUP
+    umi = ensure_umi(run, sub_id)
+    if not umi:
+        echo(f"azure: could not create user-managed identity {UMI_NAME}")
+        return False
+    cfg.azure_umi_name = UMI_NAME
+    principal = umi.get("principalId")
+    failed = assign_roles(run, principal, sub_id, retry_delay_s=role_retry_delay_s) if principal else list(ROLES)
+    if failed:
+        echo(f"azure: role assignment failed for {failed} — gateways may lack storage access")
+        return False
+    echo(f"azure: UMI {UMI_NAME} ready (subscription {sub_id}, roles: {', '.join(ROLES)})")
+    return True

@@ -226,6 +226,22 @@ class SSHServer(Server):
                 "sudo sysctl -w net.ipv4.tcp_congestion_control=bbr || true",
             ]
         self.run_command(" && ".join(cmds))
+        if use_bbr:
+            # verify BBR actually took (the set is `|| true`-guarded: kernels
+            # without the module fall back silently) — a cubic gateway on a
+            # long fat WAN path can cost 2-3x throughput, so surface it
+            try:
+                out, _ = self.run_command("sysctl -n net.ipv4.tcp_congestion_control", timeout=15)
+                self.congestion_control = out.strip() or "unknown"
+            except Exception:  # noqa: BLE001
+                self.congestion_control = "unknown"
+            if self.congestion_control != "bbr":
+                from skyplane_tpu.utils.logger import logger
+
+                logger.fs.warning(
+                    f"[{self.host}] BBR requested but kernel reports "
+                    f"'{self.congestion_control}' — WAN throughput may be degraded"
+                )
 
     def install_autoshutdown(self, minutes: int) -> None:
         """Safety net: the VM powers itself off (reference: const_cmds.py:64-71)."""

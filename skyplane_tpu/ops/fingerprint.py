@@ -143,10 +143,12 @@ def fixed_stride_lanes(chunk, fp_seg_bytes: int, pallas=None):
 
         pallas = use_pallas("fp") and on_accelerator()
     if pallas:
-        from skyplane_tpu.ops.pallas_kernels import FP_MAX_TILE, segment_fp_fixed_pallas
+        from skyplane_tpu.ops.pallas_kernels import FP_MAX_TILE, FP_SUB_TILE, segment_fp_fixed_pallas
 
-        if fp_seg_bytes <= FP_MAX_TILE:
-            # one VMEM pass per segment instead of per-lane HBM term arrays
+        if fp_seg_bytes <= FP_MAX_TILE and (fp_seg_bytes <= FP_SUB_TILE or fp_seg_bytes % FP_SUB_TILE == 0):
+            # one VMEM pass per segment instead of per-lane HBM term arrays;
+            # sizes outside the kernel's column-tiled domain fall through to
+            # the XLA path below instead of erroring (graceful degradation)
             return segment_fp_fixed_pallas(chunk, fp_seg_bytes)
     pos = jax.lax.iota(jnp.int32, n)
     seg_ids = pos // fp_seg_bytes

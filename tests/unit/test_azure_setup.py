@@ -1,0 +1,143 @@
+"""Azure init wizard flow against a scripted `az` CLI (no Azure SDKs).
+
+Reference parity target: skyplane/cli/cli_init.py azure wizard (UMI +
+role assignment). The flow runs entirely through the injectable Runner, so
+these tests pin the exact command surface and the idempotence/failure
+semantics without the az CLI installed.
+"""
+
+import json
+
+from skyplane_tpu.compute.azure import azure_setup
+from skyplane_tpu.config import SkyplaneConfig
+
+
+class ScriptedAz:
+    """Runner that dispatches on the az subcommand and records calls."""
+
+    def __init__(self, *, subs=None, umi_exists=True, fail_roles=(), group_exists=True, role_flakes=0):
+        self.calls = []
+        self.subs = subs if subs is not None else [{"name": "prod", "id": "sub-1", "state": "Enabled"}]
+        self.umi_exists = umi_exists
+        self.fail_roles = set(fail_roles)
+        self.group_exists = group_exists
+        self.created_umi = False
+        self.role_flakes = role_flakes  # first N role-assign calls fail (AAD propagation)
+
+    def __call__(self, cmd):
+        self.calls.append(cmd)
+        key = tuple(cmd[:3])
+        if cmd[:2] == ["az", "version"]:
+            return 0, "azure-cli 2.x", ""
+        if key == ("az", "account", "list"):
+            return 0, json.dumps(self.subs), ""
+        if key == ("az", "group", "exists"):
+            assert "--subscription" in cmd, "group commands must pin the subscription"
+            return 0, "true" if self.group_exists else "false", ""
+        if key == ("az", "group", "create"):
+            assert "--subscription" in cmd, "group commands must pin the subscription"
+            self.group_exists = True
+            return 0, "{}", ""
+        if key == ("az", "identity", "show"):
+            if self.umi_exists or self.created_umi:
+                return 0, json.dumps({"principalId": "pid-1", "clientId": "cid-1"}), ""
+            return 1, "", "not found"
+        if key == ("az", "identity", "create"):
+            self.created_umi = True
+            return 0, json.dumps({"principalId": "pid-1", "clientId": "cid-1"}), ""
+        if key == ("az", "role", "assignment"):
+            if self.role_flakes > 0:
+                self.role_flakes -= 1
+                return 1, "", "PrincipalNotFound"
+            role = cmd[cmd.index("--role") + 1]
+            return (1, "", "denied") if role in self.fail_roles else (0, "{}", "")
+        raise AssertionError(f"unexpected az command: {cmd}")
+
+
+def test_setup_creates_umi_and_assigns_all_roles():
+    az = ScriptedAz(umi_exists=False, group_exists=False)
+    cfg = SkyplaneConfig.default_config()
+    assert azure_setup.setup_azure(cfg, run=az, echo=lambda m: None, role_retry_delay_s=0)
+    assert cfg.azure_subscription_id == "sub-1"
+    assert cfg.azure_resource_group == azure_setup.RESOURCE_GROUP
+    assert cfg.azure_umi_name == azure_setup.UMI_NAME
+    roles = [c[c.index("--role") + 1] for c in az.calls if c[:3] == ["az", "role", "assignment"]]
+    assert roles == list(azure_setup.ROLES)
+    # scope covers the whole subscription and targets the UMI principal
+    role_cmd = next(c for c in az.calls if c[:3] == ["az", "role", "assignment"])
+    assert "/subscriptions/sub-1" in role_cmd
+    assert "pid-1" in role_cmd
+    assert any(c[:3] == ["az", "identity", "create"] for c in az.calls)
+    assert any(c[:3] == ["az", "group", "create"] for c in az.calls)
+
+
+def test_setup_is_idempotent_for_existing_identity():
+    az = ScriptedAz(umi_exists=True, group_exists=True)
+    cfg = SkyplaneConfig.default_config()
+    assert azure_setup.setup_azure(cfg, run=az, echo=lambda m: None, role_retry_delay_s=0)
+    assert not any(c[:3] == ["az", "identity", "create"] for c in az.calls)
+    assert not any(c[:3] == ["az", "group", "create"] for c in az.calls)
+
+
+def test_setup_keeps_configured_subscription_when_visible():
+    az = ScriptedAz(
+        subs=[
+            {"name": "a", "id": "sub-a", "state": "Enabled"},
+            {"name": "b", "id": "sub-b", "state": "Enabled"},
+        ]
+    )
+    cfg = SkyplaneConfig.default_config()
+    cfg.azure_subscription_id = "sub-b"
+    assert azure_setup.setup_azure(cfg, run=az, echo=lambda m: None, role_retry_delay_s=0)
+    assert cfg.azure_subscription_id == "sub-b"
+
+
+def test_setup_refuses_invisible_configured_subscription():
+    """Never silently repoint the config at another subscription — granting
+    Contributor over an arbitrary sub is not recoverable."""
+    az = ScriptedAz(subs=[{"name": "a", "id": "sub-a", "state": "Enabled"}])
+    cfg = SkyplaneConfig.default_config()
+    cfg.azure_subscription_id = "sub-gone"
+    msgs = []
+    assert not azure_setup.setup_azure(cfg, run=az, echo=msgs.append, role_retry_delay_s=0)
+    assert cfg.azure_subscription_id == "sub-gone"  # untouched
+    assert any("sub-gone" in m for m in msgs)
+    # no mutating az commands were issued
+    assert not any(c[:3] in (["az", "group", "create"], ["az", "identity", "create"]) for c in az.calls)
+
+
+def test_role_assignment_retries_aad_propagation():
+    """A freshly created principal can 404 for a few seconds; assignment retries."""
+    az = ScriptedAz(umi_exists=False, role_flakes=2)
+    cfg = SkyplaneConfig.default_config()
+    assert azure_setup.setup_azure(cfg, run=az, echo=lambda m: None, role_retry_delay_s=0)
+    n_role_calls = sum(1 for c in az.calls if c[:3] == ["az", "role", "assignment"])
+    assert n_role_calls == len(azure_setup.ROLES) + 2  # 2 flaked attempts retried
+
+
+def test_setup_fails_cleanly_on_role_denial():
+    az = ScriptedAz(fail_roles={"Contributor"})
+    cfg = SkyplaneConfig.default_config()
+    msgs = []
+    assert not azure_setup.setup_azure(cfg, run=az, echo=msgs.append, role_retry_delay_s=0)
+    assert any("Contributor" in m for m in msgs)
+
+
+def test_setup_fails_cleanly_without_az_cli():
+    def no_az(cmd):
+        raise FileNotFoundError("az")
+
+    cfg = SkyplaneConfig.default_config()
+    msgs = []
+    assert not azure_setup.setup_azure(cfg, run=no_az, echo=msgs.append, role_retry_delay_s=0)
+    assert any("az" in m for m in msgs)
+
+
+def test_disabled_subscriptions_are_filtered():
+    az = ScriptedAz(
+        subs=[
+            {"name": "dead", "id": "sub-d", "state": "Disabled"},
+            {"name": "live", "id": "sub-l", "state": "Enabled"},
+        ]
+    )
+    assert azure_setup.list_subscriptions(az) == {"live": "sub-l"}
