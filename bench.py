@@ -102,7 +102,12 @@ def maybe_enable_pallas() -> dict:
         data = jnp.asarray(rng.integers(0, 2**32, size=2 * TILE, dtype=np_.uint32))
         want = np_.asarray(_windowed_sum_doubling(data))
         got = np_.asarray(gear_windowed_sum_pallas(data))
-        enabled["gear"] = np_.array_equal(want, got)
+        # the production fused path runs this kernel UNDER vmap (fused_cdc
+        # _candidates_impl) — validate that lowering too, not just the 1-D form
+        vdata = jnp.stack([data, data[::-1]])
+        vwant = np_.stack([want, np_.asarray(_windowed_sum_doubling(vdata[1]))])
+        vgot = np_.asarray(jax.vmap(gear_windowed_sum_pallas)(vdata))
+        enabled["gear"] = np_.array_equal(want, got) and np_.array_equal(vwant, vgot)
         if not enabled["gear"]:
             log("WARN: pallas gear kernel mismatch on device; gear stays on XLA path")
     except Exception as e:  # noqa: BLE001 — pallas failure must not kill the bench
@@ -329,6 +334,9 @@ def main() -> None:
     gbits = ours["raw_bytes"] * 8 / 1e9
     ours_gbps = gbits / ours["seconds"]
     base_gbps = base["raw_bytes"] * 8 / 1e9 / base["seconds"]
+    from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+
+    rate_per_gb = get_egress_cost_per_gb("aws:us-east-1", "gcp:us-central1")  # the BASELINE.json route
     result = {
         "metric": (
             f"sender datapath effective throughput (CDC dedup + compress, "
@@ -342,6 +350,11 @@ def main() -> None:
         "pallas": pallas_on,  # {"gear": bool, "fp": bool}
         "wire_reduction_ours": round(ours["raw_bytes"] / max(ours["wire_bytes"], 1), 2),
         "wire_reduction_baseline": round(base["raw_bytes"] / max(base["wire_bytes"], 1), 2),
+        # egress $/TB of raw data actually moved (BASELINE metric's second
+        # axis): wire bytes billed at the planner's AWS->GCP egress rate
+        # (decimal TB, matching how cloud egress is billed)
+        "egress_usd_per_tb_ours": round(rate_per_gb * 1000 * ours["wire_bytes"] / ours["raw_bytes"], 2),
+        "egress_usd_per_tb_baseline": round(rate_per_gb * 1000 * base["wire_bytes"] / base["raw_bytes"], 2),
     }
     print(json.dumps(result), flush=True)
 
