@@ -51,11 +51,23 @@ class DeviceBatchRunner:
         self,
         cdc_params: CDCParams = CDCParams(),
         max_batch: int = 8,
-        max_wait_ms: float = 3.0,
+        max_wait_ms: Optional[float] = None,
         mesh=None,
     ):
         self.cdc_params = cdc_params
         self.max_batch = max_batch
+        if max_wait_ms is None:
+            # window-formation wait. 3 ms suits a locally attached chip;
+            # behind a high-latency dispatch link (tunnel) a longer wait fills
+            # windows better than it delays them — tune without code changes
+            import os
+
+            try:
+                max_wait_ms = float(os.environ.get("SKYPLANE_TPU_BATCH_WAIT_MS", "3"))
+            except ValueError:
+                max_wait_ms = 3.0
+            if not (max_wait_ms >= 0):  # also catches NaN; a negative sleep would kill the leader
+                max_wait_ms = 3.0
         self.max_wait_s = max_wait_ms / 1000.0
         self._lock = threading.Lock()
         self._open: Dict[int, List[_Entry]] = {}  # bucket size -> entries of the open window
