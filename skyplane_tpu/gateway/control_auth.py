@@ -41,6 +41,13 @@ def control_session(api_token: Optional[str] = None) -> requests.Session:
     # silently OVERRIDE session.verify — gateway control traffic must not be
     # re-verified against a system CA bundle or routed through an env proxy
     s.trust_env = False
+    # NO session-level retry policy, deliberately: profile/socket/* GETs
+    # DRAIN server-side queues (a transparent re-issue after a dropped
+    # response would silently lose the drained batch), requests timeouts
+    # apply per attempt (retries would multiply callers' poll budgets), and
+    # urllib3 retries connect errors for POSTs regardless of allowed_methods.
+    # Callers own their retry semantics: the tracker tolerates a failed poll
+    # tick, and cumulative-state GETs retry at the call site.
     if api_token:
         s.headers["Authorization"] = f"Bearer {api_token}"
     return s

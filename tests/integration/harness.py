@@ -43,7 +43,19 @@ class LocalGateway:
         return control_session(self.daemon.api_token)
 
     def get(self, route: str, **kw) -> requests.Response:
-        return self.session().get(self.url(route), **kw)
+        # cumulative-state endpoints (status map, error list) tolerate a
+        # retry after a dropped keep-alive connection (the server closing a
+        # pooled connection surfaces as RemoteDisconnected on reuse — seen
+        # in long soaks after ~30 poll waves). Drain-on-GET endpoints
+        # (profile/socket/*) must NOT retry: the drained batch would be lost.
+        retries = 0 if route.startswith("profile/socket/") else 2
+        for attempt in range(retries + 1):
+            try:
+                return self.session().get(self.url(route), **kw)
+            except requests.exceptions.ConnectionError:
+                if attempt == retries:
+                    raise
+                time.sleep(0.2 * (attempt + 1))
 
     def post(self, route: str, **kw) -> requests.Response:
         return self.session().post(self.url(route), **kw)
