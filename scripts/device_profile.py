@@ -41,6 +41,10 @@ def main() -> None:
 
     devs = jax.devices()
     log(f"devices: {devs} (+{time.time() - t0:.1f}s)")
+    marker = os.environ.get("SKYPLANE_ACQUIRE_MARKER")
+    if marker:  # tell the wrapper we now hold the device (must not be killed)
+        with open(marker, "w") as f:
+            f.write(f"{devs[0].platform} {time.time()}\n")
     emit("acquire", platform=devs[0].platform, seconds=round(time.time() - t0, 1))
     if devs[0].platform == "cpu":
         log("no accelerator; exiting")
