@@ -78,8 +78,25 @@ class AzureBlobInterface(ObjectStoreInterface):
             return False
 
     def create_bucket(self, region_tag: str) -> None:
-        if not self.bucket_exists():
-            self.service_client.create_container(self.container_name)
+        if self.bucket_exists():
+            return
+        # containers live inside a storage account; a fresh destination
+        # region needs the account first (reference parity:
+        # azure_storage_account_interface.py)
+        try:
+            from skyplane_tpu.exceptions import BadConfigException
+            from skyplane_tpu.obj_store.azure_storage_account import ensure_storage_account
+
+            region = region_tag.partition(":")[2]
+            if not region or region == "infer":  # cli mb exempts azure from --region
+                region = "eastus"
+            ensure_storage_account(self.account_name, region)
+        except (ImportError, BadConfigException):
+            # azure-mgmt-storage absent or no subscription configured:
+            # management plane unavailable — assume the account exists and
+            # let container creation report the truth
+            pass
+        self.service_client.create_container(self.container_name)
 
     def delete_bucket(self) -> None:
         self.service_client.delete_container(self.container_name)

@@ -1,0 +1,57 @@
+"""Azure storage-account management for the blob backend.
+
+Reference parity: skyplane/obj_store/azure_storage_account_interface.py —
+containers live inside a storage account, and a fresh destination region
+needs the ACCOUNT created before any container/blob call can succeed. The
+management-plane client (azure-mgmt-storage) is separate from the data-plane
+BlobServiceClient, so this lives in its own module with gated imports.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from skyplane_tpu.exceptions import BadConfigException
+
+
+def _mgmt_client(subscription_id: str):
+    from azure.identity import DefaultAzureCredential
+    from azure.mgmt.storage import StorageManagementClient
+
+    return StorageManagementClient(DefaultAzureCredential(), subscription_id)
+
+
+def ensure_storage_account(
+    account_name: str,
+    region: str,
+    resource_group: Optional[str] = None,
+    subscription_id: Optional[str] = None,
+    sku: str = "Premium_LRS",
+) -> None:
+    """Create the storage account if it does not exist (idempotent).
+
+    Premium block-blob SKU by default: gateway throughput is the point of
+    this framework, and standard-tier accounts cap egress well below a
+    gateway VM's NIC.
+    """
+    from skyplane_tpu.config_paths import cloud_config
+
+    subscription_id = subscription_id or cloud_config.azure_subscription_id
+    resource_group = resource_group or cloud_config.azure_resource_group or "skyplane"
+    if not subscription_id:
+        raise BadConfigException("azure_subscription_id is required to create storage accounts (run init)")
+    client = _mgmt_client(subscription_id)
+    if not client.storage_accounts.check_name_availability({"name": account_name}).name_available:
+        return  # exists (ours or someone else's — container creation will tell)
+    poller = client.storage_accounts.begin_create(
+        resource_group,
+        account_name,
+        {
+            "sku": {"name": sku},
+            "kind": "BlockBlobStorage" if sku.startswith("Premium") else "StorageV2",
+            "location": region,
+            "allow_blob_public_access": False,
+            "minimum_tls_version": "TLS1_2",
+        },
+    )
+    poller.result()  # block until provisioned — container create follows immediately

@@ -1,0 +1,85 @@
+"""Azure storage-account management against a stubbed azure-mgmt-storage.
+
+Reference parity target: skyplane/obj_store/azure_storage_account_interface.py
+(the account must exist before any container/blob call). Stubs pin the
+management-plane calls without the SDK installed.
+"""
+
+import sys
+import types
+
+import pytest
+
+from skyplane_tpu.exceptions import BadConfigException
+
+
+class FakePoller:
+    def __init__(self):
+        self.waited = False
+
+    def result(self):
+        self.waited = True
+        return {"id": "acct"}
+
+
+class FakeAccountsOp:
+    def __init__(self, existing_names):
+        self.existing = set(existing_names)
+        self.created = []
+        self.poller = FakePoller()
+
+    def check_name_availability(self, params):
+        return types.SimpleNamespace(name_available=params["name"] not in self.existing)
+
+    def begin_create(self, resource_group, name, params):
+        self.created.append((resource_group, name, params))
+        self.existing.add(name)
+        return self.poller
+
+
+@pytest.fixture()
+def stub_azure(monkeypatch):
+    for name in ("azure", "azure.identity", "azure.mgmt", "azure.mgmt.storage"):
+        if name not in sys.modules or not hasattr(sys.modules.get(name, None), "__path__"):
+            monkeypatch.setitem(sys.modules, name, types.ModuleType(name))
+    accounts = FakeAccountsOp(existing_names={"takenacct"})
+    client = types.SimpleNamespace(storage_accounts=accounts)
+    # monkeypatch.setattr (not bare assignment) so a REAL installed SDK's
+    # attributes are restored after the test instead of staying stubbed
+    monkeypatch.setattr(sys.modules["azure.identity"], "DefaultAzureCredential", lambda: object(), raising=False)
+    monkeypatch.setattr(
+        sys.modules["azure.mgmt.storage"], "StorageManagementClient", lambda cred, sub: client, raising=False
+    )
+    return accounts
+
+
+def test_creates_missing_account_and_blocks_until_done(stub_azure):
+    from skyplane_tpu.obj_store.azure_storage_account import ensure_storage_account
+
+    ensure_storage_account("newacct", "westus2", resource_group="rg1", subscription_id="sub-1")
+    assert len(stub_azure.created) == 1
+    rg, name, params = stub_azure.created[0]
+    assert (rg, name) == ("rg1", "newacct")
+    assert params["location"] == "westus2"
+    assert params["sku"]["name"].startswith("Premium")  # gateway-throughput SKU
+    assert params["allow_blob_public_access"] is False
+    assert stub_azure.poller.waited  # container create follows immediately
+
+
+def test_existing_account_is_left_alone(stub_azure):
+    from skyplane_tpu.obj_store.azure_storage_account import ensure_storage_account
+
+    ensure_storage_account("takenacct", "westus2", resource_group="rg1", subscription_id="sub-1")
+    assert stub_azure.created == []
+
+
+def test_requires_subscription(stub_azure, monkeypatch, tmp_path):
+    monkeypatch.setenv("SKYPLANE_TPU_CONFIG_ROOT", str(tmp_path))
+    from skyplane_tpu.obj_store.azure_storage_account import ensure_storage_account
+
+    # config has no azure_subscription_id and none passed
+    from skyplane_tpu import config_paths
+
+    monkeypatch.setattr(config_paths.cloud_config, "azure_subscription_id", None, raising=False)
+    with pytest.raises(BadConfigException):
+        ensure_storage_account("newacct", "westus2", resource_group="rg1", subscription_id=None)
