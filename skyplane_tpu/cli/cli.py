@@ -173,6 +173,29 @@ def experiments_latency_grid(region_pairs, output, no_resume):
         click.echo(f"{src} -> {dst}: {rtt:.1f} ms")
 
 
+@experiments.command("query")
+@click.argument("src")
+@click.argument("dst")
+@click.option("--profile", default=None, help="grid CSV (default: the init-captured throughput grid)")
+def experiments_query(src, dst, profile):
+    """Query the measured/estimated path throughput and egress cost for a
+    region pair (reference analog: cli/experiments/cli_query.py)."""
+    from pathlib import Path
+
+    from skyplane_tpu.config_paths import throughput_grid_path
+    from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+    from skyplane_tpu.planner.solver import ThroughputSolver
+
+    if profile and not Path(profile).exists():
+        # an explicit but missing profile must not silently degrade to the
+        # NIC-limit estimate — the operator thinks they queried measurements
+        raise click.ClickException(f"profile not found: {profile}")
+    solver = ThroughputSolver(profile or str(throughput_grid_path))
+    gbps = solver.get_path_throughput(src, dst)  # already Gbps
+    kind = "measured" if (src, dst) in solver.grid else "estimated (NIC-limit model)"
+    click.echo(f"{src} -> {dst}: {gbps:.2f} Gbps [{kind}], ${get_egress_cost_per_gb(src, dst):.3f}/GB egress")
+
+
 @main.group()
 def config():
     """Get or set configuration flags."""

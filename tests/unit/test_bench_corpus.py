@@ -1,0 +1,75 @@
+"""Sanity properties of the bench's synthetic snapshot-chain corpus.
+
+The benchmark's honesty rests on the corpus actually having the claimed
+shape: a chain of snapshots with small clustered deltas, mixed-entropy
+content, and zero extents. These tests pin those properties so a future
+corpus tweak can't silently turn the benchmark into a best-case (or
+broken) workload.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_module", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_module"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def corpus(bench):
+    return bench.make_corpus(seed=123)
+
+
+def test_corpus_shape(bench, corpus):
+    assert len(corpus) == bench.N_SNAPSHOTS * bench.CHUNKS_PER_SNAPSHOT
+    assert all(len(c) == bench.CHUNK_MB << 20 for c in corpus)
+
+
+def test_snapshot_deltas_are_small_and_localized(bench, corpus):
+    """Consecutive snapshots of the same chunk differ in only a few percent
+    of 4 KiB blocks (clustered writes), like real incremental snapshots."""
+    per_snap = bench.CHUNKS_PER_SNAPSHOT
+    a = np.frombuffer(corpus[0], np.uint8).reshape(-1, bench.BLOCK)
+    b = np.frombuffer(corpus[per_snap], np.uint8).reshape(-1, bench.BLOCK)
+    changed = (a != b).any(axis=1).mean()
+    assert 0.001 < changed < 0.10, f"snapshot delta fraction {changed}"
+
+
+def test_zero_extents_present(bench, corpus):
+    blocks = np.frombuffer(corpus[0], np.uint8).reshape(-1, bench.BLOCK)
+    zero_frac = (~blocks.any(axis=1)).mean()
+    assert 0.05 < zero_frac < 0.6, f"zero-block fraction {zero_frac}"
+
+
+def test_content_is_neither_all_random_nor_trivial(corpus):
+    """zstd-3 must land in a realistic band: well above 1.0x (not pure
+    random — that would flatter the baseline's speed and kill its ratio)
+    and well below dedup-grade ratios (content alone must not be the win)."""
+    zstd = pytest.importorskip("zstandard")
+    c = corpus[0]
+    ratio = len(c) / len(zstd.ZstdCompressor(level=3).compress(c))
+    assert 1.4 < ratio < 4.0, f"zstd-3 ratio {ratio}"
+
+
+def test_distinct_chunks_within_snapshot(bench, corpus):
+    """No accidental duplication across unrelated chunks (would inflate
+    dedup for the wrong reason)."""
+    first = [np.frombuffer(c, np.uint8)[: 1 << 16].tobytes() for c in corpus[: bench.CHUNKS_PER_SNAPSHOT]]
+    assert len(set(first)) == len(first)
+
+
+def test_corpus_is_deterministic(bench):
+    a = bench.make_corpus(seed=7)
+    b = bench.make_corpus(seed=7)
+    assert all(x == y for x, y in zip(a, b))
