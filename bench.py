@@ -223,11 +223,22 @@ def make_corpus(seed: int = 0):
     return chunks
 
 
+def batch_chunks(workers: int) -> int:
+    """Device batch-window size (accelerator path only). min(8, workers)
+    keeps the default 24-chunk corpus in exactly-full windows (3x8) with
+    2x window overlap at 16 workers — zero padded rows in the timed region.
+    SKYPLANE_BENCH_BATCH overrides for dispatch-latency experiments (pair
+    it with SKYPLANE_BENCH_SNAP_CHUNKS so windows stay full)."""
+    if os.environ.get("SKYPLANE_BENCH_BATCH"):
+        return int(os.environ["SKYPLANE_BENCH_BATCH"])
+    return min(8, workers)
+
+
 def n_workers() -> int:
     """Gateway sender pool size. On an accelerator the workers mostly wait on
     device round trips (dispatch latency dominates, esp. through a tunnel),
-    so the pool exceeds the core count to keep batches in flight; on pure
-    CPU extra threads just fight over cores."""
+    so the pool is 2x the batch window to keep a second window forming while
+    the first is in flight; on pure CPU extra threads just fight over cores."""
     if os.environ.get("SKYPLANE_BENCH_WORKERS"):
         return int(os.environ["SKYPLANE_BENCH_WORKERS"])
     from skyplane_tpu.ops.backend import on_accelerator
@@ -261,7 +272,9 @@ def bench_ours(chunks) -> dict:
         mesh = maybe_default_mesh()
         if mesh is not None:
             log(f"batch runner sharded over mesh {dict(mesh.shape)}")
-        batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=min(8, workers), mesh=mesh)
+        batch = batch_chunks(workers)
+        log(f"device batch window: {batch} chunks, {workers} workers")
+        batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=batch, mesh=mesh)
     proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
     index = SenderDedupIndex()
     # warm-up: compile all shape buckets (separate corpus so the index stays

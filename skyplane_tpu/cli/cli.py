@@ -192,7 +192,9 @@ def experiments_query(src, dst, profile):
         raise click.ClickException(f"profile not found: {profile}")
     solver = ThroughputSolver(profile or str(throughput_grid_path))
     gbps = solver.get_path_throughput(src, dst)  # already Gbps
-    kind = "measured" if (src, dst) in solver.grid else "estimated (NIC-limit model)"
+    # label must mirror get_path_throughput's branch order: the src==dst
+    # branch wins over a grid hit, so such a value is NOT a measurement
+    kind = "measured" if (src, dst) in solver.grid and src != dst else "estimated (NIC-limit model)"
     click.echo(f"{src} -> {dst}: {gbps:.2f} Gbps [{kind}], ${get_egress_cost_per_gb(src, dst):.3f}/GB egress")
 
 
