@@ -119,6 +119,36 @@ def test_sync_skips_unchanged(tmp_path):
 
 
 @pytest.mark.slow
+def test_sync_recopies_changed_and_new_files(tmp_path):
+    """Full second sync pipeline after mutating the source: only the changed
+    and new objects move, and the destination converges byte-for-byte
+    (reference semantics: transfer_job.py:792-865 delta filter)."""
+    import time
+
+    job, data, dst_root = _make_cross_site_job(tmp_path, job_cls=SyncJob)
+    cfg = TransferConfig(compress="zstd", dedup=False, multipart_threshold_mb=1024)
+    _run_pipeline(job, cfg)
+    src_root = tmp_path / "siteA"
+    time.sleep(1.1)  # mtime granularity: the delta filter compares mtimes
+    changed = rng.integers(0, 256, 300 * 1024, dtype=np.uint8).tobytes()
+    (src_root / "f1.bin").write_bytes(changed)
+    added = rng.integers(0, 256, 64 * 1024, dtype=np.uint8).tobytes()
+    (src_root / "new.bin").write_bytes(added)
+
+    job2 = SyncJob("local://siteA/", ["local://siteB/"], recursive=True)
+    job2._src_iface = POSIXInterface(str(src_root), region_tag="local:siteA")
+    job2._dst_ifaces = [POSIXInterface(str(dst_root), region_tag="local:siteB")]
+    job2.src_path = "local:///"
+    job2.dst_paths = ["local:///"]
+    to_copy = {o.key for o in job2.src_iface.list_objects() if job2._post_filter_fn(o)}
+    assert to_copy == {"f1.bin", "new.bin"}, to_copy
+    _run_pipeline(job2, cfg)
+    assert (dst_root / "f1.bin").read_bytes() == changed
+    assert (dst_root / "new.bin").read_bytes() == added
+    assert (dst_root / "f0.bin").read_bytes() == data["f0.bin"]  # untouched
+
+
+@pytest.mark.slow
 def test_multicast_two_destinations(tmp_path):
     """1 source -> 2 destination regions: mux_and fan-out, per-region dest keys,
     completion requires BOTH destinations to land every chunk."""
