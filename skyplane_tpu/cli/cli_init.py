@@ -84,7 +84,23 @@ def run_init(non_interactive: bool = False) -> int:
     if cfg.azure_enabled and (not non_interactive or cfg.azure_subscription_id):
         from skyplane_tpu.compute.azure.azure_setup import setup_azure
 
-        setup_azure(cfg, echo=lambda m: console.print(f"[dim]{m}[/dim]"))
+        def _pick_subscription(subs: dict) -> str | None:
+            # interactive only: role grants are per-subscription and not
+            # recoverable, so the user must choose when several are visible
+            names = sorted(subs)
+            console.print("Multiple Azure subscriptions are visible:")
+            for i, name in enumerate(names, 1):
+                console.print(f"  {i}. {name} ({subs[name]})")
+            raw = console.input("Pick a subscription for the skyplane UMI (number, empty to skip): ").strip()
+            if raw.isdigit() and 1 <= int(raw) <= len(names):
+                return subs[names[int(raw) - 1]]
+            return None
+
+        setup_azure(
+            cfg,
+            echo=lambda m: console.print(f"[dim]{m}[/dim]"),
+            prompt=None if non_interactive else _pick_subscription,
+        )
 
     cfg.to_config_file(config_path)
     console.print(f"Config written to [bold]{config_path}[/bold]")

@@ -115,12 +115,25 @@ def assign_roles(
     return failed
 
 
-def setup_azure(cfg, run: Runner = default_runner, echo=print, role_retry_delay_s: float = 5.0) -> bool:
+def setup_azure(
+    cfg,
+    run: Runner = default_runner,
+    echo=print,
+    role_retry_delay_s: float = 5.0,
+    prompt: Optional[Callable[[Dict[str, str]], Optional[str]]] = None,
+) -> bool:
     """Full setup flow; mutates cfg (subscription/resource group/UMI fields)
     and returns True when the UMI is ready for gateway use.
 
     Idempotent: existing identity/group/role assignments are reused
     (`az role assignment create` is a no-op for an existing assignment).
+
+    ``prompt`` (interactive runs): called with {name: id} when several
+    subscriptions are visible and none is configured; returns the chosen
+    subscription id, or None to abort. Granting Contributor over a
+    subscription is not recoverable, so with multiple candidates this flow
+    NEVER auto-picks: no prompt available means bail with instructions
+    (reference wizard behavior: the az setup prompts for the subscription).
     """
     if not az_available(run):
         echo("azure: `az` CLI not found — install it and `az login`, then re-run init")
@@ -140,9 +153,20 @@ def setup_azure(cfg, run: Runner = default_runner, echo=print, role_retry_delay_
         )
         return False
     if not sub_id:
-        if len(subs) > 1:
-            echo(f"azure: multiple subscriptions visible; using {next(iter(subs))!r} — set azure_subscription_id to override")
-        sub_id = next(iter(subs.values()))
+        if len(subs) == 1:
+            sub_id = next(iter(subs.values()))
+        elif prompt is not None:
+            sub_id = prompt(subs)
+            if not sub_id or sub_id not in subs.values():
+                echo("azure: no subscription selected — skipping Azure setup")
+                return False
+        else:
+            echo(
+                f"azure: multiple subscriptions visible ({sorted(subs)}) and no "
+                f"azure_subscription_id configured — refusing to pick one (role grants are "
+                f"per-subscription and not recoverable). Set azure_subscription_id and re-run init."
+            )
+            return False
     cfg.azure_subscription_id = sub_id
     if not ensure_resource_group(run, sub_id):
         echo(f"azure: could not create resource group {RESOURCE_GROUP}")

@@ -17,6 +17,7 @@ from azure.storage.blob import BlobServiceClient
 
 from skyplane_tpu.exceptions import ChecksumMismatchException, NoSuchObjectException
 from skyplane_tpu.obj_store.object_store_interface import ObjectStoreInterface, ObjectStoreObject
+from skyplane_tpu.utils.logger import logger
 
 
 def _block_id(part_number: int) -> str:
@@ -96,6 +97,12 @@ class AzureBlobInterface(ObjectStoreInterface):
             # management plane unavailable — assume the account exists and
             # let container creation report the truth
             pass
+        except Exception as e:  # noqa: BLE001
+            # ADVICE r2: any management-plane failure (DefaultAzureCredential
+            # unavailable, auth/HTTP errors, missing mgmt RBAC) must not
+            # abort container creation — users whose account already exists
+            # only need data-plane auth. Warn and let the data plane decide.
+            logger.warning(f"azure: storage-account check failed ({type(e).__name__}: {e}); trying container create anyway")
         self.service_client.create_container(self.container_name)
 
     def delete_bucket(self) -> None:

@@ -66,9 +66,16 @@ class DeviceBatchRunner:
                 max_wait_ms = float(os.environ.get("SKYPLANE_TPU_BATCH_WAIT_MS", "3"))
             except ValueError:
                 max_wait_ms = 3.0
-            if not (max_wait_ms >= 0):  # also catches NaN; a negative sleep would kill the leader
-                max_wait_ms = 3.0
-        self.max_wait_s = max_wait_ms / 1000.0
+        # NaN / inf / negative would stall or kill the window leader
+        # (time.sleep raises on both), whether it came from the env var or a
+        # caller's computed value; a wait beyond a few seconds is never
+        # useful (dispatch RTTs are ~100 ms even through a tunnel), so
+        # clamp rather than obey a typo
+        import math
+
+        if not math.isfinite(max_wait_ms) or max_wait_ms < 0:
+            max_wait_ms = 3.0
+        self.max_wait_s = min(max_wait_ms, 5000.0) / 1000.0
         self._lock = threading.Lock()
         self._open: Dict[int, List[_Entry]] = {}  # bucket size -> entries of the open window
         # multi-device gateway (TPU slice): run the fused kernels sharded over

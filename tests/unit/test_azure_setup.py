@@ -106,6 +106,53 @@ def test_setup_refuses_invisible_configured_subscription():
     assert not any(c[:3] in (["az", "group", "create"], ["az", "identity", "create"]) for c in az.calls)
 
 
+MULTI_SUBS = [
+    {"name": "a", "id": "sub-a", "state": "Enabled"},
+    {"name": "b", "id": "sub-b", "state": "Enabled"},
+]
+
+
+def test_setup_refuses_to_auto_pick_among_multiple_subscriptions():
+    """ADVICE r2: Contributor over an arbitrary sub is not recoverable, so
+    with several visible subs and no prompt the flow bails with instructions
+    instead of silently granting roles over the first one."""
+    az = ScriptedAz(subs=MULTI_SUBS)
+    cfg = SkyplaneConfig.default_config()
+    msgs = []
+    assert not azure_setup.setup_azure(cfg, run=az, echo=msgs.append, role_retry_delay_s=0)
+    assert any("azure_subscription_id" in m for m in msgs)
+    assert not any(c[:3] in (["az", "group", "create"], ["az", "identity", "create"]) for c in az.calls)
+    assert not any(c[:3] == ["az", "role", "assignment"] for c in az.calls)
+
+
+def test_setup_prompts_for_subscription_when_interactive():
+    az = ScriptedAz(subs=MULTI_SUBS)
+    cfg = SkyplaneConfig.default_config()
+    seen = {}
+    assert azure_setup.setup_azure(
+        cfg, run=az, echo=lambda m: None, role_retry_delay_s=0, prompt=lambda subs: seen.update(subs) or "sub-b"
+    )
+    assert seen == {"a": "sub-a", "b": "sub-b"}
+    assert cfg.azure_subscription_id == "sub-b"
+    role_cmd = next(c for c in az.calls if c[:3] == ["az", "role", "assignment"])
+    assert "/subscriptions/sub-b" in role_cmd
+
+
+def test_setup_aborts_when_prompt_declines():
+    az = ScriptedAz(subs=MULTI_SUBS)
+    cfg = SkyplaneConfig.default_config()
+    assert not azure_setup.setup_azure(cfg, run=az, echo=lambda m: None, role_retry_delay_s=0, prompt=lambda subs: None)
+    assert not cfg.azure_subscription_id
+    assert not any(c[:3] == ["az", "role", "assignment"] for c in az.calls)
+
+
+def test_single_subscription_auto_picked_without_prompt():
+    az = ScriptedAz()  # one enabled sub
+    cfg = SkyplaneConfig.default_config()
+    assert azure_setup.setup_azure(cfg, run=az, echo=lambda m: None, role_retry_delay_s=0)
+    assert cfg.azure_subscription_id == "sub-1"
+
+
 def test_role_assignment_retries_aad_propagation():
     """A freshly created principal can 404 for a few seconds; assignment retries."""
     az = ScriptedAz(umi_exists=False, role_flakes=2)

@@ -105,3 +105,12 @@ def test_mesh_axis_selection_bounds_window_inflation():
     # window smaller than every axis: mesh is dropped entirely
     runner2 = DeviceBatchRunner(cdc_params=PARAMS, max_batch=1, max_wait_ms=5.0, mesh=mesh)
     assert runner2.mesh is None and runner2.max_batch == 1
+
+
+@pytest.mark.parametrize("raw", ["inf", "nan", "-5", "1e12", "bogus"])
+def test_batch_wait_env_rejects_nonfinite_and_clamps(monkeypatch, raw):
+    """ADVICE r2: a typo'd SKYPLANE_TPU_BATCH_WAIT_MS (inf/nan/huge) must not
+    make a partially filled window's leader wait forever."""
+    monkeypatch.setenv("SKYPLANE_TPU_BATCH_WAIT_MS", raw)
+    runner = DeviceBatchRunner(cdc_params=PARAMS, max_batch=4)
+    assert 0 <= runner.max_wait_s <= 5.0
