@@ -24,6 +24,8 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
@@ -35,6 +37,11 @@ def emit(stage: str, **kv) -> None:
 
 def main() -> None:
     t0 = time.time()
+    from skyplane_tpu.utils.tunnel_lock import acquire_tunnel_lock
+
+    log("stage 0a: acquiring single-client tunnel lock (blocks while another client runs)...")
+    acquire_tunnel_lock()  # held until process exit; one tunnel client at a time
+    log(f"lock held (+{time.time() - t0:.1f}s)")
     log("stage 0: acquiring device (blocks until the tunnel is free)...")
     import jax
     import jax.numpy as jnp
@@ -79,7 +86,6 @@ def main() -> None:
     # stage 2: validate + enable the Pallas kernels BEFORE any production
     # compile: the runner warm below must cache the same lowering (pallas
     # on/off) that bench.main() will run, or the warm is wasted tunnel time
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import bench as bench_mod
 
     pallas = bench_mod.maybe_enable_pallas()
@@ -128,10 +134,10 @@ def main() -> None:
 
     if "--skip-bench" in sys.argv:
         return
-    # stage 4: the real bench, in-process (no extra clients)
+    # stage 5: the real bench, in-process (no extra clients)
     os.environ["SKYPLANE_BENCH_PLATFORM"] = "default"
     log("running bench main()...")
-    bench.main()
+    bench_mod.main()
 
 
 if __name__ == "__main__":

@@ -1,6 +1,8 @@
 #!/bin/bash
 # Single-client TPU-tunnel retry loop (round-2 discipline, see docs/benchmark.md):
 #  - exactly ONE jax client at a time; a concurrent client wedges the tunnel
+#    (device_profile.py also takes the /tmp flock in utils/tunnel_lock.py, so
+#    even a stray manual client cannot run beside an attempt)
 #  - an attempt still WAITING for device acquisition may be killed; an attempt
 #    that wrote its acquire marker holds the lease and must NEVER be killed
 #  - absolute deadline: stop launching new attempts so nothing contends with
@@ -15,6 +17,19 @@ DEADLINE=${1:-$(($(date +%s) + 9 * 3600))}
 ACQ_TIMEOUT=${ACQ_TIMEOUT:-300}   # how long an attempt may wait for acquisition
 SLEEP_BETWEEN=${SLEEP_BETWEEN:-120}
 SUCCESS=$LOGDIR/device_profile.success
+
+check_success() { # $1 = attempt number; records success if the output proves a TPU run
+  local out=$LOGDIR/attempt.$1.out
+  if grep -q '"stage": "acquire"' "$out" 2>/dev/null &&
+    ! grep -q '"platform": "cpu"' "$out" 2>/dev/null; then
+    touch "$SUCCESS"
+    cp "$out" "$LOGDIR/device_profile.out"
+    echo "[devloop] SUCCESS on attempt $1" >>"$LOGDIR/devloop.log"
+    return 0
+  fi
+  return 1
+}
+
 N=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if [ -f "$SUCCESS" ]; then
@@ -35,34 +50,34 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       # lease held: wait indefinitely, NEVER kill
       echo "[devloop] attempt $N HOLDS THE LEASE; waiting for it to finish" >>"$LOGDIR/devloop.log"
       wait "$PID"
-      RC=$?
-      echo "[devloop] attempt $N (leaseholder) exited rc=$RC" >>"$LOGDIR/devloop.log"
-      if [ "$RC" -eq 0 ] && grep -q '"stage": "acquire"' "$LOGDIR/attempt.$N.out" &&
-        ! grep -q '"platform": "cpu"' "$LOGDIR/attempt.$N.out"; then
-        touch "$SUCCESS"
-        cp "$LOGDIR/attempt.$N.out" "$LOGDIR/device_profile.out"
-        echo "[devloop] SUCCESS on attempt $N" >>"$LOGDIR/devloop.log"
-        exit 0
-      fi
+      echo "[devloop] attempt $N (leaseholder) exited rc=$?" >>"$LOGDIR/devloop.log"
       break
     fi
     sleep 5
     WAITED=$((WAITED + 5))
-    if [ "$WAITED" -ge "$ACQ_TIMEOUT" ]; then
-      if [ -f "$MARKER" ]; then
-        # lease acquired during the last sleep: never kill; loop back to
-        # the marker branch above and wait for completion
-        continue
-      fi
-      # still waiting for acquisition -> safe to kill
-      echo "[devloop] attempt $N still waiting after ${WAITED}s; killing (safe: no lease)" >>"$LOGDIR/devloop.log"
+    if [ "$WAITED" -ge "$ACQ_TIMEOUT" ] && [ ! -f "$MARKER" ]; then
+      # still waiting for acquisition -> safe to SIGTERM
+      echo "[devloop] attempt $N still waiting after ${WAITED}s; stopping (safe: no lease)" >>"$LOGDIR/devloop.log"
       kill "$PID" 2>/dev/null
       sleep 2
+      # the lease may have been acquired in the window between the marker
+      # check and the SIGTERM landing: re-check before escalating. If the
+      # marker appeared, the process is a leaseholder — never kill -9; go
+      # back to the wait-for-leaseholder branch instead.
+      if [ -f "$MARKER" ] && kill -0 "$PID" 2>/dev/null; then
+        echo "[devloop] attempt $N acquired the lease during shutdown; reverting to wait" >>"$LOGDIR/devloop.log"
+        continue
+      fi
       kill -9 "$PID" 2>/dev/null
-      wait "$PID" 2>/dev/null
       break
     fi
   done
+  # the process may also have exited on its own during a poll sleep before
+  # the marker was observed — always collect it and run the success check
+  wait "$PID" 2>/dev/null
+  if check_success "$N"; then
+    exit 0
+  fi
   echo "[devloop] $(date +%H:%M:%S) attempt $N done; sleeping ${SLEEP_BETWEEN}s" >>"$LOGDIR/devloop.log"
   sleep "$SLEEP_BETWEEN"
 done

@@ -1,0 +1,60 @@
+"""Single-client lock for the TPU tunnel.
+
+The axon tunnel tolerates exactly ONE jax client process at a time: a second
+concurrent client wedges device acquisition machine-wide for a long time.
+Every process that may initialize a non-CPU jax backend must hold this flock
+for its whole lifetime (the OS releases it automatically on exit or kill, so
+a dead holder can never wedge the lock itself).
+
+Stdlib-only so probe subprocesses can import it without pulling in jax.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import time
+
+LOCK_PATH = os.environ.get("SKYPLANE_TUNNEL_LOCK", "/tmp/skyplane_tpu_tunnel.lock")
+
+_held_fd: int | None = None  # keep the fd referenced for the process lifetime
+
+
+def acquire_tunnel_lock(timeout_s: float | None = None) -> bool:
+    """Acquire the exclusive tunnel lock, blocking up to timeout_s.
+
+    Returns True when held (also when already held by this process).
+    timeout_s=None blocks indefinitely; timeout_s=0 is a single try.
+    The lock is intentionally never released explicitly: it guards jax
+    backend state that lives until process exit.
+    """
+    global _held_fd
+    if _held_fd is not None:
+        return True
+    fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            _held_fd = fd
+            return True
+        except BlockingIOError:
+            if deadline is not None and time.monotonic() >= deadline:
+                os.close(fd)
+                return False
+            time.sleep(min(1.0, 0.2 if timeout_s == 0 else 1.0))
+
+
+def tunnel_busy() -> bool:
+    """True if some OTHER process currently holds the tunnel lock."""
+    if _held_fd is not None:
+        return False
+    fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return False
+    except BlockingIOError:
+        return True
+    finally:
+        os.close(fd)
