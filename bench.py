@@ -78,8 +78,13 @@ def probe_device() -> str:
     while time.monotonic() < deadline:
         i += 1
         if tunnel_busy():
+            # a held lock proves one of OUR clients is mid-session: the
+            # tunnel machinery is alive, just occupied. Waiting for it must
+            # not consume the probe budget (a devloop profile run can hold
+            # the lock for many minutes) — extend the deadline by the wait.
             log(f"probe {i}: tunnel lock held by another local client (alive, busy); waiting...")
-            time.sleep(min(20, max(1, deadline - time.monotonic())))
+            time.sleep(20)
+            deadline += 20
             continue
         timeout_s = min(attempt_timeout * min(i, 3), max(5.0, deadline - time.monotonic()))
         try:

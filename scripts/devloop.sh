@@ -18,9 +18,11 @@ ACQ_TIMEOUT=${ACQ_TIMEOUT:-300}   # how long an attempt may wait for acquisition
 SLEEP_BETWEEN=${SLEEP_BETWEEN:-120}
 SUCCESS=$LOGDIR/device_profile.success
 
-check_success() { # $1 = attempt number; records success if the output proves a TPU run
+check_success() { # $1 = attempt number, $2 = attempt rc; records success only
+  # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
+  # acquired but crashed mid-profile must be retried, not recorded
   local out=$LOGDIR/attempt.$1.out
-  if grep -q '"stage": "acquire"' "$out" 2>/dev/null &&
+  if [ "${2:-1}" -eq 0 ] && grep -q '"stage": "acquire"' "$out" 2>/dev/null &&
     ! grep -q '"platform": "cpu"' "$out" 2>/dev/null; then
     touch "$SUCCESS"
     cp "$out" "$LOGDIR/device_profile.out"
@@ -45,12 +47,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     >"$LOGDIR/attempt.$N.out" 2>"$LOGDIR/attempt.$N.err" &
   PID=$!
   WAITED=0
+  RC=""
   while kill -0 "$PID" 2>/dev/null; do
     if [ -f "$MARKER" ]; then
       # lease held: wait indefinitely, NEVER kill
       echo "[devloop] attempt $N HOLDS THE LEASE; waiting for it to finish" >>"$LOGDIR/devloop.log"
       wait "$PID"
-      echo "[devloop] attempt $N (leaseholder) exited rc=$?" >>"$LOGDIR/devloop.log"
+      RC=$?
+      echo "[devloop] attempt $N (leaseholder) exited rc=$RC" >>"$LOGDIR/devloop.log"
       break
     fi
     sleep 5
@@ -73,9 +77,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     fi
   done
   # the process may also have exited on its own during a poll sleep before
-  # the marker was observed — always collect it and run the success check
-  wait "$PID" 2>/dev/null
-  if check_success "$N"; then
+  # the marker was observed — collect it (unless the leaseholder branch
+  # already reaped it and captured RC) and run the success check
+  if [ -z "$RC" ]; then
+    wait "$PID" 2>/dev/null
+    RC=$?
+  fi
+  if check_success "$N" "$RC"; then
     exit 0
   fi
   echo "[devloop] $(date +%H:%M:%S) attempt $N done; sleeping ${SLEEP_BETWEEN}s" >>"$LOGDIR/devloop.log"

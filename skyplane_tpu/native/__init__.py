@@ -27,22 +27,22 @@ def _build_dir() -> Path:
 
 
 def load_library() -> ctypes.CDLL:
-    """Build (if needed) and load libskyfastlz."""
+    """Build (if needed) and load libskydp."""
     global _lib
     if _lib is not None:
         return _lib
     with _BUILD_LOCK:
         if _lib is not None:
             return _lib
-        sources = [_SRC_DIR / "fastlz.cpp", _SRC_DIR / "datapath.cpp"]
-        out = _build_dir() / "libskyfastlz.so"
+        sources = [_SRC_DIR / "skylz.cpp", _SRC_DIR / "datapath.cpp"]
+        out = _build_dir() / "libskydp.so"
         # the library is built with -march=native and MUST NOT travel between
         # hosts (an AVX-512 build SIGILLs elsewhere): a host-tag sidecar forces
         # a rebuild whenever the .so was produced on a different machine
         import platform
 
         host_tag = f"{platform.machine()}-{platform.node()}"
-        tag_file = _build_dir() / "libskyfastlz.hosttag"
+        tag_file = _build_dir() / "libskydp.hosttag"
         stale_host = not tag_file.exists() or tag_file.read_text() != host_tag
         if not out.exists() or stale_host or any(out.stat().st_mtime < s.stat().st_mtime for s in sources):
             out.parent.mkdir(parents=True, exist_ok=True)
@@ -64,13 +64,18 @@ def load_library() -> ctypes.CDLL:
         u32p = ctypes.POINTER(ctypes.c_uint32)
         i64p = ctypes.POINTER(ctypes.c_int64)
         for name, restype, argtypes in (
-            ("skyfastlz_max_compressed_size", ctypes.c_uint64, [ctypes.c_uint64]),
-            ("skyfastlz_compress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
-            ("skyfastlz_decompressed_size", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64]),
-            ("skyfastlz_decompress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
-            ("skyfastlz_checksum64", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]),
+            ("skylz_max_compressed_size", ctypes.c_uint64, [ctypes.c_uint64]),
+            ("skylz_compress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
+            ("skylz_decompressed_size", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64]),
+            ("skylz_decompress", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]),
+            ("skylz_checksum64", ctypes.c_uint64, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]),
             ("skydp_gear_candidates", None, [u8p, ctypes.c_uint64, u32p, ctypes.c_uint32, u8p]),
             ("skydp_segment_fp", None, [u8p, ctypes.c_uint64, i64p, ctypes.c_uint64, u32p, u32p]),
+            (
+                "skydp_cdc_fp",
+                ctypes.c_uint64,
+                [u8p, ctypes.c_uint64, u32p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint64, u32p, i64p, u32p, ctypes.c_uint64],
+            ),
             ("skydp_blockpack_encode", ctypes.c_uint64, [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p, u8p]),
             ("skydp_blockpack_decode", ctypes.c_int, [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, ctypes.c_uint64, u8p]),
         ):

@@ -112,14 +112,14 @@ static inline __m256i fold31_vec(__m256i x) {
 void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
                       uint64_t n_ends, const uint32_t* bases, uint32_t* out_lanes) {
     (void)n;
-    uint32_t rp[16][8];  // rp[k][l] = r_l^(k+1) mod M31
+    uint32_t rp[32][8];  // rp[k][l] = r_l^(k+1) mod M31
     for (int l = 0; l < 8; l++) {
         rp[0][l] = bases[l] >= M31 ? bases[l] - M31 : bases[l];
-        for (int k = 1; k < 16; k++) rp[k][l] = fold31((uint64_t)rp[k - 1][l] * rp[0][l]);
+        for (int k = 1; k < 32; k++) rp[k][l] = fold31((uint64_t)rp[k - 1][l] * rp[0][l]);
     }
 #if defined(__AVX512F__)
-    __m512i rpz[16];  // rp as u64 lanes: one zmm covers all 8 lanes
-    for (int k = 0; k < 16; k++) {
+    __m512i rpz[32];  // rp as u64 lanes: one zmm covers all 8 lanes
+    for (int k = 0; k < 32; k++) {
         rpz[k] = _mm512_set_epi64(rp[k][7], rp[k][6], rp[k][5], rp[k][4],
                                   rp[k][3], rp[k][2], rp[k][1], rp[k][0]);
     }
@@ -136,50 +136,51 @@ void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
         const int64_t end = ends[s];
         uint32_t f[8] = {0, 0, 0, 0, 0, 0, 0, 0};
         // Horner runs first-to-last: peel the length remainder at the HEAD so
-        // the strided loop covers an exact multiple of 16
+        // the strided loop covers an exact multiple of the stride
+#if defined(__AVX512F__)
+        // stride 32 with a SINGLE fold per step: byte terms are < 2^39 each,
+        // 32 of them sum below 2^44, and the one f-dependent product is
+        // < 2^62 — the whole step fits u64, so the critical path is one
+        // vpmuludq + one add + one fold31_zvec per 32 bytes (the 16-byte
+        // variant paid four folds per step and measured ~35% slower)
         int64_t i = start;
-        const int64_t head_end = start + ((end - start) & 15);
+        const int64_t head_end = start + ((end - start) & 31);
         for (; i < head_end; i++) {
             const uint64_t b = data[i];
             for (int l = 0; l < 8; l++) f[l] = fold31((uint64_t)f[l] * rp[0][l] + b);
         }
-#if defined(__AVX512F__)
         __m512i fz = _mm512_set_epi64(f[7], f[6], f[5], f[4], f[3], f[2], f[1], f[0]);
-        for (; i + 16 <= end; i += 16) {
-            // one zmm multiply covers all 8 lanes: 1 vpmuludq per byte term
-            __m512i hi = _mm512_add_epi64(
-                _mm512_mul_epu32(fz, rpz[15]),
-                _mm512_add_epi64(
-                    _mm512_mul_epu32(_mm512_set1_epi64(data[i + 0]), rpz[14]),
-                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 1]), rpz[13]),
-                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 2]), rpz[12]))));
-            __m512i mid = _mm512_add_epi64(
-                _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 3]), rpz[11]),
-                                 _mm512_mul_epu32(_mm512_set1_epi64(data[i + 4]), rpz[10])),
-                _mm512_add_epi64(
-                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 5]), rpz[9]),
-                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 6]), rpz[8])),
-                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 7]), rpz[7]),
-                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 8]), rpz[6]))));
-            __m512i lo = _mm512_add_epi64(
-                _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 9]), rpz[5]),
-                                 _mm512_mul_epu32(_mm512_set1_epi64(data[i + 10]), rpz[4])),
-                _mm512_add_epi64(
-                    _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 11]), rpz[3]),
-                                     _mm512_mul_epu32(_mm512_set1_epi64(data[i + 12]), rpz[2])),
-                    _mm512_add_epi64(
-                        _mm512_add_epi64(_mm512_mul_epu32(_mm512_set1_epi64(data[i + 13]), rpz[1]),
-                                         _mm512_mul_epu32(_mm512_set1_epi64(data[i + 14]), rpz[0])),
-                        _mm512_set1_epi64(data[i + 15]))));
-            fz = fold31_zvec(_mm512_add_epi64(
-                fold31_zvec(hi), _mm512_add_epi64(fold31_zvec(mid), fold31_zvec(lo))));
+        for (; i + 32 <= end; i += 32) {
+            // zero-block fast path: snapshot/filesystem corpora carry long
+            // zero extents; an all-zero block contributes nothing to acc, so
+            // F just advances by r^32 — bit-identical to the general path
+            // (acc would be 0) at ~1/10 the work. ~2 extra uops when nonzero.
+            const __m256i raw = _mm256_loadu_si256((const __m256i*)(data + i));
+            if (_mm256_testz_si256(raw, raw)) {
+                fz = fold31_zvec(_mm512_mul_epu32(fz, rpz[31]));
+                continue;
+            }
+            __m512i acc = _mm512_set1_epi64(data[i + 31]);  // b_31 * r^0
+#pragma GCC unroll 31
+            for (int j = 0; j < 31; j++) {
+                acc = _mm512_add_epi64(acc, _mm512_mul_epu32(_mm512_set1_epi64(data[i + j]), rpz[30 - j]));
+            }
+            fz = fold31_zvec(_mm512_add_epi64(_mm512_mul_epu32(fz, rpz[31]), acc));
         }
         {
             uint64_t tmp[8];
             _mm512_storeu_si512((void*)tmp, fz);
             for (int j = 0; j < 8; j++) f[j] = (uint32_t)tmp[j];
         }
+        // 16..31-byte tail after the head peel only occurs when the segment
+        // is shorter than 32 — already fully handled by the head loop
 #elif defined(__AVX2__)
+        int64_t i = start;
+        const int64_t head_end = start + ((end - start) & 15);
+        for (; i < head_end; i++) {
+            const uint64_t b = data[i];
+            for (int l = 0; l < 8; l++) f[l] = fold31((uint64_t)f[l] * rp[0][l] + b);
+        }
         __m256i fv[2];
         for (int v = 0; v < 2; v++)
             fv[v] = _mm256_set_epi64x(f[4 * v + 3], f[4 * v + 2], f[4 * v + 1], f[4 * v]);
@@ -224,6 +225,12 @@ void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
             for (int j = 0; j < 4; j++) f[4 * v + j] = (uint32_t)tmp[j];
         }
 #else
+        int64_t i = start;
+        const int64_t head_end = start + ((end - start) & 15);
+        for (; i < head_end; i++) {
+            const uint64_t b = data[i];
+            for (int l = 0; l < 8; l++) f[l] = fold31((uint64_t)f[l] * rp[0][l] + b);
+        }
         for (; i + 16 <= end; i += 16) {
             uint64_t b[16];
             for (int j = 0; j < 16; j++) b[j] = data[i + j];
@@ -248,6 +255,115 @@ void skydp_segment_fp(const uint8_t* data, uint64_t n, const int64_t* ends,
         for (int l = 0; l < 8; l++) out[l] = f[l];
         start = end;
     }
+}
+
+// Fused CDC + fingerprints: sparse gear candidates -> greedy min/max boundary
+// selection -> 8-lane segment fingerprints, all in one call. This is the
+// host fast path (DataPathProcessor._cdc_and_fps): compared to the
+// mask-producing skydp_gear_candidates it never materializes the per-byte
+// candidate mask (a 1-byte store per input byte measures ~5x slower than the
+// rare-branch sparse append below) and skips the host-side flatnonzero +
+// Python selection loop entirely. Bit-identical to
+// select_boundaries(flatnonzero(gear_candidates(..)), ..) + skydp_segment_fp
+// (tested: tests/unit/test_native_datapath.py).
+//
+// out_ends must hold n/min_bytes + 2 entries, out_lanes 8x that. Returns the
+// number of segment ends written, or UINT64_MAX if max_ends was too small
+// (cannot happen with the documented sizing; checked anyway).
+uint64_t skydp_cdc_fp(const uint8_t* data, uint64_t n, const uint32_t* table,
+                      uint32_t mask_bits, uint64_t min_bytes, uint64_t max_bytes,
+                      const uint32_t* bases, int64_t* out_ends, uint32_t* out_lanes,
+                      uint64_t max_ends) {
+    const uint32_t shift = 32 - mask_bits;
+    // --- pass 1: sparse candidate positions (8 interleaved gear chains; see
+    // skydp_gear_candidates for why the chains are split and warmed up) ---
+    const int S = 8;
+    uint64_t n_cand = 0;
+    uint32_t* cand;
+    uint32_t small_buf[1024];
+    uint32_t* heap_buf = nullptr;
+    if (n < 1024) {
+        cand = small_buf;
+        uint32_t h = 0;
+        for (uint64_t i = 0; i < n; i++) {
+            h = (h << 1) + table[data[i]];
+            if ((h >> shift) == 0) cand[n_cand++] = (uint32_t)i;
+        }
+    } else {
+        const uint64_t piece = n / S;
+        // worst case every position is a candidate: piece entries per stream.
+        // The allocation is virtual — only pages actually written are touched,
+        // and real candidate density is ~2^-mask_bits.
+        heap_buf = (uint32_t*)__builtin_malloc((n + S) * sizeof(uint32_t));
+        if (!heap_buf) return ~(uint64_t)0;
+        cand = heap_buf;
+        uint64_t start_k[S];
+        uint32_t h[S];
+        uint64_t cnt[S];
+        uint32_t* buf[S];
+        for (int k = 0; k < S; k++) {
+            start_k[k] = k * piece;
+            h[k] = 0;
+            cnt[k] = 0;
+            buf[k] = heap_buf + k * (piece + 1);
+        }
+        for (int k = 1; k < S; k++) {  // 31-byte window warm-up per stream
+            for (uint64_t i = start_k[k] - 31; i < start_k[k]; i++) h[k] = (h[k] << 1) + table[data[i]];
+        }
+#pragma GCC novector
+        for (uint64_t j = 0; j < piece; j++) {
+#pragma GCC unroll 8
+            for (int k = 0; k < S; k++) {
+                const uint64_t i = start_k[k] + j;
+                h[k] = (h[k] << 1) + table[data[i]];
+                if (__builtin_expect((h[k] >> shift) == 0, 0)) buf[k][cnt[k]++] = (uint32_t)i;
+            }
+        }
+        // merge: streams cover contiguous ascending ranges, so concatenation
+        // in stream order is globally position-sorted
+        for (int k = 0; k < S; k++) {
+            if (buf[k] != cand + n_cand) __builtin_memmove(cand + n_cand, buf[k], cnt[k] * 4);
+            n_cand += cnt[k];
+        }
+        uint32_t ht = h[S - 1];
+        for (uint64_t i = (uint64_t)S * piece; i < n; i++) {  // n % S tail
+            ht = (ht << 1) + table[data[i]];
+            if ((ht >> shift) == 0) cand[n_cand++] = (uint32_t)i;
+        }
+    }
+    // --- pass 2: greedy min/max boundary selection (mirror of
+    // ops/cdc.py select_boundaries, candidate positions -> segment ends) ---
+    uint64_t n_ends = 0;
+    uint64_t start = 0;
+    bool overflow = false;
+    for (uint64_t c = 0; c < n_cand && !overflow; c++) {
+        const uint64_t cut = (uint64_t)cand[c] + 1;
+        if (cut - start < min_bytes) continue;
+        while (cut - start > max_bytes) {  // candidate overshoots: forced cuts first
+            start += max_bytes;
+            if (n_ends >= max_ends) { overflow = true; break; }
+            out_ends[n_ends++] = (int64_t)start;
+        }
+        if (!overflow && cut - start >= min_bytes) {
+            if (n_ends >= max_ends) { overflow = true; break; }
+            out_ends[n_ends++] = (int64_t)cut;
+            start = cut;
+        }
+    }
+    while (!overflow && n - start > max_bytes) {
+        start += max_bytes;
+        if (n_ends >= max_ends) { overflow = true; break; }
+        out_ends[n_ends++] = (int64_t)start;
+    }
+    if (!overflow && (start < n || n_ends == 0)) {
+        if (n_ends >= max_ends) overflow = true;
+        else out_ends[n_ends++] = (int64_t)n;
+    }
+    __builtin_free(heap_buf);
+    if (overflow) return ~(uint64_t)0;
+    // --- pass 3: 8-lane segment fingerprints over the selected segments ---
+    skydp_segment_fp(data, n, out_ends, n_ends, bases, out_lanes);
+    return n_ends;
 }
 
 // Blockpack encode: per block_bytes block emit tag (0=zero, 1=const, 2=

@@ -55,6 +55,45 @@ def gear_candidates(data: np.ndarray, mask_bits: int) -> np.ndarray:
     return out.view(bool)
 
 
+def cdc_fp(data: np.ndarray, mask_bits: int, min_bytes: int, max_bytes: int):
+    """Fused CDC + fingerprints for one chunk in a single native call.
+
+    [N] uint8 -> (ends [n_segments] int64, lanes [n_segments, 8] uint32).
+    Bit-identical to cdc_segment_ends + segment_fp_lanes (tested), but never
+    materializes the per-byte candidate mask and runs boundary selection in C
+    — the host sender's hot path.
+    """
+    if not 1 <= mask_bits <= 31:
+        raise ValueError(f"mask_bits must be in [1, 31], got {mask_bits}")
+    from skyplane_tpu.ops.gear import GEAR_TABLE
+    from skyplane_tpu.ops.fingerprint import LANE_BASES
+
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    n = len(data)
+    if n == 0:
+        return np.asarray([0], np.int64), np.zeros((1, 8), np.uint32)
+    table = np.ascontiguousarray(GEAR_TABLE, dtype=np.uint32)
+    bases = np.ascontiguousarray(LANE_BASES, dtype=np.uint32)
+    max_ends = n // min_bytes + 2
+    ends = np.empty(max_ends, np.int64)
+    lanes = np.empty((max_ends, 8), np.uint32)
+    n_ends = load_library().skydp_cdc_fp(
+        _u8p(data),
+        n,
+        _u32p(table),
+        mask_bits,
+        min_bytes,
+        max_bytes,
+        _u32p(bases),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _u32p(lanes.reshape(-1)),
+        max_ends,
+    )
+    if n_ends == np.iinfo(np.uint64).max:
+        raise MemoryError("skydp_cdc_fp: segment buffer overflow (impossible sizing?) or OOM")
+    return ends[:n_ends].copy(), lanes[:n_ends].copy()
+
+
 def blockpack_encode(data: np.ndarray, block_bytes: int):
     """[N] uint8 (N % block_bytes == 0) -> (tags [NB] uint8, literals, n_lit),
     same contract as host_fallback.blockpack_encode_host."""

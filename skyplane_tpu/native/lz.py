@@ -10,9 +10,9 @@ from skyplane_tpu.native import load_library
 
 def compress(data: bytes) -> bytes:
     lib = load_library()
-    cap = lib.skyfastlz_max_compressed_size(len(data))
+    cap = lib.skylz_max_compressed_size(len(data))
     out = ctypes.create_string_buffer(cap)
-    n = lib.skyfastlz_compress(data, len(data), out, cap)
+    n = lib.skylz_compress(data, len(data), out, cap)
     if n == 0:
         raise CodecException("native_lz compression failed")
     return out.raw[:n]
@@ -29,7 +29,7 @@ def decompress(buf: bytes) -> bytes:
         raise CodecException(f"native_lz: container claims {raw_len} raw bytes (> {MAX_CHUNK_BYTES} cap)")
     lib = load_library()
     out = ctypes.create_string_buffer(max(raw_len, 1))
-    n = lib.skyfastlz_decompress(buf, len(buf), out, raw_len)
+    n = lib.skylz_decompress(buf, len(buf), out, raw_len)
     if n != raw_len:
         raise CodecException(f"native_lz decompression failed ({n} != {raw_len})")
     return out.raw[:raw_len]
@@ -37,4 +37,4 @@ def decompress(buf: bytes) -> bytes:
 
 def checksum64(data: bytes, seed: int = 0) -> int:
     lib = load_library()
-    return int(lib.skyfastlz_checksum64(data, len(data), seed))
+    return int(lib.skylz_checksum64(data, len(data), seed))

@@ -13,7 +13,7 @@
 //           == 15 -> varint extra match length follows
 //   stream ends when raw_len bytes have been reconstructed.
 //
-// Build: g++ -O3 -shared -fPIC fastlz.cpp -o libskyfastlz.so
+// Build: g++ -O3 -shared -fPIC skylz.cpp -o libskydp.so
 
 #include <cstdint>
 #include <cstring>
@@ -51,13 +51,13 @@ static inline size_t read_varint(const uint8_t* in, size_t avail, uint64_t* v) {
 }
 
 // worst case: header + raw + per-255-literal overhead
-uint64_t skyfastlz_max_compressed_size(uint64_t raw_len) {
+uint64_t skylz_max_compressed_size(uint64_t raw_len) {
     // header + raw + token overhead + emit()'s conservative varint headroom
     return 11 + raw_len + raw_len / 255 + 64;
 }
 
 // returns compressed size, or 0 on error / insufficient dst capacity
-uint64_t skyfastlz_compress(const uint8_t* src, uint64_t src_len, uint8_t* dst, uint64_t dst_cap) {
+uint64_t skylz_compress(const uint8_t* src, uint64_t src_len, uint8_t* dst, uint64_t dst_cap) {
     if (dst_cap < 11) return 0;
     uint8_t* out = dst;
     *out++ = MAGIC0; *out++ = MAGIC1; *out++ = VERSION;
@@ -127,15 +127,15 @@ uint64_t skyfastlz_compress(const uint8_t* src, uint64_t src_len, uint8_t* dst, 
 }
 
 // returns raw size, or 0 on error
-uint64_t skyfastlz_decompressed_size(const uint8_t* src, uint64_t src_len) {
+uint64_t skylz_decompressed_size(const uint8_t* src, uint64_t src_len) {
     if (src_len < 11 || src[0] != MAGIC0 || src[1] != MAGIC1 || src[2] != VERSION) return 0;
     uint64_t raw_len;
     memcpy(&raw_len, src + 3, 8);
     return raw_len;
 }
 
-uint64_t skyfastlz_decompress(const uint8_t* src, uint64_t src_len, uint8_t* dst, uint64_t dst_cap) {
-    uint64_t raw_len = skyfastlz_decompressed_size(src, src_len);
+uint64_t skylz_decompress(const uint8_t* src, uint64_t src_len, uint8_t* dst, uint64_t dst_cap) {
+    uint64_t raw_len = skylz_decompressed_size(src, src_len);
     if (raw_len == 0 && !(src_len >= 11 && src[0] == MAGIC0)) return 0;
     if (dst_cap < raw_len) return 0;
     const uint8_t* in = src + 11;
@@ -179,7 +179,7 @@ uint64_t skyfastlz_decompress(const uint8_t* src, uint64_t src_len, uint8_t* dst
 }
 
 // xxhash-inspired 64-bit checksum (own constants/rounds; not xxhash-compatible)
-uint64_t skyfastlz_checksum64(const uint8_t* data, uint64_t len, uint64_t seed) {
+uint64_t skylz_checksum64(const uint8_t* data, uint64_t len, uint64_t seed) {
     const uint64_t P1 = 0x9E3779B185EBCA87ULL, P2 = 0xC2B2AE3D27D4EB4FULL, P3 = 0x165667B19E3779F9ULL;
     uint64_t h = seed ^ (len * P1);
     uint64_t i = 0;

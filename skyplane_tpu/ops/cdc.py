@@ -97,6 +97,30 @@ def cdc_segment_ends(data: bytes | np.ndarray, params: CDCParams = CDCParams()) 
     return select_boundaries(candidates, n, params)
 
 
+def cdc_and_fps_host(arr: np.ndarray, params: CDCParams = CDCParams()) -> Tuple[np.ndarray, list]:
+    """Fused host CDC + segment digests: (ends, [fp16 bytes, ...]).
+
+    One native call (skydp_cdc_fp: sparse gear candidates -> C boundary
+    selection -> 8-lane fingerprints) when the library is built — ~2.5x the
+    two-stage host path, which remains the fallback and the parity oracle
+    (tests/unit/test_native_datapath.py pins them bit-identical).
+    """
+    arr = np.frombuffer(arr, np.uint8) if isinstance(arr, (bytes, bytearray, memoryview)) else np.asarray(arr, np.uint8)
+    from skyplane_tpu.native import datapath as native_dp
+
+    # the fused kernel tracks candidate positions as u32 — chunks >= 4 GiB
+    # (MAX_CHUNK_BYTES allows 8 GiB) take the two-stage int64 path instead
+    if len(arr) and len(arr) < (1 << 32) and native_dp.available():
+        from skyplane_tpu.ops.fingerprint import digests_from_lanes
+
+        ends, lanes = native_dp.cdc_fp(arr, params.mask_bits, params.min_bytes, params.max_bytes)
+        return ends, digests_from_lanes(lanes, ends)
+    ends = cdc_segment_ends(arr, params)
+    from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
+
+    return ends, segment_fingerprints_host_batch(arr, ends)
+
+
 def segment_ids_and_rev_pos(ends: np.ndarray, n: int) -> Tuple[np.ndarray, np.ndarray]:
     """Per-byte (segment_id, reversed-position-in-segment) vectors for the
     fingerprint kernel, computed vectorized on host."""

@@ -162,6 +162,23 @@ def finalize_fingerprint(lanes: np.ndarray, length: int) -> str:
     return h.hexdigest()
 
 
+def digests_from_lanes(lanes: np.ndarray, ends: np.ndarray) -> list:
+    """Finalize [n_segments, 8] uint32 lane rows into 16-byte wire digests.
+
+    Identical bytes to ``bytes.fromhex(finalize_fingerprint(lanes[i], L_i))``
+    — one bulk little-endian conversion instead of a numpy round trip per row.
+    """
+    la = np.ascontiguousarray(lanes, dtype="<u4").tobytes()
+    ends_l = np.asarray(ends, np.int64).tolist()
+    out = []
+    start = 0
+    for i, end in enumerate(ends_l):
+        h = hashlib.blake2b(la[i * 32 : i * 32 + 32] + (end - start).to_bytes(8, "little"), digest_size=16)
+        out.append(h.digest())
+        start = end
+    return out
+
+
 def fingerprint_bytes_host(data: bytes) -> str:
     """Host fallback fingerprint (CPU codec path): blake2b-128 of the raw bytes."""
     return hashlib.blake2b(data, digest_size=16).hexdigest()
@@ -222,8 +239,7 @@ def segment_fingerprints_host_batch(arr: np.ndarray, ends: np.ndarray) -> list:
                 t = (t >> np.uint64(31)) + (t & m31)  # < 2^31 + 2^8
                 total = int(t.sum())  # <= 2^18 * 2^32 < 2^50, python int exact
                 lanes[si, li] = total % M31
-    seg_lens = ends - starts
-    return [bytes.fromhex(finalize_fingerprint(lanes[i], int(seg_lens[i]))) for i in range(len(ends))]
+    return digests_from_lanes(lanes, ends)
 
 
 def segment_fingerprint_np(data: np.ndarray, boundaries: np.ndarray) -> np.ndarray:

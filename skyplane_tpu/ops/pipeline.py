@@ -205,8 +205,9 @@ class DataPathProcessor:
         """CDC boundaries + segment fingerprints with ONE device dispatch and
         ONE small packed readback on accelerators (ops/fused_cdc.py)."""
         if not self._on_accelerator():
-            ends = cdc_segment_ends(arr, self.cdc_params)
-            return ends, self._segment_fps(arr, ends)
+            from skyplane_tpu.ops.cdc import cdc_and_fps_host
+
+            return cdc_and_fps_host(arr, self.cdc_params)
         if self.batch_runner is not None:
             # the runner chunks with ITS params; both paths must agree or the
             # same bytes would fingerprint differently depending on routing
@@ -229,8 +230,15 @@ class DataPathProcessor:
         if self.dedup and index is not None and raw_len > 0:
             arr = np.frombuffer(data, np.uint8)
             ends, seg_fps = self._cdc_and_fps(arr)
-            starts = np.concatenate([[0], ends[:-1]])
-            segments = [(seg_fps[i], data[starts[i] : ends[i]]) for i in range(len(ends))]
+            # memoryview slices: REF segments never need their bytes copied
+            # (only literals are materialized, inside build_recipe's join)
+            mv = memoryview(data)
+            ends_l = np.asarray(ends).tolist()
+            segments = []
+            start = 0
+            for i, end in enumerate(ends_l):
+                segments.append((seg_fps[i], mv[start:end]))
+                start = end
             wire, n_ref, lit_bytes, new_fps, ref_fps = build_recipe(segments, index, self.codec.encode)
             payload = ProcessedPayload(
                 wire_bytes=wire,

@@ -115,16 +115,127 @@ class ThroughputSolverRON(ThroughputSolver):
 
 
 class ThroughputSolverILP(ThroughputSolver):
-    """Min-cost overlay flow via LP relaxation (reference: solver_ilp.py:15-134).
+    """Min-cost overlay flow MILP (reference: solver_ilp.py:15-134).
 
-    Variables: flow f_e >= 0 on each directed edge of the candidate region
-    graph. Constraints: flow conservation (src emits R, dst absorbs R,
-    relays conserve), per-region egress/ingress NIC caps scaled by the
-    instance limit. Objective: egress $ + instance $ (instances implied by
-    NIC utilization, priced per region-hour over the transfer duration).
+    Variables: flow f_e >= 0 per directed edge, plus an INTEGER instance
+    count n_r per region (scipy.optimize.milp; the reference co-optimizes the
+    same pair with cvxpy/GUROBI). Constraints: flow conservation (src emits
+    R, dst absorbs R, relays conserve), per-region egress/ingress NIC caps
+    scaled by n_r, per-edge caps scaled by the sending region's n_r.
+    Objective: egress $ + instance $ (n_r priced per region-hour over the
+    transfer duration) — integral instance pricing, so partially-used VMs
+    cost a whole VM, which the LP relaxation (``_solve_min_cost_lp``, kept as
+    the no-scipy-milp fallback and as the pin-test baseline) systematically
+    underestimates before its round-up step.
     """
 
     def solve_min_cost(
+        self,
+        p: ThroughputProblem,
+        candidate_regions: List[str],
+        solver_verbose: bool = False,
+    ) -> ThroughputSolution:
+        try:
+            from scipy.optimize import Bounds, LinearConstraint, milp
+        except ImportError:  # older scipy: LP + round-up approximation
+            return self._solve_min_cost_lp(p, candidate_regions, solver_verbose)
+
+        regions = [p.src] + [r for r in candidate_regions if r not in (p.src, p.dst)] + [p.dst]
+        n = len(regions)
+        idx = {r: i for i, r in enumerate(regions)}
+        edges = [(a, b) for a in regions for b in regions if a != b]
+        e_idx = {e: i for i, e in enumerate(edges)}
+        nE = len(edges)
+        R = p.required_throughput_gbits
+        transfer_hours = max(p.gbyte_to_transfer * 8 / max(R, 1e-6) / 3600, 1e-6)
+
+        # objective: egress $ per unit flow (fraction f/R of the corpus
+        # crosses the edge) + full per-VM-hour price on each integer n_r
+        c = np.zeros(nE + n)
+        for e, i in e_idx.items():
+            c[i] = self.get_path_cost(*e) * p.gbyte_to_transfer / max(R, 1e-6)
+        vm_cost = {}
+        for r in regions:
+            vm_cost[r] = get_instance_cost_per_hr(r, None) or 1.54
+            c[nE + idx[r]] = transfer_hours * vm_cost[r]
+
+        # conservation (flows only)
+        a_eq = np.zeros((n, nE + n))
+        b_eq = np.zeros(n)
+        for (a, b), i in e_idx.items():
+            a_eq[idx[a], i] += 1
+            a_eq[idx[b], i] -= 1
+        b_eq[idx[p.src]] = R
+        b_eq[idx[p.dst]] = -R
+
+        # caps tied to the integer instance counts: egress/ingress per region,
+        # per-edge scaled by the sender's instances
+        rows = []
+        for r in regions:
+            prov = r.split(":")[0]
+            egress_cap, ingress_cap = NIC_LIMITS.get(prov, (5.0, 10.0))
+            out_row = np.zeros(nE + n)
+            in_row = np.zeros(nE + n)
+            for (a, b), i in e_idx.items():
+                if a == r:
+                    out_row[i] = 1
+                if b == r:
+                    in_row[i] = 1
+            out_row[nE + idx[r]] = -egress_cap
+            in_row[nE + idx[r]] = -ingress_cap
+            rows.extend((out_row, in_row))
+        for (a, b), i in e_idx.items():
+            row = np.zeros(nE + n)
+            row[i] = 1
+            row[nE + idx[a]] = -self.get_path_throughput(a, b)
+            rows.append(row)
+        a_ub = np.array(rows)
+
+        lb = np.zeros(nE + n)
+        ub = np.concatenate([np.full(nE, np.inf), np.full(n, float(p.instance_limit))])
+        res = milp(
+            c=c,
+            constraints=[
+                LinearConstraint(a_ub, -np.inf, np.zeros(len(rows))),
+                LinearConstraint(a_eq, b_eq, b_eq),
+            ],
+            integrality=np.concatenate([np.zeros(nE), np.ones(n)]),
+            bounds=Bounds(lb, ub),
+        )
+        if not res.success:
+            return ThroughputSolution(problem=p, is_feasible=False)
+        flows = {e: float(res.x[i]) for e, i in e_idx.items() if res.x[i] > 1e-6}
+        instances: Dict[str, int] = {}
+        for r in regions:
+            cnt = int(round(res.x[nE + idx[r]]))
+            # the solver may park unused instances at 0 cost=0 regions; only
+            # count regions actually touching flow
+            touches = any(r in e for e in flows)
+            if cnt > 0 and touches:
+                instances[r] = cnt
+        egress = {e: self.get_path_cost(*e) * p.gbyte_to_transfer * (f / R) for e, f in flows.items()}
+        return ThroughputSolution(
+            problem=p,
+            is_feasible=True,
+            throughput_achieved_gbits=R,
+            cost_egress_by_edge=egress,
+            cost_total=float(res.fun),
+            edge_flow_gbits=flows,
+            instances_per_region=instances,
+        )
+
+    def true_cost(self, sol: ThroughputSolution) -> float:
+        """Deployable cost of a solution: egress $ + WHOLE instances priced
+        for the transfer duration (what you actually pay after rounding)."""
+        p = sol.problem
+        R = max(p.required_throughput_gbits, 1e-6)
+        transfer_hours = max(p.gbyte_to_transfer * 8 / R / 3600, 1e-6)
+        inst = sum(
+            (get_instance_cost_per_hr(r, None) or 1.54) * cnt for r, cnt in sol.instances_per_region.items()
+        )
+        return sum(sol.cost_egress_by_edge.values()) + transfer_hours * inst
+
+    def _solve_min_cost_lp(
         self,
         p: ThroughputProblem,
         candidate_regions: List[str],

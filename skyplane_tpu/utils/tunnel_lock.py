@@ -42,7 +42,7 @@ def acquire_tunnel_lock(timeout_s: float | None = None) -> bool:
             if deadline is not None and time.monotonic() >= deadline:
                 os.close(fd)
                 return False
-            time.sleep(min(1.0, 0.2 if timeout_s == 0 else 1.0))
+            time.sleep(1.0)
 
 
 def tunnel_busy() -> bool:

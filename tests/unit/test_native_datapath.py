@@ -152,3 +152,36 @@ def test_blockpack_decode_invalid_tag_matches_fallback():
     want = blockpack_decode_host(tags, lits, 256)
     got = ndp.blockpack_decode(tags, lits, 256)
     np.testing.assert_array_equal(want, got)
+
+
+def test_cdc_fp_fused_bit_identical():
+    """skydp_cdc_fp (sparse candidates + C boundary selection + fp) must be
+    bit-identical to the two-stage oracle: cdc_segment_ends (mask path) +
+    segment_fingerprints_host_batch."""
+    from skyplane_tpu.ops.cdc import CDCParams, cdc_and_fps_host, cdc_segment_ends
+    from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
+
+    for data in _corpora():
+        for params in (CDCParams(), CDCParams(min_bytes=64, avg_bytes=256, max_bytes=1024)):
+            ends_ref = cdc_segment_ends(data, params)
+            fps_ref = segment_fingerprints_host_batch(data, ends_ref)
+            ends, fps = cdc_and_fps_host(data, params)
+            assert np.array_equal(np.asarray(ends), ends_ref)
+            assert fps == fps_ref
+
+
+def test_cdc_fp_fused_empty_input():
+    from skyplane_tpu.ops.cdc import CDCParams, cdc_and_fps_host
+
+    ends, fps = cdc_and_fps_host(np.zeros(0, np.uint8), CDCParams())
+    assert list(ends) == [0]
+
+
+def test_digests_from_lanes_matches_finalize():
+    from skyplane_tpu.ops.fingerprint import digests_from_lanes, finalize_fingerprint
+
+    lanes = rng.integers(0, M31, size=(5, 8), dtype=np.uint32)
+    ends = np.asarray([100, 300, 301, 5000, 2 << 17], np.int64)
+    starts = np.concatenate([[0], ends[:-1]])
+    want = [bytes.fromhex(finalize_fingerprint(lanes[i], int(ends[i] - starts[i]))) for i in range(5)]
+    assert digests_from_lanes(lanes, ends) == want
