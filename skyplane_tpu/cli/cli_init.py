@@ -1,12 +1,25 @@
-"""`skyplane-tpu init`: credential detection + config bootstrap.
+"""`skyplane-tpu init`: interactive zero-to-credentials wizard + non-interactive detection.
 
-Reference parity: skyplane/cli/cli_init.py (interactive per-cloud setup,
-quota file capture). This implementation detects which SDKs + credentials are
-available, enables those clouds, and persists the config file; quota capture
-runs where the SDK supports it.
+Reference parity: skyplane/cli/cli_init.py:23-64 (AWS flow), :310-376 (GCP
+flow with API enablement + service-account path), :81-307 (Azure wizard —
+ours lives in compute/azure/azure_setup.py), :535-642 (init orchestration,
+quota capture). Interactive runs walk a user from zero credentials to a
+working config: AWS key entry (the `aws configure` step, inlined), GCP
+project + API enablement + skyplane service-account creation, Azure UMI +
+role setup. `--non-interactive` keeps the pure detection path for scripts.
+
+All prompts go through an injectable ``WizardIO`` so tests drive the full
+flow scripted (tests/unit/test_init_wizard.py), the same pattern as the
+Azure wizard's injectable az Runner.
 """
 
 from __future__ import annotations
+
+import configparser
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Optional
 
 from rich.console import Console
 
@@ -14,6 +27,32 @@ from skyplane_tpu.config import SkyplaneConfig
 from skyplane_tpu.config_paths import cloud_config, config_path
 
 console = Console()
+
+
+@dataclass
+class WizardIO:
+    """Injectable prompt surface: confirm(question, default) -> bool,
+    prompt(question, default) -> str, echo(message)."""
+
+    confirm: Callable[[str, bool], bool]
+    prompt: Callable[[str, Optional[str]], str]
+    echo: Callable[[str], None]
+
+
+def console_io() -> WizardIO:
+    def confirm(question: str, default: bool = True) -> bool:
+        suffix = "[Y/n]" if default else "[y/N]"
+        raw = console.input(f"{question} {suffix}: ").strip().lower()
+        if not raw:
+            return default
+        return raw in ("y", "yes")
+
+    def prompt(question: str, default: Optional[str] = None) -> str:
+        q = f"{question} [{default}]: " if default else f"{question}: "
+        raw = console.input(q).strip()
+        return raw or (default or "")
+
+    return WizardIO(confirm=confirm, prompt=prompt, echo=lambda m: console.print(m))
 
 
 def _detect_aws() -> bool:
@@ -49,33 +88,160 @@ def _detect_azure() -> bool:
         return False
 
 
-def run_init(non_interactive: bool = False) -> int:
+def aws_credentials_path() -> Path:
+    """The shared-credentials file boto3 reads (env-overridable, so tests and
+    sandboxes never touch the real ~/.aws)."""
+    return Path(os.environ.get("AWS_SHARED_CREDENTIALS_FILE", Path.home() / ".aws" / "credentials"))
+
+
+def load_aws_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = False) -> SkyplaneConfig:
+    """AWS flow (reference: cli_init.py:23-64 + the `aws configure` step the
+    reference points the user at, inlined as a key-entry prompt)."""
+    try:
+        import boto3
+    except ImportError:
+        cfg.aws_enabled = False
+        io.echo("[red]AWS support disabled: boto3 is not installed.[/red]")
+        return cfg
+    if not non_interactive and not io.confirm("Do you want to configure AWS support?", True):
+        cfg.aws_enabled = False
+        io.echo("Disabling AWS support")
+        return cfg
+
+    def creds_ok() -> Optional[str]:
+        session = boto3.Session()
+        creds = session.get_credentials()
+        if creds is None:
+            return None
+        frozen = creds.get_frozen_credentials()
+        if not frozen.access_key or not frozen.secret_key:
+            return None
+        return frozen.access_key
+
+    access_key = creds_ok()
+    if access_key is None and not non_interactive:
+        io.echo("[yellow]No AWS credentials found (env, shared credentials file, or instance profile).[/yellow]")
+        if io.confirm("Enter an IAM access key now (writes the shared credentials file)?", True):
+            key_id = io.prompt("AWS access key ID", None).strip()
+            secret = io.prompt("AWS secret access key", None).strip()
+            region = io.prompt("Default region", "us-east-1").strip()
+            if key_id and secret:
+                path = aws_credentials_path()
+                ini = configparser.ConfigParser()
+                if path.exists():
+                    ini.read(path)
+                if ini.has_section("default") or ini.defaults():
+                    io.echo("[red]A default profile already exists; not overwriting. Run `aws configure` instead.[/red]")
+                else:
+                    ini["default"] = {
+                        "aws_access_key_id": key_id,
+                        "aws_secret_access_key": secret,
+                        "region": region,
+                    }
+                    path.parent.mkdir(parents=True, exist_ok=True)
+                    with open(path, "w") as f:
+                        ini.write(f)
+                    os.chmod(path, 0o600)
+                    io.echo(f"Credentials written to {path}")
+                    access_key = creds_ok()
+        else:
+            io.echo("Set up credentials with `aws configure` and re-run init.")
+            io.echo("https://docs.aws.amazon.com/cli/latest/userguide/cli-chap-getting-started.html")
+    if access_key is None:
+        cfg.aws_enabled = False
+        io.echo("[yellow]AWS disabled: no usable credentials.[/yellow]")
+        return cfg
+    cfg.aws_enabled = True
+    io.echo(f"[green]Loaded AWS credentials[/green] [IAM access key ID: ...{access_key[-6:]}]")
+    return cfg
+
+
+GCP_REQUIRED_APIS = {"iam": "IAM", "compute": "Compute Engine", "storage": "Storage", "cloudresourcemanager": "Cloud Resource Manager"}
+
+
+def load_gcp_config(
+    cfg: SkyplaneConfig,
+    io: WizardIO,
+    non_interactive: bool = False,
+    auth_factory=None,
+) -> SkyplaneConfig:
+    """GCP flow (reference: cli_init.py:310-376): ADC detection, project
+    prompt, required-API enablement, skyplane service-account creation."""
+    if auth_factory is None:
+        from skyplane_tpu.compute.gcp.gcp_auth import GCPAuthentication
+
+        auth_factory = GCPAuthentication
+
+    def disable(msg: str) -> SkyplaneConfig:
+        io.echo(msg)
+        io.echo("Disabling Google Cloud support")
+        cfg.gcp_enabled = False
+        cfg.gcp_project_id = None
+        return cfg
+
+    if not non_interactive and not io.confirm("Do you want to configure GCP support?", True):
+        return disable("")
+    cred, inferred_project = auth_factory.get_adc_credential()
+    if cred is None:
+        io.echo("[red]Default GCP credentials are not set up. Run `gcloud auth application-default login`.[/red]")
+        return disable("https://cloud.google.com/docs/authentication/getting-started")
+    io.echo("[green]GCP credentials found.[/green]")
+    if non_interactive:
+        project = inferred_project
+    else:
+        project = io.prompt("Enter the GCP project ID", inferred_project) or inferred_project
+    if not project:
+        return disable("[red]No GCP project ID available.[/red]")
+    cfg.gcp_project_id = project
+    cfg.gcp_enabled = True
+    auth = auth_factory(config=cfg)
+    try:
+        for service, name in GCP_REQUIRED_APIS.items():
+            if not auth.check_api_enabled(service):
+                io.echo(f"[yellow]GCP {name} API not enabled.[/yellow]")
+                if non_interactive or io.confirm(f"Enable the {name} API?", True):
+                    auth.enable_api(service)
+                    io.echo(f"Enabled GCP {name} API")
+                else:
+                    return disable("")
+        email = auth.create_service_account()
+        io.echo(f"Using GCP service account [green]{email}[/green]")
+    except Exception as e:  # noqa: BLE001 — REST/permission failures must not crash init
+        return disable(f"[red]GCP setup failed: {e}[/red]")
+    return cfg
+
+
+def run_init(non_interactive: bool = False, io: Optional[WizardIO] = None) -> int:
     cfg = cloud_config.reload() if config_path.exists() else SkyplaneConfig.default_config()
+    io = io or console_io()
 
     from skyplane_tpu.utils.networking import get_public_ip, query_which_cloud
 
     host_cloud = query_which_cloud()
     if host_cloud:
-        console.print(f"Running inside [bold]{host_cloud}[/bold] (metadata endpoint detected)")
+        io.echo(f"Running inside [bold]{host_cloud}[/bold] (metadata endpoint detected)")
     public_ip = get_public_ip()
     if public_ip:
-        console.print(f"Client public IP: [bold]{public_ip}[/bold]")
+        io.echo(f"Client public IP: [bold]{public_ip}[/bold]")
 
-    aws = _detect_aws()
-    gcp_project = _detect_gcp()
-    azure = _detect_azure()
+    if non_interactive:
+        # detection-only path: enable whatever already works, prompt nothing
+        aws = _detect_aws()
+        gcp_project = _detect_gcp()
+        cfg.aws_enabled = bool(aws)
+        cfg.gcp_enabled = gcp_project is not None
+        if gcp_project:
+            cfg.gcp_project_id = gcp_project
+    else:
+        load_aws_config(cfg, io)
+        load_gcp_config(cfg, io)
+    cfg.azure_enabled = _detect_azure()
 
-    cfg.aws_enabled = bool(aws)
-    cfg.gcp_enabled = gcp_project is not None
-    if gcp_project:
-        cfg.gcp_project_id = gcp_project
-    cfg.azure_enabled = azure
-
-    console.print(f"AWS:   {'[green]enabled[/green]' if cfg.aws_enabled else '[yellow]no credentials[/yellow]'}")
-    console.print(
+    io.echo(f"AWS:   {'[green]enabled[/green]' if cfg.aws_enabled else '[yellow]no credentials[/yellow]'}")
+    io.echo(
         f"GCP:   {'[green]enabled (project ' + str(cfg.gcp_project_id) + ')[/green]' if cfg.gcp_enabled else '[yellow]no credentials[/yellow]'}"
     )
-    console.print(f"Azure: {'[green]enabled[/green]' if cfg.azure_enabled else '[yellow]no credentials[/yellow]'}")
+    io.echo(f"Azure: {'[green]enabled[/green]' if cfg.azure_enabled else '[yellow]no credentials[/yellow]'}")
 
     # Azure one-time setup (subscription + UMI + roles) — needs the az CLI;
     # reference parity: skyplane/cli/cli_init.py azure wizard. Interactive
@@ -88,22 +254,22 @@ def run_init(non_interactive: bool = False) -> int:
             # interactive only: role grants are per-subscription and not
             # recoverable, so the user must choose when several are visible
             names = sorted(subs)
-            console.print("Multiple Azure subscriptions are visible:")
+            io.echo("Multiple Azure subscriptions are visible:")
             for i, name in enumerate(names, 1):
-                console.print(f"  {i}. {name} ({subs[name]})")
-            raw = console.input("Pick a subscription for the skyplane UMI (number, empty to skip): ").strip()
+                io.echo(f"  {i}. {name} ({subs[name]})")
+            raw = io.prompt("Pick a subscription for the skyplane UMI (number, empty to skip)", "").strip()
             if raw.isdigit() and 1 <= int(raw) <= len(names):
                 return subs[names[int(raw) - 1]]
             return None
 
         setup_azure(
             cfg,
-            echo=lambda m: console.print(f"[dim]{m}[/dim]"),
+            echo=lambda m: io.echo(f"[dim]{m}[/dim]"),
             prompt=None if non_interactive else _pick_subscription,
         )
 
     cfg.to_config_file(config_path)
-    console.print(f"Config written to [bold]{config_path}[/bold]")
+    io.echo(f"Config written to [bold]{config_path}[/bold]")
 
     # per-region vCPU quota capture: the planner's VM-ladder input
     # (reference: cli_init.py saves quota files consumed at planner.py:36-54)
@@ -117,9 +283,9 @@ def run_init(non_interactive: bool = False) -> int:
     )
     for provider, n in captured.items():
         if n:
-            console.print(f"{provider}: captured vCPU quotas for [green]{n}[/green] regions")
+            io.echo(f"{provider}: captured vCPU quotas for [green]{n}[/green] regions")
         else:
-            console.print(f"{provider}: [yellow]quota capture unavailable[/yellow] (planner uses defaults)")
+            io.echo(f"{provider}: [yellow]quota capture unavailable[/yellow] (planner uses defaults)")
     if cfg.azure_enabled and not azure_sub:
-        console.print("azure: [yellow]set azure_subscription_id in the config to capture quotas[/yellow]")
+        io.echo("azure: [yellow]set azure_subscription_id in the config to capture quotas[/yellow]")
     return 0

@@ -45,3 +45,72 @@ class GCPAuthentication:
             return self._project is not None
         except Exception:  # noqa: BLE001
             return False
+
+    @staticmethod
+    def get_adc_credential():
+        """(credentials, project) from application-default credentials, or
+        (None, None) when the user has not run `gcloud auth application-default
+        login` (reference: gcp_auth.py get_adc_credential)."""
+        try:
+            return google.auth.default(scopes=["https://www.googleapis.com/auth/cloud-platform"])
+        except Exception:  # noqa: BLE001 — DefaultCredentialsError et al.
+            return None, None
+
+    # ---- init-wizard surface (reference: gcp_auth.py:191-238, REST-posed) ----
+
+    SERVICE_ACCOUNT_NAME = "skyplane-tpu"
+
+    def check_api_enabled(self, service: str) -> bool:
+        """True when {service}.googleapis.com is enabled for the project."""
+        r = self.session().get(
+            f"https://serviceusage.googleapis.com/v1/projects/{self.project_id}/services/{service}.googleapis.com"
+        )
+        return r.status_code == 200 and r.json().get("state") == "ENABLED"
+
+    def enable_api(self, service: str) -> None:
+        r = self.session().post(
+            f"https://serviceusage.googleapis.com/v1/projects/{self.project_id}/services/{service}.googleapis.com:enable"
+        )
+        r.raise_for_status()
+
+    def list_service_accounts(self) -> list:
+        r = self.session().get(f"https://iam.googleapis.com/v1/projects/{self.project_id}/serviceAccounts")
+        r.raise_for_status()
+        return r.json().get("accounts", [])
+
+    def create_service_account(self, name: Optional[str] = None) -> str:
+        """Find-or-create the skyplane service account and grant it
+        roles/storage.admin on the project (read-modify-write, never
+        overwriting other bindings — reference: gcp_auth.py:214-236).
+        Returns the service-account email."""
+        name = name or self.SERVICE_ACCOUNT_NAME
+        account = next((a for a in self.list_service_accounts() if a["email"].split("@")[0] == name), None)
+        if account is None:
+            r = self.session().post(
+                f"https://iam.googleapis.com/v1/projects/{self.project_id}/serviceAccounts",
+                json={"accountId": name, "serviceAccount": {"displayName": name}},
+            )
+            r.raise_for_status()
+            account = r.json()
+        from skyplane_tpu.utils.retry import retry_backoff
+
+        def read_modify_write() -> str:
+            crm = f"https://cloudresourcemanager.googleapis.com/v1/projects/{self.project_id}"
+            policy = self.session().post(f"{crm}:getIamPolicy").json()
+            handle = f"serviceAccount:{account['email']}"
+            target = "roles/storage.admin"
+            bindings = policy.setdefault("bindings", [])
+            binding = next((b for b in bindings if b["role"] == target), None)
+            modified = False
+            if binding is None:
+                bindings.append({"role": target, "members": [handle]})
+                modified = True
+            elif handle not in binding["members"]:
+                binding["members"].append(handle)  # do NOT override other members
+                modified = True
+            if modified:
+                r = self.session().post(f"{crm}:setIamPolicy", json={"policy": policy})
+                r.raise_for_status()  # concurrent edits 409 -> retry_backoff re-reads
+            return account["email"]
+
+        return retry_backoff(read_modify_write)

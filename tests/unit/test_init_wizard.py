@@ -1,0 +1,255 @@
+"""Interactive init wizard: scripted end-to-end flows (VERDICT r3 #4).
+
+Reference parity target: skyplane/cli/cli_init.py:23-64 (AWS) and :310-376
+(GCP). Every prompt goes through the injectable WizardIO, so these tests
+drive the full zero-to-credentials flows — AWS key entry writing the shared
+credentials file, GCP project selection + API enablement + service-account
+creation — without clouds, SDKs, or a pty.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+from skyplane_tpu.cli.cli_init import (
+    WizardIO,
+    aws_credentials_path,
+    load_aws_config,
+    load_gcp_config,
+)
+from skyplane_tpu.config import SkyplaneConfig
+
+
+class ScriptedIO:
+    """WizardIO whose answers come from queues; every echo is recorded."""
+
+    def __init__(self, confirms=(), prompts=()):
+        self.confirms = list(confirms)
+        self.prompts = list(prompts)
+        self.echoes = []
+
+    def as_io(self) -> WizardIO:
+        return WizardIO(confirm=self._confirm, prompt=self._prompt, echo=self.echoes.append)
+
+    def _confirm(self, question, default=True):
+        assert self.confirms, f"unexpected confirm: {question}"
+        return self.confirms.pop(0)
+
+    def _prompt(self, question, default=None):
+        assert self.prompts, f"unexpected prompt: {question}"
+        ans = self.prompts.pop(0)
+        return ans if ans is not None else (default or "")
+
+
+class _FakeFrozen:
+    def __init__(self, access_key, secret_key):
+        self.access_key = access_key
+        self.secret_key = secret_key
+
+
+class _FakeCreds:
+    def __init__(self, frozen):
+        self._frozen = frozen
+
+    def get_frozen_credentials(self):
+        return self._frozen
+
+
+def _install_fake_boto3(monkeypatch, creds_file: Path):
+    """boto3 stand-in whose Session reads the shared credentials file the
+    wizard writes — so the post-write re-verification is real."""
+    import configparser
+
+    class Session:
+        def __init__(self, *a, **k):
+            pass
+
+        def get_credentials(self):
+            if not creds_file.exists():
+                return None
+            ini = configparser.ConfigParser()
+            ini.read(creds_file)
+            if "default" not in ini:
+                return None
+            sec = ini["default"]
+            return _FakeCreds(_FakeFrozen(sec.get("aws_access_key_id"), sec.get("aws_secret_access_key")))
+
+    mod = types.ModuleType("boto3")
+    mod.Session = Session
+    monkeypatch.setitem(sys.modules, "boto3", mod)
+
+
+def test_aws_zero_to_credentials_flow(tmp_path, monkeypatch):
+    creds_file = tmp_path / "aws" / "credentials"
+    monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(creds_file))
+    _install_fake_boto3(monkeypatch, creds_file)
+    io = ScriptedIO(
+        confirms=[True, True],  # configure AWS? ; enter an access key now?
+        prompts=["AKIAEXAMPLE1234567", "secret/KEY", "eu-west-1"],
+    )
+    cfg = load_aws_config(SkyplaneConfig.default_config(), io.as_io())
+    assert cfg.aws_enabled
+    assert aws_credentials_path() == creds_file
+    content = creds_file.read_text()
+    assert "AKIAEXAMPLE1234567" in content and "eu-west-1" in content
+    assert oct(creds_file.stat().st_mode & 0o777) == "0o600"
+    assert any("...234567" in e for e in io.echoes), io.echoes  # masked key id echoed
+
+
+def test_aws_existing_default_profile_not_overwritten(tmp_path, monkeypatch):
+    creds_file = tmp_path / "credentials"
+    creds_file.write_text("[default]\naws_access_key_id = OLD\n")  # no secret -> invalid creds
+    monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(creds_file))
+    _install_fake_boto3(monkeypatch, creds_file)
+    io = ScriptedIO(confirms=[True, True], prompts=["NEWKEY", "NEWSECRET", "us-east-1"])
+    cfg = load_aws_config(SkyplaneConfig.default_config(), io.as_io())
+    assert not cfg.aws_enabled
+    assert "OLD" in creds_file.read_text() and "NEWKEY" not in creds_file.read_text()
+    assert any("not overwriting" in e for e in io.echoes)
+
+
+def test_aws_declined(monkeypatch):
+    _install_fake_boto3(monkeypatch, Path("/nonexistent"))
+    io = ScriptedIO(confirms=[False])
+    cfg = load_aws_config(SkyplaneConfig.default_config(), io.as_io())
+    assert not cfg.aws_enabled
+
+
+class FakeGCPAuth:
+    """GCPAuthentication stand-in tracking API enablement + SA creation."""
+
+    adc = (object(), "inferred-proj")
+    instances = []
+
+    def __init__(self, config=None):
+        self.config = config
+        self.enabled_apis = {"iam", "storage", "cloudresourcemanager"}  # compute missing
+        self.sa_created = False
+        FakeGCPAuth.instances.append(self)
+
+    @classmethod
+    def get_adc_credential(cls):
+        return cls.adc
+
+    def check_api_enabled(self, service):
+        return service in self.enabled_apis
+
+    def enable_api(self, service):
+        self.enabled_apis.add(service)
+
+    def create_service_account(self, name=None):
+        self.sa_created = True
+        return f"skyplane-tpu@{self.config.gcp_project_id}.iam.gserviceaccount.com"
+
+
+def test_gcp_zero_to_credentials_flow():
+    FakeGCPAuth.instances.clear()
+    io = ScriptedIO(
+        confirms=[True, True],  # configure GCP? ; enable the Compute Engine API?
+        prompts=["my-proj"],  # project id (overrides inferred)
+    )
+    cfg = load_gcp_config(SkyplaneConfig.default_config(), io.as_io(), auth_factory=FakeGCPAuth)
+    assert cfg.gcp_enabled and cfg.gcp_project_id == "my-proj"
+    auth = FakeGCPAuth.instances[-1]
+    assert "compute" in auth.enabled_apis  # wizard enabled the missing API
+    assert auth.sa_created
+    assert any("skyplane-tpu@my-proj" in e for e in io.echoes)
+
+
+def test_gcp_no_adc_disables_with_instructions():
+    class NoADC(FakeGCPAuth):
+        adc = (None, None)
+
+    io = ScriptedIO(confirms=[True])
+    cfg = load_gcp_config(SkyplaneConfig.default_config(), io.as_io(), auth_factory=NoADC)
+    assert not cfg.gcp_enabled
+    assert any("gcloud auth application-default login" in e for e in io.echoes)
+
+
+def test_gcp_api_enable_declined_disables():
+    FakeGCPAuth.instances.clear()
+    io = ScriptedIO(confirms=[True, False], prompts=[None])  # decline Compute API
+    cfg = load_gcp_config(SkyplaneConfig.default_config(), io.as_io(), auth_factory=FakeGCPAuth)
+    assert not cfg.gcp_enabled and cfg.gcp_project_id is None
+
+
+def test_gcp_setup_failure_disables_not_crashes():
+    class Exploding(FakeGCPAuth):
+        def create_service_account(self, name=None):
+            raise RuntimeError("iam permission denied")
+
+    io = ScriptedIO(confirms=[True, True], prompts=[None])  # configure; enable Compute API
+    cfg = load_gcp_config(SkyplaneConfig.default_config(), io.as_io(), auth_factory=Exploding)
+    assert not cfg.gcp_enabled
+    assert any("permission denied" in e for e in io.echoes)
+
+
+def test_gcp_rest_surface_via_fake_session(monkeypatch):
+    """Drive the REAL GCPAuthentication REST methods against a scripted
+    AuthorizedSession: API check/enable, SA find-or-create, and the
+    read-modify-write storage.admin grant that must not clobber bindings."""
+    gcp_auth_mod = pytest.importorskip("skyplane_tpu.compute.gcp.gcp_auth")
+
+    class Resp:
+        def __init__(self, status_code=200, body=None):
+            self.status_code = status_code
+            self._body = body or {}
+
+        def json(self):
+            return self._body
+
+        def raise_for_status(self):
+            if self.status_code >= 400:
+                raise RuntimeError(f"http {self.status_code}")
+
+    class FakeSession:
+        def __init__(self):
+            self.posts = []
+            self.policy = {"bindings": [{"role": "roles/viewer", "members": ["user:someone@x.com"]}]}
+            self.accounts = []
+
+        def get(self, url):
+            if "serviceusage" in url:
+                return Resp(200, {"state": "DISABLED" if "compute" in url else "ENABLED"})
+            if url.endswith("/serviceAccounts"):
+                return Resp(200, {"accounts": self.accounts})
+            raise AssertionError(url)
+
+        def post(self, url, json=None):
+            self.posts.append((url, json))
+            if url.endswith(":enable"):
+                return Resp(200, {})
+            if url.endswith("/serviceAccounts"):
+                acct = {"email": f"{json['accountId']}@proj-9.iam.gserviceaccount.com"}
+                self.accounts.append(acct)
+                return Resp(200, acct)
+            if url.endswith(":getIamPolicy"):
+                return Resp(200, self.policy)
+            if url.endswith(":setIamPolicy"):
+                self.policy = json["policy"]
+                return Resp(200, self.policy)
+            raise AssertionError(url)
+
+    auth = gcp_auth_mod.GCPAuthentication()
+    auth._credentials = object()
+    auth._project = "proj-9"
+    fake = FakeSession()
+    monkeypatch.setattr(auth, "session", lambda: fake)
+
+    assert auth.check_api_enabled("iam") is True
+    assert auth.check_api_enabled("compute") is False
+    auth.enable_api("compute")
+    email = auth.create_service_account()
+    assert email == "skyplane-tpu@proj-9.iam.gserviceaccount.com"
+    # grant preserved the pre-existing viewer binding and added storage.admin
+    roles = {b["role"]: b["members"] for b in fake.policy["bindings"]}
+    assert roles["roles/viewer"] == ["user:someone@x.com"]
+    assert f"serviceAccount:{email}" in roles["roles/storage.admin"]
+    # idempotence: second call finds the account, re-grant does not duplicate
+    email2 = auth.create_service_account()
+    assert email2 == email
+    assert len([m for m in roles["roles/storage.admin"] if m == f"serviceAccount:{email}"]) == 1
