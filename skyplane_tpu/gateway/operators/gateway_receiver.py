@@ -96,6 +96,7 @@ class GatewayReceiver:
         # sender discards fps and resends literals) — budget them separately
         # from corruption, with a higher cap, also reset on any success
         self._nack_count = 0
+        self.nacks_total = 0  # cumulative, never reset: observability + tests
         self.max_nacks = 200
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if use_tls:
@@ -279,6 +280,7 @@ class GatewayReceiver:
         drops its fps — and eventually fails the daemon."""
         with self._lock:
             self._nack_count += 1
+            self.nacks_total += 1
             count = self._nack_count
         if count >= self.max_nacks:
             self.error_queue.put(f"receiver exceeded {self.max_nacks} consecutive dedup nacks; last: {detail}")
