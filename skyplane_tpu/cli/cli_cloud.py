@@ -98,9 +98,17 @@ def run_deprovision() -> int:
     from skyplane_tpu.compute.cloud_provider import get_cloud_provider
     from skyplane_tpu.exceptions import MissingDependencyException
 
+    import os
+
     terminated = 0
-    for provider_name in ("aws", "gcp", "azure"):
-        enabled = getattr(cloud_config, f"{provider_name}_enabled", False)
+    for provider_name in ("aws", "gcp", "azure", "ibmcloud", "scp"):
+        # ibm/scp are env-credential-gated rather than config-flag-gated
+        if provider_name == "ibmcloud":
+            enabled = bool(os.environ.get("IBM_API_KEY"))
+        elif provider_name == "scp":
+            enabled = bool(os.environ.get("SCP_ACCESS_KEY"))
+        else:
+            enabled = getattr(cloud_config, f"{provider_name}_enabled", False)
         if not enabled:
             continue
         try:

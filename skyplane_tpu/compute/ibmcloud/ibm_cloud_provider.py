@@ -46,7 +46,21 @@ class IBMCloudServer(SSHServer):
         }.get(inst.get("status", ""), ServerState.UNKNOWN)
 
     def terminate_instance(self) -> None:
-        self._provider.vpc_client(self.region).delete_instance(id=self.instance_id)
+        # release the attached floating IP first — deleting only the instance
+        # leaks the IP reservation (billed) (reference: ibm_gen2/vpc_backend.py
+        # delete_instance path releases head-node IPs)
+        vpc = self._provider.vpc_client(self.region)
+        try:
+            for fip in vpc.list_floating_ips().get_result().get("floating_ips", []):
+                target = fip.get("target") or {}
+                if target.get("id") and fip.get("name", "").startswith(TAG):
+                    inst = vpc.get_instance(id=self.instance_id).get_result()
+                    nic_id = inst["primary_network_interface"]["id"]
+                    if target["id"] == nic_id:
+                        vpc.delete_floating_ip(id=fip["id"])
+        except Exception:  # noqa: BLE001 — IP cleanup is best-effort; instance delete must proceed
+            pass
+        vpc.delete_instance(id=self.instance_id)
 
 
 class IBMCloudProvider(CloudProvider):
@@ -54,6 +68,7 @@ class IBMCloudProvider(CloudProvider):
 
     def __init__(self):
         self._clients = {}
+        self._image_cache = {}
 
     def _authenticator(self):
         from ibm_cloud_sdk_core.authenticators import IAMAuthenticator
@@ -94,11 +109,33 @@ class IBMCloudProvider(CloudProvider):
             pub = key.public_key().public_bytes(serialization.Encoding.OpenSSH, serialization.PublicFormat.OpenSSH)
             path.with_suffix(".pub").write_bytes(pub + b" skyplane\n")
         pub_key = path.with_suffix(".pub").read_text().strip()
-        for k in vpc.list_keys().get_result().get("keys", []):
+        keys = vpc.list_keys().get_result().get("keys", [])
+        for k in keys:
             if k["name"] == VPC_NAME:
                 return k["id"]
-        created = vpc.create_key(public_key=pub_key, name=VPC_NAME, type="rsa").get_result()
-        return created["id"]
+        try:
+            created = vpc.create_key(public_key=pub_key, name=VPC_NAME, type="rsa").get_result()
+            return created["id"]
+        except Exception:  # noqa: BLE001 — fingerprint conflict: the same
+            # public key may already be registered under another name
+            # (reference: ibm_gen2/vpc_backend.py key-exists handling);
+            # match on key material instead of the name
+            pub_body = pub_key.split()[1] if " " in pub_key else pub_key
+            for k in vpc.list_keys().get_result().get("keys", []):
+                if pub_body in k.get("public_key", ""):
+                    return k["id"]
+            raise
+
+    def delete_keypair(self, region: str) -> bool:
+        """Remove the skyplane key from the region (key CRUD parity:
+        ibm_gen2/vpc_backend.py delete_key). Local PEM stays — other regions
+        may still register it. Returns True when a key was deleted."""
+        vpc = self.vpc_client(region)
+        for k in vpc.list_keys().get_result().get("keys", []):
+            if k["name"] == VPC_NAME:
+                vpc.delete_key(id=k["id"])
+                return True
+        return False
 
     def _ensure_network(self, region: str):
         """VPC + subnet + permissive gateway security group (reference:
@@ -146,46 +183,101 @@ class IBMCloudProvider(CloudProvider):
         self._ensure_network(region)
 
     def _image_id(self, region: str) -> str:
+        """Resolve the gateway base image: exact pinned name first, else the
+        NEWEST available ubuntu-22.04 minimal amd64 (IBM rotates image names
+        with patch suffixes, so the pin goes stale — reference:
+        ibm_gen2/vpc_backend.py image resolution). Cached per region."""
+        if region in self._image_cache:
+            return self._image_cache[region]
         vpc = self.vpc_client(region)
+        image_id = None
         for img in vpc.list_images(name=UBUNTU_IMAGE_NAME).get_result().get("images", []):
-            return img["id"]
-        raise RuntimeError(f"image {UBUNTU_IMAGE_NAME} not found in {region}")
+            image_id = img["id"]
+            break
+        if image_id is None:
+            candidates = [
+                img
+                for img in vpc.list_images().get_result().get("images", [])
+                if img.get("status") == "available"
+                and img.get("name", "").startswith("ibm-ubuntu-22-04")
+                and "minimal-amd64" in img.get("name", "")
+            ]
+            if candidates:
+                image_id = max(candidates, key=lambda i: i.get("created_at", ""))["id"]
+        if image_id is None:
+            raise RuntimeError(f"no ubuntu-22.04 minimal amd64 image found in {region} (pinned: {UBUNTU_IMAGE_NAME})")
+        self._image_cache[region] = image_id
+        return image_id
 
     def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> IBMCloudServer:
+        """Create VM + floating IP; on ANY mid-flight failure (boot timeout,
+        IP exhaustion, API error) the partially-created resources are deleted
+        before re-raising — a half-provisioned gateway must not leak billing
+        (reference: ibm_gen2/vpc_backend.py cleanup-on-create-failure)."""
+        import time
+
         region = region_tag.split(":")[-1]
         vpc = self.vpc_client(region)
         the_vpc, subnet, zone = self._ensure_network(region)
         key_id = self.ensure_keypair(region)
         name = f"{TAG}-{uuid.uuid4().hex[:8]}"
-        inst = vpc.create_instance(
-            instance_prototype={
-                "name": name,
-                "vpc": {"id": the_vpc["id"]},
-                "zone": {"name": zone},
-                "profile": {"name": vm_type or "bx2-16x64"},
-                "image": {"id": self._image_id(region)},
-                "keys": [{"id": key_id}],
-                "primary_network_interface": {"subnet": {"id": subnet["id"]}},
-            }
-        ).get_result()
-        import time
+        inst = None
+        fip = None
+        try:
+            inst = vpc.create_instance(
+                instance_prototype={
+                    "name": name,
+                    "vpc": {"id": the_vpc["id"]},
+                    "zone": {"name": zone},
+                    "profile": {"name": vm_type or "bx2-16x64"},
+                    "image": {"id": self._image_id(region)},
+                    "keys": [{"id": key_id}],
+                    "primary_network_interface": {"subnet": {"id": subnet["id"]}},
+                }
+            ).get_result()
+            deadline = time.time() + 300
+            while True:
+                cur = vpc.get_instance(id=inst["id"]).get_result()
+                if cur["status"] == "running":
+                    break
+                if cur["status"] in ("failed", "deleting"):
+                    raise RuntimeError(f"instance {name} entered state {cur['status']} during provisioning")
+                if time.time() >= deadline:
+                    raise TimeoutError(f"instance {name} not running after 300s (state {cur['status']})")
+                time.sleep(5)
+            nic_id = inst["primary_network_interface"]["id"]
+            fip = vpc.create_floating_ip(
+                floating_ip_prototype={"name": f"{name}-ip", "target": {"id": nic_id}}
+            ).get_result()
+            private_ip = inst["primary_network_interface"]["primary_ip"]["address"]
+            return IBMCloudServer(self, region, inst["id"], fip["address"], private_ip, str(self._key_path()))
+        except Exception:
+            # teardown-after-partial-provision: best-effort, reverse order
+            if fip is not None:
+                try:
+                    vpc.delete_floating_ip(id=fip["id"])
+                except Exception:  # noqa: BLE001
+                    pass
+            if inst is not None:
+                try:
+                    vpc.delete_instance(id=inst["id"])
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
 
-        deadline = time.time() + 300
-        while time.time() < deadline:
-            cur = vpc.get_instance(id=inst["id"]).get_result()
-            if cur["status"] == "running":
-                break
-            time.sleep(5)
-        nic_id = inst["primary_network_interface"]["id"]
-        fip = vpc.create_floating_ip(
-            floating_ip_prototype={"name": f"{name}-ip", "target": {"id": nic_id}}
-        ).get_result()
-        private_ip = inst["primary_network_interface"]["primary_ip"]["address"]
-        return IBMCloudServer(self, region, inst["id"], fip["address"], private_ip, str(self._key_path()))
+    DEFAULT_REGIONS = ("us-south", "us-east", "eu-de", "eu-gb", "jp-tok", "au-syd")
 
-    def get_matching_instances(self, tags: Optional[dict] = None, **kw) -> List[IBMCloudServer]:
+    def get_matching_instances(self, tags: Optional[dict] = None, regions: Optional[List[str]] = None, **kw) -> List[IBMCloudServer]:
+        """Tagged gateways across regions: regions already touched this
+        process, else SKYPLANE_IBM_REGIONS (comma-separated), else the
+        default multi-zone-region sweep list (deprovision runs in a fresh
+        process with no cached clients)."""
+        if regions is None:
+            regions = list(self._clients) or [
+                r.strip() for r in os.environ.get("SKYPLANE_IBM_REGIONS", ",".join(self.DEFAULT_REGIONS)).split(",") if r.strip()
+            ]
         servers: List[IBMCloudServer] = []
-        for region in list(self._clients) or []:
+        for region in regions:
             vpc = self.vpc_client(region)
             for inst in vpc.list_instances().get_result().get("instances", []):
                 if inst["name"].startswith(TAG) and inst.get("status") in ("running", "starting", "pending"):
@@ -201,4 +293,56 @@ class IBMCloudProvider(CloudProvider):
                     )
         return servers
 
-    def teardown_global(self) -> None: ...
+    def teardown_region(self, region: str) -> dict:
+        """Full deprovision sweep for one region: instances -> floating IPs
+        -> subnets -> VPC, waiting out dependency ordering (a VPC cannot be
+        deleted while instances/subnets reference it — reference:
+        ibm_gen2/vpc_backend.py delete-vpc path). Returns per-resource delete
+        counts for the caller's report."""
+        import time
+
+        vpc = self.vpc_client(region)
+        counts = {"instances": 0, "floating_ips": 0, "subnets": 0, "vpcs": 0}
+        for inst in vpc.list_instances().get_result().get("instances", []):
+            if inst["name"].startswith(TAG):
+                try:
+                    vpc.delete_instance(id=inst["id"])
+                    counts["instances"] += 1
+                except Exception:  # noqa: BLE001 — already deleting
+                    pass
+        if counts["instances"]:
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                remaining = [
+                    i for i in vpc.list_instances().get_result().get("instances", []) if i["name"].startswith(TAG)
+                ]
+                if not remaining:
+                    break
+                time.sleep(5)
+        for fip in vpc.list_floating_ips().get_result().get("floating_ips", []):
+            if fip.get("name", "").startswith(TAG):
+                try:
+                    vpc.delete_floating_ip(id=fip["id"])
+                    counts["floating_ips"] += 1
+                except Exception:  # noqa: BLE001
+                    pass
+        for subnet in vpc.list_subnets().get_result().get("subnets", []):
+            if subnet["name"].startswith(VPC_NAME):
+                try:
+                    vpc.delete_subnet(id=subnet["id"])
+                    counts["subnets"] += 1
+                except Exception:  # noqa: BLE001
+                    pass
+        for v in vpc.list_vpcs().get_result().get("vpcs", []):
+            if v["name"] == VPC_NAME:
+                try:
+                    vpc.delete_vpc(id=v["id"])
+                    counts["vpcs"] += 1
+                except Exception:  # noqa: BLE001 — subnets still deleting; a
+                    # re-run of the sweep finishes the job
+                    pass
+        return counts
+
+    def teardown_global(self) -> None:
+        for region in list(self._clients):
+            self.teardown_region(region)
