@@ -311,38 +311,54 @@ def bench_ours(chunks, workers: Optional[int] = None) -> dict:
         batch = batch_chunks(workers)
         log(f"device batch window: {batch} chunks, {workers} workers")
         batch_runner = DeviceBatchRunner(cdc_params=cdc, max_batch=batch, mesh=mesh)
-    proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
-    index = SenderDedupIndex()
     # warm-up: compile all shape buckets (separate corpus so the index stays
     # cold). With a batch runner, submit concurrently so the BATCHED kernel
     # shapes compile now rather than inside the timed region.
+    warm_proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
     warm_rng = np.random.default_rng(99)
     t_warm = time.perf_counter()
     if batch_runner is not None:
         warm_chunks = [warm_rng.integers(0, 256, CHUNK_MB << 20, dtype=np.uint8).tobytes() for _ in range(workers)]
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            list(pool.map(lambda c: proc.process(c, SenderDedupIndex()), warm_chunks))
+            list(pool.map(lambda c: warm_proc.process(c, SenderDedupIndex()), warm_chunks))
     else:
         warm = warm_rng.integers(0, 256, CHUNK_MB << 20, dtype=np.uint8).tobytes()
-        proc.process(warm, SenderDedupIndex())
+        warm_proc.process(warm, SenderDedupIndex())
     log(f"warm-up done in {time.perf_counter() - t_warm:.1f}s ({workers} workers)")
 
-    def one(c: bytes) -> int:
-        p = proc.process(c, index)
-        for fp, size in p.new_fingerprints:  # frame delivered -> commit (sender contract)
-            index.add(fp, size)
-        return len(p.wire_bytes)
+    # best-of-N (see bench_baseline): each rep gets a FRESH processor and
+    # dedup index — a warm index would turn rep 2+ into an all-REF fast path
+    best: Optional[dict] = None
+    for _ in range(max(1, BENCH_REPS)):
+        proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
+        index = SenderDedupIndex()
 
-    t0 = time.perf_counter()
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        wire = sum(pool.map(one, chunks))
-    dt = time.perf_counter() - t0
-    raw = sum(len(c) for c in chunks)
-    return {"seconds": dt, "raw_bytes": raw, "wire_bytes": wire, "stats": proc.stats.as_dict()}
+        def one(c: bytes) -> int:
+            p = proc.process(c, index)
+            for fp, size in p.new_fingerprints:  # frame delivered -> commit (sender contract)
+                index.add(fp, size)
+            return len(p.wire_bytes)
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            wire = sum(pool.map(one, chunks))
+        dt = time.perf_counter() - t0
+        if best is None or dt < best["seconds"]:
+            raw = sum(len(c) for c in chunks)
+            best = {"seconds": dt, "raw_bytes": raw, "wire_bytes": wire, "stats": proc.stats.as_dict()}
+    return best
+
+
+BENCH_REPS = int(os.environ.get("SKYPLANE_BENCH_REPS", "3"))
 
 
 def bench_baseline(chunks) -> dict:
-    """CPU reference path with full core-level worker parallelism."""
+    """CPU reference path with full core-level worker parallelism.
+
+    Best-of-N timing (N=SKYPLANE_BENCH_REPS): single-shot wall times on a
+    shared-tenancy core swing ±10%, enough to flip the vs_baseline ratio;
+    min-of-reps is the standard estimator for the machine's capability and is
+    applied to BOTH sides, so the ratio stays honest."""
     from concurrent.futures import ThreadPoolExecutor
 
     import zstandard
@@ -353,11 +369,14 @@ def bench_baseline(chunks) -> dict:
         return len(zstandard.ZstdCompressor(level=3).compress(c))
 
     one(chunks[0])  # warm
-    t0 = time.perf_counter()
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        wire = sum(pool.map(one, chunks))
-    dt = time.perf_counter() - t0
-    return {"seconds": dt, "raw_bytes": sum(len(c) for c in chunks), "wire_bytes": wire}
+    best = float("inf")
+    wire = 0
+    for _ in range(max(1, BENCH_REPS)):
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            wire = sum(pool.map(one, chunks))
+        best = min(best, time.perf_counter() - t0)
+    return {"seconds": best, "raw_bytes": sum(len(c) for c in chunks), "wire_bytes": wire}
 
 
 def main() -> None:
