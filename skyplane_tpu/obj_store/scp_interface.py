@@ -1,15 +1,24 @@
 """Samsung Cloud Platform object storage backend.
 
-Reference parity: skyplane/obj_store/scp_interface.py (custom REST against
-the SCP object-storage API, S3-compatible data plane). Credentials via
-SCP_ACCESS_KEY / SCP_SECRET_KEY / SCP_OBS_ENDPOINT env vars; the data plane
-reuses the S3 wire protocol so the implementation subclasses S3Interface
-with an endpoint override (the reference implements raw signed REST).
+Reference parity: skyplane/obj_store/scp_interface.py (883 LoC: HMAC-signed
+management REST for bucket lifecycle + an S3-compatible data plane). Both
+halves are reproduced here:
+
+  * management plane — bucket create/delete/lookup through the SCP open API
+    (`/object-storage/v4/...`), signed with the same X-Cmp HMAC scheme as
+    the compute provider (compute/scp/scp_cloud_provider.py SCPClient;
+    reference scp_utils/scp_network). Requires SCP_ACCESS_KEY /
+    SCP_SECRET_KEY / SCP_PROJECT_ID.
+  * data plane — object get/put/multipart reuse the S3 wire protocol against
+    SCP_OBS_ENDPOINT via the S3Interface base (the reference drives boto3 at
+    the same endpoint, reference scp_interface.py:119-137).
 """
 
 from __future__ import annotations
 
 import os
+import time
+from typing import Optional
 
 from skyplane_tpu.exceptions import BadConfigException
 from skyplane_tpu.obj_store.s3_interface import S3Interface, S3Object
@@ -29,6 +38,7 @@ class SCPInterface(S3Interface):
         self.endpoint = os.environ.get("SCP_OBS_ENDPOINT")
         if not self.endpoint:
             raise BadConfigException("SCP object storage requires SCP_OBS_ENDPOINT (and SCP_ACCESS_KEY/SCP_SECRET_KEY)")
+        self._mgmt = None
 
     @property
     def aws_region(self) -> str:
@@ -50,3 +60,96 @@ class SCPInterface(S3Interface):
             aws_secret_access_key=os.environ.get("SCP_SECRET_KEY"),
             region_name="kr-west-1",
         )
+
+    # ---- signed management plane (bucket lifecycle) ----
+
+    def _management(self):
+        """Signed SCP open-API client; available only with full management
+        credentials (SCP_PROJECT_ID in addition to the key pair)."""
+        if self._mgmt is None:
+            from skyplane_tpu.compute.scp.scp_cloud_provider import SCPClient
+
+            self._mgmt = SCPClient()
+        return self._mgmt
+
+    def _has_management_creds(self) -> bool:
+        return bool(os.environ.get("SCP_PROJECT_ID") and os.environ.get("SCP_ACCESS_KEY") and os.environ.get("SCP_SECRET_KEY"))
+
+    def _get_bucket_id(self) -> Optional[str]:
+        """Bucket name -> objectStorageBucketId (reference scp_interface.py:198-211)."""
+        data = self._management().request(
+            "GET", f"/object-storage/v4/buckets?objectStorageBucketName={self.bucket_name}"
+        )
+        contents = data.get("contents", data if isinstance(data, list) else [])
+        for item in contents:
+            if item.get("objectStorageBucketName", "") == self.bucket_name:
+                return item.get("objectStorageBucketId")
+        return None
+
+    def _get_service_zone_id(self, region: str) -> str:
+        """Region name -> serviceZoneId from the project detail (reference
+        scp_network.get_service_zone_id); falls back to treating the region
+        string as a zone id (the compute provider's convention)."""
+        client = self._management()
+        try:
+            proj = client.request("GET", f"/project/v3/projects/{client.project_id}")
+            for zone in proj.get("serviceZones", []):
+                if region in (zone.get("serviceZoneName"), zone.get("serviceZoneLocation"), zone.get("serviceZoneId")):
+                    return zone["serviceZoneId"]
+        except Exception:  # noqa: BLE001 — older API tiers lack the route
+            pass
+        return region
+
+    def get_objectstorage_id(self, zone_id: str) -> str:
+        """Zone -> objectStorageId (reference scp_interface.py:213-221)."""
+        data = self._management().request("GET", f"/object-storage/v4/object-storages?serviceZoneId={zone_id}")
+        contents = data.get("contents", data if isinstance(data, list) else [])
+        if not contents:
+            raise BadConfigException(f"no SCP object-storage service in zone {zone_id}")
+        return contents[0]["objectStorageId"]
+
+    def bucket_exists(self) -> bool:
+        if self._has_management_creds():
+            try:
+                return self._get_bucket_id() is not None
+            except Exception:  # noqa: BLE001 — fall through to the data plane
+                pass
+        return super().bucket_exists()
+
+    def create_bucket(self, region_tag: str) -> None:
+        """Create through the management API (the S3-compat endpoint does not
+        accept CreateBucket; reference scp_interface.py:222-244)."""
+        if not self._has_management_creds():
+            raise BadConfigException("SCP bucket creation requires SCP_PROJECT_ID management credentials")
+        if self.bucket_exists():
+            return
+        region = region_tag.split(":")[-1]
+        zone_id = self._get_service_zone_id(region)
+        obs_id = self.get_objectstorage_id(zone_id)
+        self._management().request(
+            "POST",
+            "/object-storage/v4/buckets",
+            {
+                "objectStorageBucketAccessControlEnabled": "false",
+                "objectStorageBucketFileEncryptionEnabled": "false",
+                "objectStorageBucketName": self.bucket_name,
+                "objectStorageBucketVersionEnabled": "false",
+                "objectStorageId": obs_id,
+                "productNames": ["Object Storage"],
+                "serviceZoneId": zone_id,
+                "tags": [{"tagKey": "skyplane-tpu", "tagValue": "gateway"}],
+            },
+        )
+        # bucket provisioning is asynchronous; poll the lookup briefly so a
+        # follow-up upload does not race the creation
+        deadline = time.time() + 30
+        while time.time() < deadline and self._get_bucket_id() is None:
+            time.sleep(1)
+
+    def delete_bucket(self) -> None:
+        if not self._has_management_creds():
+            raise BadConfigException("SCP bucket deletion requires SCP_PROJECT_ID management credentials")
+        bucket_id = self._get_bucket_id()
+        if bucket_id is None:
+            return
+        self._management().request("DELETE", f"/object-storage/v4/buckets/{bucket_id}")

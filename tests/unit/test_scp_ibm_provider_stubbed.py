@@ -134,3 +134,127 @@ def test_ibm_provider_sdk_and_credential_gating(monkeypatch):
     monkeypatch.setitem(sys.modules, "ibm_vpc", None)
     with pytest.raises((RuntimeError, ImportError)):
         provider.vpc_client("us-south")
+
+
+# ---------- SCP object storage management plane (signed bucket lifecycle) ----------
+
+
+@pytest.fixture()
+def scp_obs(monkeypatch):
+    """SCPInterface against a scripted signed-REST transport + fake boto3."""
+    monkeypatch.setenv("SCP_ACCESS_KEY", "AK")
+    monkeypatch.setenv("SCP_SECRET_KEY", "SK")
+    monkeypatch.setenv("SCP_PROJECT_ID", "P1")
+    monkeypatch.setenv("SCP_OBS_ENDPOINT", "https://obs.example")
+
+    # the S3 data-plane base imports boto3/botocore at module scope
+    boto3_mod = types.ModuleType("boto3")
+    boto3_mod.client = lambda *a, **k: None
+    botocore_mod = types.ModuleType("botocore")
+    botocore_exc = types.ModuleType("botocore.exceptions")
+    botocore_exc.ClientError = type("ClientError", (Exception,), {})
+    botocore_mod.exceptions = botocore_exc
+    monkeypatch.setitem(sys.modules, "boto3", boto3_mod)
+    monkeypatch.setitem(sys.modules, "botocore", botocore_mod)
+    monkeypatch.setitem(sys.modules, "botocore.exceptions", botocore_exc)
+
+    from skyplane_tpu.obj_store.scp_interface import SCPInterface
+
+    calls = []
+    state = {"buckets": [], "bucket_counter": 0}
+
+    class FakeResponse:
+        def __init__(self, body):
+            self._body = body
+            self.content = b"{}"
+
+        def raise_for_status(self):
+            pass
+
+        def json(self):
+            return self._body
+
+    def fake_request(method, url, headers=None, json=None, timeout=None):
+        calls.append((method, url, headers, json))
+        if method == "GET" and "/object-storage/v4/buckets?objectStorageBucketName=" in url:
+            name = url.rsplit("=", 1)[1]
+            return FakeResponse(
+                {"contents": [b for b in state["buckets"] if b["objectStorageBucketName"] == name]}
+            )
+        if method == "GET" and "/project/v3/projects/P1" in url:
+            return FakeResponse(
+                {"serviceZones": [{"serviceZoneName": "kr-west-1", "serviceZoneId": "ZONE-1"}]}
+            )
+        if method == "GET" and "/object-storage/v4/object-storages?serviceZoneId=ZONE-1" in url:
+            return FakeResponse({"contents": [{"objectStorageId": "OBS-1"}]})
+        if method == "POST" and url.endswith("/object-storage/v4/buckets"):
+            state["bucket_counter"] += 1
+            state["buckets"].append(
+                {
+                    "objectStorageBucketName": json["objectStorageBucketName"],
+                    "objectStorageBucketId": f"BUCKET-{state['bucket_counter']}",
+                }
+            )
+            return FakeResponse({})
+        if method == "DELETE" and "/object-storage/v4/buckets/" in url:
+            bucket_id = url.rsplit("/", 1)[1]
+            state["buckets"] = [b for b in state["buckets"] if b["objectStorageBucketId"] != bucket_id]
+            return FakeResponse({})
+        raise AssertionError(f"unexpected request {method} {url}")
+
+    import skyplane_tpu.compute.scp.scp_cloud_provider as scp_mod
+
+    monkeypatch.setattr(scp_mod.requests, "request", fake_request)
+    return SCPInterface("mybucket"), calls, state
+
+
+def test_scp_obs_create_bucket_signed_flow(scp_obs):
+    iface, calls, state = scp_obs
+    iface.create_bucket("scp:kr-west-1")
+    assert state["buckets"] and state["buckets"][0]["objectStorageBucketName"] == "mybucket"
+    # resolution chain: bucket lookup -> zone -> object-storage id -> create
+    urls = [u for _, u, _, _ in calls]
+    assert any("/project/v3/projects/P1" in u for u in urls)
+    assert any("serviceZoneId=ZONE-1" in u for u in urls)
+    post = next((m, u, h, j) for m, u, h, j in calls if m == "POST")
+    assert post[3]["objectStorageId"] == "OBS-1" and post[3]["serviceZoneId"] == "ZONE-1"
+    # every management call carries the X-Cmp HMAC signature headers
+    for _, _, headers, _ in calls:
+        assert headers["X-Cmp-AccessKey"] == "AK" and headers["X-Cmp-Signature"]
+    # idempotent: a second create sees the bucket and issues no second POST
+    n_posts = sum(1 for m, *_ in calls if m == "POST")
+    iface.create_bucket("scp:kr-west-1")
+    assert sum(1 for m, *_ in calls if m == "POST") == n_posts
+
+
+def test_scp_obs_bucket_exists_and_delete_by_id(scp_obs):
+    iface, calls, state = scp_obs
+    assert iface.bucket_exists() is False
+    iface.create_bucket("scp:kr-west-1")
+    assert iface.bucket_exists() is True
+    iface.delete_bucket()
+    assert state["buckets"] == []
+    assert any(m == "DELETE" and u.endswith("/BUCKET-1") for m, u, _, _ in calls)
+    # deleting an absent bucket is a no-op, not an error
+    iface.delete_bucket()
+
+
+def test_scp_obs_requires_management_creds(monkeypatch):
+    monkeypatch.setenv("SCP_OBS_ENDPOINT", "https://obs.example")
+    monkeypatch.delenv("SCP_PROJECT_ID", raising=False)
+    monkeypatch.setenv("SCP_ACCESS_KEY", "AK")
+    monkeypatch.setenv("SCP_SECRET_KEY", "SK")
+    boto3_mod = types.ModuleType("boto3")
+    botocore_mod = types.ModuleType("botocore")
+    botocore_exc = types.ModuleType("botocore.exceptions")
+    botocore_exc.ClientError = type("ClientError", (Exception,), {})
+    botocore_mod.exceptions = botocore_exc
+    monkeypatch.setitem(sys.modules, "boto3", boto3_mod)
+    monkeypatch.setitem(sys.modules, "botocore", botocore_mod)
+    monkeypatch.setitem(sys.modules, "botocore.exceptions", botocore_exc)
+    from skyplane_tpu.exceptions import BadConfigException
+    from skyplane_tpu.obj_store.scp_interface import SCPInterface
+
+    iface = SCPInterface("b")
+    with pytest.raises(BadConfigException, match="management credentials"):
+        iface.create_bucket("scp:kr-west-1")
