@@ -106,7 +106,8 @@ def run_deprovision() -> int:
         if provider_name == "ibmcloud":
             enabled = bool(os.environ.get("IBM_API_KEY"))
         elif provider_name == "scp":
-            enabled = bool(os.environ.get("SCP_ACCESS_KEY"))
+            # data-plane-only SCP configs (no project id) cannot list VMs
+            enabled = bool(os.environ.get("SCP_ACCESS_KEY") and os.environ.get("SCP_PROJECT_ID"))
         else:
             enabled = getattr(cloud_config, f"{provider_name}_enabled", False)
         if not enabled:

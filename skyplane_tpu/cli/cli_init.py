@@ -139,9 +139,11 @@ def load_aws_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = F
                         "region": region,
                     }
                     path.parent.mkdir(parents=True, exist_ok=True)
-                    with open(path, "w") as f:
+                    # 0600 from the first byte: chmod-after-write would leave
+                    # the secret world-readable for a window under umask 022
+                    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+                    with os.fdopen(fd, "w") as f:
                         ini.write(f)
-                    os.chmod(path, 0o600)
                     io.echo(f"Credentials written to {path}")
                     access_key = creds_ok()
         else:

@@ -96,7 +96,9 @@ class GCPAuthentication:
 
         def read_modify_write() -> str:
             crm = f"https://cloudresourcemanager.googleapis.com/v1/projects/{self.project_id}"
-            policy = self.session().post(f"{crm}:getIamPolicy").json()
+            pr = self.session().post(f"{crm}:getIamPolicy")
+            pr.raise_for_status()  # an error body must not be mistaken for the policy
+            policy = pr.json()
             handle = f"serviceAccount:{account['email']}"
             target = "roles/storage.admin"
             bindings = policy.setdefault("bindings", [])

@@ -51,13 +51,11 @@ class IBMCloudServer(SSHServer):
         # delete_instance path releases head-node IPs)
         vpc = self._provider.vpc_client(self.region)
         try:
+            nic_id = vpc.get_instance(id=self.instance_id).get_result()["primary_network_interface"]["id"]
             for fip in vpc.list_floating_ips().get_result().get("floating_ips", []):
                 target = fip.get("target") or {}
-                if target.get("id") and fip.get("name", "").startswith(TAG):
-                    inst = vpc.get_instance(id=self.instance_id).get_result()
-                    nic_id = inst["primary_network_interface"]["id"]
-                    if target["id"] == nic_id:
-                        vpc.delete_floating_ip(id=fip["id"])
+                if fip.get("name", "").startswith(TAG) and target.get("id") == nic_id:
+                    vpc.delete_floating_ip(id=fip["id"])
         except Exception:  # noqa: BLE001 — IP cleanup is best-effort; instance delete must proceed
             pass
         vpc.delete_instance(id=self.instance_id)
@@ -265,7 +263,9 @@ class IBMCloudProvider(CloudProvider):
                     pass
             raise
 
-    DEFAULT_REGIONS = ("us-south", "us-east", "eu-de", "eu-gb", "jp-tok", "au-syd")
+    # every multi-zone region IBM VPC offers (a deprovision sweep that skips
+    # a region silently leaks billing there)
+    DEFAULT_REGIONS = ("us-south", "us-east", "br-sao", "ca-tor", "eu-de", "eu-es", "eu-gb", "jp-osa", "jp-tok", "au-syd")
 
     def get_matching_instances(self, tags: Optional[dict] = None, regions: Optional[List[str]] = None, **kw) -> List[IBMCloudServer]:
         """Tagged gateways across regions: regions already touched this

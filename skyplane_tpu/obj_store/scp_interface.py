@@ -140,10 +140,14 @@ class SCPInterface(S3Interface):
                 "tags": [{"tagKey": "skyplane-tpu", "tagValue": "gateway"}],
             },
         )
-        # bucket provisioning is asynchronous; poll the lookup briefly so a
-        # follow-up upload does not race the creation
+        # bucket provisioning is asynchronous; poll the lookup so a follow-up
+        # upload does not race the creation — and FAIL loudly if it never
+        # appears (a silent return would surface later as an opaque
+        # data-plane NoSuchBucket)
         deadline = time.time() + 30
-        while time.time() < deadline and self._get_bucket_id() is None:
+        while self._get_bucket_id() is None:
+            if time.time() >= deadline:
+                raise BadConfigException(f"SCP bucket {self.bucket_name} not visible 30s after creation was accepted")
             time.sleep(1)
 
     def delete_bucket(self) -> None:
