@@ -158,6 +158,29 @@ def load_aws_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = F
     return cfg
 
 
+def load_cloudflare_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = False) -> SkyplaneConfig:
+    """Cloudflare R2 flow (reference: cli_init.py:66-79): R2 is
+    object-storage-only (no VMs), so 'configured' just means captured API
+    keys, persisted in the 0600 config for the R2 interface to read."""
+    if non_interactive:
+        cfg.cloudflare_enabled = bool(cfg.cloudflare_access_key_id and cfg.cloudflare_secret_access_key)
+        return cfg
+    if not io.confirm("Do you want to configure Cloudflare R2 support?", bool(cfg.cloudflare_access_key_id)):
+        cfg.cloudflare_enabled = False
+        return cfg
+    key_id = io.prompt("Enter the R2 access key ID", cfg.cloudflare_access_key_id).strip()
+    secret = io.prompt("Enter the R2 secret access key", cfg.cloudflare_secret_access_key).strip()
+    if key_id and secret:
+        cfg.cloudflare_access_key_id = key_id
+        cfg.cloudflare_secret_access_key = secret
+        cfg.cloudflare_enabled = True
+        io.echo("[green]Cloudflare R2 keys captured.[/green]")
+    else:
+        cfg.cloudflare_enabled = False
+        io.echo("[yellow]Cloudflare R2 disabled (no keys entered).[/yellow]")
+    return cfg
+
+
 GCP_REQUIRED_APIS = {"iam": "IAM", "compute": "Compute Engine", "storage": "Storage", "cloudresourcemanager": "Cloud Resource Manager"}
 
 
@@ -237,6 +260,7 @@ def run_init(non_interactive: bool = False, io: Optional[WizardIO] = None) -> in
     else:
         load_aws_config(cfg, io)
         load_gcp_config(cfg, io)
+        load_cloudflare_config(cfg, io)
     cfg.azure_enabled = _detect_azure()
 
     io.echo(f"AWS:   {'[green]enabled[/green]' if cfg.aws_enabled else '[yellow]no credentials[/yellow]'}")

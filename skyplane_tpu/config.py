@@ -10,6 +10,7 @@ path.
 from __future__ import annotations
 
 import configparser
+import os
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Dict, Optional
@@ -110,6 +111,9 @@ class SkyplaneConfig:
     azure_resource_group: Optional[str] = None
     azure_umi_name: Optional[str] = None
     gcp_project_id: Optional[str] = None
+    cloudflare_enabled: bool = False
+    cloudflare_access_key_id: Optional[str] = None
+    cloudflare_secret_access_key: Optional[str] = None
     anon_clientid: Optional[str] = None
     flags: Dict[str, Any] = field(default_factory=dict)
 
@@ -133,6 +137,10 @@ class SkyplaneConfig:
         if "gcp" in config:
             cfg.gcp_enabled = _parse_bool(config.get("gcp", "enabled", fallback="false"))
             cfg.gcp_project_id = config.get("gcp", "project_id", fallback=None)
+        if "cloudflare" in config:
+            cfg.cloudflare_enabled = _parse_bool(config.get("cloudflare", "enabled", fallback="false"))
+            cfg.cloudflare_access_key_id = config.get("cloudflare", "access_key_id", fallback=None)
+            cfg.cloudflare_secret_access_key = config.get("cloudflare", "secret_access_key", fallback=None)
         if "client" in config:
             cfg.anon_clientid = config.get("client", "anon_clientid", fallback=None)
         if "flags" in config:
@@ -156,11 +164,18 @@ class SkyplaneConfig:
         config["gcp"] = {"enabled": str(self.gcp_enabled)}
         if self.gcp_project_id:
             config["gcp"]["project_id"] = self.gcp_project_id
+        config["cloudflare"] = {"enabled": str(self.cloudflare_enabled)}
+        if self.cloudflare_access_key_id:
+            config["cloudflare"]["access_key_id"] = self.cloudflare_access_key_id
+        if self.cloudflare_secret_access_key:
+            config["cloudflare"]["secret_access_key"] = self.cloudflare_secret_access_key
         config["client"] = {}
         if self.anon_clientid:
             config["client"]["anon_clientid"] = self.anon_clientid
         config["flags"] = {k: str(v) for k, v in self.flags.items()}
-        with path.open("w") as f:
+        # 0600 from creation: the config can carry R2 access keys
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             config.write(f)
 
     @staticmethod

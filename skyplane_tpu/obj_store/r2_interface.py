@@ -41,10 +41,19 @@ class R2Interface(S3Interface):
     def _make_client(self, region: str):
         import boto3
 
+        # env wins; otherwise the keys captured by `init`'s Cloudflare wizard
+        # section (persisted in the [cloudflare] config section, 0600)
+        key_id = os.environ.get("R2_ACCESS_KEY_ID")
+        secret = os.environ.get("R2_SECRET_ACCESS_KEY")
+        if not (key_id and secret):
+            from skyplane_tpu.config_paths import cloud_config
+
+            key_id = key_id or getattr(cloud_config, "cloudflare_access_key_id", None)
+            secret = secret or getattr(cloud_config, "cloudflare_secret_access_key", None)
         return boto3.client(
             "s3",
             endpoint_url=self.endpoint_url,
-            aws_access_key_id=os.environ.get("R2_ACCESS_KEY_ID"),
-            aws_secret_access_key=os.environ.get("R2_SECRET_ACCESS_KEY"),
+            aws_access_key_id=key_id,
+            aws_secret_access_key=secret,
             region_name="auto",
         )

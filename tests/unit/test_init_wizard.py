@@ -253,3 +253,28 @@ def test_gcp_rest_surface_via_fake_session(monkeypatch):
     email2 = auth.create_service_account()
     assert email2 == email
     assert len([m for m in roles["roles/storage.admin"] if m == f"serviceAccount:{email}"]) == 1
+
+
+def test_cloudflare_r2_key_capture_and_roundtrip(tmp_path):
+    from skyplane_tpu.cli.cli_init import load_cloudflare_config
+
+    io = ScriptedIO(confirms=[True], prompts=["R2KEYID", "R2SECRET"])
+    cfg = load_cloudflare_config(SkyplaneConfig.default_config(), io.as_io())
+    assert cfg.cloudflare_enabled
+    assert cfg.cloudflare_access_key_id == "R2KEYID"
+    # keys survive the INI roundtrip and the file is private
+    path = tmp_path / "config"
+    cfg.to_config_file(path)
+    assert oct(path.stat().st_mode & 0o777) == "0o600"
+    back = SkyplaneConfig.load_config(path)
+    assert back.cloudflare_access_key_id == "R2KEYID"
+    assert back.cloudflare_secret_access_key == "R2SECRET"
+    assert back.cloudflare_enabled
+
+
+def test_cloudflare_declined_disables():
+    from skyplane_tpu.cli.cli_init import load_cloudflare_config
+
+    io = ScriptedIO(confirms=[False])
+    cfg = load_cloudflare_config(SkyplaneConfig.default_config(), io.as_io())
+    assert not cfg.cloudflare_enabled
