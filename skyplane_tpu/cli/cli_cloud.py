@@ -104,10 +104,15 @@ def run_deprovision() -> int:
     for provider_name in ("aws", "gcp", "azure", "ibmcloud", "scp"):
         # ibm/scp are env-credential-gated rather than config-flag-gated
         if provider_name == "ibmcloud":
-            enabled = bool(os.environ.get("IBM_API_KEY"))
+            from skyplane_tpu.compute.ibmcloud.ibm_cloud_provider import IBMCloudProvider
+
+            enabled = bool(IBMCloudProvider.load_api_key())
         elif provider_name == "scp":
+            from skyplane_tpu.compute.scp.scp_cloud_provider import load_scp_credentials
+
+            creds = load_scp_credentials()
             # data-plane-only SCP configs (no project id) cannot list VMs
-            enabled = bool(os.environ.get("SCP_ACCESS_KEY") and os.environ.get("SCP_PROJECT_ID"))
+            enabled = bool(creds.get("scp_access_key") and creds.get("scp_project_id"))
         else:
             enabled = getattr(cloud_config, f"{provider_name}_enabled", False)
         if not enabled:

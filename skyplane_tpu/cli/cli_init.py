@@ -158,6 +158,58 @@ def load_aws_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = F
     return cfg
 
 
+def load_ibmcloud_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = False) -> None:
+    """IBM Cloud flow (reference: cli_init.py:377-473): detect the IAM API
+    key (env or ~/.bluemix/ibm_credentials); offer key entry when absent."""
+    from skyplane_tpu.compute.ibmcloud.ibm_cloud_provider import IBMCloudProvider
+
+    if non_interactive:
+        return
+    if not io.confirm("Do you want to configure IBM Cloud support?", bool(IBMCloudProvider.load_api_key())):
+        return
+    if IBMCloudProvider.load_api_key():
+        io.echo("[green]IBM Cloud IAM API key found.[/green]")
+        return
+    key = io.prompt("Enter an IBM Cloud IAM API key (empty to skip)", None).strip()
+    if not key:
+        io.echo("[yellow]IBM Cloud skipped (no key). Set IBM_API_KEY or ~/.bluemix/ibm_credentials later.[/yellow]")
+        return
+    path = IBMCloudProvider.credential_file()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(f"iam_api_key: {key}\n")
+    io.echo(f"IBM credentials written to {path}")
+
+
+def load_scp_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = False) -> None:
+    """SCP flow (reference: cli_init.py:474-533): detect the key-triple (env
+    or ~/.scp/scp_credential); offer entry of the full triple when absent."""
+    from skyplane_tpu.compute.scp.scp_cloud_provider import load_scp_credentials, scp_credential_file
+
+    if non_interactive:
+        return
+    creds = load_scp_credentials()
+    have = bool(creds.get("scp_access_key") and creds.get("scp_secret_key"))
+    if not io.confirm("Do you want to configure Samsung Cloud Platform (SCP) support?", have):
+        return
+    if have:
+        io.echo(f"[green]Loaded SCP credentials[/green] [access key: ...{creds['scp_access_key'][-6:]}]")
+        return
+    access = io.prompt("Enter the SCP access key (empty to skip)", None).strip()
+    if not access:
+        io.echo("[yellow]SCP skipped (no key). Populate ~/.scp/scp_credential later.[/yellow]")
+        return
+    secret = io.prompt("Enter the SCP secret key", None).strip()
+    project = io.prompt("Enter the SCP project ID", None).strip()
+    path = scp_credential_file()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(f"scp_access_key = {access}\nscp_secret_key = {secret}\nscp_project_id = {project}\n")
+    io.echo(f"SCP credentials written to {path}")
+
+
 def load_cloudflare_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = False) -> SkyplaneConfig:
     """Cloudflare R2 flow (reference: cli_init.py:66-79): R2 is
     object-storage-only (no VMs), so 'configured' just means captured API
@@ -261,6 +313,8 @@ def run_init(non_interactive: bool = False, io: Optional[WizardIO] = None) -> in
         load_aws_config(cfg, io)
         load_gcp_config(cfg, io)
         load_cloudflare_config(cfg, io)
+        load_ibmcloud_config(cfg, io)
+        load_scp_config(cfg, io)
     cfg.azure_enabled = _detect_azure()
 
     io.echo(f"AWS:   {'[green]enabled[/green]' if cfg.aws_enabled else '[yellow]no credentials[/yellow]'}")

@@ -68,12 +68,37 @@ class IBMCloudProvider(CloudProvider):
         self._clients = {}
         self._image_cache = {}
 
+    @staticmethod
+    def credential_file() -> Path:
+        """~/.bluemix/ibm_credentials (IBM_CONFIG_FILE overrides) — the same
+        location the reference's init reads (cli_init.py:377-400)."""
+        return Path(os.environ.get("IBM_CONFIG_FILE", Path.home() / ".bluemix" / "ibm_credentials"))
+
+    @classmethod
+    def load_api_key(cls) -> Optional[str]:
+        """IBM_API_KEY env, else the iam_api_key field of the credential file."""
+        if os.environ.get("IBM_API_KEY"):
+            return os.environ["IBM_API_KEY"]
+        path = cls.credential_file()
+        if not path.exists():
+            return None
+        try:
+            import yaml
+
+            data = yaml.safe_load(path.read_text()) or {}
+            return data.get("iam_api_key") or data.get("iamapikey")
+        except ImportError:
+            for line in path.read_text().splitlines():  # flat "key: value" fallback
+                if line.strip().startswith(("iam_api_key:", "iamapikey:")):
+                    return line.split(":", 1)[1].strip().strip("'\"") or None
+        return None
+
     def _authenticator(self):
         from ibm_cloud_sdk_core.authenticators import IAMAuthenticator
 
-        api_key = os.environ.get("IBM_API_KEY")
+        api_key = self.load_api_key()
         if not api_key:
-            raise RuntimeError("IBM Cloud provisioning requires IBM_API_KEY")
+            raise RuntimeError(f"IBM Cloud provisioning requires IBM_API_KEY or {self.credential_file()}")
         return IAMAuthenticator(api_key)
 
     def vpc_client(self, region: str):

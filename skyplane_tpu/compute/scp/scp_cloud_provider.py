@@ -29,16 +29,43 @@ DEFAULT_ENDPOINT = "https://openapi.samsungsdscloud.com"
 TAG = "skyplane-tpu"
 
 
+def scp_credential_file() -> Path:
+    """~/.scp/scp_credential (SCP_CREDENTIAL_FILE overrides) — the
+    `key = value` file the reference's init reads (cli_init.py:474-506)."""
+    return Path(os.environ.get("SCP_CREDENTIAL_FILE", Path.home() / ".scp" / "scp_credential"))
+
+
+def load_scp_credentials() -> dict:
+    """Merged SCP credentials: env vars win, the credential file fills gaps."""
+    creds = {
+        "scp_access_key": os.environ.get("SCP_ACCESS_KEY"),
+        "scp_secret_key": os.environ.get("SCP_SECRET_KEY"),
+        "scp_project_id": os.environ.get("SCP_PROJECT_ID"),
+    }
+    path = scp_credential_file()
+    if path.exists():
+        for line in path.read_text().splitlines():
+            if " = " in line:
+                key, value = line.split(" = ", 1)
+                creds.setdefault(key.strip(), None)
+                if not creds.get(key.strip()):
+                    creds[key.strip()] = value.strip()
+    return creds
+
+
 class SCPClient:
     """Minimal signed-REST client for the SCP open API."""
 
     def __init__(self):
-        self.access_key = os.environ.get("SCP_ACCESS_KEY")
-        self.secret_key = os.environ.get("SCP_SECRET_KEY")
-        self.project_id = os.environ.get("SCP_PROJECT_ID")
+        creds = load_scp_credentials()
+        self.access_key = creds.get("scp_access_key")
+        self.secret_key = creds.get("scp_secret_key")
+        self.project_id = creds.get("scp_project_id")
         self.endpoint = os.environ.get("SCP_API_ENDPOINT", DEFAULT_ENDPOINT)
         if not (self.access_key and self.secret_key and self.project_id):
-            raise RuntimeError("SCP provisioning requires SCP_ACCESS_KEY / SCP_SECRET_KEY / SCP_PROJECT_ID")
+            raise RuntimeError(
+                f"SCP provisioning requires SCP_ACCESS_KEY / SCP_SECRET_KEY / SCP_PROJECT_ID (env or {scp_credential_file()})"
+            )
 
     def _headers(self, method: str, url: str) -> dict:
         timestamp = str(int(time.time() * 1000))

@@ -53,11 +53,14 @@ class SCPInterface(S3Interface):
     def _make_client(self, region: str):
         import boto3
 
+        from skyplane_tpu.compute.scp.scp_cloud_provider import load_scp_credentials
+
+        creds = load_scp_credentials()
         return boto3.client(
             "s3",
             endpoint_url=self.endpoint,
-            aws_access_key_id=os.environ.get("SCP_ACCESS_KEY"),
-            aws_secret_access_key=os.environ.get("SCP_SECRET_KEY"),
+            aws_access_key_id=creds.get("scp_access_key"),
+            aws_secret_access_key=creds.get("scp_secret_key"),
             region_name="kr-west-1",
         )
 
@@ -73,7 +76,10 @@ class SCPInterface(S3Interface):
         return self._mgmt
 
     def _has_management_creds(self) -> bool:
-        return bool(os.environ.get("SCP_PROJECT_ID") and os.environ.get("SCP_ACCESS_KEY") and os.environ.get("SCP_SECRET_KEY"))
+        from skyplane_tpu.compute.scp.scp_cloud_provider import load_scp_credentials
+
+        creds = load_scp_credentials()
+        return bool(creds.get("scp_project_id") and creds.get("scp_access_key") and creds.get("scp_secret_key"))
 
     def _get_bucket_id(self) -> Optional[str]:
         """Bucket name -> objectStorageBucketId (reference scp_interface.py:198-211)."""

@@ -278,3 +278,49 @@ def test_cloudflare_declined_disables():
     io = ScriptedIO(confirms=[False])
     cfg = load_cloudflare_config(SkyplaneConfig.default_config(), io.as_io())
     assert not cfg.cloudflare_enabled
+
+
+def test_ibm_key_entry_writes_credential_file(tmp_path, monkeypatch):
+    from skyplane_tpu.cli.cli_init import load_ibmcloud_config
+    from skyplane_tpu.compute.ibmcloud.ibm_cloud_provider import IBMCloudProvider
+
+    cred = tmp_path / "bluemix" / "ibm_credentials"
+    monkeypatch.setenv("IBM_CONFIG_FILE", str(cred))
+    monkeypatch.delenv("IBM_API_KEY", raising=False)
+    io = ScriptedIO(confirms=[True], prompts=["IAMKEY-123"])
+    load_ibmcloud_config(SkyplaneConfig.default_config(), io.as_io())
+    assert oct(cred.stat().st_mode & 0o777) == "0o600"
+    assert IBMCloudProvider.load_api_key() == "IAMKEY-123"
+
+
+def test_scp_key_entry_writes_credential_file(tmp_path, monkeypatch):
+    from skyplane_tpu.cli.cli_init import load_scp_config
+    from skyplane_tpu.compute.scp.scp_cloud_provider import load_scp_credentials
+
+    cred = tmp_path / "scp" / "scp_credential"
+    monkeypatch.setenv("SCP_CREDENTIAL_FILE", str(cred))
+    for var in ("SCP_ACCESS_KEY", "SCP_SECRET_KEY", "SCP_PROJECT_ID"):
+        monkeypatch.delenv(var, raising=False)
+    io = ScriptedIO(confirms=[True], prompts=["AKSCP", "SKSCP", "PROJ7"])
+    load_scp_config(SkyplaneConfig.default_config(), io.as_io())
+    assert oct(cred.stat().st_mode & 0o777) == "0o600"
+    creds = load_scp_credentials()
+    assert creds["scp_access_key"] == "AKSCP" and creds["scp_project_id"] == "PROJ7"
+    # env still wins over the file
+    monkeypatch.setenv("SCP_ACCESS_KEY", "ENVKEY")
+    assert load_scp_credentials()["scp_access_key"] == "ENVKEY"
+
+
+def test_ibm_scp_existing_creds_short_circuit(tmp_path, monkeypatch):
+    from skyplane_tpu.cli.cli_init import load_ibmcloud_config, load_scp_config
+
+    monkeypatch.setenv("IBM_API_KEY", "present")
+    monkeypatch.setenv("SCP_ACCESS_KEY", "present-key")
+    monkeypatch.setenv("SCP_SECRET_KEY", "s")
+    monkeypatch.setenv("SCP_CREDENTIAL_FILE", str(tmp_path / "nonexistent"))
+    io1 = ScriptedIO(confirms=[True])
+    load_ibmcloud_config(SkyplaneConfig.default_config(), io1.as_io())
+    assert any("IAM API key found" in e for e in io1.echoes)
+    io2 = ScriptedIO(confirms=[True])
+    load_scp_config(SkyplaneConfig.default_config(), io2.as_io())
+    assert any("...nt-key" in e for e in io2.echoes)
