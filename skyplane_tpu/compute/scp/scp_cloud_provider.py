@@ -113,41 +113,252 @@ class SCPServer(SSHServer):
         self._client.request("DELETE", f"/virtual-server/v3/virtual-servers/{self.instance_id}")
 
 
+class SCPNetwork:
+    """SCP network bootstrap: VPC -> internet gateway -> public subnet ->
+    security group (+TCP in/out rules) -> per-server firewall rules.
+
+    Reference parity: skyplane/compute/scp/scp_network.py:36-430 (the same
+    resource chain over the same /vpc/v3, /internet-gateway/v2, /subnet/v2,
+    /security-group/v3+v2, /firewall/v2 routes), compressed to the
+    find-valid-or-create + teardown surface the gateway lifecycle needs."""
+
+    VPC_NAME = "skyplane-tpu-vpc"
+    SG_NAME = "SkyplaneTpuSecuGroup"
+
+    def __init__(self, client: SCPClient, poll_interval: float = 5.0, timeout: float = 600.0):
+        self.client = client
+        self.poll_interval = poll_interval
+        self.timeout = timeout
+
+    def _wait(self, what: str, condition) -> None:
+        deadline = time.time() + self.timeout
+        while not condition():
+            if time.time() >= deadline:
+                raise TimeoutError(f"SCP: timed out waiting for {what}")
+            time.sleep(self.poll_interval)
+
+    def _contents(self, data) -> list:
+        return data.get("contents", data if isinstance(data, list) else [])
+
+    # --- vpc ---
+    def list_vpcs(self, zone_id: str) -> list:
+        data = self.client.request("GET", f"/vpc/v3/vpcs?serviceZoneId={zone_id}&vpcName={self.VPC_NAME}")
+        return [v for v in self._contents(data) if v.get("vpcName") == self.VPC_NAME]
+
+    def find_valid_vpc(self, zone_id: str) -> Optional[str]:
+        """An ACTIVE skyplane VPC with an ATTACHED IGW and an ACTIVE public
+        subnet (reference scp_network.py:247-261)."""
+        for vpc in self.list_vpcs(zone_id):
+            if vpc.get("vpcState") != "ACTIVE":
+                continue
+            vpc_id = vpc["vpcId"]
+            igws = [g for g in self.list_igws(vpc_id) if g.get("internetGatewayState") == "ATTACHED"]
+            subnets = [
+                s for s in self.list_subnets(vpc_id) if s.get("subnetState") == "ACTIVE" and s.get("subnetType") == "PUBLIC"
+            ]
+            if igws and subnets:
+                return vpc_id
+        return None
+
+    def create_vpc(self, zone_id: str) -> str:
+        resp = self.client.request(
+            "POST", "/vpc/v3/vpcs", {"serviceZoneId": zone_id, "vpcName": self.VPC_NAME, "vpcDescription": "skyplane-tpu VPC"}
+        )
+        vpc_id = resp["resourceId"]
+        self._wait("VPC ACTIVE", lambda: any(v.get("vpcId") == vpc_id and v.get("vpcState") == "ACTIVE" for v in self.list_vpcs(zone_id)))
+        return vpc_id
+
+    # --- internet gateway ---
+    def list_igws(self, vpc_id: str) -> list:
+        data = self.client.request("GET", "/internet-gateway/v2/internet-gateways")
+        return [g for g in self._contents(data) if g.get("vpcId") == vpc_id]
+
+    def create_igw(self, zone_id: str, vpc_id: str) -> str:
+        resp = self.client.request(
+            "POST",
+            "/internet-gateway/v2/internet-gateways",
+            {"firewallEnabled": True, "serviceZoneId": zone_id, "vpcId": vpc_id},
+        )
+        igw_id = resp["resourceId"]
+        self._wait(
+            "IGW ATTACHED",
+            lambda: any(g.get("internetGatewayId") == igw_id and g.get("internetGatewayState") == "ATTACHED" for g in self.list_igws(vpc_id)),
+        )
+        return igw_id
+
+    # --- subnet ---
+    def list_subnets(self, vpc_id: str) -> list:
+        return self._contents(self.client.request("GET", f"/subnet/v2/subnets?vpcId={vpc_id}"))
+
+    def create_subnet(self, zone_id: str, vpc_id: str) -> str:
+        resp = self.client.request(
+            "POST",
+            "/subnet/v2/subnets",
+            {
+                "subnetCidrBlock": "192.168.0.0/24",
+                "subnetName": f"{TAG}sub".replace("-", ""),
+                "subnetType": "PUBLIC",
+                "vpcId": vpc_id,
+                "serviceZoneId": zone_id,
+            },
+        )
+        subnet_id = resp["resourceId"]
+        self._wait(
+            "subnet ACTIVE",
+            lambda: any(s.get("subnetId") == subnet_id and s.get("subnetState") == "ACTIVE" for s in self.list_subnets(vpc_id)),
+        )
+        return subnet_id
+
+    # --- security group ---
+    def list_security_groups(self, vpc_id: str) -> list:
+        data = self.client.request("GET", f"/security-group/v3/security-groups?vpcId={vpc_id}")
+        return [g for g in self._contents(data) if g.get("securityGroupName") == self.SG_NAME]
+
+    def create_security_group(self, zone_id: str, vpc_id: str) -> str:
+        resp = self.client.request(
+            "POST",
+            "/security-group/v3/security-groups",
+            {"loggable": False, "securityGroupName": self.SG_NAME, "serviceZoneId": zone_id, "vpcId": vpc_id},
+        )
+        sg_id = resp["resourceId"]
+        self._wait(
+            "security group ACTIVE",
+            lambda: any(g.get("securityGroupState") == "ACTIVE" for g in self.list_security_groups(vpc_id)),
+        )
+        for direction, addr_key in (("IN", "sourceIpAddresses"), ("OUT", "destinationIpAddresses")):
+            self.client.request(
+                "POST",
+                f"/security-group/v2/security-groups/{sg_id}/rules",
+                {"ruleDirection": direction, "services": [{"serviceType": "TCP_ALL"}], addr_key: ["0.0.0.0/0"]},
+            )
+        return sg_id
+
+    # --- firewall (per-IGW; gateway data ports + ssh) ---
+    def get_firewall_id(self, igw_id: str) -> Optional[str]:
+        data = self.client.request("GET", "/firewall/v2/firewalls")
+        for fw in self._contents(data):
+            if fw.get("objectId") == igw_id:
+                return fw.get("firewallId")
+        return None
+
+    def add_firewall_rules(self, igw_id: str, server_ip: str) -> None:
+        fw_id = self.get_firewall_id(igw_id)
+        if fw_id is None:
+            return  # firewall not enabled on this IGW tier
+        for direction, src, dst in (("IN", ["0.0.0.0/0"], [server_ip]), ("OUT", [server_ip], ["0.0.0.0/0"])):
+            self.client.request(
+                "POST",
+                f"/firewall/v2/firewalls/{fw_id}/rules",
+                {
+                    "sourceIpAddresses": src,
+                    "destinationIpAddresses": dst,
+                    "services": [{"serviceType": "TCP_ALL"}],
+                    "ruleDirection": direction,
+                    "ruleAction": "ALLOW",
+                    "isRuleEnabled": True,
+                },
+            )
+
+    # --- orchestration ---
+    def make_vpc(self, zone_id: str) -> dict:
+        """Find-valid-or-create the full network chain; returns
+        {vpc_id, subnet_id, sg_id, igw_id}."""
+        vpc_id = self.find_valid_vpc(zone_id)
+        if vpc_id is None:
+            vpc_id = self.create_vpc(zone_id)
+            igw_id = self.create_igw(zone_id, vpc_id)
+            subnet_id = self.create_subnet(zone_id, vpc_id)
+            sg_id = self.create_security_group(zone_id, vpc_id)
+        else:
+            igw_id = self.list_igws(vpc_id)[0]["internetGatewayId"]
+            subnet_id = self.list_subnets(vpc_id)[0]["subnetId"]
+            groups = self.list_security_groups(vpc_id)
+            sg_id = groups[0]["securityGroupId"] if groups else self.create_security_group(zone_id, vpc_id)
+        return {"vpc_id": vpc_id, "subnet_id": subnet_id, "sg_id": sg_id, "igw_id": igw_id}
+
+    def teardown(self, zone_id: str) -> dict:
+        """Reverse-order deletion of every skyplane network resource in the
+        zone (reference scp_network.py delete paths). Servers must be gone
+        first; the caller (teardown_region) guarantees that."""
+        counts = {"security_groups": 0, "subnets": 0, "igws": 0, "vpcs": 0}
+        for vpc in self.list_vpcs(zone_id):
+            vpc_id = vpc["vpcId"]
+            for sg in self.list_security_groups(vpc_id):
+                self.client.request("DELETE", f"/security-group/v3/security-groups/{sg['securityGroupId']}")
+                counts["security_groups"] += 1
+            for subnet in self.list_subnets(vpc_id):
+                self.client.request("DELETE", f"/subnet/v2/subnets/{subnet['subnetId']}")
+                counts["subnets"] += 1
+            self._wait("subnets gone", lambda: not self.list_subnets(vpc_id))
+            for igw in self.list_igws(vpc_id):
+                self.client.request("DELETE", f"/internet-gateway/v2/internet-gateways/{igw['internetGatewayId']}")
+                counts["igws"] += 1
+            self._wait("IGWs gone", lambda: not self.list_igws(vpc_id))
+            self.client.request("DELETE", f"/vpc/v3/vpcs/{vpc_id}")
+            counts["vpcs"] += 1
+        return counts
+
+
 class SCPCloudProvider(CloudProvider):
     provider_name = "scp"
 
     def __init__(self):
         self.client = SCPClient()
+        self.network = SCPNetwork(self.client)
 
     def _key_path(self) -> Path:
         return Path(key_root) / "scp" / "skyplane-tpu.pem"
 
     def setup_global(self) -> None: ...
 
-    def setup_region(self, region: str) -> None: ...
+    def setup_region(self, region: str) -> None:
+        self.network.make_vpc(region)
 
     def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> SCPServer:
         region = region_tag.split(":")[-1]
         name = f"{TAG}-{uuid.uuid4().hex[:8]}"
+        net = self.network.make_vpc(region)
         body = {
             "virtualServerName": name,
             "serverType": vm_type or "s1v8m16",
             "serviceZoneId": region,
             "imageId": os.environ.get("SCP_IMAGE_ID", ""),
             "osAdmin": {"osUserId": "root"},
+            "nic": {"natEnabled": "true", "subnetId": net["subnet_id"]},
+            "securityGroupIds": [net["sg_id"]],
+            "blockStorage": {"blockStorageName": f"{TAG}-disk", "diskSize": 100},
             "tags": [{"tagKey": TAG, "tagValue": "true"}],
         }
         created = self.client.request("POST", "/virtual-server/v3/virtual-servers", body)
         server_id = created.get("resourceId") or created.get("virtualServerId")
         deadline = time.time() + 600
         ip = private_ip = ""
-        while time.time() < deadline:
-            data = self.client.request("GET", f"/virtual-server/v3/virtual-servers/{server_id}")
-            if data.get("virtualServerState") == "RUNNING":
-                ip = data.get("natIpAddress") or data.get("ipAddress", "")
-                private_ip = data.get("ipAddress", "")
-                break
-            time.sleep(10)
+        try:
+            while True:
+                data = self.client.request("GET", f"/virtual-server/v3/virtual-servers/{server_id}")
+                if data.get("virtualServerState") == "RUNNING":
+                    ip = data.get("natIpAddress") or data.get("ipAddress", "")
+                    private_ip = data.get("ipAddress", "")
+                    break
+                if data.get("virtualServerState") in ("ERROR", "TERMINATED"):
+                    raise RuntimeError(f"SCP server {name} entered {data.get('virtualServerState')} while provisioning")
+                if time.time() >= deadline:
+                    raise TimeoutError(f"SCP server {name} not RUNNING after 600s")
+                time.sleep(10)
+        except Exception:
+            # teardown-after-partial-provision: the half-created VM must not
+            # keep billing (same contract as the IBM provider)
+            try:
+                self.client.request("DELETE", f"/virtual-server/v3/virtual-servers/{server_id}")
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        # open the per-server firewall path on the IGW (reference
+        # scp_cloud_provider.py:196-199 add_firewall_22_rule)
+        try:
+            self.network.add_firewall_rules(net["igw_id"], private_ip or ip)
+        except Exception:  # noqa: BLE001 — firewall tiers vary; SG rules already permit
+            pass
         return SCPServer(self.client, region, server_id, ip, private_ip, str(self._key_path()))
 
     def get_matching_instances(self, tags: Optional[dict] = None, **kw) -> List[SCPServer]:
@@ -166,5 +377,26 @@ class SCPCloudProvider(CloudProvider):
                     )
                 )
         return servers
+
+    def teardown_region(self, region: str) -> dict:
+        """Delete tagged servers, wait them out, then sweep the network chain."""
+        counts = {"servers": 0}
+        for item in self._list_raw():
+            if item.get("virtualServerName", "").startswith(TAG) and item.get("serviceZoneId") == region:
+                self.client.request("DELETE", f"/virtual-server/v3/virtual-servers/{item['virtualServerId']}")
+                counts["servers"] += 1
+        if counts["servers"]:
+            self.network._wait(
+                "servers gone",
+                lambda: not any(
+                    i.get("virtualServerName", "").startswith(TAG) and i.get("serviceZoneId") == region
+                    for i in self._list_raw()
+                ),
+            )
+        counts.update(self.network.teardown(region))
+        return counts
+
+    def _list_raw(self) -> list:
+        return self.client.request("GET", "/virtual-server/v3/virtual-servers").get("contents", [])
 
     def teardown_global(self) -> None: ...

@@ -18,11 +18,12 @@ import pytest
 
 
 @pytest.fixture()
-def scp(monkeypatch):
+def scp(monkeypatch, tmp_path):
     monkeypatch.setenv("SCP_ACCESS_KEY", "AK")
     monkeypatch.setenv("SCP_SECRET_KEY", "SK")
     monkeypatch.setenv("SCP_PROJECT_ID", "P1")
     monkeypatch.setenv("SCP_IMAGE_ID", "IMG-1")
+    monkeypatch.setenv("SCP_CREDENTIAL_FILE", str(tmp_path / "no_scp_credential"))
 
     from skyplane_tpu.compute.scp import scp_cloud_provider as mod
 
@@ -39,44 +40,131 @@ def scp(monkeypatch):
         def json(self):
             return self._body
 
-    state = {"poll": 0}
+    # stateful network + server store so the full bootstrap chain
+    # (vpc -> igw -> subnet -> sg -> server -> firewall) runs end to end
+    state = {
+        "poll": 0,
+        "vpcs": [],
+        "igws": [],
+        "subnets": [],
+        "sgs": [],
+        "sg_rules": [],
+        "firewalls": [],
+        "fw_rules": [],
+        "servers": [
+            {
+                "virtualServerName": "skyplane-tpu-abc",
+                "virtualServerState": "RUNNING",
+                "virtualServerId": "vs-9",
+                "serviceZoneId": "kr-west-1",
+                "natIpAddress": "8.8.8.8",
+                "ipAddress": "10.0.0.9",
+            },
+            {"virtualServerName": "other", "virtualServerState": "RUNNING", "virtualServerId": "vs-x", "serviceZoneId": "kr-west-1"},
+        ],
+        "server_counter": 0,
+        "fail_server": None,  # set to a state string to break provisioning
+    }
 
     def fake_request(method, url, headers=None, json=None, timeout=None):
         calls.append((method, url, headers, json))
-        if method == "POST" and url.endswith("/virtual-servers"):
-            return FakeResponse({"resourceId": "vs-1"})
-        if method == "GET" and url.endswith("/virtual-servers/vs-1"):
-            state["poll"] += 1
-            if state["poll"] < 2:
-                return FakeResponse({"virtualServerState": "CREATING"})
-            return FakeResponse(
-                {"virtualServerState": "RUNNING", "natIpAddress": "8.8.4.4", "ipAddress": "10.2.0.9"}
+        path = url.split("openapi.samsungsdscloud.com", 1)[-1]
+        # --- vpc ---
+        if method == "POST" and path == "/vpc/v3/vpcs":
+            state["vpcs"].append({"vpcId": "VPC-1", "vpcName": json["vpcName"], "vpcState": "ACTIVE", "zone": json["serviceZoneId"]})
+            return FakeResponse({"resourceId": "VPC-1"})
+        if method == "GET" and path.startswith("/vpc/v3/vpcs"):
+            return FakeResponse({"contents": list(state["vpcs"])})
+        if method == "DELETE" and path.startswith("/vpc/v3/vpcs/"):
+            vid = path.rsplit("/", 1)[1]
+            state["vpcs"] = [v for v in state["vpcs"] if v["vpcId"] != vid]
+            return FakeResponse({})
+        # --- igw ---
+        if method == "POST" and path == "/internet-gateway/v2/internet-gateways":
+            state["igws"].append({"internetGatewayId": "IGW-1", "vpcId": json["vpcId"], "internetGatewayState": "ATTACHED"})
+            state["firewalls"].append({"firewallId": "FW-1", "objectId": "IGW-1"})
+            return FakeResponse({"resourceId": "IGW-1"})
+        if method == "GET" and path == "/internet-gateway/v2/internet-gateways":
+            return FakeResponse({"contents": list(state["igws"])})
+        if method == "DELETE" and path.startswith("/internet-gateway/v2/internet-gateways/"):
+            gid = path.rsplit("/", 1)[1]
+            state["igws"] = [g for g in state["igws"] if g["internetGatewayId"] != gid]
+            return FakeResponse({})
+        # --- subnet ---
+        if method == "POST" and path == "/subnet/v2/subnets":
+            state["subnets"].append(
+                {"subnetId": "SUB-1", "vpcId": json["vpcId"], "subnetState": "ACTIVE", "subnetType": json["subnetType"]}
             )
-        if method == "GET" and url.endswith("/virtual-servers"):
-            return FakeResponse(
+            return FakeResponse({"resourceId": "SUB-1"})
+        if method == "GET" and path.startswith("/subnet/v2/subnets"):
+            return FakeResponse({"contents": list(state["subnets"])})
+        if method == "DELETE" and path.startswith("/subnet/v2/subnets/"):
+            sid = path.rsplit("/", 1)[1]
+            state["subnets"] = [x for x in state["subnets"] if x["subnetId"] != sid]
+            return FakeResponse({})
+        # --- security group ---
+        if method == "POST" and path == "/security-group/v3/security-groups":
+            state["sgs"].append(
+                {"securityGroupId": "SG-1", "vpcId": json["vpcId"], "securityGroupName": json["securityGroupName"], "securityGroupState": "ACTIVE"}
+            )
+            return FakeResponse({"resourceId": "SG-1"})
+        if method == "GET" and path.startswith("/security-group/v3/security-groups"):
+            return FakeResponse({"contents": list(state["sgs"])})
+        if method == "DELETE" and path.startswith("/security-group/v3/security-groups/"):
+            gid = path.rsplit("/", 1)[1]
+            state["sgs"] = [g for g in state["sgs"] if g["securityGroupId"] != gid]
+            return FakeResponse({})
+        if method == "POST" and "/security-group/v2/security-groups/" in path and path.endswith("/rules"):
+            state["sg_rules"].append(json)
+            return FakeResponse({"resourceId": f"SGR-{len(state['sg_rules'])}"})
+        # --- firewall ---
+        if method == "GET" and path == "/firewall/v2/firewalls":
+            return FakeResponse({"contents": list(state["firewalls"])})
+        if method == "POST" and "/firewall/v2/firewalls/" in path and path.endswith("/rules"):
+            state["fw_rules"].append(json)
+            return FakeResponse({"resourceId": f"FWR-{len(state['fw_rules'])}"})
+        # --- virtual servers ---
+        if method == "POST" and path.endswith("/virtual-servers"):
+            state["server_counter"] += 1
+            sid = f"vs-{state['server_counter']}"
+            st = state["fail_server"] or "CREATING"
+            state["servers"].append(
                 {
-                    "contents": [
-                        {
-                            "virtualServerName": "skyplane-tpu-abc",
-                            "virtualServerState": "RUNNING",
-                            "virtualServerId": "vs-9",
-                            "serviceZoneId": "kr-west-1",
-                            "natIpAddress": "8.8.8.8",
-                            "ipAddress": "10.0.0.9",
-                        },
-                        {"virtualServerName": "other", "virtualServerState": "RUNNING"},
-                    ]
+                    "virtualServerName": json["virtualServerName"],
+                    "virtualServerState": st,
+                    "virtualServerId": sid,
+                    "serviceZoneId": json["serviceZoneId"],
+                    "natIpAddress": "8.8.4.4",
+                    "ipAddress": "10.2.0.9",
                 }
             )
+            return FakeResponse({"resourceId": sid})
+        if method == "GET" and "/virtual-servers/" in path:
+            sid = path.rsplit("/", 1)[1]
+            srv = next((x for x in state["servers"] if x["virtualServerId"] == sid), None)
+            if srv is None:
+                return FakeResponse({})
+            if srv["virtualServerState"] == "CREATING":
+                state["poll"] += 1
+                if state["poll"] >= 2:
+                    srv["virtualServerState"] = "RUNNING"
+            return FakeResponse(dict(srv))
+        if method == "GET" and path.endswith("/virtual-servers"):
+            return FakeResponse({"contents": [dict(x) for x in state["servers"]]})
+        if method == "DELETE" and "/virtual-servers/" in path:
+            sid = path.rsplit("/", 1)[1]
+            state["servers"] = [x for x in state["servers"] if x["virtualServerId"] != sid]
+            return FakeResponse({})
         return FakeResponse({})
 
     monkeypatch.setattr(mod.requests, "request", fake_request)
     monkeypatch.setattr(mod.time, "sleep", lambda s: None)
-    return mod, calls
+    mod_state = state
+    return mod, calls, mod_state
 
 
 def test_scp_request_signing(scp):
-    mod, calls = scp
+    mod, calls, _ = scp
     client = mod.SCPClient()
     client.request("GET", "/x")
     method, url, headers, _ = calls[0]
@@ -88,21 +176,28 @@ def test_scp_request_signing(scp):
 
 
 def test_scp_provision_waits_for_running(scp):
-    mod, calls = scp
+    mod, calls, state = scp
     provider = mod.SCPCloudProvider()
     server = provider.provision_instance("scp:kr-west-1", vm_type="s1v4m8")
-    create = next(j for m, u, h, j in calls if m == "POST")
+    create = next(j for m, u, h, j in calls if m == "POST" and u.endswith("/virtual-servers"))
     assert create["serverType"] == "s1v4m8"
     assert create["serviceZoneId"] == "kr-west-1"
     assert create["imageId"] == "IMG-1"
     assert {"tagKey": "skyplane-tpu", "tagValue": "true"} in create["tags"]
+    # the network chain was bootstrapped and wired into the VM body
+    assert create["nic"] == {"natEnabled": "true", "subnetId": "SUB-1"}
+    assert create["securityGroupIds"] == ["SG-1"]
     assert server.instance_id == "vs-1"
     assert server.public_ip() == "8.8.4.4"
     assert server.private_ip() == "10.2.0.9"
+    # per-server firewall rules landed on the IGW's firewall
+    assert len(state["fw_rules"]) == 2
+    # SG got the TCP in+out rules exactly once
+    assert {r["ruleDirection"] for r in state["sg_rules"]} == {"IN", "OUT"}
 
 
 def test_scp_matching_instances_filters_by_name_prefix(scp):
-    mod, calls = scp
+    mod, calls, _ = scp
     provider = mod.SCPCloudProvider()
     servers = provider.get_matching_instances()
     assert [s.instance_id for s in servers] == ["vs-9"]
@@ -258,3 +353,45 @@ def test_scp_obs_requires_management_creds(monkeypatch):
     iface = SCPInterface("b")
     with pytest.raises(BadConfigException, match="management credentials"):
         iface.create_bucket("scp:kr-west-1")
+
+
+def test_scp_make_vpc_idempotent(scp):
+    mod, calls, state = scp
+    provider = mod.SCPCloudProvider()
+    net1 = provider.network.make_vpc("kr-west-1")
+    assert net1 == {"vpc_id": "VPC-1", "subnet_id": "SUB-1", "sg_id": "SG-1", "igw_id": "IGW-1"}
+    n_posts = sum(1 for m, u, _, _ in calls if m == "POST")
+    # second call finds the valid VPC and creates nothing new
+    net2 = provider.network.make_vpc("kr-west-1")
+    assert net2 == net1
+    assert sum(1 for m, u, _, _ in calls if m == "POST") == n_posts
+
+
+def test_scp_partial_provision_cleanup(scp):
+    mod, calls, state = scp
+    provider = mod.SCPCloudProvider()
+    state["fail_server"] = "ERROR"
+    n_before = len(state["servers"])
+    with pytest.raises(RuntimeError, match="ERROR"):
+        provider.provision_instance("scp:kr-west-1")
+    # the half-created server was deleted again
+    assert len(state["servers"]) == n_before
+    assert any(m == "DELETE" and "/virtual-servers/" in u for m, u, _, _ in calls)
+
+
+def test_scp_teardown_region_sweeps_network(scp):
+    mod, calls, state = scp
+    provider = mod.SCPCloudProvider()
+    provider.provision_instance("scp:kr-west-1")
+    counts = provider.teardown_region("kr-west-1")
+    # tagged servers (pre-seeded vs-9 + the provisioned one) and the chain
+    assert counts["servers"] == 2
+    assert counts == {"servers": 2, "security_groups": 1, "subnets": 1, "igws": 1, "vpcs": 1}
+    assert state["vpcs"] == [] and state["subnets"] == [] and state["igws"] == [] and state["sgs"] == []
+    # untagged server survives
+    assert [s["virtualServerId"] for s in state["servers"]] == ["vs-x"]
+    names = [(m, u.split("openapi.samsungsdscloud.com", 1)[-1].split("/")[1]) for m, u, _, _ in calls if m == "DELETE"]
+    # dependency order: servers first, vpc last
+    kinds = [k for _, k in names]
+    assert kinds.index("virtual-server") < kinds.index("vpc")
+    assert kinds.index("subnet") < kinds.index("vpc") and kinds.index("internet-gateway") < kinds.index("vpc")
