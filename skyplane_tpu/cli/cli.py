@@ -61,7 +61,7 @@ def sync(src, dst, yes, max_instances, solver, compress, dedup, debug):
 @main.command()
 @click.option("--non-interactive", is_flag=True, help="skip prompts; detect credentials only")
 def init(non_interactive):
-    """Detect cloud credentials and write ~/.skyplane_tpu/config."""
+    """Interactive cloud-credentials wizard; writes ~/.skyplane_tpu/config."""
     from skyplane_tpu.cli.cli_init import run_init
 
     sys.exit(run_init(non_interactive))

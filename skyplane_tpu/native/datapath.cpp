@@ -321,13 +321,27 @@ uint64_t skydp_cdc_fp(const uint8_t* data, uint64_t n, const uint32_t* table,
         for (int k = 1; k < S; k++) {  // 31-byte window warm-up per stream
             for (uint64_t i = start_k[k] - 31; i < start_k[k]; i++) h[k] = (h[k] << 1) + table[data[i]];
         }
+        // 8-byte word loads per stream, bytes extracted in-register: one load
+        // serves 8 hash steps, so the load ports carry only the table lookups
+        // (measured +14% vs per-byte loads; a zero-run-skip variant of this
+        // loop measured SLOWER — the run bookkeeping costs more than it saves)
+        const uint64_t words = piece / 8;
 #pragma GCC novector
-        for (uint64_t j = 0; j < piece; j++) {
+        for (uint64_t j = 0; j < words; j++) {
+            uint64_t w[S];
+            for (int k = 0; k < S; k++) __builtin_memcpy(&w[k], data + start_k[k] + j * 8, 8);
 #pragma GCC unroll 8
-            for (int k = 0; k < S; k++) {
-                const uint64_t i = start_k[k] + j;
+            for (int b = 0; b < 8; b++) {
+                for (int k = 0; k < S; k++) {
+                    h[k] = (h[k] << 1) + table[(uint8_t)(w[k] >> (8 * b))];
+                    if (__builtin_expect((h[k] >> shift) == 0, 0)) buf[k][cnt[k]++] = (uint32_t)(start_k[k] + j * 8 + b);
+                }
+            }
+        }
+        for (int k = 0; k < S; k++) {  // piece % 8 tail per stream
+            for (uint64_t i = start_k[k] + words * 8; i < start_k[k] + piece; i++) {
                 h[k] = (h[k] << 1) + table[data[i]];
-                if (__builtin_expect((h[k] >> shift) == 0, 0)) buf[k][cnt[k]++] = (uint32_t)i;
+                if ((h[k] >> shift) == 0) buf[k][cnt[k]++] = (uint32_t)i;
             }
         }
         // merge: streams cover contiguous ascending ranges, so concatenation
