@@ -70,7 +70,10 @@ def probe_device() -> str:
     """
     if os.environ.get("SKYPLANE_BENCH_PLATFORM"):
         return os.environ["SKYPLANE_BENCH_PLATFORM"]
-    budget_s = float(os.environ.get("SKYPLANE_BENCH_PROBE_BUDGET", "900"))
+    # 600s: long enough to ride out a tunnel hiccup (round-3 lost the round
+    # giving up after ~6.7 min), short enough that a driver-side timeout on
+    # the whole bench run cannot end the round with NO number at all
+    budget_s = float(os.environ.get("SKYPLANE_BENCH_PROBE_BUDGET", "600"))
     attempt_timeout = float(os.environ.get("SKYPLANE_BENCH_PROBE_TIMEOUT", "60"))
     deadline = time.monotonic() + budget_s
     from skyplane_tpu.utils.tunnel_lock import tunnel_busy
