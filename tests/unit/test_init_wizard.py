@@ -324,3 +324,43 @@ def test_ibm_scp_existing_creds_short_circuit(tmp_path, monkeypatch):
     io2 = ScriptedIO(confirms=[True])
     load_scp_config(SkyplaneConfig.default_config(), io2.as_io())
     assert any("...nt-key" in e for e in io2.echoes)
+
+
+def test_run_init_interactive_end_to_end(tmp_path, monkeypatch):
+    """Full wizard orchestration on a machine with no credentials anywhere:
+    AWS disables (no boto3), GCP disables (no ADC), R2/IBM/SCP declined —
+    init must still write the config and exit 0."""
+    import importlib
+    import os as os_mod
+
+    import skyplane_tpu.config_paths as config_paths
+
+    old_root = os_mod.environ.get("SKYPLANE_TPU_CONFIG_ROOT")
+    monkeypatch.setenv("SKYPLANE_TPU_CONFIG_ROOT", str(tmp_path))
+    importlib.reload(config_paths)  # re-derive paths under the tmp root
+    import skyplane_tpu.cli.cli_init as cli_init
+
+    importlib.reload(cli_init)
+    try:
+        monkeypatch.setitem(sys.modules, "boto3", None)  # import boto3 -> ImportError
+
+        class NoADC:
+            @staticmethod
+            def get_adc_credential():
+                return None, None
+
+        monkeypatch.setattr("skyplane_tpu.compute.gcp.gcp_auth.GCPAuthentication", NoADC)
+        io = ScriptedIO(confirms=[True, False, False, False])  # gcp; r2; ibm; scp declined
+        rc = cli_init.run_init(non_interactive=False, io=io.as_io())
+        assert rc == 0
+        assert (tmp_path / "config").exists()
+        from skyplane_tpu.config import SkyplaneConfig
+
+        cfg = SkyplaneConfig.load_config(tmp_path / "config")
+        assert not cfg.aws_enabled and not cfg.gcp_enabled and not cfg.cloudflare_enabled
+    finally:
+        # undo the module-level path rebinding for the rest of the session
+        if old_root is not None:
+            os_mod.environ["SKYPLANE_TPU_CONFIG_ROOT"] = old_root
+        importlib.reload(config_paths)
+        importlib.reload(cli_init)
