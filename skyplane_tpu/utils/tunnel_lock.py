@@ -45,6 +45,11 @@ def acquire_tunnel_lock(timeout_s: float | None = None) -> bool:
             time.sleep(1.0)
 
 
+def held() -> bool:
+    """True when THIS process holds the tunnel lock."""
+    return _held_fd is not None
+
+
 def tunnel_busy() -> bool:
     """True if some OTHER process currently holds the tunnel lock."""
     if _held_fd is not None:
