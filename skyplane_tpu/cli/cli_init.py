@@ -23,7 +23,7 @@ from typing import Callable, Optional
 
 from rich.console import Console
 
-from skyplane_tpu.config import SkyplaneConfig
+from skyplane_tpu.config import SkyplaneConfig, open_0600
 from skyplane_tpu.config_paths import cloud_config, config_path
 
 console = Console()
@@ -94,6 +94,55 @@ def aws_credentials_path() -> Path:
     return Path(os.environ.get("AWS_SHARED_CREDENTIALS_FILE", Path.home() / ".aws" / "credentials"))
 
 
+def botocore_config_path() -> Path:
+    """The AWS config file (`aws configure` writes region here). Named to
+    stay distinct from config_paths.aws_config_path, which is skyplane's own
+    copied-config Path, not botocore's."""
+    return Path(os.environ.get("AWS_CONFIG_FILE", Path.home() / ".aws" / "config"))
+
+
+
+
+def _write_aws_region(cfg_path: Path, region: str, io: WizardIO) -> None:
+    """Set `region` in the config file's [default] section by text edit — a
+    configparser round-trip would strip the user's comments, and a file
+    configparser cannot parse must not crash init after the credentials were
+    already written. An existing region is left untouched."""
+    try:
+        # ValueError covers UnicodeDecodeError on a non-UTF-8 config file —
+        # same must-not-crash-after-credentials-written contract
+        text = cfg_path.read_text() if cfg_path.exists() else ""
+        lines = text.splitlines()
+        in_default = False
+        default_at = None
+        for i, line in enumerate(lines):
+            s = line.strip()
+            if s.startswith("["):
+                in_default = s == "[default]"
+                if in_default:
+                    default_at = i
+            elif in_default and s.split("=")[0].strip() == "region":
+                # user already chose a region; don't second-guess it — but
+                # say so, or the region just prompted for silently vanishes
+                existing = s.split("=", 1)[1].strip() if "=" in s else ""
+                if existing and existing != region:
+                    io.echo(
+                        f"[yellow]Keeping existing default region {existing} from {cfg_path} "
+                        f"(requested {region}). Edit the file to change it.[/yellow]"
+                    )
+                return
+        if default_at is not None:
+            lines.insert(default_at + 1, f"region = {region}")
+        else:
+            if lines and lines[-1].strip():
+                lines.append("")
+            lines += ["[default]", f"region = {region}"]
+        cfg_path.parent.mkdir(parents=True, exist_ok=True)
+        cfg_path.write_text("\n".join(lines) + "\n")
+    except (OSError, ValueError) as e:
+        io.echo(f"[yellow]Could not write region to {cfg_path}: {e}. Set it with `aws configure`.[/yellow]")
+
+
 def load_aws_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = False) -> SkyplaneConfig:
     """AWS flow (reference: cli_init.py:23-64 + the `aws configure` step the
     reference points the user at, inlined as a key-entry prompt)."""
@@ -133,17 +182,19 @@ def load_aws_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = F
                 if ini.has_section("default") or ini.defaults():
                     io.echo("[red]A default profile already exists; not overwriting. Run `aws configure` instead.[/red]")
                 else:
+                    # Key pair in the credentials file, region in the config
+                    # file's [default] section — the split `aws configure`
+                    # produces, so later `aws configure` runs and tooling that
+                    # only reads ~/.aws/config find the region where they
+                    # expect it.
                     ini["default"] = {
                         "aws_access_key_id": key_id,
                         "aws_secret_access_key": secret,
-                        "region": region,
                     }
-                    path.parent.mkdir(parents=True, exist_ok=True)
-                    # 0600 from the first byte: chmod-after-write would leave
-                    # the secret world-readable for a window under umask 022
-                    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-                    with os.fdopen(fd, "w") as f:
+                    with os.fdopen(open_0600(path), "w") as f:
                         ini.write(f)
+                    if region:
+                        _write_aws_region(botocore_config_path(), region, io)
                     io.echo(f"Credentials written to {path}")
                     access_key = creds_ok()
         else:
@@ -164,6 +215,10 @@ def load_ibmcloud_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: boo
     from skyplane_tpu.compute.ibmcloud.ibm_cloud_provider import IBMCloudProvider
 
     if non_interactive:
+        # detection-only, same as AWS/GCP: report whatever already works so
+        # scripted re-runs pick up newly provided keys
+        if IBMCloudProvider.load_api_key():
+            io.echo("[green]IBM Cloud IAM API key found.[/green]")
         return
     if not io.confirm("Do you want to configure IBM Cloud support?", bool(IBMCloudProvider.load_api_key())):
         return
@@ -175,9 +230,7 @@ def load_ibmcloud_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: boo
         io.echo("[yellow]IBM Cloud skipped (no key). Set IBM_API_KEY or ~/.bluemix/ibm_credentials later.[/yellow]")
         return
     path = IBMCloudProvider.credential_file()
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-    with os.fdopen(fd, "w") as f:
+    with os.fdopen(open_0600(path), "w") as f:
         f.write(f"iam_api_key: {key}\n")
     io.echo(f"IBM credentials written to {path}")
 
@@ -188,6 +241,9 @@ def load_scp_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = F
     from skyplane_tpu.compute.scp.scp_cloud_provider import load_scp_credentials, scp_credential_file
 
     if non_interactive:
+        creds = load_scp_credentials()
+        if creds.get("scp_access_key") and creds.get("scp_secret_key"):
+            io.echo(f"[green]Loaded SCP credentials[/green] [access key: ...{creds['scp_access_key'][-6:]}]")
         return
     creds = load_scp_credentials()
     have = bool(creds.get("scp_access_key") and creds.get("scp_secret_key"))
@@ -203,9 +259,7 @@ def load_scp_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: bool = F
     secret = io.prompt("Enter the SCP secret key", None).strip()
     project = io.prompt("Enter the SCP project ID", None).strip()
     path = scp_credential_file()
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-    with os.fdopen(fd, "w") as f:
+    with os.fdopen(open_0600(path), "w") as f:
         f.write(f"scp_access_key = {access}\nscp_secret_key = {secret}\nscp_project_id = {project}\n")
     io.echo(f"SCP credentials written to {path}")
 
@@ -218,7 +272,12 @@ def load_cloudflare_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: b
         cfg.cloudflare_enabled = bool(cfg.cloudflare_access_key_id and cfg.cloudflare_secret_access_key)
         return cfg
     if not io.confirm("Do you want to configure Cloudflare R2 support?", bool(cfg.cloudflare_access_key_id)):
+        # clear the stored keys too: the non-interactive path re-enables from
+        # key presence, so keys left behind would silently flip R2 back on at
+        # the next scripted `init --non-interactive`
         cfg.cloudflare_enabled = False
+        cfg.cloudflare_access_key_id = None
+        cfg.cloudflare_secret_access_key = None
         return cfg
     key_id = io.prompt("Enter the R2 access key ID", cfg.cloudflare_access_key_id).strip()
     secret = io.prompt("Enter the R2 secret access key", cfg.cloudflare_secret_access_key).strip()
@@ -302,13 +361,19 @@ def run_init(non_interactive: bool = False, io: Optional[WizardIO] = None) -> in
         io.echo(f"Client public IP: [bold]{public_ip}[/bold]")
 
     if non_interactive:
-        # detection-only path: enable whatever already works, prompt nothing
+        # detection-only path: enable whatever already works, prompt nothing.
+        # Cloudflare/IBM/SCP go through the same loaders as the interactive
+        # path (with non_interactive=True) so scripted re-runs pick up newly
+        # provided credentials uniformly across clouds.
         aws = _detect_aws()
         gcp_project = _detect_gcp()
         cfg.aws_enabled = bool(aws)
         cfg.gcp_enabled = gcp_project is not None
         if gcp_project:
             cfg.gcp_project_id = gcp_project
+        load_cloudflare_config(cfg, io, non_interactive=True)
+        load_ibmcloud_config(cfg, io, non_interactive=True)
+        load_scp_config(cfg, io, non_interactive=True)
     else:
         load_aws_config(cfg, io)
         load_gcp_config(cfg, io)

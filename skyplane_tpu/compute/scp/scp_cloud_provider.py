@@ -145,9 +145,12 @@ class SCPNetwork:
         data = self.client.request("GET", f"/vpc/v3/vpcs?serviceZoneId={zone_id}&vpcName={self.VPC_NAME}")
         return [v for v in self._contents(data) if v.get("vpcName") == self.VPC_NAME]
 
-    def find_valid_vpc(self, zone_id: str) -> Optional[str]:
+    def find_valid_vpc(self, zone_id: str) -> Optional[dict]:
         """An ACTIVE skyplane VPC with an ATTACHED IGW and an ACTIVE public
-        subnet (reference scp_network.py:247-261)."""
+        subnet (reference scp_network.py:247-261). Returns the qualifying
+        {vpc_id, igw_id, subnet_id} so the caller reuses exactly the
+        resources that passed the validity filters — a detached IGW or
+        pending/private subnet listed first must never be selected."""
         for vpc in self.list_vpcs(zone_id):
             if vpc.get("vpcState") != "ACTIVE":
                 continue
@@ -157,7 +160,7 @@ class SCPNetwork:
                 s for s in self.list_subnets(vpc_id) if s.get("subnetState") == "ACTIVE" and s.get("subnetType") == "PUBLIC"
             ]
             if igws and subnets:
-                return vpc_id
+                return {"vpc_id": vpc_id, "igw_id": igws[0]["internetGatewayId"], "subnet_id": subnets[0]["subnetId"]}
         return None
 
     def create_vpc(self, zone_id: str) -> str:
@@ -263,16 +266,20 @@ class SCPNetwork:
     def make_vpc(self, zone_id: str) -> dict:
         """Find-valid-or-create the full network chain; returns
         {vpc_id, subnet_id, sg_id, igw_id}."""
-        vpc_id = self.find_valid_vpc(zone_id)
-        if vpc_id is None:
+        found = self.find_valid_vpc(zone_id)
+        if found is None:
             vpc_id = self.create_vpc(zone_id)
             igw_id = self.create_igw(zone_id, vpc_id)
             subnet_id = self.create_subnet(zone_id, vpc_id)
             sg_id = self.create_security_group(zone_id, vpc_id)
         else:
-            igw_id = self.list_igws(vpc_id)[0]["internetGatewayId"]
-            subnet_id = self.list_subnets(vpc_id)[0]["subnetId"]
-            groups = self.list_security_groups(vpc_id)
+            vpc_id, igw_id, subnet_id = found["vpc_id"], found["igw_id"], found["subnet_id"]
+            # A group mid-deletion from an earlier teardown (DELETE is issued
+            # without waiting) must not be reused; stubs/older responses omit
+            # the state field, which counts as usable.
+            groups = [
+                g for g in self.list_security_groups(vpc_id) if g.get("securityGroupState") in (None, "ACTIVE")
+            ]
             sg_id = groups[0]["securityGroupId"] if groups else self.create_security_group(zone_id, vpc_id)
         return {"vpc_id": vpc_id, "subnet_id": subnet_id, "sg_id": sg_id, "igw_id": igw_id}
 

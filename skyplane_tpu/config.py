@@ -17,6 +17,18 @@ from typing import Any, Dict, Optional
 
 from skyplane_tpu.exceptions import BadConfigException
 
+
+def open_0600(path: Path) -> int:
+    """Open a secrets file write-only at mode 0600, tightening a pre-existing
+    file too: os.open's mode only applies at creation, so a file written
+    earlier under umask 022 would otherwise stay world-readable as secrets
+    land in it. Single home for this idiom — cli_init's credential writers
+    reuse it."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.fchmod(fd, 0o600)
+    return fd
+
 _FLAG_TYPES: Dict[str, type] = {
     # data path
     "compress": str,  # none | zstd | tpu | tpu_zstd | native_lz
@@ -173,9 +185,8 @@ class SkyplaneConfig:
         if self.anon_clientid:
             config["client"]["anon_clientid"] = self.anon_clientid
         config["flags"] = {k: str(v) for k, v in self.flags.items()}
-        # 0600 from creation: the config can carry R2 access keys
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        with os.fdopen(fd, "w") as f:
+        # 0600 (tightening pre-existing files): the config can carry R2 keys
+        with os.fdopen(open_0600(Path(path)), "w") as f:
             config.write(f)
 
     @staticmethod

@@ -85,7 +85,9 @@ def _install_fake_boto3(monkeypatch, creds_file: Path):
 
 def test_aws_zero_to_credentials_flow(tmp_path, monkeypatch):
     creds_file = tmp_path / "aws" / "credentials"
+    config_file = tmp_path / "aws" / "config"
     monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(creds_file))
+    monkeypatch.setenv("AWS_CONFIG_FILE", str(config_file))
     _install_fake_boto3(monkeypatch, creds_file)
     io = ScriptedIO(
         confirms=[True, True],  # configure AWS? ; enter an access key now?
@@ -95,7 +97,10 @@ def test_aws_zero_to_credentials_flow(tmp_path, monkeypatch):
     assert cfg.aws_enabled
     assert aws_credentials_path() == creds_file
     content = creds_file.read_text()
-    assert "AKIAEXAMPLE1234567" in content and "eu-west-1" in content
+    # key pair in the credentials file; region in the config file — the same
+    # split `aws configure` produces
+    assert "AKIAEXAMPLE1234567" in content and "eu-west-1" not in content
+    assert "eu-west-1" in config_file.read_text()
     assert oct(creds_file.stat().st_mode & 0o777) == "0o600"
     assert any("...234567" in e for e in io.echoes), io.echoes  # masked key id echoed
 
@@ -110,6 +115,26 @@ def test_aws_existing_default_profile_not_overwritten(tmp_path, monkeypatch):
     assert not cfg.aws_enabled
     assert "OLD" in creds_file.read_text() and "NEWKEY" not in creds_file.read_text()
     assert any("not overwriting" in e for e in io.echoes)
+
+
+def test_aws_region_write_preserves_comments_and_existing_region(tmp_path):
+    from skyplane_tpu.cli.cli_init import _write_aws_region
+
+    io = ScriptedIO()
+    # comments and other sections survive; region inserted into [default]
+    cfg = tmp_path / "config"
+    cfg.write_text("# sso setup\n[profile dev]\nregion = ap-south-1\n\n[default]\noutput = json\n")
+    _write_aws_region(cfg, "eu-west-1", io.as_io())
+    text = cfg.read_text()
+    assert "# sso setup" in text and "ap-south-1" in text
+    assert "[default]\nregion = eu-west-1\noutput = json" in text
+    # an existing default region is never overwritten
+    _write_aws_region(cfg, "us-east-2", io.as_io())
+    assert "us-east-2" not in cfg.read_text()
+    # no config file at all -> fresh [default]
+    fresh = tmp_path / "none" / "config"
+    _write_aws_region(fresh, "eu-west-1", io.as_io())
+    assert fresh.read_text() == "[default]\nregion = eu-west-1\n"
 
 
 def test_aws_declined(monkeypatch):
