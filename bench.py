@@ -2,10 +2,20 @@
 """Benchmark: sender-side data-path effective throughput (dedup + compress).
 
 Measures the TPU data path (CDC + 8-lane fingerprints + dedup recipes +
-blockpack/zstd, DataPathProcessor) against the CPU reference path (plain
-zstd-3 per chunk — the LZ4-class codec the reference runs on gateway CPUs,
-skyplane/gateway/operators/gateway_operator.py:358-361) on a synthetic
-redundant snapshot corpus (the BASELINE.json workload shape).
+blockpack/zstd, DataPathProcessor) against TWO CPU baselines on a synthetic
+redundant snapshot corpus (the BASELINE.json workload shape):
+
+- ``vs_baseline`` / ``baseline_gbps``: plain zstd-3 per chunk (a stronger
+  modern codec than the reference ships — kept for round-over-round
+  comparability);
+- ``vs_baseline_lz4`` / ``baseline_lz4_gbps``: REAL LZ4 frames via the system
+  liblz4 — the exact codec family the reference runs on gateway CPUs
+  (skyplane/gateway/operators/gateway_operator.py:358-361 uses
+  ``lz4.frame.compress``, which wraps the same library). LZ4 is much faster
+  per core than zstd-3, so this is the harder, honest bar; when the raw-Gbps
+  ratio loses, ``wan_crossover_vs_lz4_gbps`` reports the WAN bandwidth below
+  which the dedup path's ~6x wire reduction still wins end-to-end
+  (planner/estimator.wan_crossover_gbps).
 
 Effective throughput = raw corpus bits / wall time of producing wire bytes —
 the number that bounds what a gateway VM can push when the WAN is not the
@@ -355,22 +365,16 @@ def bench_ours(chunks, workers: Optional[int] = None) -> dict:
 BENCH_REPS = int(os.environ.get("SKYPLANE_BENCH_REPS", "3"))
 
 
-def bench_baseline(chunks) -> dict:
-    """CPU reference path with full core-level worker parallelism.
+def _bench_codec(chunks, one) -> dict:
+    """Time a per-chunk codec with full core-level worker parallelism.
 
     Best-of-N timing (N=SKYPLANE_BENCH_REPS): single-shot wall times on a
     shared-tenancy core swing ±10%, enough to flip the vs_baseline ratio;
     min-of-reps is the standard estimator for the machine's capability and is
-    applied to BOTH sides, so the ratio stays honest."""
+    applied to ALL sides, so the ratios stay honest."""
     from concurrent.futures import ThreadPoolExecutor
 
-    import zstandard
-
     workers = min(8, os.cpu_count() or 1)
-
-    def one(c: bytes) -> int:
-        return len(zstandard.ZstdCompressor(level=3).compress(c))
-
     one(chunks[0])  # warm
     best = float("inf")
     wire = 0
@@ -380,6 +384,25 @@ def bench_baseline(chunks) -> dict:
             wire = sum(pool.map(one, chunks))
         best = min(best, time.perf_counter() - t0)
     return {"seconds": best, "raw_bytes": sum(len(c) for c in chunks), "wire_bytes": wire}
+
+
+def bench_baseline(chunks) -> dict:
+    """zstd-3 per chunk (round-1..4 comparability baseline)."""
+    import zstandard
+
+    return _bench_codec(chunks, lambda c: len(zstandard.ZstdCompressor(level=3).compress(c)))
+
+
+def bench_baseline_lz4(chunks) -> Optional[dict]:
+    """REAL LZ4 frames (system liblz4 — the reference's wire codec family).
+    None when the host has no liblz4; the JSON then omits the lz4 rows
+    rather than substituting another codec for it."""
+    from skyplane_tpu.utils import lz4ref
+
+    if not lz4ref.available():
+        log("WARN: liblz4 not present on this host; no vs_baseline_lz4 row")
+        return None
+    return _bench_codec(chunks, lambda c: len(lz4ref.compress(c)))
 
 
 def _run_accel_bench_supervised() -> bool:
@@ -489,6 +512,9 @@ def main() -> None:
     log("corpus ready")
     base = bench_baseline(chunks)
     log(f"baseline done: {base['seconds']:.2f}s")
+    base_lz4 = bench_baseline_lz4(chunks)
+    if base_lz4:
+        log(f"lz4 baseline done: {base_lz4['seconds']:.2f}s")
     # two pool sizes: the deployable gateway configuration (n_workers) is the
     # headline; 1 worker isolates per-chunk latency (VERDICT r3 #7 asked for
     # both so the "deployable VM" figure is explicit)
@@ -528,6 +554,30 @@ def main() -> None:
         "egress_usd_per_tb_ours": round(rate_per_gb * 1000 * ours["wire_bytes"] / ours["raw_bytes"], 2),
         "egress_usd_per_tb_baseline": round(rate_per_gb * 1000 * base["wire_bytes"] / base["raw_bytes"], 2),
     }
+    if base_lz4:
+        # the honest reference-codec bar (BASELINE.json names LZ4, not zstd)
+        from skyplane_tpu.planner.estimator import wan_crossover_gbps
+
+        lz4_gbps = base_lz4["raw_bytes"] * 8 / 1e9 / base_lz4["seconds"]
+        red_ours = ours["raw_bytes"] / max(ours["wire_bytes"], 1)
+        red_lz4 = base_lz4["raw_bytes"] / max(base_lz4["wire_bytes"], 1)
+        result.update(
+            {
+                "baseline_lz4_gbps": round(lz4_gbps, 3),
+                "vs_baseline_lz4": round(ours_gbps / lz4_gbps, 3),
+                "wire_reduction_baseline_lz4": round(red_lz4, 2),
+                "egress_usd_per_tb_baseline_lz4": round(rate_per_gb * 1000 * base_lz4["wire_bytes"] / base_lz4["raw_bytes"], 2),
+                # WAN bandwidth below which our pipeline beats the LZ4 gateway
+                # END-TO-END despite any raw-Gbps loss (estimator model).
+                # null = wins at EVERY bandwidth (faster and more reduction);
+                # strict JSON has no Infinity, and 0.0 already means never.
+                "wan_crossover_vs_lz4_gbps": (
+                    None
+                    if (xover := wan_crossover_gbps(ours_gbps, red_ours, lz4_gbps, red_lz4)) == float("inf")
+                    else round(xover, 2)
+                ),
+            }
+        )
     print(json.dumps(result), flush=True)
 
 

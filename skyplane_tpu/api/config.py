@@ -16,7 +16,7 @@ from skyplane_tpu.ops.cdc import CDCParams
 @dataclass(frozen=True)
 class TransferConfig:
     # data path
-    compress: str = "tpu_zstd"  # none | zstd | tpu | tpu_zstd | native_lz
+    compress: str = "tpu_zstd"  # none | zstd | tpu | tpu_zstd | native_lz | lz4
     dedup: bool = True
     # planner may sample-compress the source corpus and disable codec/dedup
     # per edge when ratio x egress-price x bandwidth says raw bytes win

@@ -67,6 +67,7 @@ class Codec(IntEnum):
     TPU_BLOCK = 2  # TPU block-suppress codec (ops/blockpack.py)
     TPU_BLOCK_ZSTD = 3  # TPU block codec, literals further packed with zstd
     NATIVE_LZ = 4  # native C++ LZ codec (skyplane_tpu/native)
+    LZ4 = 5  # real LZ4 frames via system liblz4 (reference's wire codec)
 
 
 class ChunkFlags(IntEnum):

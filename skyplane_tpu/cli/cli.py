@@ -28,7 +28,7 @@ def main():
 @click.option("-y", "--yes", is_flag=True, help="skip confirmation")
 @click.option("--max-instances", default=None, type=int, help="gateway VMs per region")
 @click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided", "ron", "ilp"]))
-@click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz"]))
+@click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz", "lz4"]))
 @click.option("--dedup/--no-dedup", default=None, help="content-defined dedup on the TPU path")
 @click.option("--resume", is_flag=True, help="journal chunk progress; re-run continues where a killed transfer stopped")
 @click.option("--debug", is_flag=True, help="collect gateway logs on exit")
@@ -47,7 +47,7 @@ def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, resume,
 @click.option("-y", "--yes", is_flag=True)
 @click.option("--max-instances", default=None, type=int)
 @click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided", "ron", "ilp"]))
-@click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz"]))
+@click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz", "lz4"]))
 @click.option("--dedup/--no-dedup", default=None)
 @click.option("--debug", is_flag=True)
 def sync(src, dst, yes, max_instances, solver, compress, dedup, debug):

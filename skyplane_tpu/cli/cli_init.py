@@ -122,10 +122,16 @@ def _write_aws_region(cfg_path: Path, region: str, io: WizardIO) -> None:
                 if in_default:
                     default_at = i
             elif in_default and s.split("=")[0].strip() == "region":
+                existing = s.split("=", 1)[1].strip() if "=" in s else ""
+                if not existing:
+                    # an empty `region =` (aborted edit) is no region at all —
+                    # leaving it would hand boto3 a NoRegionError later
+                    lines[i] = f"region = {region}"
+                    cfg_path.write_text("\n".join(lines) + "\n")
+                    return
                 # user already chose a region; don't second-guess it — but
                 # say so, or the region just prompted for silently vanishes
-                existing = s.split("=", 1)[1].strip() if "=" in s else ""
-                if existing and existing != region:
+                if existing != region:
                     io.echo(
                         f"[yellow]Keeping existing default region {existing} from {cfg_path} "
                         f"(requested {region}). Edit the file to change it.[/yellow]"
@@ -269,15 +275,18 @@ def load_cloudflare_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: b
     object-storage-only (no VMs), so 'configured' just means captured API
     keys, persisted in the 0600 config for the R2 interface to read."""
     if non_interactive:
-        cfg.cloudflare_enabled = bool(cfg.cloudflare_access_key_id and cfg.cloudflare_secret_access_key)
+        # keys must be present AND the persisted enabled flag must not have
+        # been explicitly turned off — key presence alone must not override a
+        # user's interactive decline
+        cfg.cloudflare_enabled = bool(
+            cfg.cloudflare_enabled and cfg.cloudflare_access_key_id and cfg.cloudflare_secret_access_key
+        )
         return cfg
     if not io.confirm("Do you want to configure Cloudflare R2 support?", bool(cfg.cloudflare_access_key_id)):
-        # clear the stored keys too: the non-interactive path re-enables from
-        # key presence, so keys left behind would silently flip R2 back on at
-        # the next scripted `init --non-interactive`
+        # keys stay stored (declining means "don't use R2", not "forget my
+        # credentials"); the non-interactive path honors this flag, so a
+        # scripted re-run cannot flip R2 back on from key presence alone
         cfg.cloudflare_enabled = False
-        cfg.cloudflare_access_key_id = None
-        cfg.cloudflare_secret_access_key = None
         return cfg
     key_id = io.prompt("Enter the R2 access key ID", cfg.cloudflare_access_key_id).strip()
     secret = io.prompt("Enter the R2 secret access key", cfg.cloudflare_secret_access_key).strip()

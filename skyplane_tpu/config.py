@@ -2,7 +2,7 @@
 
 Reference parity: skyplane/config.py:11-370 (``_FLAG_TYPES``/``_DEFAULT_FLAGS``
 registry, INI persistence, ``get_flag``/``set_flag``). TPU-native additions:
-``compress`` accepts codec names (none/zstd/tpu/tpu_zstd/native_lz), plus
+``compress`` accepts codec names (none/zstd/tpu/tpu_zstd/native_lz/lz4), plus
 ``dedup`` / ``cdc_*`` / ``tpu_batch_*`` knobs controlling the accelerator data
 path.
 """
@@ -31,7 +31,7 @@ def open_0600(path: Path) -> int:
 
 _FLAG_TYPES: Dict[str, type] = {
     # data path
-    "compress": str,  # none | zstd | tpu | tpu_zstd | native_lz
+    "compress": str,  # none | zstd | tpu | tpu_zstd | native_lz | lz4
     "dedup": bool,  # content-defined-chunking dedup on the TPU path
     "encrypt_e2e": bool,
     "encrypt_socket_tls": bool,
@@ -98,7 +98,7 @@ _DEFAULT_FLAGS: Dict[str, Any] = {
     "gateway_docker_image": "",
 }
 
-_AVAILABLE_CODECS = ("none", "zstd", "tpu", "tpu_zstd", "native_lz")
+_AVAILABLE_CODECS = ("none", "zstd", "tpu", "tpu_zstd", "native_lz", "lz4")
 
 
 def _parse_bool(v: Any) -> bool:

@@ -127,12 +127,34 @@ def _decode_native(buf: bytes) -> bytes:
     return native_lz.decompress(buf)
 
 
+def _encode_lz4(data: bytes) -> bytes:
+    from skyplane_tpu.utils import lz4ref
+
+    return lz4ref.compress(data)
+
+
+def _decode_lz4(buf: bytes) -> bytes:
+    # LZ4F frame content size is optional, so the decoder caps allocation at
+    # the wire chunk bound rather than trusting the frame
+    from skyplane_tpu.chunk import MAX_CHUNK_BYTES
+    from skyplane_tpu.utils import lz4ref
+
+    try:
+        return lz4ref.decompress(buf, MAX_CHUNK_BYTES)
+    except ValueError as e:
+        raise CodecException(f"lz4 decode failed: {e}") from e
+
+
 _REGISTRY: Dict[str, CodecSpec] = {
     "none": CodecSpec("none", Codec.NONE, lambda b: b, lambda b: b),
     "zstd": CodecSpec("zstd", Codec.ZSTD, _encode_zstd, _decode_zstd),
     "tpu": CodecSpec("tpu", Codec.TPU_BLOCK, _encode_tpu, _decode_tpu),
     "tpu_zstd": CodecSpec("tpu_zstd", Codec.TPU_BLOCK_ZSTD, _encode_tpu_zstd, _decode_tpu_zstd),
     "native_lz": CodecSpec("native_lz", Codec.NATIVE_LZ, _encode_native, _decode_native),
+    # the reference's wire codec (gateway_operator.py:358-361), bound to the
+    # system liblz4; registered unconditionally — encode/decode raise on
+    # hosts without the library, same lazy-failure contract as native_lz
+    "lz4": CodecSpec("lz4", Codec.LZ4, _encode_lz4, _decode_lz4),
 }
 
 _BY_ID: Dict[int, CodecSpec] = {int(spec.codec_id): spec for spec in _REGISTRY.values()}

@@ -105,9 +105,37 @@ CODEC_GBPS = {
     "none": float("inf"),
     "zstd": 8.0,
     "native_lz": 3.0,
+    "lz4": 8.5,  # system liblz4 frame, measured per-core (docs/benchmark.md)
     "tpu": 80.0,
     "tpu_zstd": 40.0,
 }
+
+
+def wan_crossover_gbps(proc_a_gbps: float, reduction_a: float, proc_b_gbps: float, reduction_b: float) -> float:
+    """WAN bandwidth below which pipelined strategy A beats strategy B
+    end-to-end.
+
+    Each sender overlaps processing with the WAN write, so time per raw byte
+    is ``max(1/P, 1/(W*R))`` — processing-bound or WAN-bound, whichever is
+    slower (P = processing rate in raw Gbps, R = wire reduction, W = WAN
+    Gbps). For the interesting case — A reduces more but processes slower
+    (CDC dedup vs plain LZ4) — A wins while the WAN is scarce enough that its
+    smaller wire footprint dominates, and the tie point is ``P_a / R_b``
+    where A is processing-bound while B is still WAN-bound:
+    ``1/P_a = 1/(W * R_b)``  ⇒  ``W = P_a / R_b``.
+
+    Returns ``inf`` when A wins at every bandwidth, ``0.0`` when it never
+    wins. This is the quantification BASELINE.md's north star implies: a
+    raw-Gbps loss to LZ4 still wins end-to-end below the returned bandwidth.
+    """
+    if proc_a_gbps >= proc_b_gbps and reduction_a >= reduction_b:
+        return float("inf")
+    if proc_a_gbps <= proc_b_gbps and reduction_a <= reduction_b:
+        return 0.0
+    if reduction_a > reduction_b:
+        return proc_a_gbps / reduction_b
+    # A is the faster/lower-reduction side: it wins ABOVE P_b/R_a, never below
+    return 0.0
 
 DEDUP_MIN_DUP_FRAC = 0.05  # below this, recipes are overhead for nothing
 
