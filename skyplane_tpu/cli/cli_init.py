@@ -275,12 +275,18 @@ def load_cloudflare_config(cfg: SkyplaneConfig, io: WizardIO, non_interactive: b
     object-storage-only (no VMs), so 'configured' just means captured API
     keys, persisted in the 0600 config for the R2 interface to read."""
     if non_interactive:
-        # keys must be present AND the persisted enabled flag must not have
-        # been explicitly turned off — key presence alone must not override a
-        # user's interactive decline
-        cfg.cloudflare_enabled = bool(
-            cfg.cloudflare_enabled and cfg.cloudflare_access_key_id and cfg.cloudflare_secret_access_key
-        )
+        # explicit decline (False) sticks; with keys present, enable — so a
+        # first-time scripted setup that ships keys in the config works, but
+        # key presence never overrides an interactive decline. With NO keys,
+        # the tri-state None must survive (writing False here would read as
+        # an explicit decline on every later run and permanently block
+        # scripted enablement after keys arrive).
+        if cfg.cloudflare_enabled is False:
+            return cfg
+        if cfg.cloudflare_access_key_id and cfg.cloudflare_secret_access_key:
+            cfg.cloudflare_enabled = True
+        else:
+            cfg.cloudflare_enabled = None
         return cfg
     if not io.confirm("Do you want to configure Cloudflare R2 support?", bool(cfg.cloudflare_access_key_id)):
         # keys stay stored (declining means "don't use R2", not "forget my

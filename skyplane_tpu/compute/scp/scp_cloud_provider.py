@@ -11,6 +11,7 @@ SCP_SECRET_KEY / SCP_PROJECT_ID (+ SCP_API_ENDPOINT override).
 from __future__ import annotations
 
 import base64
+import json
 import hashlib
 import hmac
 import os
@@ -84,8 +85,36 @@ class SCPClient:
     def request(self, method: str, path: str, json_body: Optional[dict] = None) -> dict:
         url = self.endpoint + path
         resp = requests.request(method, url, headers=self._headers(method, url), json=json_body, timeout=60)
+        self._trace(method, path, json_body, resp)
         resp.raise_for_status()
         return resp.json() if resp.content else {}
+
+    @staticmethod
+    def _trace(method: str, path: str, json_body: Optional[dict], resp) -> None:
+        """Record/replay capture (SKYPLANE_TPU_HTTP_TRACE=1): each call's
+        request/response pair appends to ~/.skyplane_tpu/scp_trace.jsonl so a
+        field run (docs/field_validation.md) can be turned into stub-test
+        fixtures. Secrets never land in the trace (headers are omitted; the
+        signature is derived, not reusable beyond its timestamp)."""
+        if os.environ.get("SKYPLANE_TPU_HTTP_TRACE") != "1":
+            return
+        try:
+            from skyplane_tpu.config_paths import config_root
+
+            record = {
+                "ts": time.time(),
+                "method": method,
+                "path": path,
+                "request": json_body,
+                "status": resp.status_code,
+                "response": resp.json() if resp.content else {},
+            }
+            path_out = Path(config_root) / "scp_trace.jsonl"
+            path_out.parent.mkdir(parents=True, exist_ok=True)
+            with open(path_out, "a") as f:
+                f.write(json.dumps(record, default=str) + "\n")
+        except Exception:  # noqa: BLE001 — tracing must never break a live call
+            pass
 
 
 class SCPServer(SSHServer):

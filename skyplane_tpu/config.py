@@ -123,7 +123,11 @@ class SkyplaneConfig:
     azure_resource_group: Optional[str] = None
     azure_umi_name: Optional[str] = None
     gcp_project_id: Optional[str] = None
-    cloudflare_enabled: bool = False
+    # tri-state: None = never configured (scripted init may enable from key
+    # presence), False = explicitly declined (scripted init must NOT
+    # re-enable), True = enabled. None is falsy, so boolean checks read
+    # naturally everywhere.
+    cloudflare_enabled: Optional[bool] = None
     cloudflare_access_key_id: Optional[str] = None
     cloudflare_secret_access_key: Optional[str] = None
     anon_clientid: Optional[str] = None
@@ -150,7 +154,8 @@ class SkyplaneConfig:
             cfg.gcp_enabled = _parse_bool(config.get("gcp", "enabled", fallback="false"))
             cfg.gcp_project_id = config.get("gcp", "project_id", fallback=None)
         if "cloudflare" in config:
-            cfg.cloudflare_enabled = _parse_bool(config.get("cloudflare", "enabled", fallback="false"))
+            raw_enabled = config.get("cloudflare", "enabled", fallback=None)
+            cfg.cloudflare_enabled = None if raw_enabled is None else _parse_bool(raw_enabled)
             cfg.cloudflare_access_key_id = config.get("cloudflare", "access_key_id", fallback=None)
             cfg.cloudflare_secret_access_key = config.get("cloudflare", "secret_access_key", fallback=None)
         if "client" in config:
@@ -176,7 +181,9 @@ class SkyplaneConfig:
         config["gcp"] = {"enabled": str(self.gcp_enabled)}
         if self.gcp_project_id:
             config["gcp"]["project_id"] = self.gcp_project_id
-        config["cloudflare"] = {"enabled": str(self.cloudflare_enabled)}
+        # the enabled key is omitted while tri-state None (never configured),
+        # so a hand-written keys-only section stays scriptable-enable
+        config["cloudflare"] = {} if self.cloudflare_enabled is None else {"enabled": str(self.cloudflare_enabled)}
         if self.cloudflare_access_key_id:
             config["cloudflare"]["access_key_id"] = self.cloudflare_access_key_id
         if self.cloudflare_secret_access_key:

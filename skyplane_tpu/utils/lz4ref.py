@@ -60,7 +60,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p,
                 ctypes.c_void_p,
                 ctypes.POINTER(ctypes.c_size_t),
-                ctypes.c_char_p,
+                ctypes.c_void_p,  # src advances by byref offset, not a fresh c_char_p slice
                 ctypes.POINTER(ctypes.c_size_t),
                 ctypes.c_void_p,
             ]
@@ -110,6 +110,10 @@ def decompress(buf: bytes, max_out: int) -> bytes:
         raise RuntimeError("LZ4F_createDecompressionContext failed")
     try:
         window = ctypes.create_string_buffer(min(_DECODE_WINDOW, max(max_out, 1)))
+        # one up-front copy of the input so the loop advances by pointer
+        # offset — re-slicing buf[consumed:] per iteration would be O(n^2)
+        # memcpy on the receiver's hot path
+        src = (ctypes.c_char * len(buf)).from_buffer_copy(buf) if buf else (ctypes.c_char * 0)()
         out = bytearray()
         consumed = 0
         rc = 1  # LZ4F: nonzero = frame not yet complete
@@ -120,13 +124,13 @@ def decompress(buf: bytes, max_out: int) -> bytes:
                 ctx,
                 window,
                 ctypes.byref(dst_size),
-                buf[consumed:],
+                ctypes.byref(src, consumed),
                 ctypes.byref(src_size),
                 None,
             )
             if lib.LZ4F_isError(rc):
                 raise ValueError("corrupt LZ4 frame")
-            out += window.raw[: dst_size.value]
+            out += ctypes.string_at(window, dst_size.value)
             consumed += src_size.value
             if len(out) > max_out:
                 raise ValueError(f"LZ4 frame exceeds the {max_out}-byte output cap")
@@ -138,6 +142,10 @@ def decompress(buf: bytes, max_out: int) -> bytes:
             # input exhausted mid-frame: a truncated wire chunk must surface
             # as an error, never as silently-shortened plaintext
             raise ValueError("truncated LZ4 frame")
+        if consumed != len(buf):
+            # bytes after a complete frame = framing corruption (same strict
+            # whole-buffer contract as the zstd decoder)
+            raise ValueError(f"{len(buf) - consumed} trailing bytes after LZ4 frame")
         return bytes(out)
     finally:
         lib.LZ4F_freeDecompressionContext(ctx)

@@ -50,6 +50,9 @@ def test_lz4_corruption_and_output_cap_stay_in_contract():
     whole = lz4ref.compress(b"truncate me " * 5_000)
     with pytest.raises(ValueError):
         lz4ref.decompress(whole[:-10], 1 << 20)
+    # trailing bytes after a complete frame = framing corruption, not success
+    with pytest.raises(ValueError):
+        lz4ref.decompress(whole + b"GARBAGE", 1 << 20)
     # a multi-window frame (> _DECODE_WINDOW output) still roundtrips exactly
     data = b"W" * (3 * lz4ref._DECODE_WINDOW + 12345)
     assert lz4ref.decompress(lz4ref.compress(data), len(data)) == data
