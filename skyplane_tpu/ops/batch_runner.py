@@ -40,6 +40,7 @@ from skyplane_tpu.ops.fused_cdc import FusedCDCFP
 class _Entry:  # raises 'ambiguous truth value' in membership tests
     arr: np.ndarray  # padded to the bucket size
     n: int  # true length
+    dev: object = None  # pre-staged device buffer (async H2D at submit)
     done: threading.Event = field(default_factory=threading.Event)
     ends: Optional[np.ndarray] = None
     fps: Optional[List[bytes]] = None
@@ -78,6 +79,11 @@ class DeviceBatchRunner:
         self.max_wait_s = min(max_wait_ms, 5000.0) / 1000.0
         self._lock = threading.Lock()
         self._open: Dict[int, List[_Entry]] = {}  # bucket size -> entries of the open window
+        # batches currently executing, PER BUCKET: a lone chunk's timed flush
+        # defers only while its own bucket's previous batch runs (bounded by
+        # one batch duration — the FIFO floor); sustained traffic in another
+        # bucket must not starve it
+        self._in_flight: Dict[int, int] = {}
         # multi-device gateway (TPU slice): run the fused kernels sharded over
         # the mesh so every chip works the data path, not just chip 0
         # (VERDICT r1 weak #4 — the SPMD path must be the production path).
@@ -130,6 +136,18 @@ class DeviceBatchRunner:
         ``padded`` is the zero-padded power-of-two bucket of ``arr``.
         """
         entry = _Entry(arr=padded, n=len(arr))
+        # double-buffered H2D (single-device runners): upload NOW (async) so
+        # the transfer overlaps the in-flight window's compute and this
+        # worker's own socket pump; the flush then stacks device-resident
+        # buffers. Sharded runners skip staging — device_put would pin every
+        # row on chip 0 and the mesh kernels would reshard at flush, paying
+        # the transfer on the critical path anyway. Staging failure is not
+        # fatal — the flush falls back to a host upload for that row.
+        if self.mesh is None:
+            try:
+                entry.dev = self._fused.stage(padded)
+            except Exception:  # noqa: BLE001
+                entry.dev = None
         bucket = len(padded)
         with self._lock:
             group = self._open.setdefault(bucket, [])
@@ -144,17 +162,30 @@ class DeviceBatchRunner:
         if to_run is not None:
             self._run_batch(to_run)
         elif leader:
-            # wait for peers, then flush whatever joined the window
+            # Window-formation policy (bounded latency + adaptive fill): wait
+            # max_wait_ms for peers, but while a previous batch is still
+            # EXECUTING keep the window open — device compute is FIFO, so this
+            # window cannot start any sooner by flushing, and staggered
+            # arrivals (the realistic socket-pump pattern) accumulate into a
+            # full window instead of degenerating into padded windows of one
+            # chunk each. The device going idle (or the window filling, via
+            # the full-flush path above) ends the wait, so small transfers
+            # still see only the max_wait_ms floor.
             import time
 
-            time.sleep(self.max_wait_s)
-            with self._lock:
-                group_now = self._open.get(bucket, [])
-                # the window may already have been flushed by a 'full' flush
-                # (identity check: _Entry has eq=False by design)
-                if any(e is entry for e in group_now):
-                    self._open[bucket] = []
-                    to_run = group_now
+            deadline = time.monotonic() + self.max_wait_s
+            while True:
+                time.sleep(min(self.max_wait_s, 0.01) or 0.001)
+                with self._lock:
+                    group_now = self._open.get(bucket, [])
+                    # the window may already have been flushed by a 'full'
+                    # flush (identity check: _Entry has eq=False by design)
+                    if not any(e is entry for e in group_now):
+                        break
+                    if time.monotonic() >= deadline and self._in_flight.get(bucket, 0) == 0:
+                        self._open[bucket] = []
+                        to_run = group_now
+                        break
             if to_run is not None:
                 self._run_batch(to_run)
         entry.done.wait(timeout=600)
@@ -167,6 +198,9 @@ class DeviceBatchRunner:
     # ---- batch execution (leader) ----
 
     def _run_batch(self, entries: List[_Entry]) -> None:
+        bucket = len(entries[0].arr)
+        with self._lock:
+            self._in_flight[bucket] = self._in_flight.get(bucket, 0) + 1
         try:
             # pad the batch dimension to max_batch with zero rows so XLA sees
             # ONE batch shape per bucket instead of max_batch variants (each
@@ -174,12 +208,29 @@ class DeviceBatchRunner:
             # pad rows carry n=0 and are dropped before unpacking
             rows = [e.arr for e in entries]
             lens = [e.n for e in entries]
-            n_pad_rows = self.max_batch - len(rows)
-            if n_pad_rows > 0:
-                zero_row = np.zeros_like(rows[0])
-                rows = rows + [zero_row] * n_pad_rows
-                lens = lens + [0] * n_pad_rows
-            results = self._fused(np.stack(rows), lens)
+            # batch-dim buckets {1, max_batch}: a LONE flush (start-of-stream,
+            # tail, trickle traffic) runs the ~B-times-cheaper B=1 program
+            # instead of a fully padded window; all other sizes pad to
+            # max_batch so XLA still compiles at most two programs per bucket.
+            # Sharded runners always pad: a batch of 1 cannot split across
+            # the mesh's batch axis.
+            pad_batch = not (len(rows) == 1 and self.mesh is None)
+            n_pad_rows = self.max_batch - len(rows) if pad_batch else 0
+            if self.mesh is not None:
+                # sharded path: one host stack; the mesh kernels distribute it
+                if n_pad_rows > 0:
+                    rows = rows + [np.zeros_like(rows[0])] * n_pad_rows
+                    lens = lens + [0] * n_pad_rows
+                results = self._fused(np.stack(rows), lens)
+            else:
+                import jax.numpy as jnp
+
+                dev_rows = [e.dev if e.dev is not None else self._fused.stage(e.arr) for e in entries]
+                if n_pad_rows > 0:
+                    rows = rows + [np.zeros_like(rows[0])] * n_pad_rows
+                    lens = lens + [0] * n_pad_rows
+                    dev_rows = dev_rows + [jnp.zeros_like(dev_rows[0])] * n_pad_rows
+                results = self._fused(rows, lens, dev_rows=dev_rows)
             for e, (ends, fps) in zip(entries, results):
                 e.ends = ends
                 e.fps = fps
@@ -187,5 +238,7 @@ class DeviceBatchRunner:
             for e in entries:
                 e.error = err
         finally:
+            with self._lock:
+                self._in_flight[bucket] -= 1
             for e in entries:
                 e.done.set()

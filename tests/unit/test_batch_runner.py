@@ -77,6 +77,47 @@ def test_single_submission_not_held_hostage():
     assert fps == want_fps
 
 
+def test_cross_bucket_traffic_does_not_starve_lone_flush():
+    """The adaptive window holds a flush only while ITS OWN bucket's previous
+    batch runs — sustained in-flight work in another bucket must not defer a
+    lone chunk past its max_wait deadline (regression: a global busy gate
+    starved small-bucket tail chunks under load)."""
+    import threading
+    import time
+
+    runner = DeviceBatchRunner(cdc_params=PARAMS, max_batch=8, max_wait_ms=10.0)
+    big = _chunk(1, n=120_000)
+    small = _chunk(2, n=60_000)
+    runner.cdc_and_fps(small, _pad(small))  # warm the small bucket's kernels
+    # hold the BIG bucket 'in flight' by pinning a slow batch through the
+    # fused layer (monkeypatched): the small bucket's flush must not wait
+    real_fused = runner._fused
+
+    class SlowFused:
+        mesh = None
+
+        def stage(self, arr):
+            return real_fused.stage(arr)
+
+        def __call__(self, rows, lens, dev_rows=None):
+            if (rows[0].shape[-1] if hasattr(rows[0], "shape") else len(rows[0])) == len(_pad(big)):
+                time.sleep(1.5)
+            return real_fused(rows, lens, dev_rows=dev_rows)
+
+    runner._fused = SlowFused()
+    t_big = threading.Thread(target=runner.cdc_and_fps, args=(big, _pad(big)), daemon=True)
+    t_big.start()
+    time.sleep(0.2)  # big bucket is now mid-flight
+    t0 = time.perf_counter()
+    ends, fps = runner.cdc_and_fps(small, _pad(small))
+    elapsed = time.perf_counter() - t0
+    t_big.join(timeout=30)
+    assert elapsed < 1.0, f"lone small-bucket flush starved {elapsed:.2f}s by cross-bucket traffic"
+    want_ends, want_fps = _expected(small)
+    np.testing.assert_array_equal(ends, want_ends)
+    assert fps == want_fps
+
+
 def test_error_wakes_all_waiters():
     runner = DeviceBatchRunner(cdc_params=PARAMS, max_batch=4, max_wait_ms=10.0)
     bad = np.zeros(10, np.uint8)  # padded shorter than arr -> stack/shape error in batch
