@@ -302,7 +302,7 @@ def bench_ours(chunks, workers: Optional[int] = None) -> dict:
 
     from skyplane_tpu.ops.cdc import CDCParams
     from skyplane_tpu.ops.dedup import SenderDedupIndex
-    from skyplane_tpu.ops.pipeline import DataPathProcessor
+    from skyplane_tpu.ops.pipeline import DataPathProcessor, effective_codec_name
 
     from skyplane_tpu.ops.backend import on_accelerator
 
@@ -327,7 +327,10 @@ def bench_ours(chunks, workers: Optional[int] = None) -> dict:
     # warm-up: compile all shape buckets (separate corpus so the index stays
     # cold). With a batch runner, submit concurrently so the BATCHED kernel
     # shapes compile now rather than inside the timed region.
-    warm_proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
+    # same hardware-aware codec choice the gateway daemon makes at operator
+    # construction (tpu_zstd -> zstd on hosts with no accelerator)
+    codec_name = effective_codec_name("tpu_zstd")
+    warm_proc = DataPathProcessor(codec_name=codec_name, dedup=True, cdc_params=cdc, batch_runner=batch_runner)
     warm_rng = np.random.default_rng(99)
     t_warm = time.perf_counter()
     if batch_runner is not None:
@@ -343,7 +346,7 @@ def bench_ours(chunks, workers: Optional[int] = None) -> dict:
     # dedup index — a warm index would turn rep 2+ into an all-REF fast path
     best: Optional[dict] = None
     for _ in range(max(1, BENCH_REPS)):
-        proc = DataPathProcessor(codec_name="tpu_zstd", dedup=True, cdc_params=cdc, batch_runner=batch_runner)
+        proc = DataPathProcessor(codec_name=codec_name, dedup=True, cdc_params=cdc, batch_runner=batch_runner)
         index = SenderDedupIndex()
 
         def one(c: bytes) -> int:

@@ -377,8 +377,10 @@ class GatewaySenderOperator(GatewayOperator):
         self.target_host = target_host
         self.target_control_port = target_control_port
         self.use_tls = use_tls
+        from skyplane_tpu.ops.pipeline import effective_codec_name
+
         self.processor = DataPathProcessor(
-            codec_name=codec_name, dedup=dedup, cdc_params=cdc_params, batch_runner=batch_runner
+            codec_name=effective_codec_name(codec_name), dedup=dedup, cdc_params=cdc_params, batch_runner=batch_runner
         )
         self.dedup_index = SenderDedupIndex() if dedup else None
         self.source_gateway_id = source_gateway_id

@@ -44,13 +44,16 @@ _codec_local = threading.local()
 def zstd_level() -> int:
     """Encoder level for the zstd-backed codecs (SKYPLANE_TPU_ZSTD_LEVEL).
 
-    Default 1: the data-path blobs this codec sees are dedup-collapsed
-    literals (blockpack-compacted first-occurrence segments), where level 3's
-    deeper match search measured +55% CPU for ~3% smaller wire on the
-    snapshot-corpus bench — at gateway line rates the CPU is the scarcer
-    resource. Level is an encoder-only knob; frames stay standard.
+    Default -2 (a standard zstd "fast" level — frames stay decoder-
+    compatible): the data-path blobs this codec sees are dedup-collapsed
+    literals (first-occurrence segments), where deeper match search buys
+    little: level 3 measured +55% CPU for ~3% smaller wire vs level 1
+    (round 2), and level 1 measured -6% throughput for +1.8% smaller wire
+    vs -2 on the round-5 full-bench sweep (5.04 vs 4.75 Gbps; reduction
+    6.02x vs 6.13x). At gateway line rates the CPU is the scarcer resource;
+    set the env var to a positive level when egress dollars dominate.
     """
-    return int(os.environ.get("SKYPLANE_TPU_ZSTD_LEVEL", "1"))
+    return int(os.environ.get("SKYPLANE_TPU_ZSTD_LEVEL", "-2"))
 
 
 def _encode_zstd(data: bytes) -> bytes:

@@ -101,6 +101,10 @@ def estimate_corpus(
 # data. CPU figures from docs/benchmark.md microbenchmarks; TPU figures are
 # the device-path targets (validated on hardware by bench.py). Used only for
 # the enable/disable decision, so order-of-magnitude accuracy suffices.
+# Gateways without an accelerator substitute zstd for a planned tpu_zstd at
+# operator construction (ops/pipeline.effective_codec_name, logged and
+# visible in the wire headers) — so on all-CPU deployments the tpu_zstd row
+# effectively executes at the zstd rate.
 CODEC_GBPS = {
     "none": float("inf"),
     "zstd": 8.0,

@@ -54,7 +54,7 @@ def test_roundtrip_and_native_numpy_wire_identity(case, monkeypatch):
         avg_bytes=4096,
         max_bytes=int(rng.integers(8192, 65536)),
     )
-    codec = ["tpu_zstd", "zstd", "none", "native_lz"][case % 4]
+    codec = ["tpu_zstd", "zstd", "none", "native_lz", "tpu"][case % 5]
 
     def run(native: bool):
         monkeypatch.setattr(native_dp, "_available", native)
