@@ -1,0 +1,84 @@
+"""Hardware-aware codec routing (ops/pipeline.effective_codec_name).
+
+Gateways without an accelerator substitute plain zstd for a configured
+``tpu_zstd`` at operator construction — wire-legal (codec id travels per
+chunk) and measured equal-reduction-but-faster on CPU (docs/benchmark.md
+round 5). These tests pin the decision table and the env opt-out.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from skyplane_tpu.ops import backend
+from skyplane_tpu.ops.pipeline import effective_codec_name
+
+
+@pytest.fixture()
+def cpu_backend(monkeypatch):
+    monkeypatch.delenv("SKYPLANE_TPU_KEEP_TPU_CODEC", raising=False)
+    monkeypatch.setattr(backend, "_is_accelerator", False)
+
+
+@pytest.fixture()
+def accel_backend(monkeypatch):
+    monkeypatch.delenv("SKYPLANE_TPU_KEEP_TPU_CODEC", raising=False)
+    monkeypatch.setattr(backend, "_is_accelerator", True)
+
+
+def test_tpu_zstd_routes_to_zstd_on_cpu(cpu_backend):
+    assert effective_codec_name("tpu_zstd") == "zstd"
+
+
+def test_tpu_zstd_kept_on_accelerator(accel_backend):
+    assert effective_codec_name("tpu_zstd") == "tpu_zstd"
+
+
+def test_other_codecs_never_substituted(cpu_backend):
+    # 'tpu' (blockpack-only) stays: its cheap suppression is the point on
+    # any backend; everything else passes through untouched
+    for name in ("tpu", "zstd", "none", "native_lz", "lz4"):
+        assert effective_codec_name(name) == name
+
+
+def test_env_opt_out_preserves_container_coverage(cpu_backend, monkeypatch):
+    monkeypatch.setenv("SKYPLANE_TPU_KEEP_TPU_CODEC", "1")
+    assert effective_codec_name("tpu_zstd") == "tpu_zstd"
+
+
+def test_processor_stays_codec_faithful(cpu_backend):
+    # the processor itself must NOT substitute (dryrun host/device wire
+    # parity depends on it) — routing happens one layer up, in the daemon
+    from skyplane_tpu.ops.pipeline import DataPathProcessor
+
+    proc = DataPathProcessor(codec_name="tpu_zstd", dedup=False)
+    assert proc.codec.name == "tpu_zstd"
+
+
+def test_sender_operator_routes_at_construction(cpu_backend, tmp_path):
+    # the ACTUAL substitution site: GatewaySenderOperator's processor must
+    # come up on zstd when the host has no accelerator — pins the
+    # effective_codec_name() wrapper at the operator call site
+    import queue
+    import threading
+
+    from skyplane_tpu.gateway.chunk_store import ChunkStore
+    from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+    from skyplane_tpu.gateway.operators.gateway_operator import GatewaySenderOperator
+
+    op = GatewaySenderOperator(
+        handle="send",
+        region="local:test",
+        input_queue=GatewayQueue(),
+        output_queue=None,
+        error_event=threading.Event(),
+        error_queue=queue.Queue(),
+        chunk_store=ChunkStore(str(tmp_path / "chunks")),
+        target_gateway_id="gw_dst",
+        target_host="127.0.0.1",
+        target_control_port=1,
+        codec_name="tpu_zstd",
+        dedup=False,
+        use_tls=False,
+    )
+    assert op.processor.codec.name == "zstd"
