@@ -395,3 +395,72 @@ def test_scp_teardown_region_sweeps_network(scp):
     kinds = [k for _, k in names]
     assert kinds.index("virtual-server") < kinds.index("vpc")
     assert kinds.index("subnet") < kinds.index("vpc") and kinds.index("internet-gateway") < kinds.index("vpc")
+
+
+def test_scp_object_data_retry_and_uploadid_strip(monkeypatch):
+    """SCP OBS endpoint quirks (reference scp_interface.py:324-369, :413,
+    :419-433): download retries broadly, upload retries client errors
+    (incl. checksum mismatch) but not local file errors; upload ids arrive
+    whitespace-padded."""
+    monkeypatch.setenv("SCP_OBS_ENDPOINT", "https://obs.example")
+    monkeypatch.setenv("SCP_ACCESS_KEY", "AK")
+    monkeypatch.setenv("SCP_SECRET_KEY", "SK")
+    monkeypatch.setenv("SCP_PROJECT_ID", "P1")
+    # self-contained fake boto3/botocore (same pattern as the bucket tests):
+    # the S3 data-plane base imports them at module scope, and this test must
+    # pass in isolation on the boto3-less env
+    boto3_mod = types.ModuleType("boto3")
+    boto3_mod.client = lambda *a, **k: None
+    botocore_mod = types.ModuleType("botocore")
+    botocore_exc = types.ModuleType("botocore.exceptions")
+    botocore_exc.ClientError = type("ClientError", (Exception,), {})
+    botocore_exc.BotoCoreError = type("BotoCoreError", (Exception,), {})
+    botocore_mod.exceptions = botocore_exc
+    monkeypatch.setitem(sys.modules, "boto3", boto3_mod)
+    monkeypatch.setitem(sys.modules, "botocore", botocore_mod)
+    monkeypatch.setitem(sys.modules, "botocore.exceptions", botocore_exc)
+
+    from skyplane_tpu.exceptions import ChecksumMismatchException
+    from skyplane_tpu.obj_store.s3_interface import S3Interface
+    from skyplane_tpu.obj_store.scp_interface import SCPInterface
+
+    iface = SCPInterface("bkt")
+    iface.DATA_RETRY_SLEEP_S = 0.0  # keep the test instant
+
+    attempts = {"n": 0}
+
+    def flaky_download(*a, **k):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise OSError("connection reset by OBS")
+        return "mime"
+
+    monkeypatch.setattr(S3Interface, "download_object", flaky_download)
+    assert iface.download_object("k", "/tmp/x") == "mime"
+    assert attempts["n"] == 3  # two transient failures absorbed
+
+    # upload: a transiently corrupted part (checksum mismatch) heals on retry
+    def corrupt_then_ok(*a, **k):
+        attempts["n"] += 1
+        if attempts["n"] < 2:
+            raise ChecksumMismatchException("scp://bkt/obj")
+
+    attempts["n"] = 0
+    monkeypatch.setattr(S3Interface, "upload_object", corrupt_then_ok)
+    iface.upload_object("/tmp/src", "obj")
+    assert attempts["n"] == 2
+
+    # upload: local file errors are NOT endpoint flakiness — no retry
+    def missing_file(*a, **k):
+        attempts["n"] += 1
+        raise FileNotFoundError("/tmp/deleted-chunk")
+
+    attempts["n"] = 0
+    monkeypatch.setattr(S3Interface, "upload_object", missing_file)
+    with pytest.raises(FileNotFoundError):
+        iface.upload_object("/tmp/deleted-chunk", "obj")
+    assert attempts["n"] == 1
+
+    # whitespace-padded upload id is stripped at creation
+    monkeypatch.setattr(S3Interface, "initiate_multipart_upload", lambda self, k, m=None: "  upl-123 \n")
+    assert iface.initiate_multipart_upload("obj") == "upl-123"
