@@ -254,6 +254,18 @@ class GatewayReceiver:
             # or corrupt frames must not be a daemon DoS — payload error path
             logger.fs.warning(f"[receiver:{port}] dropping connection on allocation failure: {e}")
             self._count_payload_error(f"MemoryError receiving payload: {e}")
+        except (ssl.SSLError, ConnectionError, TimeoutError) as e:
+            # the PEER failed or abandoned the connection mid-stream (reset,
+            # broken pipe, SSL EOF on a dead socket, read/write timeout) —
+            # routine on a WAN and under load. No ack was sent for the
+            # in-flight chunk, so the sender re-queues it; this is
+            # connection-level cleanup, never daemon-fatal. (Round-5 100 GB
+            # soak: a loaded receiver missed a sender's read timeout, then
+            # its own ACK write raised SSLEOFError and took the entire
+            # destination daemon down — every later reconnect then failed.)
+            # Local OSErrors (e.g. ENOSPC writing the chunk) deliberately
+            # stay on the fatal path below.
+            logger.fs.warning(f"[receiver:{port}] connection lost mid-stream: {e}")
         except Exception:  # noqa: BLE001 — unexpected receiver error stops the daemon
             tb = traceback.format_exc()
             logger.fs.error(f"[receiver:{port}] fatal: {tb}")

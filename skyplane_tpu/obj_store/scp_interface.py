@@ -84,21 +84,39 @@ class SCPInterface(S3Interface):
     DATA_RETRY_SLEEP_S = 1.0
 
     def _retry_data(self, fn, transient, *args, **kwargs):
-        from skyplane_tpu.utils.logger import logger
+        # fixed 1s cadence like the reference loops (no exponential growth:
+        # the quirk being absorbed is short OBS blips, and these retries NEST
+        # under the operator layer's retry_backoff(max_retries=4) at
+        # gateway_operator.py — same nesting as the reference, bounded at
+        # 4x10 attempts for genuinely-down endpoints)
+        from functools import partial
 
-        for attempt in range(self.DATA_RETRIES):
-            try:
-                return fn(*args, **kwargs)
-            except transient as e:
-                if attempt == self.DATA_RETRIES - 1:
-                    raise
-                logger.fs.warning(f"SCP data call failed ({e}); retry {attempt + 1}/{self.DATA_RETRIES}")
-                time.sleep(self.DATA_RETRY_SLEEP_S)
+        from skyplane_tpu.utils.retry import retry_backoff
+
+        return retry_backoff(
+            partial(fn, *args, **kwargs),
+            max_retries=self.DATA_RETRIES,
+            initial_backoff=self.DATA_RETRY_SLEEP_S,
+            max_backoff=self.DATA_RETRY_SLEEP_S,
+            exception_class=transient,
+        )
 
     def download_object(self, *args, **kwargs):
-        # the reference download loop retries on bare Exception (ref :359) —
-        # including read-after-write 404s the flaky OBS endpoint emits
-        return self._retry_data(super().download_object, (Exception,), *args, **kwargs)
+        # the reference download loop retries bare Exception (ref :359); we
+        # narrow that to endpoint/transport errors plus read-after-write 404s
+        # (NoSuchObjectException) — retrying a programming error (TypeError,
+        # ImportError) 10x would only delay the real traceback
+        import botocore.exceptions
+
+        from skyplane_tpu.exceptions import NoSuchObjectException
+
+        transient = (
+            botocore.exceptions.BotoCoreError,
+            botocore.exceptions.ClientError,
+            NoSuchObjectException,
+            OSError,
+        )
+        return self._retry_data(super().download_object, transient, *args, **kwargs)
 
     def upload_object(self, *args, **kwargs):
         # the reference upload loop retries ClientError only (ref :419),
@@ -106,18 +124,15 @@ class SCPInterface(S3Interface):
         # re-read+resend); our base converts InvalidDigest to
         # ChecksumMismatchException, so that is retried too. Local file
         # errors (missing chunk, ENOSPC) raise immediately, as there.
+        import botocore.exceptions
+
         from skyplane_tpu.exceptions import ChecksumMismatchException
 
-        try:
-            import botocore.exceptions
-
-            transient: tuple = (
-                botocore.exceptions.BotoCoreError,
-                botocore.exceptions.ClientError,
-                ChecksumMismatchException,
-            )
-        except ImportError:  # data ops need boto3 anyway; keep the module importable without it
-            transient = (ChecksumMismatchException,)
+        transient = (
+            botocore.exceptions.BotoCoreError,
+            botocore.exceptions.ClientError,
+            ChecksumMismatchException,
+        )
         return self._retry_data(super().upload_object, transient, *args, **kwargs)
 
     def initiate_multipart_upload(self, dst_object_name: str, mime_type: Optional[str] = None) -> str:

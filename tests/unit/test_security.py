@@ -10,6 +10,7 @@ from __future__ import annotations
 import queue
 import socket
 import struct
+import time
 import threading
 import uuid
 
@@ -256,5 +257,61 @@ def test_receiver_nacks_unresolvable_ref(tmp_path):
         assert resp == NACK_UNRESOLVED
         assert not store.chunk_path(chunk_id).exists()
         assert not ev.is_set(), "an unresolvable ref must degrade, not kill the daemon"
+    finally:
+        r.stop_all()
+
+
+def test_receiver_ack_write_failure_is_connection_level(tmp_path):
+    """A peer that vanishes before reading its ack (sender-side read timeout,
+    WAN reset) is CONNECTION-level cleanup — the round-5 100 GB soak caught
+    the ack write raising ssl.SSLEOFError against the dead socket and taking
+    the whole destination daemon down, after which every reconnect failed.
+    Deterministic repro: a connection object that serves one valid frame and
+    fails the ack write exactly the way the soak's dead TLS socket did."""
+    import ssl
+
+    r, store, ev, eq, port = _mk_receiver(tmp_path)
+    try:
+        chunk_id = uuid.uuid4().hex
+        payload = b"peer vanishes before reading the ack for this"
+        header = WireProtocolHeader(chunk_id=chunk_id, data_len=len(payload), raw_data_len=len(payload))
+        stream = header.to_bytes() + payload
+
+        class DeadAfterFrame:
+            """Serves exactly one framed chunk; the ack write hits a socket
+            the peer has already reset."""
+
+            def __init__(self):
+                self.buf = stream
+
+            def recv(self, n):
+                out, self.buf = self.buf[:n], self.buf[n:]
+                if not out:
+                    raise ConnectionResetError("peer gone")
+                return out
+
+            def recv_into(self, view, n):
+                got = self.recv(min(n, len(view)))
+                view[: len(got)] = got
+                return len(got)
+
+            def sendall(self, b):
+                raise ssl.SSLEOFError("EOF occurred in violation of protocol")
+
+            def close(self):
+                pass
+
+        r._conn_loop(DeadAfterFrame(), 9999)
+        # the chunk landed; the dead-ack connection died quietly
+        assert store.chunk_path(chunk_id).with_suffix(".done").exists()
+        assert not ev.is_set(), "an abandoned connection must not kill the daemon"
+        # the receiver still serves real connections afterwards
+        chunk_id2 = uuid.uuid4().hex
+        payload2 = b"second chunk on a fresh connection"
+        header2 = WireProtocolHeader(chunk_id=chunk_id2, data_len=len(payload2), raw_data_len=len(payload2))
+        resp = _send_frame(port, header2, payload2)
+        assert resp == ACK_BYTE
+        assert store.chunk_path(chunk_id2).with_suffix(".done").exists()
+        assert not ev.is_set()
     finally:
         r.stop_all()
