@@ -199,9 +199,14 @@ class TransferProgressTracker(threading.Thread):
         self.hooks.on_chunk_dispatched(batch)
         self.hooks.on_dispatch_end()
 
-    def _poll_gateway_status(self, gateway) -> Dict[str, str]:
+    #: max chunk ids on a filtered status poll. 32-hex ids + %2C separators
+    #: must stay under the stdlib http.server 64 KiB request-line limit
+    #: (1500 x 35B ≈ 52 KiB); larger pending sets poll the full map.
+    STATUS_FILTER_MAX_IDS = 1500
+
+    def _poll_gateway_status(self, gateway, params: Optional[dict] = None) -> Dict[str, str]:
         try:
-            r = gateway.control_session().get(f"{gateway.control_url()}/chunk_status_log", timeout=10)
+            r = gateway.control_session().get(f"{gateway.control_url()}/chunk_status_log", params=params, timeout=10)
             r.raise_for_status()
             return r.json().get("chunk_status", {})
         except requests.RequestException as e:
@@ -277,7 +282,17 @@ class TransferProgressTracker(threading.Thread):
         poll_interval = self.POLL_INTERVAL_S
         while time.time() < deadline:
             self._check_gateway_errors()
-            statuses = dict(do_parallel(self._poll_gateway_status, sinks, n=16))
+            # narrow polls to the still-pending set (one shared params dict
+            # per wave, not per gateway): the daemon's cumulative status map
+            # grows with every chunk it has ever seen, and full-map polls
+            # starve its API thread on long transfers (round-5 soak finding).
+            # Completion accounting below is a UNION across waves, so a
+            # filtered poll that omits already-complete chunks cannot
+            # un-complete them.
+            with self._lock:
+                pending_ids = [cid for cid in self.dispatched_chunk_ids if cid not in self.complete_chunk_ids]
+            params = {"chunk_ids": ",".join(pending_ids)} if 0 < len(pending_ids) <= self.STATUS_FILTER_MAX_IDS else None
+            statuses = dict(do_parallel(lambda gw: self._poll_gateway_status(gw, params), sinks, n=16))
             region_complete: Dict[str, Set[str]] = {}
             for region, gws in by_region.items():
                 done: Set[str] = set()
@@ -286,9 +301,11 @@ class TransferProgressTracker(threading.Thread):
                     done |= {cid for cid, st in status.items() if st == "complete"}
                 region_complete[region] = done
             # a chunk is complete when EVERY destination region has landed it
-            all_complete = set.intersection(*region_complete.values()) if region_complete else set()
+            # THIS wave; accumulate monotonically across waves
+            wave_complete = set.intersection(*region_complete.values()) if region_complete else set()
             with self._lock:
-                self.complete_chunk_ids = all_complete
+                self.complete_chunk_ids |= wave_complete
+                all_complete = set(self.complete_chunk_ids)
                 newly = all_complete - reported_complete
                 target = set(self.dispatched_chunk_ids)
             if newly:

@@ -278,10 +278,21 @@ class GatewayDaemonAPI:
             # aggregate chunk_id -> state map. The full transition log grows
             # O(chunks x operators) and serializing it per poll made control
             # traffic quadratic on large transfers; fetch it explicitly with
-            # ?include_log=1 (debugging / profiling).
+            # ?include_log=1 (debugging / profiling). ?chunk_ids=a,b,c
+            # narrows the map to the poller's in-flight set — on long-lived
+            # daemons the cumulative map itself grows O(total chunks ever)
+            # and copying+serializing it per poll starved the API thread
+            # under data-plane load (round-5 100 GB soak: control polls
+            # timing out past ~90 waves).
             include_log = query.get("include_log") == ["1"]
+            want_ids = query.get("chunk_ids")
             with self._lock:
-                payload = {"chunk_status": dict(self.chunk_status)}
+                if want_ids:
+                    ids = want_ids[0].split(",")
+                    status = {cid: self.chunk_status[cid] for cid in ids if cid in self.chunk_status}
+                else:
+                    status = dict(self.chunk_status)
+                payload = {"chunk_status": status}
                 if include_log:
                     payload["chunk_status_log"] = list(self.chunk_status_log)
                 req._send(200, payload)

@@ -52,7 +52,10 @@ class LocalGateway:
         for attempt in range(retries + 1):
             try:
                 return self.session().get(self.url(route), **kw)
-            except requests.exceptions.ConnectionError:
+            except (requests.exceptions.ConnectionError, requests.exceptions.ReadTimeout):
+                # ReadTimeout: on a saturated single-core host the API thread
+                # can starve for seconds behind the data plane — cumulative
+                # endpoints tolerate a re-ask (drain-on-GET ones must not)
                 if attempt == retries:
                     raise
                 time.sleep(0.2 * (attempt + 1))
@@ -181,12 +184,18 @@ def wait_complete(gw: LocalGateway, chunk_ids: List[str], timeout: float = 60.0)
     deadline = time.time() + timeout
     pending = set(chunk_ids)
     while time.time() < deadline:
-        status = gw.get("chunk_status_log", timeout=10).json()["chunk_status"]
-        errs = gw.get("errors", timeout=10).json()["errors"]
+        # poll only the chunks still pending: the daemon's cumulative status
+        # map grows with every chunk ever seen, and full-map polls starved
+        # the API thread on long soaks (O(history) copy+serialize per poll).
+        # Big pending sets fall back to the full map — the query string must
+        # stay under http.server's 64 KiB request-line limit (~1500 ids).
+        params = {"chunk_ids": ",".join(sorted(pending))} if len(pending) <= 1500 else None
+        status = gw.get("chunk_status_log", params=params, timeout=30).json()["chunk_status"]
+        errs = gw.get("errors", timeout=30).json()["errors"]
         if errs:
             raise RuntimeError(f"gateway {gw.daemon.gateway_id} errors: {errs[0][:2000]}")
-        pending = {c for c in chunk_ids if status.get(c) != "complete"}
+        pending = {c for c in pending if status.get(c) != "complete"}
         if not pending:
             return
-        time.sleep(0.1)
+        time.sleep(0.25)
     raise TimeoutError(f"{len(pending)}/{len(chunk_ids)} chunks incomplete at {gw.daemon.gateway_id}")
