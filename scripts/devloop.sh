@@ -18,6 +18,19 @@ ACQ_TIMEOUT=${ACQ_TIMEOUT:-300}   # how long an attempt may wait for acquisition
 SLEEP_BETWEEN=${SLEEP_BETWEEN:-120}
 SUCCESS=$LOGDIR/device_profile.success
 
+# Static-analysis gate (CPU-only, cheap): same pass tier-1 runs in
+# tests/unit/test_static_analysis.py. Emits the machine-readable findings
+# report for BENCH/soak tooling; failures are logged LOUDLY but do not block
+# device profiling — the pytest gate is what blocks a merge.
+JAX_PLATFORMS=cpu python -m skyplane_tpu.analysis skyplane_tpu \
+  --json "$LOGDIR/lint_findings.json" >"$LOGDIR/lint.out" 2>&1
+LINT_RC=$?
+if [ "$LINT_RC" -ne 0 ]; then
+  echo "[devloop] LINT FAILURES (rc=$LINT_RC) — fix or suppress before merging; see $LOGDIR/lint.out" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] lint clean; report at $LOGDIR/lint_findings.json" >>"$LOGDIR/devloop.log"
+fi
+
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only
   # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
   # acquired but crashed mid-profile must be retried, not recorded

@@ -1,0 +1,31 @@
+"""Repo-aware static analysis for the two bug classes unit tests are worst at
+catching on this codebase (ADVICE round 5 shipped three of them):
+
+  * concurrency — the gateway daemon is ~16 threaded modules sharing state
+    through ``self.*`` attributes, locks, queues, and sockets; races and
+    blocking-under-lock stalls survive any single-threaded test run.
+  * tracer safety — Python side-effects inside ``@jax.jit`` functions are
+    silently baked into the trace at compile time (a ``time.time()`` call
+    becomes a constant; a ``print`` fires once), and u32 arithmetic without
+    explicit casts overflows only on real device dtypes.
+
+The framework (``core``) is a per-file AST walk with a checker registry,
+``file:line`` findings, and ``# sklint: disable=<rule> -- <reason>``
+suppressions (the reason is mandatory; a bare disable is itself a finding).
+Checker families live in ``concurrency`` and ``tracer``.
+
+Run it as ``python -m skyplane_tpu.analysis [paths...]`` or
+``skyplane-tpu lint``; tier-1 ``tests/unit/test_static_analysis.py`` gates the
+repo at zero unsuppressed findings. See docs/static-analysis.md.
+"""
+
+from skyplane_tpu.analysis.core import (  # noqa: F401
+    AnalysisReport,
+    Checker,
+    Finding,
+    RuleSpec,
+    all_checkers,
+    iter_rules,
+    run_paths,
+    run_source,
+)

@@ -1,0 +1,340 @@
+"""Concurrency checkers: races and stalls in the threaded gateway modules.
+
+Heuristic contracts (documented in docs/static-analysis.md): threads enter a
+class through ``threading.Thread(target=...)`` or a ``Thread`` subclass
+``run``; a lock guard is any ``with`` on a name/attribute whose identifier
+contains ``lock``/``mutex``/``cond`` or that was bound from
+``threading.Lock/RLock/Condition``. These deliberately over-approximate —
+a false positive costs one justified ``# sklint: disable`` comment, a missed
+race costs a soak-run postmortem.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skyplane_tpu.analysis.core import Checker, Finding, ModuleInfo, RuleSpec
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_LOCKISH_FRAGMENTS = ("lock", "mutex", "cond")
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT entering nested function/class defs
+    (their bodies run in a different dynamic scope, usually a different time)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lockish(expr: ast.AST, lock_attrs: Set[str]) -> bool:
+    name = dotted_name(expr)
+    if not name:
+        return False
+    terminal = name.split(".")[-1].lower()
+    if isinstance(expr, ast.Attribute) and name.startswith("self.") and expr.attr in lock_attrs:
+        return True
+    return any(frag in terminal for frag in _LOCKISH_FRAGMENTS)
+
+
+def _lock_attr_names(cls: ast.ClassDef) -> Set[str]:
+    """self.X attributes bound from a threading lock factory anywhere in the class."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            factory = dotted_name(node.value.func).split(".")[-1]
+            if factory in _LOCK_FACTORIES:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                        attrs.add(tgt.attr)
+    return attrs
+
+
+def _is_thread_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name in ("threading.Thread", "Thread")
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclass
+class _Write:
+    attr: str
+    node: ast.AST
+    func: str  # display name of the writing function
+    entry: bool  # runs on a spawned thread
+    locked: bool
+
+
+class SharedStateChecker(Checker):
+    """unlocked-shared-write: a ``self.attr`` assigned both on a spawned
+    thread's path and from another method, with at least one side unguarded.
+    ``__init__`` writes are pre-``start()`` and exempt (happens-before)."""
+
+    rules = (
+        RuleSpec(
+            "unlocked-shared-write",
+            "error",
+            "attribute written from a thread entry path and from another method without a lock on every write",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for cls in [n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]:
+            yield from self._check_class(module, cls)
+
+    def _check_class(self, module: ModuleInfo, cls: ast.ClassDef) -> Iterator[Finding]:
+        lock_attrs = _lock_attr_names(cls)
+        methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
+        entry_names = self._entry_functions(cls, methods)
+        writes: List[_Write] = []
+        for meth in methods:
+            is_entry = meth.name in entry_names
+            writes.extend(self._collect_writes(meth, meth.name, is_entry, lock_attrs))
+            # nested defs handed to Thread(target=...) write self.* via closure
+            for nested in [n for n in ast.walk(meth) if isinstance(n, ast.FunctionDef) and n is not meth]:
+                nested_entry = f"{meth.name}.{nested.name}" in entry_names
+                writes.extend(self._collect_writes(nested, f"{meth.name}.{nested.name}", nested_entry, lock_attrs))
+        by_attr: Dict[str, List[_Write]] = {}
+        for w in writes:
+            by_attr.setdefault(w.attr, []).append(w)
+        for attr, ws in sorted(by_attr.items()):
+            entry_ws = [w for w in ws if w.entry]
+            other_ws = [w for w in ws if not w.entry and w.func != "__init__"]
+            cross_entry = len({w.func for w in entry_ws}) > 1
+            if not entry_ws or not (other_ws or cross_entry):
+                continue
+            involved = entry_ws + other_ws
+            unlocked = [w for w in involved if not w.locked]
+            if not unlocked:
+                continue
+            peers = sorted({w.func for w in involved})
+            for w in unlocked:
+                yield self.finding(
+                    module,
+                    "unlocked-shared-write",
+                    w.node,
+                    f"{cls.name}.{attr} is written by {', '.join(peers)} across threads; this write in {w.func} holds no lock",
+                )
+
+    @staticmethod
+    def _entry_functions(cls: ast.ClassDef, methods: List[ast.FunctionDef]) -> Set[str]:
+        entries: Set[str] = set()
+        if any(dotted_name(b).split(".")[-1] == "Thread" for b in cls.bases):
+            entries.add("run")
+        for meth in methods:
+            for node in ast.walk(meth):
+                if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    target_attr = _self_attr_target(kw.value)
+                    if target_attr:
+                        entries.add(target_attr)
+                    elif isinstance(kw.value, ast.Name):
+                        entries.add(f"{meth.name}.{kw.value.id}")  # nested def target
+        return entries
+
+    @staticmethod
+    def _collect_writes(fn: ast.FunctionDef, display: str, entry: bool, lock_attrs: Set[str]) -> List[_Write]:
+        writes: List[_Write] = []
+
+        def visit(node: ast.AST, locked: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.With):
+                inner = locked or any(_is_lockish(item.context_expr, lock_attrs) for item in node.items)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, inner)
+                return
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and node.value is not None:
+                targets = [node.target]
+            for tgt in targets:
+                attr = _self_attr_target(tgt)
+                if attr is None or attr in lock_attrs:
+                    continue
+                # binding a lock/event/queue object is setup, not shared data
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    factory = dotted_name(node.value.func).split(".")[-1]
+                    if factory in _LOCK_FACTORIES | {"Event", "Queue", "local"}:
+                        continue
+                writes.append(_Write(attr=attr, node=node, func=display, entry=entry, locked=locked))
+            for child in ast.iter_child_nodes(node):
+                visit(child, locked)
+
+        for stmt in fn.body:
+            visit(stmt, False)
+        return writes
+
+
+class ThreadLifecycleChecker(Checker):
+    """thread-no-daemon: a Thread created with neither ``daemon=`` nor any
+    ``join()`` in the same scope leaks past shutdown and can hang exit."""
+
+    rules = (
+        RuleSpec(
+            "thread-no-daemon",
+            "warning",
+            "threading.Thread created without daemon= and never joined in the enclosing scope",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        scopes: List[ast.AST] = [module.tree]
+        scopes.extend(n for n in ast.walk(module.tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        seen: Set[ast.Call] = set()
+        for scope in scopes:
+            calls = [
+                n
+                for n in walk_scope(scope)
+                if isinstance(n, ast.Call) and _is_thread_call(n) and n not in seen
+            ]
+            if not calls:
+                continue
+            seen.update(calls)
+            # any join()/`.daemon =` in the scope counts as lifecycle handling
+            joined = any(
+                (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) and n.func.attr == "join")
+                or (isinstance(n, ast.Assign) and any(isinstance(t, ast.Attribute) and t.attr == "daemon" for t in n.targets))
+                for n in walk_scope(scope)
+            )
+            for call in calls:
+                if any(kw.arg == "daemon" for kw in call.keywords):
+                    continue
+                if joined:
+                    continue
+                yield self.finding(
+                    module,
+                    "thread-no-daemon",
+                    call,
+                    "Thread has no daemon= and no join() in this scope — it outlives shutdown silently",
+                )
+
+
+_BLOCKING_PREFIXES = ("requests.", "urllib.", "socket.", "subprocess.")
+_SOCKET_METHODS = {"recv", "recv_into", "sendall", "accept", "connect", "makefile"}
+_QUEUEISH_FRAGMENTS = ("queue", "_q")
+
+
+class BlockingUnderLockChecker(Checker):
+    """blocking-under-lock: sleeping or doing network/queue I/O while holding
+    a lock turns every peer thread's short critical section into that I/O's
+    latency — the gateway's classic whole-daemon stall."""
+
+    rules = (
+        RuleSpec(
+            "blocking-under-lock",
+            "error",
+            "blocking call (sleep / network / unbounded queue get) inside a held lock",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        lock_attrs: Set[str] = set()
+        for cls in [n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]:
+            lock_attrs |= _lock_attr_names(cls)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_is_lockish(item.context_expr, lock_attrs) for item in node.items):
+                continue
+            for stmt in node.body:
+                for sub in self._walk_with_self(stmt):
+                    if isinstance(sub, ast.Call):
+                        reason = self._blocking_reason(sub)
+                        if reason:
+                            yield self.finding(module, "blocking-under-lock", sub, f"{reason} while a lock is held")
+
+    @staticmethod
+    def _walk_with_self(node: ast.AST) -> Iterator[ast.AST]:
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from BlockingUnderLockChecker._walk_with_self(child)
+
+    @staticmethod
+    def _blocking_reason(call: ast.Call) -> Optional[str]:
+        name = dotted_name(call.func)
+        if name in ("time.sleep", "sleep"):
+            return "time.sleep"
+        if any(name.startswith(p) for p in _BLOCKING_PREFIXES):
+            return f"network/process call {name}"
+        if isinstance(call.func, ast.Attribute):
+            obj = dotted_name(call.func.value).split(".")[-1].lower()
+            if call.func.attr in _SOCKET_METHODS and ("sock" in obj or "conn" in obj):
+                return f"socket {call.func.attr}()"
+            if (
+                call.func.attr == "get"
+                and not call.args
+                and not any(kw.arg == "timeout" for kw in call.keywords)
+                and any(frag in obj for frag in _QUEUEISH_FRAGMENTS)
+            ):
+                return f"{obj}.get() with no timeout"
+        return None
+
+
+class BareExceptLoopChecker(Checker):
+    """bare-except-in-loop: an ``except:``/``except BaseException`` that does
+    not re-raise, inside a service loop, also swallows KeyboardInterrupt /
+    SystemExit — the loop can never be shut down."""
+
+    rules = (
+        RuleSpec(
+            "bare-except-in-loop",
+            "warning",
+            "bare except (or BaseException without re-raise) inside a loop swallows shutdown",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for loop in [n for n in ast.walk(module.tree) if isinstance(n, (ast.While, ast.For))]:
+            for node in walk_scope(loop):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                broad = node.type is None or dotted_name(node.type).split(".")[-1] == "BaseException"
+                if not broad:
+                    continue
+                reraises = any(isinstance(sub, ast.Raise) for sub in ast.walk(node))
+                if reraises:
+                    continue
+                yield self.finding(
+                    module,
+                    "bare-except-in-loop",
+                    node,
+                    "bare/BaseException handler in a loop with no re-raise — Ctrl-C and shutdown get eaten",
+                )
+
+
+CONCURRENCY_CHECKERS: Tuple[type, ...] = (
+    SharedStateChecker,
+    ThreadLifecycleChecker,
+    BlockingUnderLockChecker,
+    BareExceptLoopChecker,
+)

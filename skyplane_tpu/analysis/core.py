@@ -1,0 +1,283 @@
+"""Analysis framework: file loading, suppressions, checker registry, report.
+
+A checker declares the rules it owns (``RuleSpec``) and yields ``Finding``s
+from one parsed module at a time. The runner owns everything rule-agnostic:
+walking paths, parsing, attaching ``# sklint: disable=`` suppressions, and
+aggregating the machine-readable report the CLI/tests/devloop consume.
+
+Suppression contract (enforced here, not per checker):
+
+    x = risky()  # sklint: disable=rule-a,rule-b -- one-line justification
+
+  * applies to findings on its own line, or — when the comment stands alone
+    on a line — to the next code line (for statements too long to share).
+  * the justification after ``--`` (or an em dash / ``:``) is MANDATORY;
+    a reasonless disable raises a ``suppression-missing-reason`` finding
+    that cannot itself be suppressed.
+  * unknown rule names raise ``suppression-unknown-rule`` so typos fail
+    loudly instead of silently un-gating the line forever.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# rule list is one whitespace-free token (kebab-case names, comma-separated);
+# the justification follows after whitespace, optionally led by -- / — / :
+SUPPRESS_RE = re.compile(r"#\s*sklint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s+(?:--|—|:)?\s*(\S.*))?$")
+
+#: findings the framework itself emits (checker rules register separately)
+FRAMEWORK_RULES = (
+    ("parse-error", "error", "file does not parse; nothing on it was checked"),
+    ("suppression-missing-reason", "error", "sklint disable comment without a justification"),
+    ("suppression-unknown-rule", "warning", "sklint disable names a rule that does not exist"),
+)
+
+
+@dataclass
+class RuleSpec:
+    name: str
+    severity: str  # "error" | "warning"
+    description: str
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppression_reason: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.location()}: [{self.severity}] {self.rule}: {self.message}{tag}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "suppression_reason": self.suppression_reason,
+        }
+
+
+@dataclass
+class Suppression:
+    line: int  # code line the suppression covers
+    rules: Tuple[str, ...]
+    reason: str
+    comment_line: int
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file, as handed to every checker."""
+
+    path: str  # as reported in findings (relative when discovered via a dir)
+    source: str
+    tree: ast.Module
+    suppressions: List[Suppression] = field(default_factory=list)
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        for sup in self.suppressions:
+            if sup.line == line and (rule in sup.rules or "all" in sup.rules):
+                return sup
+        return None
+
+
+class Checker:
+    """Base: subclasses set ``rules`` and implement ``check``."""
+
+    rules: Tuple[RuleSpec, ...] = ()
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: ModuleInfo, rule: str, node, message: str) -> Finding:
+        spec = next(r for r in self.rules if r.name == rule)
+        line = getattr(node, "lineno", 0) if not isinstance(node, int) else node
+        return Finding(rule=rule, severity=spec.severity, path=module.path, line=line, message=message)
+
+
+def all_checkers() -> List[Checker]:
+    # local import: concurrency/tracer import this module for the base class
+    from skyplane_tpu.analysis.concurrency import CONCURRENCY_CHECKERS
+    from skyplane_tpu.analysis.tracer import TRACER_CHECKERS
+
+    return [cls() for cls in (*CONCURRENCY_CHECKERS, *TRACER_CHECKERS)]
+
+
+def iter_rules() -> List[RuleSpec]:
+    """Every rule the pass can emit, framework rules included (docs + CLI)."""
+    rules = [RuleSpec(*r) for r in FRAMEWORK_RULES]
+    for checker in all_checkers():
+        rules.extend(checker.rules)
+    return rules
+
+
+def known_rule_names() -> Set[str]:
+    return {r.name for r in iter_rules()}
+
+
+@dataclass
+class AnalysisReport:
+    findings: List[Finding] = field(default_factory=list)
+    files_checked: int = 0
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.unsuppressed if f.severity == "error"]
+
+    def ok(self) -> bool:
+        return not self.unsuppressed
+
+    def as_dict(self) -> dict:
+        return {
+            "files_checked": self.files_checked,
+            "n_findings": len(self.findings),
+            "n_unsuppressed": len(self.unsuppressed),
+            "ok": self.ok(),
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+
+def _parse_suppressions(source: str, known: Set[str]) -> Tuple[List[Suppression], List[Tuple[int, str]]]:
+    """Extract sklint comments via the tokenizer (never fooled by strings).
+
+    Returns (suppressions, problems) where problems are (line, kind) pairs for
+    reasonless/unknown-rule disables, reported by the caller as findings.
+    """
+    suppressions: List[Suppression] = []
+    problems: List[Tuple[int, str]] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [], []
+    for i, tok in enumerate(tokens):
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        # standalone comment line covers the NEXT code line; trailing covers its own
+        standalone = tok.string.strip() == tok.line.strip()
+        covered = tok.start[0]
+        if standalone:
+            for nxt in tokens[i + 1 :]:
+                if nxt.type in (tokenize.NL, tokenize.NEWLINE, tokenize.COMMENT, tokenize.INDENT, tokenize.DEDENT):
+                    continue
+                covered = nxt.start[0]
+                break
+        if not reason:
+            problems.append((tok.start[0], "suppression-missing-reason"))
+            continue  # a reasonless disable suppresses nothing
+        unknown = [r for r in rules if r not in known and r != "all"]
+        for _ in unknown:
+            problems.append((tok.start[0], "suppression-unknown-rule"))
+        suppressions.append(Suppression(line=covered, rules=rules, reason=reason, comment_line=tok.start[0]))
+    return suppressions, problems
+
+
+def load_module(path: str, display_path: Optional[str] = None, known: Optional[Set[str]] = None) -> Tuple[Optional[ModuleInfo], List[Finding]]:
+    display = display_path or path
+    source = Path(path).read_text(encoding="utf-8", errors="replace")
+    return load_module_source(source, display, known=known)
+
+
+def load_module_source(source: str, display: str, known: Optional[Set[str]] = None) -> Tuple[Optional[ModuleInfo], List[Finding]]:
+    known = known if known is not None else known_rule_names()
+    try:
+        tree = ast.parse(source, filename=display)
+    except SyntaxError as e:
+        return None, [Finding("parse-error", "error", display, e.lineno or 0, f"syntax error: {e.msg}")]
+    suppressions, problems = _parse_suppressions(source, known)
+    findings = []
+    for line, kind in problems:
+        severity = "error" if kind == "suppression-missing-reason" else "warning"
+        msg = (
+            "sklint disable without a justification — write `# sklint: disable=<rule> -- <why>`"
+            if kind == "suppression-missing-reason"
+            else "sklint disable names an unknown rule (typo un-gates nothing: the finding still fires)"
+        )
+        findings.append(Finding(kind, severity, display, line, msg))
+    return ModuleInfo(path=display, source=source, tree=tree, suppressions=suppressions), findings
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterator[Tuple[str, str]]:
+    """Yield (fs_path, display_path) for every .py under the given paths.
+
+    A path that does not exist (or is neither a directory nor a .py file)
+    raises instead of yielding nothing: a typo'd path or wrong cwd must not
+    report 'checked 0 files' with a green exit code — a vacuously clean gate
+    is worse than a loud one.
+    """
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                yield str(f), str(f)
+        elif p.is_file() and p.suffix == ".py":
+            yield str(p), str(p)
+        else:
+            raise FileNotFoundError(f"lint path is not a directory or .py file: {raw}")
+
+
+def run_module(module: ModuleInfo, checkers: Optional[Iterable[Checker]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for checker in checkers if checkers is not None else all_checkers():
+        findings.extend(checker.check(module))
+    for f in findings:
+        sup = module.suppression_for(f.rule, f.line)
+        if sup is not None:
+            f.suppressed = True
+            f.suppression_reason = sup.reason
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_source(source: str, display: str = "<string>", rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Analyze one source string (the fixture-test entry point)."""
+    module, findings = load_module_source(source, display)
+    if module is not None:
+        findings.extend(run_module(module))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    return findings
+
+
+def run_paths(paths: Sequence[str], rules: Optional[Set[str]] = None) -> AnalysisReport:
+    report = AnalysisReport()
+    checkers = all_checkers()
+    known = known_rule_names()
+    for fs_path, display in _iter_py_files(paths):
+        module, load_findings = load_module(fs_path, display, known=known)
+        report.files_checked += 1
+        found = load_findings  # framework findings obey --rule like any other
+        if module is not None:
+            found = found + run_module(module, checkers)
+        if rules is not None:
+            found = [f for f in found if f.rule in rules]
+        report.findings.extend(found)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report

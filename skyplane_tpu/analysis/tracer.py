@@ -1,0 +1,292 @@
+"""Tracer-safety checkers: side effects and overflow hazards in jitted code.
+
+``@jax.jit`` runs the Python body ONCE at trace time: ``time.time()`` becomes
+a compile-time constant, ``print`` fires once then never again, attribute
+mutation happens during tracing instead of per call, and ``float()/.item()``
+on a tracer either crashes (inside jit) or forces a silent device sync. The
+u32 checker enforces the ``ops/u32.py`` contract: modular-hash arithmetic is
+only overflow-safe after an explicit ``jnp.uint32`` cast (CPU tests pass in
+int64 where real device dtypes wrap).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from skyplane_tpu.analysis.core import Checker, Finding, ModuleInfo, RuleSpec
+from skyplane_tpu.analysis.concurrency import dotted_name
+
+# matched AFTER import-alias canonicalization (np -> numpy, t -> time, ...)
+_IMPURE_EXACT = {"print", "input", "breakpoint", "open"}
+_IMPURE_PREFIXES = ("time.", "np.random.", "numpy.random.", "random.", "os.")
+_MUTATORS = {"append", "extend", "add", "update", "insert", "setdefault", "pop", "remove", "clear", "put"}
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """alias -> canonical module path, so ``import time as t`` cannot dodge
+    the impure-call match (and ``import jax.numpy as jnp`` canonicalizes to
+    the jax.* allowlist instead of relying on the conventional alias)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def canonical_name(node: ast.AST, aliases: Dict[str, str]) -> str:
+    """dotted_name with the leading segment resolved through import aliases."""
+    name = dotted_name(node)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    resolved = aliases.get(head, head)
+    return f"{resolved}.{rest}" if rest else resolved
+
+
+def _decorator_is_jit(dec: ast.AST) -> Tuple[bool, Tuple[str, ...]]:
+    """(is_jit, static_argnames) for one decorator node."""
+    name = dotted_name(dec)
+    if name in ("jax.jit", "jit"):
+        return True, ()
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in ("jax.jit", "jit"):
+            return True, _static_argnames(dec)
+        if fname in ("partial", "functools.partial") and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner in ("jax.jit", "jit"):
+                return True, _static_argnames(dec)
+    return False, ()
+
+
+def _static_argnames(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums") and isinstance(kw.value, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in kw.value.elts if isinstance(e, ast.Constant) and isinstance(e.value, str))
+        if kw.arg == "static_argnames" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+            return (kw.value.value,)
+    return ()
+
+
+def find_jit_functions(tree: ast.Module) -> List[Tuple[ast.FunctionDef, Tuple[str, ...]]]:
+    """All functions traced by jax.jit: decorated directly, via partial, or
+    defined locally and later passed to a ``jax.jit(...)`` call."""
+    out: List[Tuple[ast.FunctionDef, Tuple[str, ...]]] = []
+    wrapped: Dict[str, Tuple[str, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in ("jax.jit", "jit"):
+            if node.args and isinstance(node.args[0], ast.Name):
+                wrapped[node.args[0].id] = _static_argnames(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            is_jit, statics = _decorator_is_jit(dec)
+            if is_jit:
+                out.append((node, statics))
+                break
+        else:
+            if node.name in wrapped:
+                out.append((node, wrapped[node.name]))
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> Set[str]:
+    args = fn.args
+    return {a.arg for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)}
+
+
+def _int_annotated(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for a in (*fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs):
+        if a.annotation is not None and dotted_name(a.annotation) in ("int", "bool"):
+            out.add(a.arg)
+    return out
+
+
+class JitPurityChecker(Checker):
+    """jit-impure-call / jit-attr-mutation: Python side effects inside traced
+    functions run once at compile time, not per call."""
+
+    rules = (
+        RuleSpec(
+            "jit-impure-call",
+            "error",
+            "impure host call (time/np.random/print/os/...) inside a jax.jit-traced function",
+        ),
+        RuleSpec(
+            "jit-attr-mutation",
+            "error",
+            "attribute/container mutation inside a jax.jit-traced function happens at trace time only",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        aliases = import_aliases(module.tree)
+        for fn, _statics in find_jit_functions(module.tree):
+            # nested defs inside a jit fn are traced too when called from it
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = canonical_name(node.func, aliases)
+                    if self._is_impure(name):
+                        yield self.finding(
+                            module,
+                            "jit-impure-call",
+                            node,
+                            f"{name}() inside jit function {fn.name!r} is baked into the trace as a constant/one-shot",
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS
+                        and dotted_name(node.func.value).startswith("self")
+                    ):
+                        yield self.finding(
+                            module,
+                            "jit-attr-mutation",
+                            node,
+                            f"self.{node.func.value.attr if isinstance(node.func.value, ast.Attribute) else '...'}."
+                            f"{node.func.attr}() in jit function {fn.name!r} mutates host state at trace time only",
+                        )
+                for tgt in self._assign_targets(node):
+                    if isinstance(tgt, ast.Attribute):
+                        yield self.finding(
+                            module,
+                            "jit-attr-mutation",
+                            node,
+                            f"assignment to {dotted_name(tgt)} in jit function {fn.name!r} happens at trace time only",
+                        )
+
+    @staticmethod
+    def _assign_targets(node: ast.AST) -> List[ast.AST]:
+        if isinstance(node, ast.Assign):
+            return list(node.targets)
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)) and getattr(node, "value", None) is not None:
+            return [node.target]
+        return []
+
+    @staticmethod
+    def _is_impure(name: str) -> bool:
+        if not name:
+            return False
+        if name in _IMPURE_EXACT:
+            return True
+        if name.startswith(("jax.", "jnp.", "lax.")):
+            return False  # jax.random etc. is trace-safe by construction (jnp/lax cover unimported-alias fixtures)
+        return any(name == p.rstrip(".") or name.startswith(p) for p in _IMPURE_PREFIXES)
+
+
+class JitHostSyncChecker(Checker):
+    """jit-host-sync: float()/int()/bool()/.item() on a traced value — a
+    ConcretizationTypeError inside jit, or a hidden device sync just outside."""
+
+    rules = (
+        RuleSpec(
+            "jit-host-sync",
+            "error",
+            "float()/int()/bool()/.item() on a likely-tracer value inside a jax.jit-traced function",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn, statics in find_jit_functions(module.tree):
+            traced = _param_names(fn) - set(statics) - _int_annotated(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
+                    yield self.finding(
+                        module,
+                        "jit-host-sync",
+                        node,
+                        f".item() in jit function {fn.name!r} concretizes a tracer (crash) or forces a device sync",
+                    )
+                    continue
+                name = dotted_name(node.func)
+                if name in ("float", "int", "bool") and node.args:
+                    used = {n.id for n in ast.walk(node.args[0]) if isinstance(n, ast.Name)}
+                    hit = used & traced
+                    if hit:
+                        yield self.finding(
+                            module,
+                            "jit-host-sync",
+                            node,
+                            f"{name}() on traced value {sorted(hit)[0]!r} in jit function {fn.name!r} "
+                            "raises ConcretizationTypeError under jit",
+                        )
+
+
+class U32CastChecker(Checker):
+    """u32-cast-missing: in ops/ modules, a function participating in the
+    M31 modular-hash contract (references M31 or calls fold31/addmod31/
+    mulmod31) does +, *, or << directly on a parameter that was never cast
+    with ``.astype(jnp.uint32)`` / ``jnp.uint32(...)`` — correct in CPU-test
+    int64, wrapping on real u32 device lanes."""
+
+    rules = (
+        RuleSpec(
+            "u32-cast-missing",
+            "warning",
+            "widening arithmetic (+ * <<) on an uncast parameter in an ops/ M31-contract function",
+        ),
+    )
+
+    _CONTRACT_CALLS = {"fold31", "addmod31", "mulmod31"}
+    _WIDENING = (ast.Add, ast.Mult, ast.LShift)
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if "/ops/" not in path and not path.startswith("ops/"):
+            return
+        for fn in [n for n in ast.walk(module.tree) if isinstance(n, ast.FunctionDef)]:
+            if not self._in_contract(fn):
+                continue
+            params = _param_names(fn) - _int_annotated(fn)
+            recast = self._recast_params(fn)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.BinOp) and isinstance(node.op, self._WIDENING)):
+                    continue
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Name) and side.id in params and side.id not in recast:
+                        op = {"Add": "+", "Mult": "*", "LShift": "<<"}[type(node.op).__name__]
+                        yield self.finding(
+                            module,
+                            "u32-cast-missing",
+                            node,
+                            f"parameter {side.id!r} used in `{op}` in {fn.name!r} without an explicit jnp.uint32 cast "
+                            "(ops/u32.py overflow contract)",
+                        )
+
+    def _in_contract(self, fn: ast.FunctionDef) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == "M31":
+                return True
+            if isinstance(node, ast.Call) and dotted_name(node.func).split(".")[-1] in self._CONTRACT_CALLS:
+                return True
+        return False
+
+    @staticmethod
+    def _recast_params(fn: ast.FunctionDef) -> Set[str]:
+        """Params rebound as ``p = p.astype(jnp.uint32)`` / ``p = jnp.uint32(p)``."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            tgt_names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+            func = node.value.func
+            if isinstance(func, ast.Attribute) and func.attr == "astype":
+                args_u32 = any("uint32" in dotted_name(a) for a in node.value.args)
+                if args_u32 and isinstance(func.value, ast.Name) and func.value.id in tgt_names:
+                    out |= tgt_names
+            elif dotted_name(func).endswith("uint32") and node.value.args:
+                arg = node.value.args[0]
+                if isinstance(arg, ast.Name) and arg.id in tgt_names:
+                    out |= tgt_names
+        return out
+
+
+TRACER_CHECKERS: Tuple[type, ...] = (JitPurityChecker, JitHostSyncChecker, U32CastChecker)

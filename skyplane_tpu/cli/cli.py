@@ -118,6 +118,18 @@ def cloud_rm(path, recursive):
     _run_cloud_cmd(run_rm, path, recursive)
 
 
+@main.command(context_settings={"ignore_unknown_options": True})
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def lint(args):
+    """Concurrency + tracer-safety lint (same pass the tier-1 gate runs).
+
+    Forwards to `python -m skyplane_tpu.analysis`; try `lint --list-rules`
+    or `lint skyplane_tpu --json findings.json`."""
+    from skyplane_tpu.analysis.__main__ import main as lint_main
+
+    sys.exit(lint_main(list(args)))
+
+
 @main.command()
 @click.option("--index", default=0, help="gateway index to connect to")
 def ssh(index):

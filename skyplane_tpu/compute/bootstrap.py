@@ -88,6 +88,7 @@ def build_wheel() -> Path:
             ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so", "*.o"),
         )
         out_dir = Path(tempfile.mkdtemp(prefix="skyplane_tpu_wheel_"))
+        # sklint: disable=blocking-under-lock -- _bundle_lock exists to serialize this one-shot wheel build; waiters need its result
         proc = subprocess.run(
             [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-build-isolation", "-q",
              str(stage), "-w", str(out_dir)],

@@ -89,13 +89,20 @@ class SCPClient:
         resp.raise_for_status()
         return resp.json() if resp.content else {}
 
-    @staticmethod
-    def _trace(method: str, path: str, json_body: Optional[dict], resp) -> None:
+    #: rotate the trace once it exceeds this (one .1 generation is kept, so
+    #: worst-case disk use is 2x the cap — an unattended field run with
+    #: tracing left on cannot fill the disk)
+    TRACE_MAX_BYTES = 16 << 20
+
+    @classmethod
+    def _trace(cls, method: str, path: str, json_body: Optional[dict], resp) -> None:
         """Record/replay capture (SKYPLANE_TPU_HTTP_TRACE=1): each call's
         request/response pair appends to ~/.skyplane_tpu/scp_trace.jsonl so a
         field run (docs/field_validation.md) can be turned into stub-test
         fixtures. Secrets never land in the trace (headers are omitted; the
-        signature is derived, not reusable beyond its timestamp)."""
+        signature is derived, not reusable beyond its timestamp), but request
+        and response BODIES do — so like every other file under the config
+        root the trace is 0600, and it is size-capped (ADVICE r5)."""
         if os.environ.get("SKYPLANE_TPU_HTTP_TRACE") != "1":
             return
         try:
@@ -111,7 +118,16 @@ class SCPClient:
             }
             path_out = Path(config_root) / "scp_trace.jsonl"
             path_out.parent.mkdir(parents=True, exist_ok=True)
-            with open(path_out, "a") as f:
+            try:
+                if path_out.stat().st_size >= cls.TRACE_MAX_BYTES:
+                    os.replace(path_out, path_out.with_suffix(".jsonl.1"))
+            except OSError:
+                pass  # no trace file yet
+            # O_APPEND + explicit 0600 (mode on os.open only applies at
+            # creation; fchmod also tightens a pre-existing loose file)
+            fd = os.open(path_out, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600)
+            os.fchmod(fd, 0o600)
+            with os.fdopen(fd, "a") as f:
                 f.write(json.dumps(record, default=str) + "\n")
         except Exception:  # noqa: BLE001 — tracing must never break a live call
             pass

@@ -105,7 +105,12 @@ class SCPInterface(S3Interface):
         # the reference download loop retries bare Exception (ref :359); we
         # narrow that to endpoint/transport errors plus read-after-write 404s
         # (NoSuchObjectException) — retrying a programming error (TypeError,
-        # ImportError) 10x would only delay the real traceback
+        # ImportError) 10x would only delay the real traceback. Transport
+        # errors are ConnectionError/socket.timeout ONLY, not plain OSError:
+        # a local file error writing the downloaded chunk (ENOSPC, EACCES)
+        # must raise immediately, matching the upload path's contract.
+        import socket
+
         import botocore.exceptions
 
         from skyplane_tpu.exceptions import NoSuchObjectException
@@ -114,7 +119,8 @@ class SCPInterface(S3Interface):
             botocore.exceptions.BotoCoreError,
             botocore.exceptions.ClientError,
             NoSuchObjectException,
-            OSError,
+            ConnectionError,
+            socket.timeout,
         )
         return self._retry_data(super().download_object, transient, *args, **kwargs)
 

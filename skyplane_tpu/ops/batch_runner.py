@@ -77,6 +77,14 @@ class DeviceBatchRunner:
         if not math.isfinite(max_wait_ms) or max_wait_ms < 0:
             max_wait_ms = 3.0
         self.max_wait_s = min(max_wait_ms, 5000.0) / 1000.0
+        # hard ceiling on the leader's window-deferral loop (ADVICE r5): the
+        # "keep the window open while the previous batch runs" optimization
+        # assumes the in-flight batch finishes. If a fused call wedges,
+        # _in_flight never returns to 0 and the leader would busy-poll
+        # forever, never reaching the 600s entry.done backstop that protects
+        # every other waiter. Past the ceiling the leader flushes anyway, so
+        # a wedged device batch surfaces as the existing TimeoutError.
+        self.defer_ceiling_s = max(100.0 * self.max_wait_s, 120.0)
         self._lock = threading.Lock()
         self._open: Dict[int, List[_Entry]] = {}  # bucket size -> entries of the open window
         # batches currently executing, PER BUCKET: a lone chunk's timed flush
@@ -174,6 +182,8 @@ class DeviceBatchRunner:
             import time
 
             deadline = time.monotonic() + self.max_wait_s
+            hard_deadline = deadline + self.defer_ceiling_s
+            ceiling_flush = False
             while True:
                 time.sleep(min(self.max_wait_s, 0.01) or 0.001)
                 with self._lock:
@@ -182,12 +192,25 @@ class DeviceBatchRunner:
                     # flush (identity check: _Entry has eq=False by design)
                     if not any(e is entry for e in group_now):
                         break
-                    if time.monotonic() >= deadline and self._in_flight.get(bucket, 0) == 0:
+                    now = time.monotonic()
+                    if now >= deadline and (self._in_flight.get(bucket, 0) == 0 or now >= hard_deadline):
+                        ceiling_flush = now >= hard_deadline and self._in_flight.get(bucket, 0) > 0
                         self._open[bucket] = []
                         to_run = group_now
                         break
             if to_run is not None:
-                self._run_batch(to_run)
+                if ceiling_flush:
+                    # the previous batch blew the ceiling and may be wedged
+                    # inside a hung fused call; a synchronous _run_batch here
+                    # would wedge the LEADER in the device FIFO too. Run on a
+                    # helper thread so the leader falls through to its own
+                    # entry.done backstop and raises TimeoutError like every
+                    # other waiter.
+                    threading.Thread(
+                        target=self._run_batch, args=(to_run,), name="batch-ceiling-flush", daemon=True
+                    ).start()
+                else:
+                    self._run_batch(to_run)
         entry.done.wait(timeout=600)
         if not entry.done.is_set():
             raise TimeoutError("device batch runner stalled")

@@ -66,7 +66,7 @@ def do_parallel(
             for fut in as_completed(futures):
                 try:
                     results.append(fut.result())
-                except BaseException as e:  # noqa: BLE001 - re-raised below
+                except BaseException as e:  # noqa: BLE001  # sklint: disable=bare-except-in-loop -- first_exc is re-raised after the drain loop
                     if first_exc is None:
                         first_exc = e
         finally:

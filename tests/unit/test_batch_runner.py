@@ -148,6 +148,31 @@ def test_mesh_axis_selection_bounds_window_inflation():
     assert runner2.mesh is None and runner2.max_batch == 1
 
 
+def test_wedged_in_flight_batch_does_not_defer_leader_forever():
+    """ADVICE r5: the leader's window-deferral loop must have a hard ceiling.
+    With a same-bucket batch permanently 'in flight' (wedged fused call), the
+    leader used to busy-poll forever, never reaching the 600s entry.done
+    backstop; now it flushes at defer_ceiling_s and completes."""
+    import time
+
+    runner = DeviceBatchRunner(cdc_params=PARAMS, max_batch=8, max_wait_ms=10.0)
+    chunk = _chunk(0, n=70_000)
+    runner.cdc_and_fps(chunk, _pad(chunk))  # warm kernels (compile off the clock)
+    # simulate a wedged in-flight batch for this bucket: the counter never
+    # returns to 0 (a hung fused call holds it in _run_batch's try body)
+    bucket = len(_pad(chunk))
+    with runner._lock:
+        runner._in_flight[bucket] = 1
+    runner.defer_ceiling_s = 0.3
+    t0 = time.perf_counter()
+    ends, fps = runner.cdc_and_fps(chunk, _pad(chunk))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 30, f"leader still deferring {elapsed:.1f}s past the hard ceiling"
+    want_ends, want_fps = _expected(chunk)
+    np.testing.assert_array_equal(ends, want_ends)
+    assert fps == want_fps
+
+
 @pytest.mark.parametrize("raw", ["inf", "nan", "-5", "1e12", "bogus"])
 def test_batch_wait_env_rejects_nonfinite_and_clamps(monkeypatch, raw):
     """ADVICE r2: a typo'd SKYPLANE_TPU_BATCH_WAIT_MS (inf/nan/huge) must not

@@ -397,6 +397,43 @@ def test_scp_teardown_region_sweeps_network(scp):
     assert kinds.index("subnet") < kinds.index("vpc") and kinds.index("internet-gateway") < kinds.index("vpc")
 
 
+def test_scp_http_trace_is_0600_and_rotates(monkeypatch, tmp_path):
+    """SKYPLANE_TPU_HTTP_TRACE writes API request/response BODIES: the file
+    must be 0600 like every other file under the config root, and must
+    rotate at the size cap instead of appending unbounded (ADVICE r5)."""
+    import os
+    import stat
+
+    from skyplane_tpu.compute.scp import scp_cloud_provider as mod
+
+    monkeypatch.setenv("SKYPLANE_TPU_HTTP_TRACE", "1")
+    monkeypatch.setattr("skyplane_tpu.config_paths.config_root", tmp_path)
+
+    class FakeResp:
+        status_code = 200
+        content = b"{}"
+
+        def json(self):
+            return {}
+
+    trace = tmp_path / "scp_trace.jsonl"
+    mod.SCPClient._trace("GET", "/x", None, FakeResp())
+    assert trace.exists()
+    assert stat.S_IMODE(os.stat(trace).st_mode) == 0o600
+    # a pre-existing loose-permission trace is tightened on the next append
+    os.chmod(trace, 0o644)
+    mod.SCPClient._trace("GET", "/y", None, FakeResp())
+    assert stat.S_IMODE(os.stat(trace).st_mode) == 0o600
+    assert len(trace.read_text().splitlines()) == 2
+    # over the cap: current file rotates to .1 and a fresh one starts
+    monkeypatch.setattr(mod.SCPClient, "TRACE_MAX_BYTES", 64)
+    mod.SCPClient._trace("GET", "/z", None, FakeResp())
+    rotated = tmp_path / "scp_trace.jsonl.1"
+    assert rotated.exists() and len(rotated.read_text().splitlines()) == 2
+    assert len(trace.read_text().splitlines()) == 1  # only the post-rotate record
+    assert stat.S_IMODE(os.stat(trace).st_mode) == 0o600
+
+
 def test_scp_object_data_retry_and_uploadid_strip(monkeypatch):
     """SCP OBS endpoint quirks (reference scp_interface.py:324-369, :413,
     :419-433): download retries broadly, upload retries client errors
@@ -432,12 +469,26 @@ def test_scp_object_data_retry_and_uploadid_strip(monkeypatch):
     def flaky_download(*a, **k):
         attempts["n"] += 1
         if attempts["n"] < 3:
-            raise OSError("connection reset by OBS")
+            raise ConnectionResetError("connection reset by OBS")
         return "mime"
 
     monkeypatch.setattr(S3Interface, "download_object", flaky_download)
     assert iface.download_object("k", "/tmp/x") == "mime"
     assert attempts["n"] == 3  # two transient failures absorbed
+
+    # download: plain OSError is a LOCAL file error (ENOSPC writing the
+    # chunk), not endpoint flakiness — it must propagate on the first
+    # attempt, matching the upload path's contract (ADVICE r5)
+    def disk_full(*a, **k):
+        attempts["n"] += 1
+        raise OSError(28, "No space left on device")
+
+    attempts["n"] = 0
+    monkeypatch.setattr(S3Interface, "download_object", disk_full)
+    with pytest.raises(OSError) as exc_info:
+        iface.download_object("k", "/tmp/x")
+    assert exc_info.value.errno == 28
+    assert attempts["n"] == 1  # no 10x1s retry delaying the real traceback
 
     # upload: a transiently corrupted part (checksum mismatch) heals on retry
     def corrupt_then_ok(*a, **k):
