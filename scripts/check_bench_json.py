@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Validate a bench.py output file: exactly one well-formed JSON result line
+with the full perf-counter schema (docs/datapath-performance.md).
+
+Exit 0 iff the result parses and every required key is present; used by the
+bench-smoke step in scripts/devloop.sh so a counter-schema regression is
+caught in seconds on CPU, not after a multi-hour accelerator bench run.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "platform", "datapath_counters")
+REQUIRED_COUNTERS = (
+    "pool_hit_rate",
+    "pool_hits",
+    "pool_misses",
+    "batch_windows",
+    "batch_occupancy",
+    "batch_padded_rows",
+    "device_wait_ns",
+    "donated_batches",
+    "stage_failures",
+)
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: check_bench_json.py <bench-output-file>", file=sys.stderr)
+        return 2
+    try:
+        lines = [ln for ln in open(argv[1]).read().splitlines() if ln.strip()]
+    except OSError as e:
+        print(f"bench-smoke: cannot read output: {e}", file=sys.stderr)
+        return 1
+    if not lines:
+        print("bench-smoke: bench.py produced no output line", file=sys.stderr)
+        return 1
+    results = []
+    for ln in lines:
+        try:
+            parsed = json.loads(ln)
+        except json.JSONDecodeError:
+            print(f"bench-smoke: non-JSON stdout line: {ln[:200]!r}", file=sys.stderr)
+            return 1
+        if isinstance(parsed, dict) and "metric" in parsed:
+            results.append(parsed)
+    if len(results) != 1:
+        print(f"bench-smoke: expected exactly ONE result line, found {len(results)}", file=sys.stderr)
+        return 1
+    result = results[0]
+    missing = [k for k in REQUIRED_TOP if k not in result]
+    counters = result.get("datapath_counters")
+    if not isinstance(counters, dict):
+        missing.append("datapath_counters(dict)")
+    else:
+        missing += [f"datapath_counters.{k}" for k in REQUIRED_COUNTERS if k not in counters]
+    if missing:
+        print(f"bench-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    if not isinstance(result["value"], (int, float)) or result["value"] <= 0:
+        print(f"bench-smoke: implausible throughput value {result['value']!r}", file=sys.stderr)
+        return 1
+    print(f"bench-smoke OK: {result['value']} {result['unit']} on {result['platform']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -31,6 +31,25 @@ else
   echo "[devloop] lint clean; report at $LOGDIR/lint_findings.json" >>"$LOGDIR/devloop.log"
 fi
 
+# Bench-smoke gate (CPU-only, seconds): bench.py on a tiny corpus, then
+# validate the JSON result line and the perf-counter schema
+# (docs/datapath-performance.md). Catches a malformed result or a dropped
+# counter key BEFORE a multi-hour real bench run discovers it. Like lint:
+# failures are logged LOUDLY but do not block device profiling.
+SKYPLANE_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu \
+  SKYPLANE_BENCH_CHUNK_MB=1 SKYPLANE_BENCH_SNAPSHOTS=2 SKYPLANE_BENCH_SNAP_CHUNKS=2 SKYPLANE_BENCH_REPS=1 \
+  python bench.py >"$LOGDIR/bench_smoke.out" 2>"$LOGDIR/bench_smoke.err"
+BENCH_RC=$?
+if [ "$BENCH_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/bench_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  BENCH_RC=$?
+fi
+if [ "$BENCH_RC" -ne 0 ]; then
+  echo "[devloop] BENCH-SMOKE FAILURE (rc=$BENCH_RC) — bench.py output malformed or counter keys missing; see $LOGDIR/bench_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] bench-smoke clean; result at $LOGDIR/bench_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only
   # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
   # acquired but crashed mid-profile must be retried, not recorded

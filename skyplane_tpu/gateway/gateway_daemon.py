@@ -227,13 +227,30 @@ class GatewayDaemon:
         return events
 
     def _compression_stats(self) -> dict:
-        agg = {"chunks": 0, "raw_bytes": 0, "wire_bytes": 0, "segments": 0, "ref_segments": 0}
+        from skyplane_tpu.ops.pipeline import DataPathStats
+
+        agg = {"chunks": 0, "raw_bytes": 0, "wire_bytes": 0, "segments": 0, "ref_segments": 0, "device_wait_ns": 0}
+        hot_path = dict(DataPathStats.EXTERNAL_ZERO)  # pool / batch / donation counters
         for op in self.operators:
             if isinstance(op, GatewaySenderOperator):
                 d = op.processor.stats.as_dict()
                 for k in agg:
                     agg[k] += d.get(k, 0)
+                if self.batch_runner is None:
+                    # per-processor pools: summing is correct (nothing shared);
+                    # derived ratios are recomputed from the summed counts below
+                    for k in hot_path:
+                        if k not in ("pool_hit_rate", "batch_occupancy"):
+                            hot_path[k] = hot_path.get(k, 0) + d.get(k, 0)
+        if self.batch_runner is None:
+            lookups = hot_path["pool_hits"] + hot_path["pool_misses"]
+            hot_path["pool_hit_rate"] = round(hot_path["pool_hits"] / lookups, 4) if lookups else 0.0
+        if self.batch_runner is not None:
+            # ONE runner (and pool) shared by every sender operator: read its
+            # counters once — summing each operator's copy would multiply them
+            hot_path.update(self.batch_runner.counters())
         agg["compression_ratio"] = (agg["raw_bytes"] / agg["wire_bytes"]) if agg["wire_bytes"] else 1.0
+        agg.update(hot_path)
         return agg
 
     def _build_operators(self, program: dict) -> None:

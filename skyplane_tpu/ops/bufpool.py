@@ -1,0 +1,188 @@
+"""Bucket-keyed pool of reusable padded host buffers for the sender data path.
+
+Every chunk the gateway processes on an accelerator is padded to a
+power-of-two bucket before upload (ops/pipeline.py); allocating a fresh
+zero-filled bucket per chunk costs an ``np.zeros`` + copy of up to 64 MiB on
+the hot path, and the freed pages bounce through the allocator under 16-32
+concurrent workers. This pool recycles those buffers: steady-state traffic
+reuses the same handful of buckets, so per-chunk host allocation drops to
+zero after warmup (the ``misses`` counter stops moving — asserted in
+tests/unit/test_bufpool.py).
+
+Ownership contract:
+
+  * ``acquire(bucket)`` returns a writable uint8 buffer of exactly ``bucket``
+    bytes with ARBITRARY contents — the caller must overwrite ``[:n]`` and
+    zero ``[n:]`` itself (zeroing only the tail is cheaper than np.zeros).
+  * ``release(buf)`` recycles a buffer previously returned by ``acquire``.
+    Foreign buffers (anything the pool did not issue — e.g. a caller-owned
+    array passed through the same code path) are ignored, so a release can
+    never alias caller memory into another chunk's buffer.
+  * Leak-proof by construction: an acquired buffer that is never released is
+    simply garbage-collected once the caller drops it; the pool tracks
+    outstanding buffers in a bounded map and forgets the oldest entries past
+    the cap, so even a pathological leak cannot grow pool state unboundedly.
+
+Scratch arrays (``acquire_scratch``) extend the same recycling to the small
+per-batch metadata buffers (packed candidate readback targets, fingerprint
+end-offset uploads) keyed by (shape, dtype).
+
+Thread safety: one mutex around the free lists and counters. Critical
+sections are a few dict operations — far below the numpy copies they guard,
+and uncontended relative to the single big locks this PR shards elsewhere.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+MIN_BUCKET = 1 << 16  # 64 KiB — smallest padded upload worth a device dispatch
+
+
+def bucket_size(n: int) -> int:
+    """Power-of-two bucket for an ``n``-byte chunk, floored at MIN_BUCKET.
+
+    ``(n - 1).bit_length()`` is the exact ceil-log2 — one int op per chunk
+    instead of the former shift loop (up to 10 iterations at 64 MiB).
+    """
+    if n <= MIN_BUCKET:
+        return MIN_BUCKET
+    return 1 << (n - 1).bit_length()
+
+
+class BufferPool:
+    def __init__(
+        self,
+        max_per_bucket: int = 32,
+        max_total_bytes: int = 4 << 30,
+        max_outstanding_tracked: int = 4096,
+    ):
+        # free lists: bucket size -> LIFO of idle buffers (LIFO keeps the
+        # cache-warm buffer on top). OrderedDict over buckets gives LRU
+        # eviction when bucket sizes churn and the byte bound bites.
+        self._free: "OrderedDict[int, List[np.ndarray]]" = OrderedDict()
+        self._free_bytes = 0
+        self._max_per_bucket = max(1, int(max_per_bucket))
+        self._max_total_bytes = max(0, int(max_total_bytes))
+        # buffers issued and not yet released, id -> array. Holding the array
+        # keeps its id stable (no reuse by a new allocation); the bound drops
+        # the OLDEST tracked entries so a leaking caller degrades to plain
+        # allocation instead of growing this map forever.
+        self._outstanding: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._max_outstanding = max(1, int(max_outstanding_tracked))
+        self._scratch: Dict[Tuple[Tuple[int, ...], str], List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._recycled = 0
+        self._dropped = 0
+        self._evicted_bytes = 0
+
+    # ---- padded bucket buffers ----
+
+    def acquire(self, bucket: int) -> np.ndarray:
+        """A writable uint8 buffer of ``bucket`` bytes (contents arbitrary)."""
+        with self._lock:
+            free = self._free.get(bucket)
+            if free:
+                buf = free.pop()
+                self._free_bytes -= bucket
+                self._free.move_to_end(bucket)  # this bucket is hot
+                self._hits += 1
+                self._track_outstanding(buf)
+                return buf
+            self._misses += 1
+        buf = np.empty(bucket, np.uint8)  # fallback: fresh allocation (off-lock)
+        with self._lock:
+            self._track_outstanding(buf)
+        return buf
+
+    def release(self, buf: np.ndarray) -> None:
+        """Recycle a pool-issued buffer; silently ignores foreign buffers."""
+        with self._lock:
+            if self._outstanding.pop(id(buf), None) is None:
+                return  # not ours (caller-owned padded array, or already leaked out)
+            bucket = len(buf)
+            free = self._free.setdefault(bucket, [])
+            if len(free) >= self._max_per_bucket:
+                self._dropped += 1
+                return
+            free.append(buf)
+            self._free_bytes += bucket
+            self._free.move_to_end(bucket)
+            self._recycled += 1
+            self._evict_lru_buckets()
+
+    def _track_outstanding(self, buf: np.ndarray) -> None:
+        """Lock held. Remember an issued buffer, bounding the map."""
+        self._outstanding[id(buf)] = buf
+        while len(self._outstanding) > self._max_outstanding:
+            self._outstanding.popitem(last=False)  # oldest entry: treat as leaked
+
+    def _evict_lru_buckets(self) -> None:
+        """Lock held. Drop idle buffers of the least-recently-used bucket
+        sizes until the byte bound holds (bucket-size churn: a workload that
+        moved from 64 MiB to 8 MiB chunks must not pin the old giants)."""
+        while self._free_bytes > self._max_total_bytes and self._free:
+            bucket, free = next(iter(self._free.items()))
+            if free:
+                free.pop()
+                self._free_bytes -= bucket
+                self._evicted_bytes += bucket
+            if not free:
+                del self._free[bucket]
+
+    # ---- small per-batch scratch arrays ----
+
+    def acquire_scratch(self, shape: Tuple[int, ...], dtype) -> np.ndarray:
+        """A reusable scratch array (contents arbitrary) keyed by shape+dtype
+        — the per-batch metadata buffers (ends-slot uploads and readback
+        staging), a few KiB each, recycled the same way as bucket buffers."""
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            free = self._scratch.get(key)
+            if free:
+                self._hits += 1
+                arr = free.pop()
+                self._track_outstanding(arr)
+                return arr
+            self._misses += 1
+        arr = np.empty(shape, dtype)
+        with self._lock:
+            self._track_outstanding(arr)
+        return arr
+
+    def release_scratch(self, arr: np.ndarray) -> None:
+        """Recycle a pool-issued scratch array; same foreign/double-release
+        protection as release() — anything the pool did not issue (or already
+        took back) is ignored, never aliased into another batch."""
+        with self._lock:
+            if self._outstanding.pop(id(arr), None) is None:
+                return
+            key = (tuple(arr.shape), arr.dtype.str)
+            free = self._scratch.setdefault(key, [])
+            if len(free) < self._max_per_bucket:
+                free.append(arr)
+                self._recycled += 1
+            else:
+                self._dropped += 1
+
+    # ---- introspection ----
+
+    def counters(self) -> dict:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "pool_hits": self._hits,
+                "pool_misses": self._misses,
+                "pool_hit_rate": round(self._hits / total, 4) if total else 0.0,
+                "pool_recycled": self._recycled,
+                "pool_dropped": self._dropped,
+                "pool_evicted_bytes": self._evicted_bytes,
+                "pool_idle_bytes": self._free_bytes,
+                "pool_outstanding": len(self._outstanding),
+            }

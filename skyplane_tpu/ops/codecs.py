@@ -93,7 +93,15 @@ def _decode_zstd(buf: bytes) -> bytes:
             raise CodecException("zstd frame does not declare content size (rejected)")
         if params.content_size > MAX_CHUNK_BYTES:
             raise CodecException(f"zstd frame claims {params.content_size} bytes (> {MAX_CHUNK_BYTES} cap)")
-        return zstd.ZstdDecompressor().decompress(buf)
+        # decompressor cached per worker thread (same discipline as the
+        # encoder above): constructing a ZstdDecompressor per chunk puts an
+        # allocation + context setup on the receiver hot path for nothing —
+        # decompression state is reset per frame anyway
+        decomp = getattr(_codec_local, "zstd_decompressor", None)
+        if decomp is None:
+            decomp = zstd.ZstdDecompressor()
+            _codec_local.zstd_decompressor = decomp
+        return decomp.decompress(buf)
     except zstd.ZstdError as e:
         raise CodecException(f"zstd decode failed (corrupt frame): {e}") from e
 

@@ -39,6 +39,17 @@ KIND_REF = 0
 KIND_LIT = 1
 
 
+class _IndexStripe:
+    """One lock + one recency-ordered fp map of a striped SenderDedupIndex."""
+
+    __slots__ = ("lock", "lru", "bytes")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.lru: "OrderedDict[bytes, Tuple[int, int]]" = OrderedDict()  # fp -> (size, last-touch seq)
+        self.bytes = 0
+
+
 class SenderDedupIndex:
     """Bounded LRU of fingerprints known to be resident at one destination.
 
@@ -46,51 +57,108 @@ class SenderDedupIndex:
     receiver-side SegmentStore capacity (mem + spill): a sender REF to a
     segment the receiver has already evicted is an unrecoverable
     DedupIntegrityException. Default 16 GiB vs the receiver's 4+32 GiB.
+
+    Hot-path striping: ``__contains__`` runs once per SEGMENT per chunk from
+    every sender worker (build_recipe), so a single mutex here serializes
+    the whole pool. Lookups/inserts lock only the stripe selected by the
+    fingerprint's first byte (blake2b output — uniform). Global recency is
+    kept via a monotonic touch sequence per entry, so eviction still removes
+    the globally least-recently-used fingerprint (each stripe's head is its
+    oldest; the evictor picks the minimum-seq head across stripes) and the
+    strictly-below-receiver-capacity bound stays a GLOBAL byte bound, not a
+    per-stripe approximation. Under concurrent touches eviction is
+    approximately-LRU (a head touched between peek and pop may be evicted one
+    slot early) — always the SAFE direction: evicting keeps refs resolvable,
+    only over-retention can break them.
     """
 
-    def __init__(self, max_bytes: int = 16 << 30):
-        self._lru: "OrderedDict[bytes, int]" = OrderedDict()  # fp -> segment size
+    def __init__(self, max_bytes: int = 16 << 30, stripes: int = 16):
+        import itertools
+
+        n = 1
+        while n < max(1, int(stripes)):
+            n <<= 1
+        self._stripes = [_IndexStripe() for _ in range(n)]
+        self._mask = n - 1
+        self._seq = itertools.count()  # itertools.count: GIL-atomic next()
+        self._budget_lock = threading.Lock()  # guards the global byte total
         self._max_bytes = max_bytes
         self._bytes = 0
-        self._lock = threading.Lock()
+
+    def _stripe(self, fp: bytes) -> _IndexStripe:
+        return self._stripes[fp[0] & self._mask]
 
     def __contains__(self, fp: bytes) -> bool:
-        with self._lock:
-            if fp in self._lru:
-                self._lru.move_to_end(fp)
-                return True
-            return False
+        s = self._stripe(fp)
+        with s.lock:
+            entry = s.lru.get(fp)
+            if entry is None:
+                return False
+            s.lru[fp] = (entry[0], next(self._seq))
+            s.lru.move_to_end(fp)
+            return True
 
     def add(self, fp: bytes, size: int = 0) -> None:
-        with self._lock:
-            if fp in self._lru:
-                self._lru.move_to_end(fp)
+        s = self._stripe(fp)
+        with s.lock:
+            entry = s.lru.get(fp)
+            if entry is not None:
+                s.lru[fp] = (entry[0], next(self._seq))
+                s.lru.move_to_end(fp)
                 return
-            self._lru[fp] = size
+            s.lru[fp] = (size, next(self._seq))
+            s.bytes += size
+        with self._budget_lock:
             self._bytes += size
-            while self._bytes > self._max_bytes and self._lru:
-                _, old_size = self._lru.popitem(last=False)
-                self._bytes -= old_size
+        self._evict_to_budget()
 
     def __len__(self) -> int:
-        return len(self._lru)
+        return sum(len(s.lru) for s in self._stripes)
 
     def discard(self, fp: bytes) -> None:
         """Forget a fingerprint (receiver nacked an unresolvable REF to it)."""
-        with self._lock:
-            size = self._lru.pop(fp, None)
-            if size is not None:
-                self._bytes -= size
+        s = self._stripe(fp)
+        with s.lock:
+            entry = s.lru.pop(fp, None)
+            if entry is None:
+                return
+            s.bytes -= entry[0]
+        with self._budget_lock:
+            self._bytes -= entry[0]
 
     def set_max_bytes(self, max_bytes: int) -> None:
         """Rebound the index (multi-source capacity split: each sender takes a
         fair share of the receiver's advertised segment-store capacity).
         Shrinking evicts oldest entries immediately."""
-        with self._lock:
+        with self._budget_lock:
             self._max_bytes = max(1, int(max_bytes))
-            while self._bytes > self._max_bytes and self._lru:
-                _, old_size = self._lru.popitem(last=False)
-                self._bytes -= old_size
+        self._evict_to_budget()
+
+    def _evict_to_budget(self) -> None:
+        """Evict globally-oldest entries until the byte bound holds. Locks
+        are taken one stripe at a time (never nested), so the hot path stays
+        contention-free while an eviction sweep runs."""
+        while True:
+            with self._budget_lock:
+                if self._bytes <= self._max_bytes:
+                    return
+            victim: Optional[_IndexStripe] = None
+            victim_seq = None
+            for s in self._stripes:
+                with s.lock:
+                    if s.lru:
+                        _, (_, seq) = next(iter(s.lru.items()))
+                        if victim_seq is None or seq < victim_seq:
+                            victim, victim_seq = s, seq
+            if victim is None:
+                return  # nothing left to evict
+            with victim.lock:
+                if not victim.lru:
+                    continue  # raced with a discard; rescan
+                _, (size, _) = victim.lru.popitem(last=False)
+                victim.bytes -= size
+            with self._budget_lock:
+                self._bytes -= size
 
     @property
     def max_bytes(self) -> int:

@@ -25,7 +25,24 @@ every transfer tiny and every device op vectorized:
 
 The chunk batch is uploaded once and stays device-resident across both
 calls. Fingerprint slot counts are static per bucket (bucket/min_bytes + 2),
-so each bucket size compiles exactly two programs, ever.
+so each bucket size compiles at most three programs, ever (candidates,
+fingerprints, donated fingerprints).
+
+Overlap structure (``dispatch`` / ``PendingBatch``): boundary selection only
+needs call A, so ``dispatch`` returns as soon as call B is *enqueued* — the
+segment ends are already final while the fingerprint compute and readback
+are still in flight. DeviceBatchRunner uses this to wake its waiters in two
+phases (ends-ready, then fps-ready) so workers overlap recipe assembly with
+the device. ``__call__`` keeps the original blocking contract.
+
+HBM donation: when this driver owns the stacked device batch exclusively
+(per-row staged buffers restacked at flush, or a host-list stack it built
+itself), the batch is donated into call B (``donate_argnums``) — the last
+consumer — so XLA reuses its HBM for outputs/temps instead of holding two
+copies per in-flight window. Caller-provided contiguous [B, N] arrays are
+NEVER donated (the caller may reuse them; jax would also invalidate aliased
+buffers). Sharded (mesh) kernels are not donated either — resharding
+already copies, and shard_map donation semantics differ per backend.
 
 Overflow contract: candidate counts above the static compaction capacity
 (pathological data — ~8x the expected candidate density) are detected via
@@ -38,6 +55,7 @@ is the TPU-native data-path addition (BASELINE.json north star).
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import List, Optional, Tuple
 
@@ -86,8 +104,7 @@ def _candidates_impl(batch: jax.Array, lens: jax.Array, *, mask_bits: int, cap: 
     return jax.vmap(one)(batch, lens)
 
 
-@partial(jax.jit, static_argnames=("n_slots",))
-def _fp_impl(batch: jax.Array, ends_slots: jax.Array, *, n_slots: int):
+def _fp_body(batch: jax.Array, ends_slots: jax.Array, *, n_slots: int):
     """[B, bucket] u8 + [B, n_slots] i32 end offsets -> [B, n_slots, 8] u32.
 
     ends_slots rows: ascending real segment ends (last == chunk length),
@@ -113,6 +130,12 @@ def _fp_impl(batch: jax.Array, ends_slots: jax.Array, *, n_slots: int):
     return jax.vmap(one)(batch, ends_slots)
 
 
+# two jitted variants of the same trace: the donated one consumes its batch
+# argument (HBM reuse), the plain one leaves it valid for the caller
+_fp_impl = partial(jax.jit, static_argnames=("n_slots",))(_fp_body)
+_fp_impl_donated = partial(jax.jit, static_argnames=("n_slots",), donate_argnums=(0,))(_fp_body)
+
+
 def _host_exact(arr: np.ndarray, params: CDCParams) -> Tuple[np.ndarray, List[bytes]]:
     """Exact host recompute for overflow rows (pathological candidate
     density): the plain host CDC+fingerprint pipeline, which materializes
@@ -124,6 +147,49 @@ def _host_exact(arr: np.ndarray, params: CDCParams) -> Tuple[np.ndarray, List[by
     return ends, segment_fingerprints_host_batch(arr, ends)
 
 
+def finalize_row(lanes_row: np.ndarray, ends: np.ndarray) -> List[bytes]:
+    """Per-row digest finalization ([n_slots, 8] u32 lanes -> 16-byte
+    digests). Module-level so workers can finalize their OWN row after the
+    batched readback instead of serializing the whole batch in the leader."""
+    starts = np.concatenate([[0], ends[:-1]])
+    return [bytes.fromhex(finalize_fingerprint(lanes_row[j], int(ends[j] - starts[j]))) for j in range(len(ends))]
+
+
+class PendingBatch:
+    """Phase split of one batched fused call: segment ends are final at
+    construction (call A + host selection done, call B enqueued); ``lanes()``
+    blocks on the fingerprint readback. Rows that overflowed the candidate
+    cap carry their complete exact result in ``fallback`` instead."""
+
+    def __init__(self, fused: "FusedCDCFP", b: int, ends_rows, fallback, lanes_dev, ends_scratch):
+        self._fused = fused
+        self.b = b
+        self.ends_rows = ends_rows  # per-row np ends, None for fallback rows
+        self.fallback = fallback  # per-row (ends, digests) or None
+        self._lanes_dev = lanes_dev
+        self._ends_scratch = ends_scratch
+        self._lanes: Optional[np.ndarray] = None
+
+    def lanes(self) -> np.ndarray:
+        """[B, n_slots, 8] fingerprint lanes — blocks until readback lands.
+        Idempotent; releases the per-batch scratch on first completion."""
+        if self._lanes is None:
+            self._lanes = np.asarray(self._lanes_dev)
+            self._lanes_dev = None
+            if self._ends_scratch is not None:
+                # safe to recycle only now: the upload backing this scratch is
+                # consumed once the kernel that read it has produced output
+                self._fused.release_scratch(self._ends_scratch)
+                self._ends_scratch = None
+        return self._lanes
+
+    def result_row(self, i: int) -> Tuple[np.ndarray, List[bytes]]:
+        if self.fallback[i] is not None:
+            return self.fallback[i]
+        ends = self.ends_rows[i]
+        return ends, finalize_row(self.lanes()[i], ends)
+
+
 class FusedCDCFP:
     """Host-side driver for the batched CDC+fingerprint device steps over
     padded same-bucket rows.
@@ -131,9 +197,18 @@ class FusedCDCFP:
     ``__call__`` takes a [B, bucket] uint8 batch (rows zero-padded) and the
     true lengths, and returns per-row (segment ends, 16-byte digests) —
     bit-identical to ``cdc_segment_ends`` + ``segment_fingerprints_host_batch``.
+    ``dispatch`` exposes the two-phase form (see PendingBatch).
     """
 
-    def __init__(self, params: CDCParams, pallas: Optional[bool] = None, mesh=None, shard_axes=None):
+    def __init__(
+        self,
+        params: CDCParams,
+        pallas: Optional[bool] = None,
+        mesh=None,
+        shard_axes=None,
+        pool=None,
+        donate: Optional[bool] = None,
+    ):
         self.params = params
         if pallas is None:
             from skyplane_tpu.ops.backend import on_accelerator
@@ -143,7 +218,26 @@ class FusedCDCFP:
         self.pallas = bool(pallas)
         self.mesh = mesh
         self.shard_axes = tuple(shard_axes) if shard_axes else (tuple(mesh.shape.keys()) if mesh is not None else None)
+        self.pool = pool  # optional BufferPool for per-batch scratch reuse
+        if donate is None:
+            import os
+
+            env = os.environ.get("SKYPLANE_TPU_DONATE", "auto").strip().lower()
+            if env in ("0", "false", "off"):
+                donate = False
+            elif env in ("1", "true", "on"):
+                donate = True
+            else:
+                # auto: donation reuses HBM on accelerators; XLA-CPU cannot
+                # alias the batch into the smaller fp output and would warn
+                # 'donated buffers were not usable' on every compile
+                from skyplane_tpu.ops.backend import on_accelerator
+
+                donate = on_accelerator()
+        self.donate = bool(donate)
         self._sharded = {}  # bucket -> (candidates_fn, fp_fn)
+        self._stats_lock = threading.Lock()
+        self._donated_batches = 0
 
     def _kernels(self, bucket: int):
         cap = candidate_cap(bucket, self.params)
@@ -168,26 +262,39 @@ class FusedCDCFP:
         leader stacks device buffers instead of copying 64 MiB on host."""
         return jax.device_put(padded)
 
-    def __call__(
-        self, batch, lens, dev_rows: Optional[List[jax.Array]] = None
-    ) -> List[Tuple[np.ndarray, List[bytes]]]:
-        """``batch``: [B, bucket] uint8 (rows zero-padded) — or a list of B
-        1-D host rows, which avoids materializing the stacked host copy when
+    def release_scratch(self, arr: np.ndarray) -> None:
+        if self.pool is not None:
+            self.pool.release_scratch(arr)
+
+    def counters(self) -> dict:
+        with self._stats_lock:
+            return {"donated_batches": self._donated_batches}
+
+    def dispatch(self, batch, lens, dev_rows: Optional[List[jax.Array]] = None) -> PendingBatch:
+        """Run call A + host boundary selection and ENQUEUE call B.
+
+        ``batch``: [B, bucket] uint8 (rows zero-padded) — or a list of B 1-D
+        host rows, which avoids materializing the stacked host copy when
         ``dev_rows`` (pre-staged device buffers from :meth:`stage`) carry the
         actual compute input. Host rows are only touched on the rare
-        candidate-overflow fallback."""
+        candidate-overflow fallback. Segment ends are FINAL in the returned
+        PendingBatch; fingerprints land at ``lanes()``.
+        """
         if isinstance(batch, (list, tuple)):
             host_rows = list(batch)
             b, bucket = len(host_rows), len(host_rows[0])
+            owned = True  # we stack these ourselves below
         else:
             # already-contiguous 2D batch: row VIEWS only — no extra copy
             host_rows = [batch[i] for i in range(batch.shape[0])]
             b, bucket = batch.shape
+            owned = False  # the caller's array (or a jax alias of it): never donate
         cap = candidate_cap(bucket, self.params)
         n_slots = slots_cap(bucket, self.params)
         cand_fn, fp_fn = self._kernels(bucket)
         if dev_rows is not None:
             dev_batch = jnp.stack(dev_rows)  # device-side: rows uploaded at submit
+            owned = True
         elif isinstance(batch, (list, tuple)):
             dev_batch = jnp.asarray(np.stack(host_rows))  # uploaded once, shared by both calls
         else:
@@ -195,7 +302,12 @@ class FusedCDCFP:
         packed = np.asarray(cand_fn(dev_batch, jnp.asarray(np.asarray(lens, np.int32))))  # small fetch
         ends_rows: List[Optional[np.ndarray]] = []
         fallback: List[Optional[Tuple[np.ndarray, List[bytes]]]] = []
-        ends_slots = np.full((b, n_slots), bucket, np.int32)
+        if self.pool is not None:
+            ends_scratch = self.pool.acquire_scratch((b, n_slots), np.int32)
+            ends_scratch.fill(bucket)
+        else:
+            ends_scratch = None
+        ends_slots = ends_scratch if ends_scratch is not None else np.full((b, n_slots), bucket, np.int32)
         for i in range(b):
             n = int(lens[i])
             n_cand = int(packed[i, cap])
@@ -210,20 +322,19 @@ class FusedCDCFP:
             ends_slots[i, : len(ends)] = ends
             if n < bucket:  # one garbage end covering the zero padding
                 ends_slots[i, len(ends)] = bucket
-        lanes = np.asarray(fp_fn(dev_batch, jnp.asarray(ends_slots)))  # one fetch
-        out: List[Tuple[np.ndarray, List[bytes]]] = []
-        for i in range(b):
-            if fallback[i] is not None:
-                out.append(fallback[i])
-                continue
-            ends = ends_rows[i]
-            starts = np.concatenate([[0], ends[:-1]])
-            digests = [
-                bytes.fromhex(finalize_fingerprint(lanes[i, j], int(ends[j] - starts[j])))
-                for j in range(len(ends))
-            ]
-            out.append((ends, digests))
-        return out
+        if self.donate and owned and self.mesh is None:
+            lanes_dev = _fp_impl_donated(dev_batch, jnp.asarray(ends_slots), n_slots=n_slots)
+            with self._stats_lock:
+                self._donated_batches += 1
+        else:
+            lanes_dev = fp_fn(dev_batch, jnp.asarray(ends_slots))  # enqueued; readback deferred
+        return PendingBatch(self, b, ends_rows, fallback, lanes_dev, ends_scratch)
+
+    def __call__(
+        self, batch, lens, dev_rows: Optional[List[jax.Array]] = None
+    ) -> List[Tuple[np.ndarray, List[bytes]]]:
+        pending = self.dispatch(batch, lens, dev_rows=dev_rows)
+        return [pending.result_row(i) for i in range(pending.b)]
 
 
 def make_sharded_kernels(mesh, params: CDCParams, bucket: int, pallas: bool = False, shard_axes=None):
@@ -249,7 +360,7 @@ def make_sharded_kernels(mesh, params: CDCParams, bucket: int, pallas: bool = Fa
     )
     fp = jax.jit(
         jax.shard_map(
-            lambda b, e: _fp_impl(b, e, n_slots=n_slots),
+            lambda b, e: _fp_body(b, e, n_slots=n_slots),
             mesh=mesh,
             in_specs=(P(axes, None), P(axes, None)),
             out_specs=P(axes, None, None),

@@ -18,9 +18,10 @@ per chunk.
 from __future__ import annotations
 
 import hashlib
+import threading
 from dataclasses import dataclass, field
 from functools import partial
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 import jax
 import numpy as np
@@ -28,20 +29,16 @@ import numpy as np
 from skyplane_tpu.chunk import Codec, WireProtocolHeader
 from skyplane_tpu.exceptions import ChecksumMismatchException, CodecException
 from skyplane_tpu.ops import blockpack
+from skyplane_tpu.ops.bufpool import MIN_BUCKET, BufferPool, bucket_size
 from skyplane_tpu.ops.cdc import CDCParams, cdc_segment_ends
 from skyplane_tpu.ops.codecs import CodecSpec, get_codec, get_codec_by_id
 from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex, build_recipe, parse_recipe
 from skyplane_tpu.ops.fingerprint import fixed_stride_lanes
 from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
 
-MIN_BUCKET = 1 << 16  # 64 KiB
-
-
-def _bucket_size(n: int) -> int:
-    b = MIN_BUCKET
-    while b < n:
-        b <<= 1
-    return b
+# canonical home is ops/bufpool.py (the pool keys on it); kept under the old
+# name here because this is where every data-path caller historically looked
+_bucket_size = bucket_size
 
 
 @partial(jax.jit, static_argnames=("block_bytes", "fp_seg_bytes", "mask_bits", "_pallas_gear", "_pallas_fp"))
@@ -107,44 +104,91 @@ class ProcessedPayload:
     ref_fingerprints: list = field(default_factory=list)  # discard from index on unresolvable-ref nack
 
 
-@dataclass
 class DataPathStats:
     """Cumulative sender-side accounting (feeds /profile/compression).
 
-    observe() is called from every worker of an operator pool sharing one
-    processor, and numpy/zstd release the GIL mid-call — so updates take a
-    lock."""
+    observe() is called for EVERY chunk from every worker of an operator pool
+    sharing one processor; a single mutex here measurably serializes 16-32
+    workers whose actual work (numpy/zstd/XLA) releases the GIL. Counters are
+    therefore SHARDED per thread: each worker increments its own dict (plain
+    GIL-atomic int ops, no lock), and ``as_dict()`` merges the shards. The
+    merge may interleave with in-flight increments — each counter is
+    individually monotonic and exact once traffic quiesces, which is all a
+    monitoring surface needs; the old whole-snapshot consistency bought
+    nothing but contention.
 
-    chunks: int = 0
-    raw_bytes: int = 0
-    wire_bytes: int = 0
-    segments: int = 0
-    ref_segments: int = 0
+    External per-subsystem counters (buffer pool, batch runner, donation) are
+    merged in via registered source callables, with a zero-filled default set
+    so the key schema is stable whether or not those subsystems are active
+    (bench-smoke and dashboard queries rely on the keys always existing).
+    """
 
-    def __post_init__(self):
-        import threading
+    _KEYS = ("chunks", "raw_bytes", "wire_bytes", "segments", "ref_segments", "device_wait_ns")
+    EXTERNAL_ZERO = {
+        "pool_hits": 0,
+        "pool_misses": 0,
+        "pool_hit_rate": 0.0,
+        "pool_recycled": 0,
+        "pool_dropped": 0,
+        "pool_evicted_bytes": 0,
+        "pool_idle_bytes": 0,
+        "pool_outstanding": 0,
+        "batch_windows": 0,
+        "batch_rows": 0,
+        "batch_padded_rows": 0,
+        "batch_occupancy": 0.0,
+        "stage_failures": 0,
+        "donated_batches": 0,
+    }
 
-        self._lock = threading.Lock()
+    def __init__(self):
+        self._lock = threading.Lock()  # guards shard/source registries only
+        self._tls = threading.local()
+        self._shards: List[dict] = []
+        self._sources: List[Callable[[], dict]] = []
+
+    def _shard(self) -> dict:
+        d = getattr(self._tls, "counters", None)
+        if d is None:
+            d = {k: 0 for k in self._KEYS}
+            with self._lock:
+                self._shards.append(d)
+            self._tls.counters = d
+        return d
 
     def observe(self, p: ProcessedPayload) -> None:
+        d = self._shard()
+        d["chunks"] += 1
+        d["raw_bytes"] += p.raw_len
+        d["wire_bytes"] += len(p.wire_bytes)
+        d["segments"] += p.n_segments
+        d["ref_segments"] += p.n_ref_segments
+
+    def observe_device_wait(self, ns: int) -> None:
+        """Time this worker spent BLOCKED on the device (phase waits in the
+        batch runner) — the stall the overlap scheduling exists to hide."""
+        if ns:
+            self._shard()["device_wait_ns"] += int(ns)
+
+    def add_source(self, fn: Callable[[], dict]) -> None:
+        """Register an external counter provider merged into as_dict()."""
         with self._lock:
-            self.chunks += 1
-            self.raw_bytes += p.raw_len
-            self.wire_bytes += len(p.wire_bytes)
-            self.segments += p.n_segments
-            self.ref_segments += p.n_ref_segments
+            self._sources.append(fn)
 
     def as_dict(self) -> dict:
-        with self._lock:  # consistent snapshot vs concurrent observe()
-            ratio = self.raw_bytes / self.wire_bytes if self.wire_bytes else 1.0
-            return {
-                "chunks": self.chunks,
-                "raw_bytes": self.raw_bytes,
-                "wire_bytes": self.wire_bytes,
-                "compression_ratio": ratio,
-                "segments": self.segments,
-                "ref_segments": self.ref_segments,
-            }
+        with self._lock:
+            shards = list(self._shards)
+            sources = list(self._sources)
+        out = {k: 0 for k in self._KEYS}
+        for d in shards:
+            for k in self._KEYS:
+                out[k] += d[k]
+        out["compression_ratio"] = out["raw_bytes"] / out["wire_bytes"] if out["wire_bytes"] else 1.0
+        merged = dict(self.EXTERNAL_ZERO)
+        for fn in sources:
+            merged.update(fn())
+        out.update(merged)
+        return out
 
 
 def effective_codec_name(codec_name: str) -> str:
@@ -177,6 +221,26 @@ def effective_codec_name(codec_name: str) -> str:
     return "zstd"
 
 
+class _PhasedCDC:
+    """Two-phase CDC result: ``ends`` (segment boundaries) are final at
+    construction; ``fps()`` blocks until the segment fingerprints land.
+    ``wait_ns`` reports the device-blocked time once fps() returned."""
+
+    __slots__ = ("ends", "_fps_fn", "_wait_ns_fn")
+
+    def __init__(self, ends, fps_fn, wait_ns_fn=None):
+        self.ends = ends
+        self._fps_fn = fps_fn
+        self._wait_ns_fn = wait_ns_fn
+
+    def fps(self):
+        return self._fps_fn()
+
+    @property
+    def wait_ns(self) -> int:
+        return self._wait_ns_fn() if self._wait_ns_fn is not None else 0
+
+
 class DataPathProcessor:
     """Per-connection host orchestrator for the TPU data path.
 
@@ -207,7 +271,17 @@ class DataPathProcessor:
         # store or a fingerprint collision, at the cost of re-hashing
         self.paranoid_verify = paranoid_verify
         self._fused = None  # lazy FusedCDCFP for the unbatched accelerator path
+        # padded-bucket buffer reuse: share the runner's pool when batching
+        # (the runner recycles after dispatch), else own one for the
+        # unbatched device path
+        self.bufpool = batch_runner.pool if batch_runner is not None else BufferPool()
         self.stats = DataPathStats()
+        if batch_runner is not None:
+            # the runner's counters() already folds in its pool + fused stats
+            self.stats.add_source(batch_runner.counters)
+        else:
+            self.stats.add_source(self.bufpool.counters)
+            self.stats.add_source(lambda: self._fused.counters() if self._fused is not None else {})
 
     # ---- fingerprints ----
 
@@ -226,28 +300,50 @@ class DataPathProcessor:
 
         return segment_fingerprints_host_batch(arr, ends)
 
-    @staticmethod
-    def _pad_to_bucket(arr: np.ndarray) -> np.ndarray:
-        bucket = _bucket_size(len(arr))
-        return arr if len(arr) == bucket else np.concatenate([arr, np.zeros(bucket - len(arr), np.uint8)])
-
-    def _cdc_and_fps(self, arr: np.ndarray):
+    def _cdc_and_fps_phased(self, arr: np.ndarray) -> "_PhasedCDC":
         """CDC boundaries + segment fingerprints with ONE device dispatch and
-        ONE small packed readback on accelerators (ops/fused_cdc.py)."""
+        ONE small packed readback on accelerators (ops/fused_cdc.py).
+
+        Two-phase contract: the returned handle's ``.ends`` are final
+        immediately; ``.fps()`` may block until the fingerprint readback
+        lands. Callers do boundary-dependent work (recipe span assembly)
+        between the two so host work overlaps the in-flight device batch.
+        Host and unbatched paths degenerate to both-ready-now.
+        """
         if not self._on_accelerator():
             from skyplane_tpu.ops.cdc import cdc_and_fps_host
 
-            return cdc_and_fps_host(arr, self.cdc_params)
+            ends, fps = cdc_and_fps_host(arr, self.cdc_params)
+            return _PhasedCDC(ends, lambda: fps)
         if self.batch_runner is not None:
             # the runner chunks with ITS params; both paths must agree or the
             # same bytes would fingerprint differently depending on routing
             assert self.batch_runner.cdc_params == self.cdc_params, "batch runner CDC params diverge from processor"
-            return self.batch_runner.cdc_and_fps(arr, self._pad_to_bucket(arr))
+            handle = self.batch_runner.submit(arr)
+            return _PhasedCDC(handle.ends(), handle.fps, wait_ns_fn=lambda: handle.wait_ns)
         if self._fused is None:
             from skyplane_tpu.ops.fused_cdc import FusedCDCFP
 
-            self._fused = FusedCDCFP(self.cdc_params)
-        return self._fused(self._pad_to_bucket(arr)[None, :], [len(arr)])[0]
+            self._fused = FusedCDCFP(self.cdc_params, pool=self.bufpool)
+        bucket = _bucket_size(len(arr))
+        if len(arr) == bucket:
+            # exact-bucket chunk: pass the caller's bytes through untouched
+            # (read-only np.frombuffer views are fine — the device upload copies)
+            ends, fps = self._fused(arr[None, :], [len(arr)])[0]
+            return _PhasedCDC(ends, lambda: fps)
+        padded = self.bufpool.acquire(bucket)
+        try:
+            padded[: len(arr)] = arr
+            padded[len(arr) :] = 0
+            ends, fps = self._fused(padded[None, :], [len(arr)])[0]
+        finally:
+            self.bufpool.release(padded)
+        return _PhasedCDC(ends, lambda: fps)
+
+    def _cdc_and_fps(self, arr: np.ndarray):
+        """Blocking single-phase form of :meth:`_cdc_and_fps_phased`."""
+        phased = self._cdc_and_fps_phased(arr)
+        return phased.ends, phased.fps()
 
     def _chunk_fingerprint(self, seg_fps: List[bytes], raw_len: int) -> str:
         h = hashlib.blake2b(b"".join(seg_fps) + raw_len.to_bytes(8, "little"), digest_size=16)
@@ -259,16 +355,22 @@ class DataPathProcessor:
         raw_len = len(data)
         if self.dedup and index is not None and raw_len > 0:
             arr = np.frombuffer(data, np.uint8)
-            ends, seg_fps = self._cdc_and_fps(arr)
+            phased = self._cdc_and_fps_phased(arr)
+            # boundary-dependent assembly runs BETWEEN the phases: spans are
+            # final once ends land, so they're cut while the fingerprint
+            # readback of this worker's batch is still in flight
+            ends_l = np.asarray(phased.ends).tolist()
             # memoryview slices: REF segments never need their bytes copied
             # (only literals are materialized, inside build_recipe's join)
             mv = memoryview(data)
-            ends_l = np.asarray(ends).tolist()
-            segments = []
+            spans = []
             start = 0
-            for i, end in enumerate(ends_l):
-                segments.append((seg_fps[i], mv[start:end]))
+            for end in ends_l:
+                spans.append(mv[start:end])
                 start = end
+            seg_fps = phased.fps()
+            self.stats.observe_device_wait(phased.wait_ns)
+            segments = list(zip(seg_fps, spans))
             wire, n_ref, lit_bytes, new_fps, ref_fps = build_recipe(segments, index, self.codec.encode)
             payload = ProcessedPayload(
                 wire_bytes=wire,

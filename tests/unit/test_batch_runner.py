@@ -99,10 +99,10 @@ def test_cross_bucket_traffic_does_not_starve_lone_flush():
         def stage(self, arr):
             return real_fused.stage(arr)
 
-        def __call__(self, rows, lens, dev_rows=None):
+        def dispatch(self, rows, lens, dev_rows=None):
             if (rows[0].shape[-1] if hasattr(rows[0], "shape") else len(rows[0])) == len(_pad(big)):
                 time.sleep(1.5)
-            return real_fused(rows, lens, dev_rows=dev_rows)
+            return real_fused.dispatch(rows, lens, dev_rows=dev_rows)
 
     runner._fused = SlowFused()
     t_big = threading.Thread(target=runner.cdc_and_fps, args=(big, _pad(big)), daemon=True)

@@ -1,0 +1,251 @@
+"""BufferPool: alias-freedom, recycle correctness, churn behavior, and the
+zero-allocation steady state of the batched device path (CPU-backend XLA)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.ops.bufpool import MIN_BUCKET, BufferPool, bucket_size
+
+
+def _bucket_size_reference(n: int) -> int:
+    """The original shift-loop formulation (replaced by bit_length)."""
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+@pytest.mark.parametrize(
+    "n",
+    [0, 1, 2, MIN_BUCKET - 1, MIN_BUCKET, MIN_BUCKET + 1, 2 * MIN_BUCKET - 1, 2 * MIN_BUCKET,
+     2 * MIN_BUCKET + 1, (1 << 26) - 1, 1 << 26, (1 << 26) + 1],
+)
+def test_bucket_size_matches_shift_loop_at_boundaries(n):
+    got = bucket_size(n)
+    assert got == _bucket_size_reference(n)
+    assert got >= MIN_BUCKET and got >= n
+    assert got & (got - 1) == 0  # power of two
+
+
+def test_acquire_release_reuses_buffer():
+    pool = BufferPool()
+    a = pool.acquire(MIN_BUCKET)
+    pool.release(a)
+    b = pool.acquire(MIN_BUCKET)
+    assert b is a  # LIFO reuse of the cache-warm buffer
+    c = pool.counters()
+    assert c["pool_hits"] == 1 and c["pool_misses"] == 1 and c["pool_recycled"] == 1
+
+
+def test_outstanding_buffers_never_alias():
+    """Concurrent workers must never receive the same buffer while another
+    worker still holds it — in-flight chunks aliasing would corrupt data."""
+    pool = BufferPool()
+    held, errs = [], []
+    lock = threading.Lock()
+
+    def worker(i):
+        try:
+            for _ in range(50):
+                buf = pool.acquire(MIN_BUCKET)
+                buf[:8] = i  # stamp
+                with lock:
+                    assert all(h is not buf for h in held), "pool issued an in-flight buffer twice"
+                    held.append(buf)
+                assert (buf[:8] == i).all(), "another worker scribbled on a held buffer"
+                with lock:
+                    held.remove(buf)
+                pool.release(buf)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+
+
+def test_foreign_release_is_ignored():
+    """Releasing a buffer the pool never issued (caller-owned padded array,
+    possibly read-only user memory) must NOT enter the free list."""
+    pool = BufferPool()
+    foreign = np.zeros(MIN_BUCKET, np.uint8)
+    foreign.setflags(write=False)
+    pool.release(foreign)
+    got = pool.acquire(MIN_BUCKET)
+    assert got is not foreign
+    assert got.flags.writeable
+
+
+def test_double_release_is_idempotent():
+    pool = BufferPool()
+    a = pool.acquire(MIN_BUCKET)
+    pool.release(a)
+    pool.release(a)  # second release: a is no longer outstanding -> no-op
+    b = pool.acquire(MIN_BUCKET)
+    c = pool.acquire(MIN_BUCKET)
+    assert b is not c, "double release put the same buffer in the free list twice"
+
+
+def test_per_bucket_cap_drops_excess():
+    pool = BufferPool(max_per_bucket=2)
+    bufs = [pool.acquire(MIN_BUCKET) for _ in range(4)]
+    for b in bufs:
+        pool.release(b)
+    c = pool.counters()
+    assert c["pool_recycled"] == 2 and c["pool_dropped"] == 2
+
+
+def test_bucket_churn_evicts_lru_sizes():
+    """When the workload's bucket size changes, idle buffers of the old size
+    must be evicted once the total-byte bound bites — not pinned forever."""
+    pool = BufferPool(max_per_bucket=8, max_total_bytes=4 * MIN_BUCKET)
+    old = [pool.acquire(2 * MIN_BUCKET) for _ in range(2)]  # 2x 128K = 256K = cap
+    for b in old:
+        pool.release(b)
+    assert pool.counters()["pool_idle_bytes"] == 4 * MIN_BUCKET
+    # churn to a new bucket size; releasing it must push the OLD size out
+    new = [pool.acquire(MIN_BUCKET) for _ in range(3)]
+    for b in new:
+        pool.release(b)
+    c = pool.counters()
+    assert c["pool_idle_bytes"] <= 4 * MIN_BUCKET
+    assert c["pool_evicted_bytes"] >= 2 * MIN_BUCKET, "old bucket size never evicted after churn"
+    # the new (hot) bucket still serves from the pool
+    assert pool.acquire(MIN_BUCKET) is new[-1]
+
+
+def test_leaked_buffer_bounded_tracking():
+    """A caller that never releases must not grow pool state unboundedly."""
+    pool = BufferPool(max_outstanding_tracked=4)
+    for _ in range(16):
+        pool.acquire(MIN_BUCKET)  # dropped on the floor (leak)
+    assert pool.counters()["pool_outstanding"] <= 4
+
+
+def test_scratch_reuse():
+    pool = BufferPool()
+    a = pool.acquire_scratch((4, 34), np.int32)
+    pool.release_scratch(a)
+    b = pool.acquire_scratch((4, 34), np.int32)
+    assert b is a
+    assert pool.acquire_scratch((4, 35), np.int32) is not a  # different shape key
+
+
+def test_scratch_foreign_and_double_release_ignored():
+    """Same aliasing protection as bucket buffers: a scratch array released
+    twice (or never issued by the pool) must not enter the free list twice —
+    two concurrent batches sharing one ends_slots array would corrupt both."""
+    pool = BufferPool()
+    pool.release_scratch(np.zeros((2, 3), np.int32))  # foreign: ignored
+    a = pool.acquire_scratch((2, 3), np.int32)
+    pool.release_scratch(a)
+    pool.release_scratch(a)  # double release: no-op
+    b = pool.acquire_scratch((2, 3), np.int32)
+    c = pool.acquire_scratch((2, 3), np.int32)
+    assert b is not c, "double release aliased one scratch array to two owners"
+
+
+# ---- the steady-state contract through the real batched device path ----
+
+PARAMS = None
+
+
+def _params():
+    from skyplane_tpu.ops.cdc import CDCParams
+
+    return CDCParams(min_bytes=1024, avg_bytes=4096, max_bytes=16384)
+
+
+def _expected(arr):
+    from skyplane_tpu.ops.cdc import cdc_segment_ends
+    from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
+
+    ends = cdc_segment_ends(arr, _params())
+    return ends, segment_fingerprints_host_batch(arr, ends)
+
+
+def test_zero_pool_misses_after_warmup():
+    """Acceptance bar: steady-state per-chunk host allocations for bucket
+    buffers drop to ZERO — after warmup the pool serves every submission."""
+    from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
+
+    rng = np.random.default_rng(11)
+    runner = DeviceBatchRunner(cdc_params=_params(), max_batch=4, max_wait_ms=2.0)
+    chunks = [rng.integers(0, 256, 60_000 + 1000 * i, dtype=np.uint8) for i in range(4)]
+    for c in chunks:  # warmup: compiles + first allocations
+        runner.cdc_and_fps(c)
+    warm = runner.pool.counters()
+    for _ in range(5):  # steady state: same bucket sizes recirculate
+        for c in chunks:
+            ends, fps = runner.cdc_and_fps(c)
+            want_ends, want_fps = _expected(c)
+            np.testing.assert_array_equal(ends, want_ends)
+            assert fps == want_fps
+    after = runner.pool.counters()
+    assert after["pool_misses"] == warm["pool_misses"], (
+        f"steady state still allocating: misses {warm['pool_misses']} -> {after['pool_misses']}"
+    )
+    assert after["pool_hits"] > warm["pool_hits"]
+    assert after["pool_outstanding"] == 0, "buffers leaked out of the recycle path"
+
+
+def test_concurrent_pooled_batches_bitexact():
+    """Pooled padding + batched execution under real concurrency must equal
+    the sequential host path — buffer recycling must never hand a window a
+    buffer another in-flight window still reads."""
+    from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
+
+    rng = np.random.default_rng(12)
+    runner = DeviceBatchRunner(cdc_params=_params(), max_batch=4, max_wait_ms=20.0)
+    chunks = [rng.integers(0, 256, 50_000 + 3000 * (i % 5), dtype=np.uint8) for i in range(16)]
+    results = [None] * len(chunks)
+    errs = []
+
+    def worker(i):
+        try:
+            results[i] = runner.cdc_and_fps(chunks[i])  # no padded arg: pooled path
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(chunks))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs
+    for i, c in enumerate(chunks):
+        ends, fps = results[i]
+        want_ends, want_fps = _expected(c)
+        np.testing.assert_array_equal(ends, want_ends)
+        assert fps == want_fps, f"chunk {i}: pooled batched path diverges from host path"
+    assert runner.pool.counters()["pool_outstanding"] == 0
+
+
+def test_overflow_recompute_recycles_pooled_buffer(monkeypatch):
+    """Candidate-cap overflow routes the row through the exact host
+    recompute, which reads the POOLED padded buffer — the buffer must only
+    recycle after that read, and results must stay bit-exact."""
+    import skyplane_tpu.ops.fused_cdc as fused_mod
+    from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
+    from skyplane_tpu.ops.cdc import CDCParams
+
+    params = CDCParams(min_bytes=64, avg_bytes=256, max_bytes=1024)
+    rng = np.random.default_rng(13)
+    chunk = rng.integers(0, 256, 60_000, dtype=np.uint8)
+    monkeypatch.setattr(fused_mod, "candidate_cap", lambda bucket, params=None: 16)  # force overflow
+    runner = DeviceBatchRunner(cdc_params=params, max_batch=2, max_wait_ms=2.0)
+    for _ in range(3):
+        ends, fps = runner.cdc_and_fps(chunk)
+        from skyplane_tpu.ops.cdc import cdc_segment_ends
+        from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
+
+        want_ends = cdc_segment_ends(chunk, params)
+        np.testing.assert_array_equal(ends, want_ends)
+        assert fps == segment_fingerprints_host_batch(chunk, want_ends)
+    c = runner.pool.counters()
+    assert c["pool_outstanding"] == 0 and c["pool_recycled"] > 0
