@@ -416,6 +416,93 @@ def _rep_counter_delta(pre: dict, post: dict, max_batch: int) -> dict:
 
 BENCH_REPS = int(os.environ.get("SKYPLANE_BENCH_REPS", "3"))
 
+# decode-counter keys reported in the result's decode_counters section —
+# the receiver-side mirror of datapath_counters; check_bench_json.py (and so
+# the devloop bench-smoke) asserts they are always present
+DECODE_COUNTER_KEYS = (
+    "store_mem_hits",
+    "store_spill_reads",
+    "store_lock_held_disk_reads",
+    "store_stripe_contention",
+    "store_ref_wait_ns",
+    "pool_hit_rate",
+    "verify_total",
+    "verify_batched",
+)
+
+
+def encode_frames_for_decode(chunks, codec_name: str):
+    """Encode the corpus once through the sender path into framed recipe
+    payloads (wire header + wire bytes), committing fingerprints after each
+    chunk — so later chunks REF earlier ones, exactly the stream a receiver
+    sees from one well-behaved sender."""
+    from skyplane_tpu.chunk import ChunkFlags, Codec, WireProtocolHeader
+    from skyplane_tpu.ops.cdc import CDCParams
+    from skyplane_tpu.ops.dedup import SenderDedupIndex
+    from skyplane_tpu.ops.pipeline import DataPathProcessor
+
+    proc = DataPathProcessor(codec_name=codec_name, dedup=True, cdc_params=CDCParams())
+    index = SenderDedupIndex()
+    frames = []
+    for i, c in enumerate(chunks):
+        p = proc.process(c, index)
+        for fp, size in p.new_fingerprints:
+            index.add(fp, size)
+        flags = ChunkFlags.RECIPE | (ChunkFlags.COMPRESSED if p.codec != Codec.NONE else 0)
+        frames.append(
+            (
+                WireProtocolHeader(
+                    chunk_id=f"{i:032x}",
+                    data_len=len(p.wire_bytes),
+                    raw_data_len=p.raw_len,
+                    codec=int(p.codec),
+                    flags=int(flags),
+                    fingerprint=p.fingerprint,
+                ),
+                p.wire_bytes,
+            )
+        )
+    return frames
+
+
+def bench_decode(frames, workers=None) -> dict:
+    """Receiver decode-path throughput: parallel restore of the framed corpus
+    through a fresh SegmentStore per rep (the decode pool's hot loop —
+    pooled output assembly, striped store, per-fp ref waits — without socket
+    framing). Workers decode OUT OF ORDER like the gateway's decode pool;
+    refs to earlier chunks' literals resolve via the store's arrival events."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from skyplane_tpu.ops.dedup import SegmentStore
+    from skyplane_tpu.ops.pipeline import DataPathProcessor
+
+    if workers is None:
+        workers = int(os.environ.get("SKYPLANE_BENCH_DECODE_WORKERS", "0")) or min(8, os.cpu_count() or 1)
+    best = None
+    for _ in range(max(1, BENCH_REPS)):
+        # fresh store + receiver per rep: a warm store would turn rep 2+ into
+        # an all-mem-hit fast path that no first-contact receiver ever sees
+        store = SegmentStore()
+        recv = DataPathProcessor(codec_name="none", dedup=True)
+
+        def one(frame) -> int:
+            header, wire = frame
+            out = recv.restore(wire, header, store=store, ref_wait_timeout=60.0, pooled=True)
+            n = len(out)
+            if not isinstance(out, (bytes, bytearray)):
+                out.release()  # recycle the pooled output buffer
+            return n
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            restored = sum(pool.map(one, frames))
+        dt = time.perf_counter() - t0
+        assert restored == sum(h.raw_data_len for h, _ in frames), "decode bench restored wrong byte count"
+        if best is None or dt < best["seconds"]:
+            counters = {**store.counters(), **recv.bufpool.counters(), **recv.verify_counters()}
+            best = {"seconds": dt, "raw_bytes": restored, "counters": counters, "workers": workers}
+    return best
+
 
 def _bench_codec(chunks, one) -> dict:
     """Time a per-chunk codec with full core-level worker parallelism.
@@ -582,6 +669,14 @@ def main() -> None:
         by_workers["1"] = round(ours_1["raw_bytes"] * 8 / 1e9 / ours_1["seconds"], 3)
         log(f"ours done (1 worker): {ours_1['seconds']:.2f}s")
 
+    # receiver decode path: restore throughput over the SAME corpus, encoded
+    # once (north-star effective Gbps counts end-to-end restore, not just
+    # sender encode — BASELINE.md)
+    frames = encode_frames_for_decode(chunks, ours_codec)
+    dec = bench_decode(frames)
+    decode_gbps = dec["raw_bytes"] * 8 / 1e9 / dec["seconds"]
+    log(f"decode done ({dec['workers']} workers): {dec['seconds']:.2f}s ({decode_gbps:.2f} Gbps)")
+
     ours_gbps = gbits / ours["seconds"]
     base_gbps = base["raw_bytes"] * 8 / 1e9 / base["seconds"]
     from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
@@ -628,6 +723,14 @@ def main() -> None:
                 "stage_failures",
             )
         },
+        # receiver decode path (parallel restore of the same corpus): the
+        # other half of the end-to-end effective-Gbps story. Healthy runs
+        # show store_lock_held_disk_reads == 0 (the striped store never pays
+        # disk inside a lock) and store_ref_wait_ns near 0 when decode order
+        # tracks frame order. bench-smoke asserts these keys exist too.
+        "decode_gbps": round(decode_gbps, 3),
+        "decode_workers": dec["workers"],
+        "decode_counters": {k: dec["counters"].get(k, 0) for k in DECODE_COUNTER_KEYS},
     }
     if base_lz4:
         # the honest reference-codec bar (BASELINE.json names LZ4, not zstd)

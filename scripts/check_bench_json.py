@@ -12,7 +12,7 @@ from __future__ import annotations
 import json
 import sys
 
-REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "platform", "datapath_counters")
+REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "platform", "datapath_counters", "decode_gbps", "decode_counters")
 REQUIRED_COUNTERS = (
     "pool_hit_rate",
     "pool_hits",
@@ -23,6 +23,17 @@ REQUIRED_COUNTERS = (
     "device_wait_ns",
     "donated_batches",
     "stage_failures",
+)
+# receiver decode-path section (mirrors bench.py DECODE_COUNTER_KEYS)
+REQUIRED_DECODE_COUNTERS = (
+    "store_mem_hits",
+    "store_spill_reads",
+    "store_lock_held_disk_reads",
+    "store_stripe_contention",
+    "store_ref_wait_ns",
+    "pool_hit_rate",
+    "verify_total",
+    "verify_batched",
 )
 
 
@@ -57,13 +68,24 @@ def main(argv) -> int:
         missing.append("datapath_counters(dict)")
     else:
         missing += [f"datapath_counters.{k}" for k in REQUIRED_COUNTERS if k not in counters]
+    dec = result.get("decode_counters")
+    if not isinstance(dec, dict):
+        missing.append("decode_counters(dict)")
+    else:
+        missing += [f"decode_counters.{k}" for k in REQUIRED_DECODE_COUNTERS if k not in dec]
     if missing:
         print(f"bench-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
         return 1
     if not isinstance(result["value"], (int, float)) or result["value"] <= 0:
         print(f"bench-smoke: implausible throughput value {result['value']!r}", file=sys.stderr)
         return 1
-    print(f"bench-smoke OK: {result['value']} {result['unit']} on {result['platform']}")
+    if not isinstance(result["decode_gbps"], (int, float)) or result["decode_gbps"] <= 0:
+        print(f"bench-smoke: implausible decode throughput {result['decode_gbps']!r}", file=sys.stderr)
+        return 1
+    print(
+        f"bench-smoke OK: {result['value']} {result['unit']} encode, "
+        f"{result['decode_gbps']} {result['unit']} decode on {result['platform']}"
+    )
     return 0
 
 

@@ -31,13 +31,15 @@ else
   echo "[devloop] lint clean; report at $LOGDIR/lint_findings.json" >>"$LOGDIR/devloop.log"
 fi
 
-# Bench-smoke gate (CPU-only, seconds): bench.py on a tiny corpus, then
-# validate the JSON result line and the perf-counter schema
-# (docs/datapath-performance.md). Catches a malformed result or a dropped
-# counter key BEFORE a multi-hour real bench run discovers it. Like lint:
-# failures are logged LOUDLY but do not block device profiling.
+# Bench-smoke gate (CPU-only, seconds): bench.py on a tiny corpus — the
+# sender encode bench AND the receiver decode bench (decode_gbps +
+# decode_counters) — then validate the JSON result line and BOTH perf-counter
+# schemas (docs/datapath-performance.md). Catches a malformed result or a
+# dropped counter key BEFORE a multi-hour real bench run discovers it. Like
+# lint: failures are logged LOUDLY but do not block device profiling.
 SKYPLANE_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu \
   SKYPLANE_BENCH_CHUNK_MB=1 SKYPLANE_BENCH_SNAPSHOTS=2 SKYPLANE_BENCH_SNAP_CHUNKS=2 SKYPLANE_BENCH_REPS=1 \
+  SKYPLANE_BENCH_DECODE_WORKERS=4 \
   python bench.py >"$LOGDIR/bench_smoke.out" 2>"$LOGDIR/bench_smoke.err"
 BENCH_RC=$?
 if [ "$BENCH_RC" -eq 0 ]; then

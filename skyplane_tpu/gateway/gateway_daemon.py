@@ -113,22 +113,11 @@ class GatewayDaemon:
                 "split these across separate gateways"
             )
         raw_forward = relay_receives > 0
-        self.receiver = GatewayReceiver(
-            region=region,
-            chunk_store=self.chunk_store,
-            error_event=self.error_event,
-            error_queue=self.error_queue,
-            use_tls=use_tls,
-            e2ee_key=e2ee_key,
-            dedup=dedup_receive,
-            segment_store=self._make_segment_store(chunk_dir) if dedup_receive else None,
-            bind_host=bind_host,
-            raw_forward=raw_forward,
-            cdc_params=self.cdc_params,
-        )
 
         # one device batch runner per daemon, shared by every sender worker on
-        # accelerator gateways (micro-batches CDC+fingerprint device calls)
+        # accelerator gateways (micro-batches CDC+fingerprint device calls).
+        # Built BEFORE the receiver so paranoid recipe verification in the
+        # decode pool batches through the same runner.
         self.batch_runner = None
         from skyplane_tpu.ops.backend import on_accelerator
 
@@ -149,6 +138,21 @@ class GatewayDaemon:
             self.batch_runner = DeviceBatchRunner(cdc_params=self.cdc_params, max_batch=tpu_batch, mesh=mesh)
             if mesh is not None:
                 logger.fs.info(f"[daemon {gateway_id}] batch runner sharded over mesh {dict(mesh.shape)}")
+
+        self.receiver = GatewayReceiver(
+            region=region,
+            chunk_store=self.chunk_store,
+            error_event=self.error_event,
+            error_queue=self.error_queue,
+            use_tls=use_tls,
+            e2ee_key=e2ee_key,
+            dedup=dedup_receive,
+            segment_store=self._make_segment_store(chunk_dir) if dedup_receive else None,
+            bind_host=bind_host,
+            raw_forward=raw_forward,
+            cdc_params=self.cdc_params,
+            batch_runner=self.batch_runner,
+        )
 
         self.upload_id_map: Dict[str, str] = {}
         self.operators: List[GatewayOperator] = []

@@ -20,6 +20,7 @@ directly:
   GET  /api/v1/profile/socket/receiver     per-recv socket profile events
   GET  /api/v1/profile/socket/sender       per-send-window profile events
   GET  /api/v1/profile/compression         TPU data-path stats (ratio, dedup)
+  GET  /api/v1/profile/decode              receiver decode-pool counters+events
 
 Completion accounting (the reference's most bug-prone logic, SURVEY §7 #6):
 an explicit per-chunk refcount of terminal-operator completions — a chunk is
@@ -316,6 +317,16 @@ class GatewayDaemonAPI:
             req._send(200, {"events": self.sender_profile_fn()})
         elif path == "/api/v1/profile/compression":
             req._send(200, self.compression_stats_fn())
+        elif path == "/api/v1/profile/decode":
+            # receiver decode-path health: stable counter schema (the decode
+            # mirror of /profile/compression) + per-chunk decode events
+            events = []
+            while True:
+                try:
+                    events.append(self.receiver.decode_profile_events.get_nowait())
+                except queue.Empty:
+                    break
+            req._send(200, {"counters": self.receiver.decode_counters(), "events": events})
         elif path == "/api/v1/logs":
             # live daemon log tail (reference analog: the dozzle container log
             # viewer on :8888); ?bytes=N bounds the tail (default 64 KiB,

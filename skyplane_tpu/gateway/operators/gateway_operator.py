@@ -28,7 +28,7 @@ import json
 
 from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
-from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED
+from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, put_drop_oldest
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
 from skyplane_tpu.gateway.gateway_queue import GatewayQueue
@@ -604,16 +604,5 @@ class GatewaySenderOperator(GatewayOperator):
             "wire_bytes": window_wire,
             "seconds": round(time.perf_counter() - t_window, 6),
         }
-        try:
-            self.socket_profile_events.put_nowait(event)
-        except queue.Full:
-            # drop-oldest so a quiet endpoint keeps the freshest windows
-            try:
-                self.socket_profile_events.get_nowait()
-            except queue.Empty:
-                pass
-            try:
-                self.socket_profile_events.put_nowait(event)
-            except queue.Full:
-                pass
+        put_drop_oldest(self.socket_profile_events, event)
         return results

@@ -1,10 +1,12 @@
-"""Micro-batching of CDC + fingerprint device work across sender workers.
+"""Micro-batching of CDC + fingerprint device work across gateway workers.
 
-A gateway runs 16-32 sender workers, each processing one chunk at a time.
-On an accelerator, per-chunk device calls waste dispatch round trips and run
-undersized kernels; this runner groups concurrent same-size submissions into
-one [B, N] batch (SURVEY §7 hard part #2: batching with BOUNDED latency —
-small transfers must not wait for a full batch).
+A gateway runs 16-32 sender workers (plus the receiver decode pool when
+paranoid recipe verification re-fingerprints restored chunks), each
+processing one chunk at a time. On an accelerator, per-chunk device calls
+waste dispatch round trips and run undersized kernels; this runner groups
+concurrent same-size submissions into one [B, N] batch (SURVEY §7 hard part
+#2: batching with BOUNDED latency — small transfers must not wait for a
+full batch).
 
 The batched work itself is the fused single-dispatch kernel
 (ops/fused_cdc.py): gear hash, boundary selection, and segment fingerprints

@@ -1,4 +1,4 @@
-"""Bucket-keyed pool of reusable padded host buffers for the sender data path.
+"""Bucket-keyed pool of reusable padded host buffers for the data path.
 
 Every chunk the gateway processes on an accelerator is padded to a
 power-of-two bucket before upload (ops/pipeline.py); allocating a fresh
@@ -7,7 +7,9 @@ the hot path, and the freed pages bounce through the allocator under 16-32
 concurrent workers. This pool recycles those buffers: steady-state traffic
 reuses the same handful of buckets, so per-chunk host allocation drops to
 zero after warmup (the ``misses`` counter stops moving — asserted in
-tests/unit/test_bufpool.py).
+tests/unit/test_bufpool.py). The receiver decode pool draws its restored-
+chunk output buffers (``ops/dedup.py`` ``PooledChunk``) from the same pool,
+so decode-side assembly is allocation-free at steady state too.
 
 Ownership contract:
 

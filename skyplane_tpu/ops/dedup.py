@@ -24,19 +24,32 @@ Recipe container layout (little-endian):
 
 from __future__ import annotations
 
+import itertools
+import os
 import struct
 import threading
+import time
 from collections import OrderedDict
+from contextlib import contextmanager
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from skyplane_tpu.exceptions import CodecException, DedupIntegrityException
+from skyplane_tpu.ops.bufpool import BufferPool, bucket_size
+from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
 
 MAGIC = b"\xde\xd1"
 VERSION = 1
 _ENTRY = struct.Struct("<B16sQ")
 KIND_REF = 0
 KIND_LIT = 1
+# hard cap on the raw bytes a recipe may claim to restore to — mirrors
+# chunk.MAX_CHUNK_BYTES without importing the wire module here. A hostile
+# entry list must not drive a multi-GiB output allocation before the
+# post-restore raw_data_len check ever runs.
+MAX_RECIPE_RAW_BYTES = 8 << 30
 
 
 class _IndexStripe:
@@ -165,111 +178,394 @@ class SenderDedupIndex:
         return self._max_bytes
 
 
+class _StoreStripe:
+    """One lock + its share of the in-memory fp map of a striped SegmentStore."""
+
+    __slots__ = ("lock", "mem", "waiters", "contended")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.mem: "OrderedDict[bytes, list]" = OrderedDict()  # fp -> [data, last-touch seq]
+        # fp -> [arrival Event, waiter refcount]: REFs that raced ahead of
+        # their LITERAL park here and wake the moment put() lands the bytes
+        self.waiters: Dict[bytes, list] = {}
+        self.contended = 0  # monitoring counter (GIL increments; approximate)
+
+
 class SegmentStore:
     """Receiver-side fingerprint -> segment bytes store.
 
     In-memory LRU bounded by bytes, with optional disk spill directory so the
     working set can exceed RAM (gateway VMs stage chunks on disk anyway,
     reference: skyplane/gateway/chunk_store.py:108-109).
+
+    Hot-path striping (the receiver mirror of ``SenderDedupIndex``): every
+    decode worker resolves one ``get``/``put`` per SEGMENT, so a single mutex
+    here serializes the whole decode pool — and the old implementation held
+    that mutex across spill-file disk reads and a 1-second-granularity
+    ref-arrival poll. Now:
+
+      * lookups/inserts lock only the stripe selected by the fingerprint's
+        first byte (blake2b output — uniform);
+      * the byte bound stays GLOBAL with globally-ordered eviction via a
+        monotonic touch sequence (evictor pops the minimum-seq stripe head,
+        exactly the SenderDedupIndex scheme — approximately-LRU under races,
+        always in the safe direction);
+      * disk I/O (spill writes, spill reads, promotion reads) happens with NO
+        store lock held; an ``_in_transit`` map keeps evictees resolvable
+        during the off-lock spill write;
+      * a REF arriving before its LITERAL waits on a per-fingerprint arrival
+        event set by ``put`` — no polling, wake latency is scheduler-bound.
     """
 
-    def __init__(self, max_bytes: int = 4 << 30, spill_dir: Optional[Path] = None, spill_max_bytes: int = 32 << 30):
-        self._mem: "OrderedDict[bytes, bytes]" = OrderedDict()
-        self._mem_bytes = 0
+    def __init__(
+        self,
+        max_bytes: int = 4 << 30,
+        spill_dir: Optional[Path] = None,
+        spill_max_bytes: int = 32 << 30,
+        stripes: int = 16,
+    ):
+        n = 1
+        while n < max(1, int(stripes)):
+            n <<= 1
+        self._stripes = [_StoreStripe() for _ in range(n)]
+        self._mask = n - 1
+        self._seq = itertools.count()  # itertools.count: GIL-atomic next()
+        self._budget_lock = threading.Lock()  # guards the global mem byte total
         self._max_bytes = max_bytes
+        self._mem_bytes = 0
         self._spill_dir = Path(spill_dir) if spill_dir else None
         self._spill_max_bytes = spill_max_bytes
+        self._spill_lock = threading.Lock()  # guards spill index + in-transit map
         self._spill_bytes = 0
-        self._spill_order: "OrderedDict[bytes, int]" = OrderedDict()  # fp -> size, insertion order
+        self._spill_order: "OrderedDict[bytes, int]" = OrderedDict()  # fp -> size, recency order
+        # segments popped from memory whose spill write is still in flight:
+        # membership here keeps them resolvable during the off-lock disk write
+        self._in_transit: Dict[bytes, bytes] = {}
         if self._spill_dir:
             self._spill_dir.mkdir(parents=True, exist_ok=True)
             # spill is per-run state: stale files from a previous daemon would
             # never be REF'd (fresh sender index) but would eat disk forever
-            for stale in self._spill_dir.glob("*.seg"):
+            # (*.seg* also sweeps orphaned .tmp files from a crashed writer)
+            for stale in self._spill_dir.glob("*.seg*"):
                 stale.unlink()
-        self._lock = threading.Lock()
-        self._arrival = threading.Condition(self._lock)
+        self._tls = threading.local()  # per-thread held-lock depth (disk-read audit)
+        # monitoring counters: plain ints bumped under the GIL — monotonic and
+        # exact once traffic quiesces, which is all /profile needs
+        self._c_mem_hits = 0
+        self._c_spill_reads = 0
+        self._c_promotions = 0
+        self._c_lock_held_disk_reads = 0
+        self._c_ref_wait_ns = 0
+        self._c_ref_timeouts = 0
+        self._c_mem_evictions = 0
+        self._c_spill_evictions = 0
+
+    # ---- lock discipline ----
+
+    @contextmanager
+    def _hold(self, lock: threading.Lock, stripe: Optional[_StoreStripe] = None):
+        """Acquire a store lock, counting stripe contention and tracking the
+        per-thread held-lock depth so ``_read_spill_file`` can prove (via the
+        ``store_lock_held_disk_reads`` counter) that no disk read ever runs
+        inside a critical section."""
+        if not lock.acquire(False):
+            if stripe is not None:
+                stripe.contended += 1
+            lock.acquire()
+        self._tls.depth = getattr(self._tls, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            self._tls.depth -= 1
+            lock.release()
+
+    def _stripe(self, fp: bytes) -> _StoreStripe:
+        return self._stripes[fp[0] & self._mask]
 
     def _spill_path(self, fp: bytes) -> Optional[Path]:
         return self._spill_dir / f"{fp.hex()}.seg" if self._spill_dir else None
 
-    def put(self, fp: bytes, data: bytes) -> None:
-        with self._lock:
-            self._admit(fp, data)
-            self._arrival.notify_all()
+    # ---- writes ----
 
-    def _admit(self, fp: bytes, data: bytes) -> None:
-        """Insert into the in-memory LRU, spilling evictees to disk. Lock held."""
-        if fp in self._mem:
-            self._mem.move_to_end(fp)
-            return
-        self._mem[fp] = data
-        self._mem_bytes += len(data)
-        while self._mem_bytes > self._max_bytes and self._mem:
-            old_fp, old_data = self._mem.popitem(last=False)
-            self._mem_bytes -= len(old_data)
-            p = self._spill_path(old_fp)
-            if p is not None:
-                if old_fp in self._spill_order:
-                    # already on disk from an earlier eviction: refresh recency
-                    self._spill_order.move_to_end(old_fp)
+    def put(self, fp: bytes, data: bytes) -> None:
+        self._insert(fp, data)
+        self._evict_to_budget()
+
+    def _insert(self, fp: bytes, data: bytes) -> None:
+        """Insert into the striped in-memory map and wake any parked REFs."""
+        s = self._stripe(fp)
+        added = 0
+        with self._hold(s.lock, s):
+            entry = s.mem.get(fp)
+            if entry is not None:
+                entry[1] = next(self._seq)
+                s.mem.move_to_end(fp)
+            else:
+                s.mem[fp] = [data, next(self._seq)]
+                added = len(data)
+            waiter = s.waiters.pop(fp, None)
+        if waiter is not None:
+            waiter[0].set()  # outside the stripe lock; waiters re-check under it
+        if added:
+            with self._hold(self._budget_lock):
+                self._mem_bytes += added
+
+    def _evict_to_budget(self) -> None:
+        """Evict globally-oldest segments to spill until the byte bound holds.
+        Locks are taken one stripe at a time; the spill-file write runs with
+        no lock held (the evictee stays resolvable via ``_in_transit``)."""
+        while True:
+            with self._hold(self._budget_lock):
+                if self._mem_bytes <= self._max_bytes:
+                    return
+            victim: Optional[_StoreStripe] = None
+            victim_seq = None
+            for s in self._stripes:
+                with self._hold(s.lock, s):
+                    if s.mem:
+                        head = next(iter(s.mem.values()))
+                        if victim_seq is None or head[1] < victim_seq:
+                            victim, victim_seq = s, head[1]
+            if victim is None:
+                return  # nothing left to evict
+            with self._hold(victim.lock, victim):
+                if not victim.mem:
+                    continue  # raced with another evictor; rescan
+                vfp, (data, _) = victim.mem.popitem(last=False)
+                if self._spill_dir is not None:
+                    # stage for spill INSIDE the stripe lock (stripe -> spill
+                    # nesting, this one site only) so a concurrent get()
+                    # always finds the segment in mem ∪ in_transit ∪ spill
+                    with self._hold(self._spill_lock):
+                        self._in_transit[vfp] = data
+            with self._hold(self._budget_lock):
+                self._mem_bytes -= len(data)
+            self._c_mem_evictions += 1
+            if self._spill_dir is not None:
+                self._spill_out(vfp, data)
+
+    def _spill_out(self, fp: bytes, data: bytes) -> None:
+        """Persist an evictee to the spill tier and enforce the spill byte
+        bound. Called with NO lock held; the file write is off-lock."""
+        with self._hold(self._spill_lock):
+            known = fp in self._spill_order
+            if known:
+                # already on disk from an earlier eviction: refresh recency
+                self._spill_order.move_to_end(fp)
+                self._in_transit.pop(fp, None)
+        if not known:
+            # atomic landing (temp + rename): two evictors can race the same
+            # fp (evict -> in-transit promote -> evict again), and a
+            # truncating in-place write would let a reader see a short or
+            # hole-zeroed file. Spill content is content-addressed (same fp
+            # => identical bytes), so whichever replace wins, readers always
+            # see one complete, correct file.
+            p = self._spill_path(fp)
+            tmp = p.with_name(f"{p.name}.tmp{threading.get_ident()}")
+            try:
+                tmp.write_bytes(data)
+                os.replace(tmp, p)
+            except OSError:
+                # disk failure: drop the in-transit pin, then surface (a full
+                # spill disk is daemon-fatal, same as the old in-lock write)
+                with self._hold(self._spill_lock):
+                    self._in_transit.pop(fp, None)
+                try:
+                    tmp.unlink()
+                except OSError:
+                    pass
+                raise
+            with self._hold(self._spill_lock):
+                self._in_transit.pop(fp, None)
+                if fp in self._spill_order:
+                    # raced a concurrent spill of the same fp (evict ->
+                    # promote -> evict again): registering twice would
+                    # permanently inflate the spill byte accounting
+                    self._spill_order.move_to_end(fp)
                 else:
-                    p.write_bytes(old_data)
-                    self._spill_order[old_fp] = len(old_data)
-                    self._spill_bytes += len(old_data)
-                # bound spill disk usage: drop the LEAST-RECENTLY-USED spilled
-                # segments (get() refreshes recency, so retention here stays
-                # coherent with the sender's LRU index — a hot segment the
-                # sender keeps REF'ing is never the one evicted)
-                while self._spill_bytes > self._spill_max_bytes and self._spill_order:
-                    drop_fp, drop_sz = self._spill_order.popitem(last=False)
-                    self._spill_bytes -= drop_sz
-                    dp = self._spill_path(drop_fp)
-                    if dp is not None and dp.exists():
-                        dp.unlink()
+                    self._spill_order[fp] = len(data)
+                    self._spill_bytes += len(data)
+        # bound spill disk usage: drop the LEAST-RECENTLY-USED spilled
+        # segments (get() refreshes recency, so retention here stays coherent
+        # with the sender's LRU index — a hot segment the sender keeps
+        # REF'ing is never the one evicted). Unlinks run off-lock.
+        drops: List[bytes] = []
+        with self._hold(self._spill_lock):
+            while self._spill_bytes > self._spill_max_bytes and self._spill_order:
+                drop_fp, drop_sz = self._spill_order.popitem(last=False)
+                self._spill_bytes -= drop_sz
+                drops.append(drop_fp)
+        for drop_fp in drops:
+            self._c_spill_evictions += 1
+            dp = self._spill_path(drop_fp)
+            try:
+                dp.unlink()
+            except OSError:
+                pass  # already gone (readers tolerate a vanished file)
+
+    # ---- reads ----
+
+    def _read_spill_file(self, fp: bytes) -> Optional[bytes]:
+        """The one place spill bytes are read from disk. Counts (rather than
+        assumes) lock discipline: a read issued while this thread holds any
+        store lock bumps ``store_lock_held_disk_reads`` — asserted zero under
+        contention in the unit tests."""
+        if getattr(self._tls, "depth", 0):
+            self._c_lock_held_disk_reads += 1
+        p = self._spill_path(fp)
+        try:
+            data = p.read_bytes()
+        except OSError:
+            return None  # raced with spill eviction: treat as a miss
+        self._c_spill_reads += 1
+        return data
+
+    def _spill_get(self, fp: bytes) -> Optional[bytes]:
+        """Resolve from the spill tier (or the in-transit window). Membership
+        is checked under the spill lock; the disk read happens outside it."""
+        if self._spill_dir is None:
+            return None
+        with self._hold(self._spill_lock):
+            data = self._in_transit.get(fp)
+            if data is not None:
+                return data
+            if fp not in self._spill_order:
+                return None
+            self._spill_order.move_to_end(fp)
+        return self._read_spill_file(fp)
 
     def get(self, fp: bytes, wait_timeout: float = 0.0) -> bytes:
         """Resolve a fingerprint, optionally blocking for in-flight literals.
 
-        With parallel sender sockets a REF can land before its LITERAL
-        (SURVEY §7 hard part #3); ``wait_timeout`` > 0 turns unresolved refs
-        into a bounded wait on literal arrival instead of an instant failure.
+        With parallel sender sockets (and parallel decode workers) a REF can
+        land before its LITERAL (SURVEY §7 hard part #3); ``wait_timeout`` > 0
+        parks the caller on a per-fingerprint arrival event that ``put`` sets
+        the moment the literal lands — a bounded wait with no poll tick.
 
-        Hits refresh recency on BOTH tiers (memory LRU move-to-end; spill hits
-        are promoted back into memory), so receiver retention dominates the
+        Hits refresh recency on BOTH tiers (memory LRU touch; spill hits are
+        promoted back into memory), so receiver retention dominates the
         sender index's LRU — a segment the sender still REFs stays resolvable.
         """
-        import time as _time
-
-        deadline = _time.monotonic() + wait_timeout
-        with self._lock:
-            while True:
-                if fp in self._mem:
-                    self._mem.move_to_end(fp)
-                    return self._mem[fp]
-                p = self._spill_path(fp)
-                if p is not None and p.exists():
-                    data = p.read_bytes()
-                    if fp in self._spill_order:
-                        self._spill_order.move_to_end(fp)
-                    self._admit(fp, data)  # promote hot spilled segment to memory
-                    return data
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    raise DedupIntegrityException(f"unresolvable dedup ref {fp.hex()}")
-                self._arrival.wait(timeout=min(remaining, 1.0))
+        deadline = time.monotonic() + wait_timeout
+        s = self._stripe(fp)
+        while True:
+            with self._hold(s.lock, s):
+                entry = s.mem.get(fp)
+                if entry is not None:
+                    entry[1] = next(self._seq)
+                    s.mem.move_to_end(fp)
+                    self._c_mem_hits += 1
+                    return entry[0]
+            data = self._spill_get(fp)
+            if data is not None:
+                self._insert(fp, data)  # promote hot spilled segment to memory
+                self._evict_to_budget()
+                self._c_promotions += 1
+                return data
+            # miss: park on the per-fp arrival event. Re-check membership
+            # AFTER registering (under the stripe lock) so a put() landing
+            # between the lookups above and the registration cannot be lost.
+            with self._hold(s.lock, s):
+                entry = s.mem.get(fp)
+                if entry is not None:
+                    entry[1] = next(self._seq)
+                    s.mem.move_to_end(fp)
+                    self._c_mem_hits += 1
+                    return entry[0]
+                waiter = s.waiters.get(fp)
+                if waiter is None:
+                    waiter = s.waiters[fp] = [threading.Event(), 0]
+                waiter[1] += 1
+            try:
+                # close the put -> immediate-evict race: the literal may have
+                # landed AND been evicted to the spill tier between the spill
+                # miss above and the registration — eviction never fires
+                # arrival events, so without this re-check the waiter would
+                # park the full timeout for a segment that is resolvable now
+                data = self._spill_get(fp)
+                if data is not None:
+                    fired = None  # resolved via spill; no wait happened
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        fired = False
+                    else:
+                        t0 = time.perf_counter_ns()
+                        fired = waiter[0].wait(remaining)
+                        self._c_ref_wait_ns += time.perf_counter_ns() - t0
+            finally:
+                with self._hold(s.lock, s):
+                    waiter[1] -= 1
+                    if waiter[1] <= 0 and not waiter[0].is_set() and s.waiters.get(fp) is waiter:
+                        del s.waiters[fp]  # last waiter gone and never satisfied
+            if fired is None:
+                self._insert(fp, data)  # promote, as on the ordinary spill-hit path
+                self._evict_to_budget()
+                self._c_promotions += 1
+                return data
+            if not fired:
+                self._c_ref_timeouts += 1
+                raise DedupIntegrityException(f"unresolvable dedup ref {fp.hex()}")
+            # the literal (or a spill transition) landed: retry the lookup
 
     def __contains__(self, fp: bytes) -> bool:
-        if fp in self._mem:
-            return True
-        p = self._spill_path(fp)
-        return p is not None and p.exists()
+        # membership must be read under the owning locks: probing spill PATHS
+        # without them raced spill eviction (file unlinked between the mem
+        # miss and the exists() probe -> false positive/negative flapping)
+        s = self._stripe(fp)
+        with self._hold(s.lock, s):
+            if fp in s.mem:
+                return True
+        if self._spill_dir is None:
+            return False
+        with self._hold(self._spill_lock):
+            return fp in self._in_transit or fp in self._spill_order
+
+    def set_bounds(self, max_bytes: Optional[int] = None, spill_max_bytes: Optional[int] = None) -> None:
+        """Rebound the store (capacity-starvation tests, adaptive sizing).
+        Shrinking the memory bound evicts immediately; the spill bound is
+        enforced as evictees flow through the spill tier."""
+        if max_bytes is not None:
+            with self._hold(self._budget_lock):
+                self._max_bytes = max(1, int(max_bytes))
+        if spill_max_bytes is not None:
+            with self._hold(self._spill_lock):
+                self._spill_max_bytes = max(0, int(spill_max_bytes))
+        self._evict_to_budget()
+
+    # ---- introspection ----
+
+    @property
+    def mem_segment_count(self) -> int:
+        return sum(len(s.mem) for s in self._stripes)
 
     @property
     def capacity_bytes(self) -> int:
         """Total retention capacity (memory + spill) — advertised to source
         gateways so their SenderDedupIndex bounds split it fairly."""
         return self._max_bytes + (self._spill_max_bytes if self._spill_dir else 0)
+
+    def counters(self) -> dict:
+        """Decode-side health counters (merged into the receiver's stable
+        decode-counter schema; see docs/datapath-performance.md)."""
+        with self._hold(self._budget_lock):
+            mem_bytes = self._mem_bytes
+        with self._hold(self._spill_lock):
+            spill_bytes = self._spill_bytes
+        return {
+            "store_mem_hits": self._c_mem_hits,
+            "store_spill_reads": self._c_spill_reads,
+            "store_promotions": self._c_promotions,
+            "store_lock_held_disk_reads": self._c_lock_held_disk_reads,
+            "store_stripe_contention": sum(s.contended for s in self._stripes),
+            "store_ref_wait_ns": self._c_ref_wait_ns,
+            "store_ref_timeouts": self._c_ref_timeouts,
+            "store_mem_evictions": self._c_mem_evictions,
+            "store_spill_evictions": self._c_spill_evictions,
+            "store_mem_bytes": mem_bytes,
+            "store_spill_bytes": spill_bytes,
+        }
 
 
 def build_recipe(
@@ -309,19 +605,60 @@ def build_recipe(
     return head + bytes(entries) + lit_blob, len(ref_fps), sum(len(p) for p in lit_parts), new_fps, ref_fps
 
 
+class PooledChunk:
+    """Restored chunk bytes assembled in a pooled buffer (zero extra copies).
+
+    ``view`` is a memoryview over exactly the chunk's bytes; callers hand it
+    straight to the sink (file write / socket send) and then ``release()``
+    the underlying buffer back to its pool. The view must not be touched
+    after release — release() invalidates it so misuse raises, never aliases
+    another chunk's bytes.
+    """
+
+    __slots__ = ("_arr", "_pool", "view")
+
+    def __init__(self, arr: np.ndarray, pool: BufferPool, n: int):
+        self._arr = arr
+        self._pool = pool
+        self.view = memoryview(arr)[:n]
+
+    def __len__(self) -> int:
+        return len(self.view)
+
+    def release(self) -> None:
+        if self._arr is None:
+            return  # idempotent
+        self.view.release()
+        self._pool.release(self._arr)
+        self._arr = None
+
+
 def parse_recipe(
     buf: bytes,
     store: SegmentStore,
     decode_blob,
     ref_wait_timeout: float = 0.0,
     verify_literals: bool = False,
-) -> bytes:
+    out_pool: Optional[BufferPool] = None,
+    expected_raw_len: Optional[int] = None,
+):
     """Receiver side: resolve a recipe back into raw chunk bytes.
+
+    ``expected_raw_len`` (the wire header's ``raw_data_len``) is checked
+    against the entry-claimed total BEFORE any buffer allocation or store
+    work — a hostile entry list must not size an allocation, and the
+    mismatch fails fast instead of after a full restore.
 
     Every literal segment is inserted into ``store`` so later refs resolve.
     With ``verify_literals``, each literal's fingerprint is recomputed before
     admission — a corrupted literal stored under a healthy fingerprint would
     propagate to every future chunk that REFs it.
+
+    With ``out_pool``, segments are assembled directly into a pooled output
+    buffer (one copy per segment, no intermediate list + ``b"".join`` pass)
+    and a :class:`PooledChunk` is returned instead of ``bytes``; the caller
+    writes its ``view`` out and releases it. Without a pool the historical
+    ``bytes`` return is unchanged.
     """
     head_len = 2 + struct.calcsize("<BI")
     if len(buf) < head_len or buf[:2] != MAGIC:
@@ -335,33 +672,51 @@ def parse_recipe(
     if n_entries * _ENTRY.size > len(buf) - off:
         raise CodecException(f"recipe claims {n_entries} entries but only {len(buf) - off} bytes follow")
     entries = []
+    total = 0
     for _ in range(n_entries):
         kind, fp, seg_len = _ENTRY.unpack_from(buf, off)
         off += _ENTRY.size
         entries.append((kind, fp, seg_len))
+        total += seg_len
+    if total > MAX_RECIPE_RAW_BYTES:
+        raise CodecException(f"recipe claims {total} raw bytes (> {MAX_RECIPE_RAW_BYTES} cap)")
+    if expected_raw_len is not None and total != expected_raw_len:
+        raise CodecException(f"recipe entries claim {total} raw bytes but the header declared {expected_raw_len}")
     lit_blob = decode_blob(buf[off:])
+    arr: Optional[np.ndarray] = None
+    if out_pool is not None and total > 0:
+        arr = out_pool.acquire(bucket_size(total))
     out: List[bytes] = []
+    out_off = 0
     lit_off = 0
-    for kind, fp, seg_len in entries:
-        if kind == KIND_LIT:
-            seg = lit_blob[lit_off : lit_off + seg_len]
-            if len(seg) != seg_len:
-                raise DedupIntegrityException("literal blob shorter than recipe entries")
-            lit_off += seg_len
-            if verify_literals:
-                from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
-
-                if segment_fingerprint_host(seg) != fp:
-                    raise DedupIntegrityException(f"literal segment fingerprint mismatch (claimed {fp.hex()})")
-            store.put(fp, seg)
-            out.append(seg)
-        elif kind == KIND_REF:
-            seg = store.get(fp, wait_timeout=ref_wait_timeout)
-            if len(seg) != seg_len:
-                raise DedupIntegrityException(f"dedup ref {fp.hex()} length mismatch")
-            out.append(seg)
-        else:
-            raise CodecException(f"bad recipe entry kind {kind}")
-    if lit_off != len(lit_blob):
-        raise DedupIntegrityException("literal blob longer than recipe entries")
+    try:
+        for kind, fp, seg_len in entries:
+            if kind == KIND_LIT:
+                seg = lit_blob[lit_off : lit_off + seg_len]
+                if len(seg) != seg_len:
+                    raise DedupIntegrityException("literal blob shorter than recipe entries")
+                lit_off += seg_len
+                if verify_literals:
+                    if segment_fingerprint_host(seg) != fp:
+                        raise DedupIntegrityException(f"literal segment fingerprint mismatch (claimed {fp.hex()})")
+                store.put(fp, seg)
+            elif kind == KIND_REF:
+                seg = store.get(fp, wait_timeout=ref_wait_timeout)
+                if len(seg) != seg_len:
+                    raise DedupIntegrityException(f"dedup ref {fp.hex()} length mismatch")
+            else:
+                raise CodecException(f"bad recipe entry kind {kind}")
+            if arr is not None:
+                arr[out_off : out_off + seg_len] = np.frombuffer(seg, np.uint8)
+                out_off += seg_len
+            else:
+                out.append(seg)
+        if lit_off != len(lit_blob):
+            raise DedupIntegrityException("literal blob longer than recipe entries")
+    except BaseException:
+        if arr is not None:
+            out_pool.release(arr)  # a failed decode must not leak the buffer
+        raise
+    if arr is not None:
+        return PooledChunk(arr, out_pool, total)
     return b"".join(out)

@@ -32,7 +32,7 @@ from skyplane_tpu.ops import blockpack
 from skyplane_tpu.ops.bufpool import MIN_BUCKET, BufferPool, bucket_size
 from skyplane_tpu.ops.cdc import CDCParams, cdc_segment_ends
 from skyplane_tpu.ops.codecs import CodecSpec, get_codec, get_codec_by_id
-from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex, build_recipe, parse_recipe
+from skyplane_tpu.ops.dedup import PooledChunk, SegmentStore, SenderDedupIndex, build_recipe, parse_recipe
 from skyplane_tpu.ops.fingerprint import fixed_stride_lanes
 from skyplane_tpu.ops.gear import boundary_candidate_mask, gear_hash
 
@@ -275,6 +275,12 @@ class DataPathProcessor:
         # (the runner recycles after dispatch), else own one for the
         # unbatched device path
         self.bufpool = batch_runner.pool if batch_runner is not None else BufferPool()
+        # paranoid-verify accounting (decode side): total recipe chunks
+        # re-fingerprinted, and how many went through the shared batch runner
+        # (micro-batched device calls) instead of a per-chunk dispatch.
+        # Plain GIL increments — monitoring-grade, like the store counters.
+        self._verify_total = 0
+        self._verify_batched = 0
         self.stats = DataPathStats()
         if batch_runner is not None:
             # the runner's counters() already folds in its pool + fused stats
@@ -406,39 +412,68 @@ class DataPathProcessor:
 
     # ---- decode ----
 
+    def verify_counters(self) -> dict:
+        """Paranoid-verify counters, merged into the receiver's decode schema."""
+        return {"verify_total": self._verify_total, "verify_batched": self._verify_batched}
+
     def restore(
         self,
         payload: bytes,
         header: WireProtocolHeader,
         store: Optional[SegmentStore] = None,
         ref_wait_timeout: float = 60.0,
-    ) -> bytes:
+        pooled: bool = False,
+    ):
+        """Wire payload -> raw chunk bytes, driven by the wire header.
+
+        With ``pooled`` (the gateway receiver's decode pool), recipe payloads
+        assemble into a pooled buffer and a :class:`PooledChunk` is returned —
+        the caller writes ``.view`` out and calls ``.release()``. Non-recipe
+        payloads (and ``pooled=False``) return plain ``bytes``.
+        """
         codec = get_codec_by_id(header.codec)
         if header.is_recipe:
             if store is None:
                 raise CodecException("recipe payload but no SegmentStore configured")
             data = parse_recipe(
-                payload, store, codec.decode, ref_wait_timeout=ref_wait_timeout, verify_literals=self.verify_checksums
+                payload,
+                store,
+                codec.decode,
+                ref_wait_timeout=ref_wait_timeout,
+                verify_literals=self.verify_checksums,
+                out_pool=self.bufpool if pooled else None,
+                expected_raw_len=header.raw_data_len,
             )
         else:
             data = codec.decode(payload)
-        if len(data) != header.raw_data_len:
-            raise ChecksumMismatchException(
-                f"chunk {header.chunk_id}: raw length {len(data)} != header {header.raw_data_len}"
-            )
-        if self.verify_checksums and not header.is_recipe and header.fingerprint != "0" * 32:
-            got = hashlib.blake2b(data, digest_size=16).hexdigest()
-            if got != header.fingerprint:
-                raise ChecksumMismatchException(f"chunk {header.chunk_id}: fingerprint mismatch")
-        if self.paranoid_verify and header.is_recipe and header.fingerprint != "0" * 32:
-            # full end-to-end recipe verification: re-chunk the restored bytes
-            # (deterministic CDC) and rebuild the chunk fingerprint the sender
-            # embedded in the header — any wrong REF substitution surfaces here
-            arr = np.frombuffer(data, np.uint8)
-            _, seg_fps = self._cdc_and_fps(arr)
-            got = self._chunk_fingerprint(seg_fps, len(data))
-            if got != header.fingerprint:
+        view = data.view if isinstance(data, PooledChunk) else data
+        try:
+            if len(view) != header.raw_data_len:
                 raise ChecksumMismatchException(
-                    f"chunk {header.chunk_id}: paranoid recipe verification failed (restored bytes re-fingerprint differently)"
+                    f"chunk {header.chunk_id}: raw length {len(view)} != header {header.raw_data_len}"
                 )
+            if self.verify_checksums and not header.is_recipe and header.fingerprint != "0" * 32:
+                got = hashlib.blake2b(view, digest_size=16).hexdigest()
+                if got != header.fingerprint:
+                    raise ChecksumMismatchException(f"chunk {header.chunk_id}: fingerprint mismatch")
+            if self.paranoid_verify and header.is_recipe and header.fingerprint != "0" * 32:
+                # full end-to-end recipe verification: re-chunk the restored bytes
+                # (deterministic CDC) and rebuild the chunk fingerprint the sender
+                # embedded in the header — any wrong REF substitution surfaces here.
+                # Concurrent decode workers sharing a batch runner micro-batch
+                # these device calls instead of dispatching one blocking call each.
+                self._verify_total += 1
+                if self.batch_runner is not None and self._on_accelerator():
+                    self._verify_batched += 1
+                arr = np.frombuffer(view, np.uint8)
+                _, seg_fps = self._cdc_and_fps(arr)
+                got = self._chunk_fingerprint(seg_fps, len(view))
+                if got != header.fingerprint:
+                    raise ChecksumMismatchException(
+                        f"chunk {header.chunk_id}: paranoid recipe verification failed (restored bytes re-fingerprint differently)"
+                    )
+        except BaseException:
+            if isinstance(data, PooledChunk):
+                data.release()  # failed verification must not leak the buffer
+            raise
         return data

@@ -50,19 +50,15 @@ def test_receiver_eviction_nack_discard_resend(tmp_path):
         assert out1.read_bytes() == f1.read_bytes()
 
         store = dst.daemon.receiver.segment_store
-        assert len(store._mem) > 0, "phase 1 should have populated the segment store"
+        assert store.mem_segment_count > 0, "phase 1 should have populated the segment store"
         # capacity-starve BELOW the sender's index bound mid-transfer: shrink
-        # both tiers and let one real put() run the eviction loop — memory
-        # evictees overflow the zero-byte spill bound and are dropped
-        with store._lock:
-            store._max_bytes = 1
-            store._spill_max_bytes = 0
+        # both tiers through the REAL eviction loop — memory evictees overflow
+        # the zero-byte spill bound and are dropped
+        store.set_bounds(max_bytes=1, spill_max_bytes=0)
         store.put(b"\x00" * 16, b"x")
-        assert len(store._mem) <= 1 and store._spill_bytes == 0
+        assert store.mem_segment_count <= 1 and store._spill_bytes == 0
         # restore enough capacity for phase 2's working set
-        with store._lock:
-            store._max_bytes = 64 << 20
-            store._spill_max_bytes = 64 << 20
+        store.set_bounds(max_bytes=64 << 20, spill_max_bytes=64 << 20)
 
         sender = next(op for op in src.daemon.operators if getattr(op, "dedup_index", None) is not None)
         assert len(sender.dedup_index) > 0, "phase 1 should have committed fps to the sender index"

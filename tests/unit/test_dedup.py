@@ -144,8 +144,10 @@ def test_paranoid_verify_catches_poisoned_store():
     assert recv.restore(p1.wire_bytes, hdr1, store=store) == data
 
     # poison the store: swap one segment's bytes under its fingerprint
-    victim_fp = next(iter(store._mem))
-    store._mem[victim_fp] = bytes(len(store._mem[victim_fp]))
+    # (reach into the owning stripe — the striped store has no single map)
+    victim_fp = next(fp for s in store._stripes for fp in s.mem)
+    entry = store._stripe(victim_fp).mem[victim_fp]
+    entry[0] = bytes(len(entry[0]))
     hdr2 = WireProtocolHeader(
         chunk_id="b" * 32, data_len=len(p2.wire_bytes), raw_data_len=p2.raw_len,
         codec=int(p2.codec), flags=int(ChunkFlags.COMPRESSED | ChunkFlags.RECIPE), fingerprint=p2.fingerprint,
