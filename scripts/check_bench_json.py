@@ -12,7 +12,18 @@ from __future__ import annotations
 import json
 import sys
 
-REQUIRED_TOP = ("metric", "value", "unit", "vs_baseline", "platform", "datapath_counters", "decode_gbps", "decode_counters")
+REQUIRED_TOP = (
+    "metric",
+    "value",
+    "unit",
+    "vs_baseline",
+    "platform",
+    "device",
+    "datapath_counters",
+    "decode_gbps",
+    "decode_counters",
+    "wire_counters",
+)
 REQUIRED_COUNTERS = (
     "pool_hit_rate",
     "pool_hits",
@@ -34,6 +45,18 @@ REQUIRED_DECODE_COUNTERS = (
     "pool_hit_rate",
     "verify_total",
     "verify_batched",
+)
+# sender wire-engine section (mirrors bench.py WIRE_COUNTER_KEYS /
+# operators/sender_wire.py SENDER_WIRE_COUNTER_ZERO)
+REQUIRED_WIRE_COUNTERS = (
+    "frames_pipelined",
+    "wire_stall_ns",
+    "ack_lag_ns",
+    "wire_inflight_bytes",
+    "streams_open",
+    "windows",
+    "wire_stall_ns_per_window",
+    "serial_drain_ns_per_window",
 )
 
 
@@ -73,6 +96,11 @@ def main(argv) -> int:
         missing.append("decode_counters(dict)")
     else:
         missing += [f"decode_counters.{k}" for k in REQUIRED_DECODE_COUNTERS if k not in dec]
+    wire = result.get("wire_counters")
+    if not isinstance(wire, dict):
+        missing.append("wire_counters(dict)")
+    else:
+        missing += [f"wire_counters.{k}" for k in REQUIRED_WIRE_COUNTERS if k not in wire]
     if missing:
         print(f"bench-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
         return 1
@@ -82,9 +110,24 @@ def main(argv) -> int:
     if not isinstance(result["decode_gbps"], (int, float)) or result["decode_gbps"] <= 0:
         print(f"bench-smoke: implausible decode throughput {result['decode_gbps']!r}", file=sys.stderr)
         return 1
+    # acceptance gate for the pipelined sender wire engine: the continuous
+    # stream must actually pipeline, and its per-window transmit-idle time
+    # must beat the serial path's frame+ack drain on the loopback bench
+    if not wire["frames_pipelined"]:
+        print("bench-smoke: wire engine reported zero frames_pipelined (stream did not overlap)", file=sys.stderr)
+        return 1
+    if wire["wire_stall_ns_per_window"] >= wire["serial_drain_ns_per_window"]:
+        print(
+            f"bench-smoke: pipelined stall {wire['wire_stall_ns_per_window']}ns/window is not "
+            f"below the serial drain {wire['serial_drain_ns_per_window']}ns/window",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"bench-smoke OK: {result['value']} {result['unit']} encode, "
-        f"{result['decode_gbps']} {result['unit']} decode on {result['platform']}"
+        f"{result['decode_gbps']} {result['unit']} decode on {result['platform']} "
+        f"(device {result['device']}); wire: {wire['frames_pipelined']} frames pipelined, "
+        f"stall {wire['wire_stall_ns_per_window']}ns/window vs serial drain {wire['serial_drain_ns_per_window']}ns/window"
     )
     return 0
 

@@ -32,11 +32,14 @@ else
 fi
 
 # Bench-smoke gate (CPU-only, seconds): bench.py on a tiny corpus — the
-# sender encode bench AND the receiver decode bench (decode_gbps +
-# decode_counters) — then validate the JSON result line and BOTH perf-counter
-# schemas (docs/datapath-performance.md). Catches a malformed result or a
-# dropped counter key BEFORE a multi-hour real bench run discovers it. Like
-# lint: failures are logged LOUDLY but do not block device profiling.
+# sender encode bench, the receiver decode bench (decode_gbps +
+# decode_counters), and the loopback sender wire bench (wire_counters:
+# serial-vs-pipelined drain comparison) — then validate the JSON result line
+# and ALL THREE perf-counter schemas plus the device-provenance field
+# (docs/datapath-performance.md). Catches a malformed result, a dropped
+# counter key, or a wire engine that stopped pipelining BEFORE a multi-hour
+# real bench run discovers it. Like lint: failures are logged LOUDLY but do
+# not block device profiling.
 SKYPLANE_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu \
   SKYPLANE_BENCH_CHUNK_MB=1 SKYPLANE_BENCH_SNAPSHOTS=2 SKYPLANE_BENCH_SNAP_CHUNKS=2 SKYPLANE_BENCH_REPS=1 \
   SKYPLANE_BENCH_DECODE_WORKERS=4 \

@@ -238,14 +238,15 @@ class ThreadLifecycleChecker(Checker):
 
 
 _BLOCKING_PREFIXES = ("requests.", "urllib.", "socket.", "subprocess.")
-_SOCKET_METHODS = {"recv", "recv_into", "sendall", "accept", "connect", "makefile"}
 _QUEUEISH_FRAGMENTS = ("queue", "_q")
 
 
 class BlockingUnderLockChecker(Checker):
     """blocking-under-lock: sleeping or doing network/queue I/O while holding
     a lock turns every peer thread's short critical section into that I/O's
-    latency — the gateway's classic whole-daemon stall."""
+    latency — the gateway's classic whole-daemon stall. Socket-method calls
+    are owned by the dedicated ``socket-io-under-lock`` rule (which also
+    tracks acquire()/release() spans and matches any receiver object)."""
 
     rules = (
         RuleSpec(
@@ -288,8 +289,6 @@ class BlockingUnderLockChecker(Checker):
             return f"network/process call {name}"
         if isinstance(call.func, ast.Attribute):
             obj = dotted_name(call.func.value).split(".")[-1].lower()
-            if call.func.attr in _SOCKET_METHODS and ("sock" in obj or "conn" in obj):
-                return f"socket {call.func.attr}()"
             if (
                 call.func.attr == "get"
                 and not call.args
@@ -298,6 +297,102 @@ class BlockingUnderLockChecker(Checker):
             ):
                 return f"{obj}.get() with no timeout"
         return None
+
+
+_SOCKET_IO_METHODS = {"recv", "recv_into", "recvfrom", "send", "sendall", "accept", "connect", "do_handshake", "unwrap", "makefile"}
+
+
+class SocketIOUnderLockChecker(Checker):
+    """socket-io-under-lock: a blocking socket call (``recv``/``sendall``/…)
+    while holding a lock couples every peer thread's critical section to one
+    peer's network latency — a stalled remote stalls the whole operator pool.
+    This is the bug class the pipelined sender rewrite must never
+    reintroduce (its pump owns the socket and takes its stream lock only for
+    deque bookkeeping, never across a socket call).
+
+    Broader than ``blocking-under-lock``'s old socket branch on BOTH axes:
+    the receiver object's NAME does not matter (a socket held in ``self.s``
+    or ``peer`` still blocks), and explicit ``lock.acquire()``/``release()``
+    spans count as held regions alongside ``with lock:`` bodies. Wake-channel
+    writes on a non-blocking socketpair are the one legitimate pattern —
+    suppress those with a justification per policy."""
+
+    rules = (
+        RuleSpec(
+            "socket-io-under-lock",
+            "error",
+            "blocking socket call (recv/sendall/accept/connect/...) while a lock is held",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        lock_attrs: Set[str] = set()
+        for cls in [n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]:
+            lock_attrs |= _lock_attr_names(cls)
+        out: List[Finding] = []
+        for fn in [n for n in ast.walk(module.tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            self._scan_stmts(module, fn.body, 0, lock_attrs, out)
+        yield from out
+
+    def _scan_stmts(self, module: ModuleInfo, stmts, held: int, lock_attrs: Set[str], out: List[Finding]) -> int:
+        """Walk one statement sequence tracking the held-lock depth; returns
+        the depth after the sequence (acquire/release are sequential effects)."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute) and _is_lockish(call.func.value, lock_attrs):
+                    if call.func.attr == "acquire":
+                        held += 1
+                        continue
+                    if call.func.attr == "release":
+                        held = max(0, held - 1)
+                        continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # different dynamic scope; scanned as its own function
+            if isinstance(stmt, ast.With):
+                inner = held + sum(1 for item in stmt.items if _is_lockish(item.context_expr, lock_attrs))
+                self._scan_stmts(module, stmt.body, inner, lock_attrs, out)
+                continue
+            if isinstance(stmt, ast.Try):
+                # body runs after any preceding acquire(); finally typically
+                # holds the release — scanning in source order models exactly
+                # the acquire()/try/finally-release() idiom
+                self._scan_stmts(module, stmt.body, held, lock_attrs, out)
+                for handler in stmt.handlers:
+                    self._scan_stmts(module, handler.body, held, lock_attrs, out)
+                self._scan_stmts(module, stmt.orelse, held, lock_attrs, out)
+                held = self._scan_stmts(module, stmt.finalbody, held, lock_attrs, out)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                self._scan_expr(module, stmt.test, held, out)
+                self._scan_stmts(module, stmt.body, held, lock_attrs, out)
+                self._scan_stmts(module, stmt.orelse, held, lock_attrs, out)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(module, stmt.iter, held, out)
+                self._scan_stmts(module, stmt.body, held, lock_attrs, out)
+                self._scan_stmts(module, stmt.orelse, held, lock_attrs, out)
+                continue
+            self._scan_expr(module, stmt, held, out)
+        return held
+
+    def _scan_expr(self, module: ModuleInfo, node: ast.AST, held: int, out: List[Finding]) -> None:
+        if not held:
+            return
+        for sub in BlockingUnderLockChecker._walk_with_self(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _SOCKET_IO_METHODS
+            ):
+                out.append(
+                    self.finding(
+                        module,
+                        "socket-io-under-lock",
+                        sub,
+                        f"socket {sub.func.attr}() on {dotted_name(sub.func.value) or 'object'} while a lock is held",
+                    )
+                )
 
 
 class BareExceptLoopChecker(Checker):
@@ -336,5 +431,6 @@ CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     SharedStateChecker,
     ThreadLifecycleChecker,
     BlockingUnderLockChecker,
+    SocketIOUnderLockChecker,
     BareExceptLoopChecker,
 )

@@ -217,10 +217,14 @@ class GatewayDaemon:
     def _update_upload_ids(self, body: Dict[str, str]) -> None:
         self.upload_id_map.update(body)
 
-    def _sender_socket_events(self) -> list:
-        """Drain per-window send profile events from every sender operator
-        (sender-side analog of the receiver socket profiler)."""
+    def _sender_socket_events(self) -> dict:
+        """Per-window send profile events + the stable wire-counter schema
+        from every sender operator (sender-side analog of the receiver
+        socket/decode profilers): GET /api/v1/profile/socket/sender."""
+        from skyplane_tpu.gateway.operators.sender_wire import SENDER_WIRE_COUNTER_ZERO
+
         events = []
+        counters = dict(SENDER_WIRE_COUNTER_ZERO)
         for op in self.operators:
             if isinstance(op, GatewaySenderOperator):
                 while True:
@@ -228,7 +232,10 @@ class GatewayDaemon:
                         events.append(op.socket_profile_events.get_nowait())
                     except queue.Empty:
                         break
-        return events
+                per_op = op.wire_counters()
+                for k in counters:
+                    counters[k] += per_op.get(k, 0)
+        return {"events": events, "counters": counters}
 
     def _compression_stats(self) -> dict:
         from skyplane_tpu.ops.pipeline import DataPathStats

@@ -18,7 +18,7 @@ directly:
   POST /api/v1/upload_id_maps              dest_key -> multipart upload id
   GET  /api/v1/errors                      operator tracebacks
   GET  /api/v1/profile/socket/receiver     per-recv socket profile events
-  GET  /api/v1/profile/socket/sender       per-send-window profile events
+  GET  /api/v1/profile/socket/sender       per-send-window events + wire counters
   GET  /api/v1/profile/compression         TPU data-path stats (ratio, dedup)
   GET  /api/v1/profile/decode              receiver decode-pool counters+events
 
@@ -70,7 +70,7 @@ class GatewayDaemonAPI:
         self.region = region
         self.gateway_id = gateway_id
         self.compression_stats_fn = compression_stats_fn or (lambda: {})
-        self.sender_profile_fn = sender_profile_fn or (lambda: [])
+        self.sender_profile_fn = sender_profile_fn or (lambda: {"events": [], "counters": {}})
         # bearer token required on every route except GET /status (liveness
         # probes predate token distribution during provisioning). None =
         # auth disabled (local in-process harness).
@@ -314,7 +314,12 @@ class GatewayDaemonAPI:
                     break
             req._send(200, {"events": events})
         elif path == "/api/v1/profile/socket/sender":
-            req._send(200, {"events": self.sender_profile_fn()})
+            # {"events": [...], "counters": {...}} — the counters follow the
+            # stable SENDER_WIRE_COUNTER_ZERO schema (docs/datapath-performance.md)
+            profile = self.sender_profile_fn()
+            if isinstance(profile, list):  # legacy events-only provider
+                profile = {"events": profile, "counters": {}}
+            req._send(200, profile)
         elif path == "/api/v1/profile/compression":
             req._send(200, self.compression_stats_fn())
         elif path == "/api/v1/profile/decode":

@@ -13,12 +13,14 @@ framing bytes onto the socket.
 
 from __future__ import annotations
 
+import os
 import queue
 import socket
 import ssl
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from pathlib import Path
 from typing import Dict, List, Optional
 
@@ -29,6 +31,7 @@ import json
 from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, put_drop_oldest
+from skyplane_tpu.gateway.operators.sender_wire import EngineCallbacks
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
 from skyplane_tpu.gateway.gateway_queue import GatewayQueue
@@ -106,6 +109,11 @@ class GatewayOperator:
                         self.chunk_store.log_chunk_state(chunk_req, ChunkState.in_progress, self.handle, worker_id)
                 try:
                     results = self.process_batch(batch, worker_id)
+                    if results is None:
+                        # streaming operator (pipelined sender): the batch was
+                        # handed to an internal pipeline that does its own
+                        # completion/requeue/failure accounting as acks land
+                        continue
                 except BatchPartialFailure as bf:
                     # account the already-delivered chunks truthfully, fail
                     # the rest, then escalate the underlying cause
@@ -212,24 +220,77 @@ class GatewayReadLocalOperator(GatewayOperator):
 class GatewayWriteLocalOperator(GatewayOperator):
     """Writes a received chunk into its destination position in a local file
     (reference WriteLocal is a no-op :457-473; ours actually materializes the
-    file so the localhost harness is a full end-to-end data plane)."""
+    file so the localhost harness is a full end-to-end data plane).
+
+    Positional writes go through ``os.pwrite`` on a per-destination cached
+    fd: workers landing different chunks — different offsets of one file or
+    different files entirely — never serialize behind a shared lock (the old
+    ``_open_lock`` gated EVERY write on one mutex). The small cache lock only
+    guards the fd map itself; opens and pwrites run outside it. Entries are
+    refcounted so LRU eviction can never close an fd mid-write."""
+
+    MAX_CACHED_FDS = 256
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self._open_lock = threading.Lock()
+        self._fd_lock = threading.Lock()
+        self._fds: "OrderedDict[str, list]" = OrderedDict()  # dest -> [fd, refcount]
+
+    def _acquire_fd(self, dest: Path) -> int:
+        key = str(dest)
+        with self._fd_lock:
+            entry = self._fds.get(key)
+            if entry is not None:
+                entry[1] += 1
+                self._fds.move_to_end(key)
+                return entry[0]
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        fd = os.open(key, os.O_WRONLY | os.O_CREAT, 0o644)  # sparse-safe positional create
+        with self._fd_lock:
+            entry = self._fds.setdefault(key, [fd, 0])
+            if entry[0] != fd:
+                stale = fd  # raced another worker opening the same destination
+            else:
+                stale = None
+                while len(self._fds) > self.MAX_CACHED_FDS:
+                    victim = next((k for k, e in self._fds.items() if e[1] == 0 and k != key), None)
+                    if victim is None:
+                        break  # everything in use: let the map run hot briefly
+                    os.close(self._fds.pop(victim)[0])
+            entry[1] += 1
+        if stale is not None:
+            os.close(stale)
+        return entry[0]
+
+    def _release_fd(self, dest: Path) -> None:
+        with self._fd_lock:
+            entry = self._fds.get(str(dest))
+            if entry is not None:
+                entry[1] -= 1
+
+    def stop_workers(self, timeout: float = 5.0) -> None:
+        super().stop_workers(timeout)
+        with self._fd_lock:
+            fds, self._fds = [e[0] for e in self._fds.values()], OrderedDict()
+        for fd in fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     def process(self, chunk_req: ChunkRequest, worker_id: int) -> bool:
         chunk = chunk_req.chunk
         data = self.chunk_store.chunk_path(chunk.chunk_id).read_bytes()
         dest = Path(chunk.dest_key)
-        dest.parent.mkdir(parents=True, exist_ok=True)
         offset = chunk.file_offset_bytes or 0
-        with self._open_lock:
-            # open r+b if exists else create; sparse-safe positional write
-            mode = "r+b" if dest.exists() else "wb"
-            with open(dest, mode) as f:
-                f.seek(offset)
-                f.write(data)
+        fd = self._acquire_fd(dest)
+        try:
+            written = 0
+            view = memoryview(data)
+            while written < len(data):
+                written += os.pwrite(fd, view[written:], offset + written)
+        finally:
+            self._release_fd(dest)
         return True
 
 
@@ -320,34 +381,142 @@ class GatewayObjStoreWriteOperator(_ObjStoreOperator):
 
 
 class _WindowFpView:
-    """Dedup-index view for one in-flight send window.
+    """Dedup-index view for the in-flight frames of one socket.
 
     Fingerprints whose literals were framed EARLIER ON THE SAME SOCKET (but
-    not yet acked) are REF-safe for later chunks in the window: the receiver
+    not yet acked) are REF-safe for later chunks on that socket: the receiver
     stores literals in frame order before resolving later refs (dedup.py
-    consistency contract). The view is discarded if the window fails, so
+    consistency contract). The view is discarded if the stream fails, so
     nothing uncommitted ever leaks into the durable index.
+
+    Serial mode allocates a fresh ``pending`` set per window; the pipelined
+    engine passes each stream's persistent pending set, extending the same
+    REF-safety across every frame in flight on that stream.
     """
 
-    def __init__(self, index: SenderDedupIndex):
+    def __init__(self, index: SenderDedupIndex, pending: Optional[set] = None):
         self.index = index
-        self.pending: set = set()
+        self.pending: set = pending if pending is not None else set()
 
     def __contains__(self, fp: bytes) -> bool:
         return fp in self.pending or fp in self.index
 
 
+class _WindowStats:
+    """Per-window profile event carrier for the pipelined sender: frames of
+    one `_drain_batch` window share this object, and the event (same schema
+    as the serial path's per-window event) is emitted when the LAST frame of
+    the window resolves — acked, re-queued, or failed."""
+
+    __slots__ = ("op", "worker_id", "n_chunks", "t0", "lock", "n_done", "n_acked", "wire_bytes")
+
+    def __init__(self, op: "GatewaySenderOperator", worker_id: int, n_chunks: int):
+        self.op = op
+        self.worker_id = worker_id
+        self.n_chunks = n_chunks
+        self.t0 = time.perf_counter()
+        self.lock = threading.Lock()
+        self.n_done = 0
+        self.n_acked = 0
+        self.wire_bytes = 0
+
+    def add_wire(self, n: int) -> None:
+        with self.lock:
+            self.wire_bytes += n
+
+    def note(self, acked: bool) -> None:
+        with self.lock:
+            self.n_done += 1
+            if acked:
+                self.n_acked += 1
+            done = self.n_done >= self.n_chunks
+            if not done:
+                return
+            event = {
+                "handle": self.op.handle,
+                "worker_id": self.worker_id,
+                "target": self.op.target_gateway_id,
+                "n_chunks": self.n_chunks,
+                "n_acked": self.n_acked,
+                "wire_bytes": self.wire_bytes,
+                "seconds": round(time.perf_counter() - self.t0, 6),
+                "pipelined": True,
+            }
+        put_drop_oldest(self.op.socket_profile_events, event)
+
+
+class _SenderEngineOps(EngineCallbacks):
+    """Chunk/index accounting for one worker's wire engine — the reaper-side
+    half of what the serial worker loop did inline: commit-after-delivery,
+    NACK fingerprint rollback, silent re-queue of transient failures, and
+    daemon-fatal escalation."""
+
+    def __init__(self, op: "GatewaySenderOperator", worker_id: int):
+        self.op = op
+        self.worker_id = worker_id
+
+    def on_delivered(self, frame) -> None:
+        op = self.op
+        if op.dedup_index is not None:
+            # the ack means the chunk (and its dedup literals) is durably
+            # landed, so these commits are truthful (commit-after-delivery)
+            for fp, size in frame.new_fps:
+                op.dedup_index.add(fp, size)
+        op.chunk_store.log_chunk_state(frame.req, ChunkState.complete, op.handle, self.worker_id)
+        if op.output_queue is not None:
+            op.output_queue.put(frame.req)
+        if frame.window is not None:
+            frame.window.note(acked=True)
+
+    def on_nack(self, frame) -> None:
+        op = self.op
+        if op.dedup_index is not None:
+            # receiver no longer holds a segment this recipe REF'd: forget
+            # exactly those fps (the engine clears them from the stream's
+            # pending view) so the re-queued retry resends literals
+            for fp in frame.ref_fps:
+                op.dedup_index.discard(fp)
+        logger.fs.warning(
+            f"[{op.handle}:{self.worker_id}] receiver nacked chunk {frame.req.chunk.chunk_id}; "
+            f"dropped {len(frame.ref_fps)} fps, will resend literals"
+        )
+
+    def on_requeue(self, frame) -> None:
+        # transient (socket death / NACK retry): back to THIS handle's queue,
+        # state stays in_progress — the serial path's silent-requeue contract
+        self.op.input_queue.put_for_handle(self.op.handle, frame.req)
+        if frame.window is not None:
+            frame.window.note(acked=False)
+
+    def on_failed(self, frame) -> None:
+        self.op.chunk_store.log_chunk_state(frame.req, ChunkState.failed, self.op.handle, self.worker_id)
+        if frame.window is not None:
+            frame.window.note(acked=False)
+
+    def on_fatal(self, msg: str) -> None:
+        logger.fs.error(f"[{self.op.handle}:{self.worker_id}] {msg}")
+        self.op.error_queue.put(msg)
+        self.op.error_event.set()
+
+
 class GatewaySenderOperator(GatewayOperator):
     """Pushes chunks to a remote gateway over framed TCP(+TLS).
 
-    Per-worker persistent socket (reference opens one socket per sender
-    process, :248-262). Unlike round 1's stop-and-wait (one chunk, one ack,
-    one RTT), each worker drains up to ``window`` chunks from its queue,
-    pre-registers them in ONE control POST, streams all frames back-to-back,
-    then collects the per-chunk acks cumulatively — so a full window is in
-    flight per RTT (reference streams with no app-level ack at all,
-    chunk.py:96-155 n_chunks_left; we keep the ack for the dedup
-    commit-after-delivery contract and pipeline it instead).
+    Default mode is the pipelined wire engine (operators/sender_wire.py):
+    each worker keeps a continuous stream flowing — the worker thread frames
+    (file read + DataPathProcessor + seal) into a bounded frame-ahead queue,
+    a socket pump streams frames back-to-back under a byte-bounded in-flight
+    window with NO drain at window boundaries, and an ack reaper commits
+    fingerprints as the frame-ordered acks land concurrently with ongoing
+    sends. When the in-flight window stays full and acks lag, the engine
+    stripes up to ``SKYPLANE_TPU_SENDER_STREAMS`` extra connections.
+
+    ``SKYPLANE_TPU_SENDER_PIPELINED=0`` selects the legacy serial wire loop
+    (drain a window, stream its frames, then block collecting acks — one
+    full pipeline drain per window); the exactness suites compare the two
+    byte-for-byte. The reference streams with no app-level ack at all
+    (chunk.py:96-155 n_chunks_left); we keep the ack for the dedup
+    commit-after-delivery contract and pipeline around it instead.
 
     The payload runs through DataPathProcessor (codec + dedup) and optional
     AES-GCM seal.
@@ -370,6 +539,9 @@ class GatewaySenderOperator(GatewayOperator):
         api_token: Optional[str] = None,
         control_tls: bool = False,
         source_gateway_id: Optional[str] = None,
+        pipelined: Optional[bool] = None,
+        max_streams: Optional[int] = None,
+        frame_ahead: Optional[int] = None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -394,6 +566,29 @@ class GatewaySenderOperator(GatewayOperator):
         # accumulate one dict per window forever
         self.socket_profile_events: "queue.Queue[dict]" = queue.Queue(maxsize=4096)
         self._local = threading.local()
+        # pipelined wire engine config (operators/sender_wire.py); env knobs
+        # documented in docs/configuration.md. Constructor args override for
+        # tests and the serial-vs-pipelined exactness suites.
+        if pipelined is None:
+            pipelined = os.environ.get("SKYPLANE_TPU_SENDER_PIPELINED", "1").strip().lower() not in ("0", "false", "off")
+        self.pipelined = bool(pipelined)
+        if max_streams is None:
+            try:
+                extra = int(os.environ.get("SKYPLANE_TPU_SENDER_STREAMS", "2"))
+            except ValueError:
+                logger.fs.warning("ignoring malformed SKYPLANE_TPU_SENDER_STREAMS; using 2")
+                extra = 2
+            max_streams = 1 + max(0, extra)
+        self.max_streams = max(1, int(max_streams))
+        if frame_ahead is None:
+            try:
+                frame_ahead = int(os.environ.get("SKYPLANE_TPU_SENDER_FRAME_AHEAD", "2"))
+            except ValueError:
+                logger.fs.warning("ignoring malformed SKYPLANE_TPU_SENDER_FRAME_AHEAD; using 2")
+                frame_ahead = 2
+        self.frame_ahead = max(1, int(frame_ahead))
+        self._engines: list = []  # every worker's live engine (wire_counters aggregation)
+        self._engines_lock = threading.Lock()
         from skyplane_tpu.gateway.control_auth import control_session
 
         self._session = control_session(api_token)
@@ -455,7 +650,46 @@ class GatewaySenderOperator(GatewayOperator):
         self._local.sock = None
 
     def worker_teardown(self, worker_id: int) -> None:
+        engine = getattr(self._local, "engine", None)
+        if engine is not None:
+            engine.close(drain_timeout_s=2.0)
+            self._local.engine = None
         self._reset_sock()
+
+    def _engine(self, worker_id: int):
+        """This worker's pipelined wire engine (created on first use; one per
+        worker so frames stay ordered per framer)."""
+        engine = getattr(self._local, "engine", None)
+        if engine is None:
+            from skyplane_tpu.gateway.operators.sender_wire import SenderWireEngine
+
+            engine = SenderWireEngine(
+                self._make_socket,
+                _SenderEngineOps(self, worker_id),
+                inflight_limit_bytes=self.window_bytes,
+                frame_ahead=self.frame_ahead,
+                max_streams=self.max_streams,
+                name=f"{self.handle}-w{worker_id}",
+                abort_check=lambda: self.exit_flag.is_set() or self.error_event.is_set(),
+            )
+            self._local.engine = engine
+            with self._engines_lock:
+                self._engines.append(engine)
+        return engine
+
+    def wire_counters(self) -> dict:
+        """Stable-schema sender wire counters summed across worker engines
+        (GET /api/v1/profile/socket/sender and bench.py's wire section)."""
+        from skyplane_tpu.gateway.operators.sender_wire import SENDER_WIRE_COUNTER_ZERO
+
+        out = dict(SENDER_WIRE_COUNTER_ZERO)
+        with self._engines_lock:
+            engines = list(self._engines)
+        for engine in engines:
+            counters = engine.counters()
+            for k in out:
+                out[k] += counters.get(k, 0)
+        return out
 
     def _drain_batch(self) -> List[ChunkRequest]:
         """One blocking pop, then opportunistically fill the window — bounded
@@ -513,7 +747,7 @@ class GatewaySenderOperator(GatewayOperator):
         )
         return payload, wire, header
 
-    def process_batch(self, batch: List[ChunkRequest], worker_id: int) -> List[bool]:
+    def _register_batch(self, batch: List[ChunkRequest]) -> None:
         # pre-register the whole window at the destination in ONE control POST
         # (reference pre-registers per chunk, :277-319). Must precede the data
         # frames so completion accounting never sees an unregistered chunk.
@@ -522,12 +756,56 @@ class GatewaySenderOperator(GatewayOperator):
             try:
                 resp = self._session.post(f"{self._control_base}/chunk_requests", json=regs, timeout=30)
                 resp.raise_for_status()
-                break
+                return
             except requests.RequestException as e:
                 if attempt == 2:
                     raise
                 logger.fs.warning(f"[{self.handle}] chunk pre-register retry: {e}")
                 time.sleep(0.5 * (attempt + 1))
+
+    def process_batch(self, batch: List[ChunkRequest], worker_id: int) -> Optional[List[bool]]:
+        self._register_batch(batch)
+        if not self.pipelined:
+            return self._process_batch_serial(batch, worker_id)
+        # pipelined path: hand the window to this worker's wire engine. The
+        # submit loop below IS the framer stage — it runs the data path and
+        # blocks only on the frame-ahead queue, so by the time the last chunk
+        # is framed the first ones are already on the wire (and possibly
+        # acked). Completion/requeue/failure accounting happens in the
+        # engine's reaper as acks land; worker_loop sees None and moves
+        # straight to the next _drain_batch with no inter-window drain.
+        engine = self._engine(worker_id)
+        engine.note_window()
+        window = _WindowStats(self, worker_id, len(batch))
+        for req in batch:
+            # wire bytes counted on the frame the engine actually enqueued
+            # (a saturation-striped chunk is re-framed; counting inside the
+            # frame builder would double it)
+            frame = engine.submit(lambda pending, _req=req: self._build_wire_frame(_req, pending, window))
+            window.add_wire(frame.wire_len)
+        return None
+
+    def _build_wire_frame(self, req: ChunkRequest, pending_fps: set, window: "_WindowStats"):
+        """Framer body: one chunk -> WireFrame, REF decisions against the
+        target stream's in-flight pending view (engine-chosen)."""
+        from skyplane_tpu.gateway.operators.sender_wire import WireFrame
+
+        view = _WindowFpView(self.dedup_index, pending=pending_fps) if self.dedup_index is not None else None
+        # n_left=0: the reference-compat window countdown has no meaning on a
+        # continuous stream (receivers ignore it; docs/wire_protocol.md) —
+        # the one header field where serial and pipelined frames differ
+        payload, wire, header = self._frame_chunk(req, view, n_left=0)
+        return WireFrame(
+            req,
+            header,
+            wire,
+            new_fps=payload.new_fingerprints if payload is not None else (),
+            ref_fps=payload.ref_fingerprints if payload is not None else (),
+            relay=payload is None,
+            window=window,
+        )
+
+    def _process_batch_serial(self, batch: List[ChunkRequest], worker_id: int) -> List[bool]:
         view = _WindowFpView(self.dedup_index) if self.dedup_index is not None else None
         results = [False] * len(batch)
         sent = []  # (req, payload) for acked-frame bookkeeping only

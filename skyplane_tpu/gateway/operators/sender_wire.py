@@ -1,0 +1,597 @@
+"""Pipelined sender wire engine: framer / socket pump / ack reaper.
+
+The serial sender wire loop (one window: frame+send each chunk, then sit in
+a blocking ack-collection loop with the socket transmit-idle) pays a full
+pipeline drain — frame stall plus ack RTT — at every window boundary. This
+engine rebuilds the per-connection data path as a three-stage pipeline so
+the socket streams continuously across window boundaries:
+
+  framer (the operator worker thread)
+      file read + DataPathProcessor + seal; feeds a bounded frame-ahead
+      queue per stream, so the TPU batch runner stays fed while earlier
+      frames are still on the wire.
+  socket pump (one thread per stream, OWNS the socket)
+      streams frames back-to-back under a byte-bounded in-flight window and
+      opportunistically reads ack bytes between sends. Single-thread socket
+      ownership because concurrent SSL_read/SSL_write on one SSLSocket is
+      not safe (the same invariant as the receiver's framing loop).
+  ack reaper (one thread per engine)
+      consumes the pump's frame-ordered completions concurrently with
+      ongoing sends: commits fingerprints to the durable index on ACK,
+      rolls back only the affected fps on NACK, re-queues on socket death.
+
+Correctness contracts preserved from the serial path (docs/wire_protocol.md):
+
+  * REF-safety: a chunk may REF fingerprints whose literals were framed
+    EARLIER ON THE SAME STREAM but are not yet acked (`pending_fps` — the
+    window view generalized to the whole in-flight stream). Striped sibling
+    streams get independent pending sets: cross-stream in-flight REFs would
+    race frame order on the other socket.
+  * Commit-after-delivery: fingerprints enter the durable index only when
+    that frame's ack lands (the reaper), never at send time.
+  * NACK rollback discards only the nacked frame's REF'd fps (durable and
+    pending); the chunk re-queues and resends with literals.
+  * Socket death: every un-acked frame's chunk re-queues, the stream's
+    pending set resets (nothing uncommitted leaks into the durable index),
+    already-acked chunks stay complete — the truthful accounting the serial
+    path expressed through BatchPartialFailure.
+
+Adaptive stream count: an engine starts with ONE stream (socket) per
+worker and opens up to ``max_streams`` total striped connections when a
+submit finds every stream saturated — in-flight window full AND the
+frame-ahead queue full, i.e. the wire is the bottleneck and acks lag.
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import ssl
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED
+from skyplane_tpu.utils.logger import logger
+
+# stable sender wire-counter schema (the sender mirror of DECODE_COUNTER_ZERO):
+# every key always present — zeros when the pipelined engine is off — so
+# /profile/socket/sender, bench.py's wire section, and check_bench_json.py can
+# rely on the shape without probing which mode is active.
+SENDER_WIRE_COUNTER_ZERO = {
+    "wire_inflight_bytes": 0,  # gauge: sent-but-unacked bytes across streams
+    "wire_stall_ns": 0,  # pump idle with a frame READY but the in-flight window full
+    "ack_lag_ns": 0,  # sum over frames of (ack received - frame fully sent)
+    "frames_pipelined": 0,  # frames sent while >=1 earlier frame was still unacked
+    "streams_open": 0,  # gauge: live striped connections across engines
+    "frames_sent": 0,
+    "wire_bytes_sent": 0,
+    "acks_reaped": 0,
+    "nacks_reaped": 0,
+    "stream_resets": 0,
+    "windows": 0,  # submit batches (the _drain_batch granularity)
+}
+
+
+class WireFrame:
+    """One framed chunk flowing through the pipeline."""
+
+    __slots__ = ("req", "header", "wire", "wire_len", "new_fps", "ref_fps", "relay", "sent_ns", "window")
+
+    def __init__(self, req, header, wire: bytes, new_fps=(), ref_fps=(), relay: bool = False, window=None):
+        self.req = req
+        self.header = header
+        self.wire = wire
+        self.wire_len = len(wire)
+        self.new_fps = list(new_fps)  # (fp, size) committed to the durable index on ack
+        self.ref_fps = list(ref_fps)  # fps discarded on an unresolvable-REF nack
+        self.relay = relay  # opaque re-framed bytes: a NACK is unrecoverable
+        self.sent_ns = 0
+        self.window = window  # optional per-window stats carrier (profile events)
+
+
+class EngineCallbacks:
+    """Accounting hooks the engine invokes from its pump/reaper threads.
+
+    The engine owns stream mechanics (pending sets, in-flight windows); the
+    callbacks own everything chunk- and index-shaped. All default to no-ops
+    so benches and tests can drive the wire loop bare.
+    """
+
+    def on_delivered(self, frame: WireFrame) -> None:  # ack landed: commit + complete
+        ...
+
+    def on_nack(self, frame: WireFrame) -> None:  # discard REF'd fps from the durable index
+        ...
+
+    def on_requeue(self, frame: WireFrame) -> None:  # transient: chunk goes back to the queue
+        ...
+
+    def on_failed(self, frame: WireFrame) -> None:  # fatal path: chunk marked failed
+        ...
+
+    def on_fatal(self, msg: str) -> None:  # escalate to the daemon error machinery
+        ...
+
+
+class _Stream:
+    """One striped connection: frame-ahead queue, in-flight window, pending
+    fingerprint view, and the pump thread that owns the socket."""
+
+    __slots__ = (
+        "idx",
+        "lock",
+        "cond",
+        "frames",
+        "frames_bytes",
+        "inflight",
+        "inflight_bytes",
+        "pending_fps",
+        "sock",
+        "selector",
+        "dead",
+        "wake_r",
+        "wake_w",
+        "thread",
+    )
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.frames: "deque[WireFrame]" = deque()  # framed, not yet sent
+        self.frames_bytes = 0
+        self.inflight: "deque[WireFrame]" = deque()  # sent, not yet acked
+        self.inflight_bytes = 0
+        self.pending_fps: set = set()  # framed-on-this-stream, not yet committed/discarded
+        self.sock: Optional[socket.socket] = None
+        self.selector: Optional[selectors.BaseSelector] = None
+        self.dead = False
+        # wake channel: a submit (new frame) nudges the pump out of its ack
+        # wait so the frame goes on the wire now, not at the next select tick
+        self.wake_r, self.wake_w = socket.socketpair()
+        self.wake_r.setblocking(False)
+        self.wake_w.setblocking(False)
+        self.thread: Optional[threading.Thread] = None
+
+    def wake(self) -> None:
+        try:
+            self.wake_w.send(b"\x01")
+        except OSError:
+            pass  # wake already pending (buffer full) or channel torn down
+
+    def load_bytes(self) -> int:
+        with self.lock:
+            return self.inflight_bytes + self.frames_bytes
+
+    def close_channels(self) -> None:
+        if self.selector is not None:
+            try:
+                self.selector.close()
+            except OSError:
+                pass
+            self.selector = None
+        for s in (self.wake_r, self.wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class SenderWireEngine:
+    """Per-worker pipeline coordinator (see module docstring).
+
+    ``socket_factory`` returns a CONNECTED socket to the target (the
+    operator's `_make_socket`, including its control handshake and TLS).
+    ``callbacks`` is an :class:`EngineCallbacks`. ``frame_fn`` is supplied
+    per submit: it receives the chosen stream's pending-fp set and returns a
+    :class:`WireFrame` (the framer stage body — file read, DataPathProcessor,
+    seal — runs in the SUBMITTING thread, which is the operator worker).
+    """
+
+    IDLE_TICK_S = 0.2  # bounds shutdown latency and lost-wake recovery
+
+    def __init__(
+        self,
+        socket_factory: Callable[[], socket.socket],
+        callbacks: EngineCallbacks,
+        *,
+        inflight_limit_bytes: int = 256 << 20,
+        frame_ahead: int = 2,
+        max_streams: int = 1,
+        ack_timeout_s: float = 30.0,
+        name: str = "sender-wire",
+        abort_check: Optional[Callable[[], bool]] = None,
+    ):
+        self.socket_factory = socket_factory
+        self.callbacks = callbacks
+        # polled while a submit waits on a full frame-ahead queue: lets the
+        # framer (the operator worker thread) escape a stalled stream when
+        # the daemon is shutting down, instead of wedging worker_loop exit
+        self.abort_check = abort_check
+        self.inflight_limit = max(1, int(inflight_limit_bytes))
+        self.frame_ahead = max(1, int(frame_ahead))
+        self.max_streams = max(1, int(max_streams))
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.name = name
+        self._streams: List[_Stream] = []
+        self._streams_lock = threading.Lock()
+        self._completion_q: "deque" = deque()  # (stream, frame, resp byte) in ack order
+        self._completion_cond = threading.Condition()
+        self._counters = dict(SENDER_WIRE_COUNTER_ZERO)
+        self._counters_lock = threading.Lock()
+        self._closed = False
+        self._reaper = threading.Thread(target=self._reap, name=f"{name}-reaper", daemon=True)
+        self._reaper.start()
+        with self._streams_lock:
+            self._open_stream_locked()
+
+    # ---- framer-side API ----
+
+    def submit(self, frame_fn: Callable[[set], WireFrame]) -> WireFrame:
+        """Frame one chunk onto the least-loaded stream and enqueue it.
+        Blocks when the chosen stream's frame-ahead queue is full — that
+        backpressure is what bounds per-worker memory to frame_ahead chunks
+        per stream. A submit that finds its stream SATURATED (in-flight
+        window full AND frame-ahead queue full — the wire is the bottleneck
+        and acks lag) stripes a new connection instead of waiting, up to
+        ``max_streams``: the chunk is re-framed against the new stream's
+        (empty) pending view so REF-safety stays per-socket."""
+        stream = self._pick_stream()
+        frame = frame_fn(stream.pending_fps)
+        while True:
+            with stream.lock:
+                if stream.dead:
+                    self.callbacks.on_requeue(frame)
+                    return frame
+                if len(stream.frames) < self.frame_ahead:
+                    stream.frames.append(frame)
+                    stream.frames_bytes += frame.wire_len
+                    stream.cond.notify_all()
+                    break
+                saturated = stream.inflight_bytes >= self.inflight_limit
+            if saturated:
+                new = self._try_open_stream()
+                if new is not None:
+                    # the frame's new fps were staged into the old stream's
+                    # pending view at frame time; retire them there (their
+                    # literal frame will never ride that socket) and re-frame
+                    # against the new stream so REFs stay socket-consistent
+                    with stream.lock:
+                        stream.pending_fps.difference_update(fp for fp, _ in frame.new_fps)
+                    stream = new
+                    frame = frame_fn(stream.pending_fps)
+                    continue
+            if self.abort_check is not None and self.abort_check():
+                self.callbacks.on_requeue(frame)
+                return frame
+            with stream.lock:
+                if not stream.dead and len(stream.frames) >= self.frame_ahead:
+                    stream.cond.wait(self.IDLE_TICK_S)
+        stream.wake()
+        return frame
+
+    def note_window(self) -> None:
+        """Caller marker: one submit batch (= one `_drain_batch` window)."""
+        self._bump("windows")
+
+    def counters(self) -> dict:
+        with self._counters_lock:
+            out = dict(self._counters)
+        with self._streams_lock:
+            streams = list(self._streams)
+        out["streams_open"] = sum(1 for s in streams if not s.dead)
+        total = 0
+        for s in streams:
+            with s.lock:
+                total += s.inflight_bytes
+        out["wire_inflight_bytes"] = total
+        return out
+
+    def close(self, drain_timeout_s: float = 2.0) -> None:
+        """Drain in-flight frames (bounded), then stop every thread. Frames
+        that could not drain re-queue so a restart resends them."""
+        deadline = time.monotonic() + max(0.0, drain_timeout_s)
+        with self._streams_lock:
+            streams = list(self._streams)
+        for s in streams:
+            with s.lock:
+                while (s.frames or s.inflight) and not s.dead and time.monotonic() < deadline:
+                    s.cond.wait(min(self.IDLE_TICK_S, max(0.01, deadline - time.monotonic())))
+        self._closed = True
+        leftovers: List[WireFrame] = []
+        for s in streams:
+            with s.lock:
+                s.dead = True
+                leftovers += list(s.inflight) + list(s.frames)
+                s.inflight.clear()
+                s.frames.clear()
+                s.inflight_bytes = s.frames_bytes = 0
+                s.pending_fps.clear()
+                s.cond.notify_all()
+            s.wake()
+        for frame in leftovers:
+            self.callbacks.on_requeue(frame)
+        with self._completion_cond:
+            self._completion_cond.notify_all()
+        for s in streams:
+            if s.thread is not None:
+                s.thread.join(timeout=1.0)
+        self._reaper.join(timeout=1.0)
+
+    # ---- stream management ----
+
+    def _open_stream_locked(self) -> _Stream:
+        stream = _Stream(len(self._streams))
+        stream.thread = threading.Thread(
+            target=self._pump, args=(stream,), name=f"{self.name}-pump{stream.idx}", daemon=True
+        )
+        self._streams.append(stream)
+        stream.thread.start()
+        return stream
+
+    def _pick_stream(self) -> _Stream:
+        with self._streams_lock:
+            best = min(self._streams, key=_Stream.load_bytes)
+            if len(self._streams) < self.max_streams and self._saturated(best):
+                # every stream has a full in-flight window AND a full
+                # frame-ahead queue: acks lag the wire — stripe wider
+                return self._open_stream_locked()
+        return best
+
+    def _try_open_stream(self) -> Optional[_Stream]:
+        with self._streams_lock:
+            if self._closed or len(self._streams) >= self.max_streams:
+                return None
+            return self._open_stream_locked()
+
+    def _saturated(self, stream: _Stream) -> bool:
+        with stream.lock:
+            return stream.inflight_bytes >= self.inflight_limit and len(stream.frames) >= self.frame_ahead
+
+    # ---- socket pump (one per stream; the ONLY thread touching its socket) ----
+
+    def _pump(self, stream: _Stream) -> None:
+        try:
+            while True:
+                with stream.lock:
+                    while not stream.frames and not stream.inflight and not stream.dead:
+                        stream.cond.wait(self.IDLE_TICK_S)
+                    if stream.dead and not stream.frames and not stream.inflight:
+                        break
+                if stream.sock is None and not self._connect(stream):
+                    continue
+                try:
+                    self._pump_once(stream)
+                except (OSError, ssl.SSLError) as e:
+                    self._reset_stream(stream, str(e))
+                    time.sleep(0.2)  # same reconnect backoff as the serial path
+        except Exception:  # noqa: BLE001 — unexpected pump error is daemon-fatal
+            import traceback
+
+            self._fatal(f"sender wire pump died: {traceback.format_exc()}")
+        finally:
+            sock = stream.sock
+            stream.sock = None
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            stream.close_channels()
+
+    def _connect(self, stream: _Stream) -> bool:
+        try:
+            sock = self.socket_factory()
+        except Exception as e:  # noqa: BLE001 — control POST / TCP / TLS failures retry
+            self._reset_stream(stream, f"connect failed: {e}")
+            time.sleep(0.2)
+            return False
+        stream.sock = sock
+        stream.selector = selectors.DefaultSelector()
+        stream.selector.register(sock, selectors.EVENT_READ, "conn")
+        stream.selector.register(stream.wake_r, selectors.EVENT_READ, "wake")
+        return True
+
+    def _pump_once(self, stream: _Stream) -> None:
+        frame = None
+        with stream.lock:
+            # the window bound gates SENDS, so in-flight bytes are bounded by
+            # inflight_limit plus at most one frame; an empty window always
+            # admits one frame so an oversized chunk cannot wedge the stream
+            if stream.frames and (stream.inflight_bytes < self.inflight_limit or not stream.inflight):
+                frame = stream.frames.popleft()
+                stream.frames_bytes -= frame.wire_len
+                stream.cond.notify_all()  # the framer may enqueue the next chunk
+        if frame is not None:
+            try:
+                frame.header.to_socket(stream.sock)
+                stream.sock.sendall(frame.wire)
+            except (OSError, ssl.SSLError):
+                # the frame is in-hand (already popped): put it back so the
+                # reset path requeues its chunk — otherwise a socket death
+                # DURING the send would strand the chunk in_progress forever
+                with stream.lock:
+                    stream.frames.appendleft(frame)
+                    stream.frames_bytes += frame.wire_len
+                raise
+            frame.sent_ns = time.perf_counter_ns()
+            frame.wire = b""  # wire bytes are on the socket; keep only bookkeeping
+            with stream.lock:
+                pipelined = bool(stream.inflight)
+                stream.inflight.append(frame)
+                stream.inflight_bytes += frame.wire_len
+            self._bump("frames_sent")
+            self._bump("wire_bytes_sent", frame.wire_len)
+            if pipelined:
+                self._bump("frames_pipelined")
+            self._drain_acks(stream, block=False)
+            return
+        with stream.lock:
+            stalled = bool(stream.frames)  # frame ready, in-flight window full
+            has_inflight = bool(stream.inflight)
+        if not has_inflight:
+            return  # outer loop waits for work
+        t0 = time.perf_counter_ns() if stalled else 0
+        self._drain_acks(stream, block=True)
+        if stalled:
+            self._bump("wire_stall_ns", time.perf_counter_ns() - t0)
+
+    def _drain_acks(self, stream: _Stream, block: bool) -> None:
+        """Read response bytes for the in-flight frames, oldest first. With
+        ``block``, waits one tick for readability; raises OSError when the
+        oldest in-flight frame has outlived the ack timeout (the serial
+        path's socket-timeout semantics)."""
+        while True:
+            with stream.lock:
+                if not stream.inflight:
+                    return
+                oldest_sent = stream.inflight[0].sent_ns
+            sock = stream.sock
+            pending = getattr(sock, "pending", None)
+            readable = bool(pending is not None and sock.pending())
+            if not readable:
+                try:
+                    events = stream.selector.select(self.IDLE_TICK_S if block else 0)
+                except (OSError, ValueError):
+                    raise OSError("socket torn down mid-select")
+                ready = {key.data for key, _ in events}
+                if "wake" in ready:
+                    try:
+                        stream.wake_r.recv(4096)
+                    except OSError:
+                        pass
+                readable = "conn" in ready
+            if not readable:
+                if block and (time.perf_counter_ns() - oldest_sent) / 1e9 > self.ack_timeout_s:
+                    raise OSError(f"no ack for {self.ack_timeout_s:.0f}s with frames in flight")
+                return
+            b = sock.recv(1)
+            if not b:
+                raise ConnectionError("peer closed mid-stream")
+            if b not in (ACK_BYTE, NACK_UNRESOLVED):
+                raise OSError(f"bad/missing chunk ack ({b!r})")
+            now = time.perf_counter_ns()
+            with stream.lock:
+                frame = stream.inflight.popleft()
+                stream.inflight_bytes -= frame.wire_len
+                stream.cond.notify_all()  # in-flight window opened: sends resume
+            self._bump("ack_lag_ns", now - frame.sent_ns)
+            with self._completion_cond:
+                self._completion_q.append((stream, frame, b))
+                self._completion_cond.notify()
+            block = False  # past the first ack, only drain what is already here
+
+    def _reset_stream(self, stream: _Stream, why: str) -> None:
+        """Socket death: close, re-queue every un-sent and un-acked frame,
+        reset the pending view (nothing uncommitted leaked — acked frames'
+        fps were already committed by the reaper)."""
+        logger.fs.warning(f"[{self.name}:stream{stream.idx}] socket error mid-stream: {why}")
+        self._bump("stream_resets")
+        with stream.lock:
+            doomed = list(stream.inflight) + list(stream.frames)
+            stream.inflight.clear()
+            stream.frames.clear()
+            stream.inflight_bytes = stream.frames_bytes = 0
+            stream.pending_fps.clear()
+            sock, stream.sock = stream.sock, None
+            stream.cond.notify_all()
+        if stream.selector is not None:
+            # a fresh selector comes with the next connect; closing (not just
+            # unregistering) releases the epoll fd of the dead one
+            try:
+                stream.selector.close()
+            except OSError:
+                pass
+            stream.selector = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for frame in doomed:
+            self.callbacks.on_requeue(frame)
+
+    # ---- ack reaper (one per engine; never touches a socket) ----
+
+    def _reap(self) -> None:
+        try:
+            while True:
+                with self._completion_cond:
+                    while not self._completion_q and not self._closed:
+                        self._completion_cond.wait(self.IDLE_TICK_S)
+                    if not self._completion_q:
+                        if self._closed:
+                            return
+                        continue
+                    stream, frame, b = self._completion_q.popleft()
+                if b == ACK_BYTE:
+                    self._bump("acks_reaped")
+                    # commit to the durable index FIRST, then retire the fps
+                    # from the stream view — membership (pending ∪ durable)
+                    # never has a gap a concurrent framer could fall through
+                    self.callbacks.on_delivered(frame)
+                    if frame.new_fps:
+                        with stream.lock:
+                            stream.pending_fps.difference_update(fp for fp, _ in frame.new_fps)
+                else:  # NACK_UNRESOLVED
+                    self._bump("nacks_reaped")
+                    if frame.relay:
+                        # opaque staged bytes: the recipe cannot be rebuilt and a
+                        # re-queue would replay the identical unresolvable frame
+                        # forever — fail the stream's outstanding work loudly
+                        self._fatal(
+                            f"downstream receiver nacked relayed chunk {frame.req.chunk.chunk_id} "
+                            "(unresolvable dedup ref; relay cannot rebuild the recipe)",
+                            frame,
+                        )
+                        return
+                    self.callbacks.on_nack(frame)  # durable-index rollback
+                    with stream.lock:
+                        for fp in frame.ref_fps:
+                            stream.pending_fps.discard(fp)
+                    self.callbacks.on_requeue(frame)  # resend with literals
+        except Exception:  # noqa: BLE001 — unexpected reaper error is daemon-fatal
+            import traceback
+
+            self._fatal(f"sender wire reaper died: {traceback.format_exc()}")
+
+    def _fatal(self, msg: str, frame: Optional[WireFrame] = None) -> None:
+        """Unrecoverable: fail the nacked frame plus everything still queued
+        or in flight (the BatchPartialFailure truth: acked chunks stay
+        complete, the rest are failed), then escalate."""
+        doomed = [frame] if frame is not None else []
+        with self._streams_lock:
+            streams = list(self._streams)
+        for s in streams:
+            with s.lock:
+                s.dead = True
+                doomed += list(s.inflight) + list(s.frames)
+                s.inflight.clear()
+                s.frames.clear()
+                s.inflight_bytes = s.frames_bytes = 0
+                s.cond.notify_all()
+            s.wake()
+        self._closed = True
+        # honour responses already reaped off the wire before failing the
+        # rest: a completion sitting in the queue is a durably delivered (or
+        # definitively nacked) chunk — "acked chunks stay complete" must hold
+        # even when the fatal interleaves with in-flight completions
+        with self._completion_cond:
+            leftovers = list(self._completion_q)
+            self._completion_q.clear()
+            self._completion_cond.notify_all()
+        for _stream, f, b in leftovers:
+            if b == ACK_BYTE:
+                self._bump("acks_reaped")
+                self.callbacks.on_delivered(f)
+            else:
+                doomed.append(f)
+        for f in doomed:
+            self.callbacks.on_failed(f)
+        self.callbacks.on_fatal(msg)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._counters_lock:
+            self._counters[key] += n

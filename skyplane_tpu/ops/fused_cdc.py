@@ -185,6 +185,14 @@ class PendingBatch:
 
     def result_row(self, i: int) -> Tuple[np.ndarray, List[bytes]]:
         if self.fallback[i] is not None:
+            if self._ends_scratch is not None and all(f is not None for f in self.fallback):
+                # EVERY row overflowed to the exact host path: no caller will
+                # ever ask for lanes(), which is the only other place the
+                # pooled ends scratch (and the enqueued fingerprint readback)
+                # are released. Consume the device result now — the readback
+                # wait is acceptable on this pathological-density path —
+                # instead of stranding the scratch in BufferPool._outstanding.
+                self.lanes()
             return self.fallback[i]
         ends = self.ends_rows[i]
         return ends, finalize_row(self.lanes()[i], ends)

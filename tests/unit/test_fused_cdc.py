@@ -111,3 +111,54 @@ def test_fuzz_params_and_lengths():
             want_ends, want_fps = _expected(c, params)
             np.testing.assert_array_equal(ends, want_ends)
             assert fps == want_fps
+
+
+def test_all_fallback_batch_releases_pooled_scratch(monkeypatch):
+    """When EVERY row of a batch overflows the candidate cap, lanes() is
+    never demanded by result_row — the all-fallback path must still release
+    the pooled ends scratch (and consume the enqueued fingerprint readback)
+    so BufferPool._outstanding returns to zero (ROADMAP open item from PR 3)."""
+    import skyplane_tpu.ops.fused_cdc as fused_mod
+    from skyplane_tpu.ops.bufpool import BufferPool
+
+    params = CDCParams(min_bytes=64, avg_bytes=256, max_bytes=1024)
+    n = 1 << 16
+    # ~n/256 = 256 expected candidates per row; cap of 16 guarantees overflow
+    monkeypatch.setattr(fused_mod, "candidate_cap", lambda bucket, params=None: 16)
+    pool = BufferPool()
+    fused = fused_mod.FusedCDCFP(params, pallas=False, pool=pool)
+    batch = rng.integers(0, 256, (2, n), dtype=np.uint8)  # pathological density corpus
+    pending = fused.dispatch(batch, [n, n])
+    assert all(f is not None for f in pending.fallback), "scenario must be all-fallback"
+    for i in range(2):
+        ends, fps = pending.result_row(i)
+        want_ends, want_fps = _expected(batch[i], params)
+        np.testing.assert_array_equal(ends, want_ends)
+        assert fps == want_fps
+    counters = pool.counters()
+    assert counters["pool_outstanding"] == 0, "all-fallback batch stranded the pooled ends scratch"
+    assert counters["pool_recycled"] >= 1
+
+
+def test_mixed_fallback_batch_releases_scratch_via_lanes(monkeypatch):
+    """A batch mixing overflow and normal rows releases scratch through the
+    normal lanes() path — the all-fallback release must not double-release."""
+    import skyplane_tpu.ops.fused_cdc as fused_mod
+    from skyplane_tpu.ops.bufpool import BufferPool
+
+    params = CDCParams(min_bytes=64, avg_bytes=256, max_bytes=1024)
+    n = 1 << 16
+    # cap of 16: row 0 (random content, ~256 candidates) overflows; row 1
+    # (all zeros -> few/no gear candidates) stays on the device path
+    monkeypatch.setattr(fused_mod, "candidate_cap", lambda bucket, params_=None: 16)
+    pool = BufferPool()
+    fused = fused_mod.FusedCDCFP(params, pallas=False, pool=pool)
+    batch = np.stack([rng.integers(0, 256, n, dtype=np.uint8), np.zeros(n, np.uint8)])
+    pending = fused.dispatch(batch, [n, n])
+    assert pending.fallback[0] is not None and pending.fallback[1] is None, "scenario must be mixed"
+    for i in range(2):
+        ends, fps = pending.result_row(i)
+        want_ends, want_fps = _expected(batch[i], params)
+        np.testing.assert_array_equal(ends, want_ends)
+        assert fps == want_fps
+    assert pool.counters()["pool_outstanding"] == 0

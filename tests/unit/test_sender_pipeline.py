@@ -1,0 +1,414 @@
+"""Pipelined sender wire engine (operators/sender_wire.py + the operator's
+pipelined process_batch): serial-vs-pipelined wire-byte determinism, truthful
+accounting across mid-stream socket death, NACK fingerprint rollback scoped
+to the affected fps, the byte-bounded in-flight window under a stalled
+receiver, and adaptive stream striping."""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from skyplane_tpu.chunk import Chunk, ChunkRequest, WireProtocolHeader
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+from skyplane_tpu.gateway.operators.gateway_operator import GatewaySenderOperator
+from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED
+from skyplane_tpu.gateway.operators.sender_wire import SENDER_WIRE_COUNTER_ZERO
+from skyplane_tpu.ops import dedup as dedup_mod
+from skyplane_tpu.ops.dedup import SenderDedupIndex
+from skyplane_tpu.ops.pipeline import DataPathProcessor
+
+rng = np.random.default_rng(23)
+
+
+class AckServer:
+    """Plain-TCP receiver double: parses sender frames and answers per a
+    scripted policy. ``script(i, header, payload) -> bytes | "kill" | None``
+    where i is the global arrival index; None = receive but never respond
+    (a stalled receiver). Default: ack everything."""
+
+    def __init__(self, script=None, ack_delay_s: float = 0.0):
+        self.script = script
+        self.ack_delay_s = ack_delay_s
+        self.lock = threading.Lock()
+        self.frames = []  # (chunk_id, payload) in arrival order
+        self.received_bytes = 0
+        self.conn_count = 0
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(16)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self.lock:
+                self.conn_count += 1
+            threading.Thread(target=self._conn, args=(conn,), daemon=True).start()
+
+    def _conn(self, conn):
+        try:
+            while True:
+                header = WireProtocolHeader.from_socket(conn)
+                remaining = header.data_len
+                payload = b""
+                while remaining:
+                    got = conn.recv(min(1 << 20, remaining))
+                    if not got:
+                        return
+                    remaining -= len(got)
+                    payload += got
+                with self.lock:
+                    i = len(self.frames)
+                    self.frames.append((header.chunk_id, payload))
+                    self.received_bytes += 78 + header.data_len
+                action = self.script(i, header, payload) if self.script else ACK_BYTE
+                if action == "kill":
+                    return
+                if action:
+                    if self.ack_delay_s:
+                        time.sleep(self.ack_delay_s)
+                    conn.sendall(action)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def frame_log(self):
+        with self.lock:
+            return list(self.frames)
+
+    def close(self):
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def make_sender(tmp_path, port, *, dedup=True, n_workers=1, **kw):
+    """A GatewaySenderOperator wired straight at an AckServer: the control
+    plane (/servers + chunk pre-registration) is stubbed out, the data
+    socket connects directly."""
+    store = ChunkStore(str(tmp_path / f"tx_{uuid.uuid4().hex[:8]}"))
+    in_q = GatewayQueue()
+    out_q = GatewayQueue()
+    out_q.register_handle("sink")
+    error_event = threading.Event()
+    error_queue: "queue.Queue[str]" = queue.Queue()
+    op = GatewaySenderOperator(
+        handle="send",
+        region="test:r",
+        input_queue=in_q,
+        output_queue=out_q,
+        error_event=error_event,
+        error_queue=error_queue,
+        chunk_store=store,
+        n_workers=n_workers,
+        target_gateway_id="gw_test",
+        target_host="127.0.0.1",
+        target_control_port=0,
+        codec_name="none",
+        dedup=dedup,
+        use_tls=False,
+        **kw,
+    )
+
+    def direct_socket():
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    op._make_socket = direct_socket
+    op._register_batch = lambda batch: None
+    return op, in_q, out_q, error_event, store
+
+
+def stage_chunks(store: ChunkStore, datas):
+    reqs = []
+    for i, data in enumerate(datas):
+        cid = f"{i:032x}"
+        store.chunk_path(cid).write_bytes(data)
+        reqs.append(
+            ChunkRequest(chunk=Chunk(src_key="s", dest_key="d", chunk_id=cid, chunk_length_bytes=len(data)))
+        )
+    return reqs
+
+
+def drain_n(out_q: GatewayQueue, n: int, timeout: float = 20.0):
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        try:
+            got.append(out_q.pop("sink", timeout=0.25))
+        except queue.Empty:
+            continue
+    return got
+
+
+def recipe_kinds(payload: bytes):
+    """Entry kinds (0=REF, 1=LIT) of a codec-none recipe payload."""
+    assert payload[: len(dedup_mod.MAGIC)] == dedup_mod.MAGIC
+    _, n = struct.unpack_from("<BI", payload, len(dedup_mod.MAGIC))
+    off = len(dedup_mod.MAGIC) + 5
+    kinds = []
+    for _ in range(n):
+        kind, _fp, _size = dedup_mod._ENTRY.unpack_from(payload, off)
+        kinds.append(kind)
+        off += dedup_mod._ENTRY.size
+    return kinds
+
+
+def expected_fps(datas):
+    """Per-chunk new fingerprints via an identical offline data path."""
+    proc = DataPathProcessor(codec_name="none", dedup=True)
+    index = SenderDedupIndex()
+    out = []
+    for data in datas:
+        p = proc.process(bytes(data), index)
+        out.append([fp for fp, _ in p.new_fingerprints])
+        for fp, size in p.new_fingerprints:
+            index.add(fp, size)
+    return out
+
+
+# ---------------------------------------------------------------- determinism
+
+
+def test_serial_vs_pipelined_wire_bytes_identical(tmp_path):
+    """The exactness contract: the pipelined engine must put byte-identical
+    PAYLOADS on the wire, in the same order, as the legacy serial path —
+    including dedup REF decisions against in-flight (unacked) literals.
+    (Headers differ only in the reference-compat n_chunks_left_on_socket
+    countdown, which is 0 on a continuous stream and ignored by receivers —
+    docs/wire_protocol.md.)"""
+    base = rng.integers(0, 256, 96_000, dtype=np.uint8).tobytes()
+    datas = [
+        base,
+        rng.integers(0, 256, 64_000, dtype=np.uint8).tobytes(),
+        base,  # all-REF against chunk 0 (possibly still unacked when framed)
+        base[:48_000] + rng.integers(0, 256, 16_000, dtype=np.uint8).tobytes(),
+    ]
+
+    def run(pipelined: bool):
+        server = AckServer(ack_delay_s=0.005)  # acks lag so frames really overlap
+        op, in_q, out_q, _, store = make_sender(
+            tmp_path, server.port, pipelined=pipelined, max_streams=1, window=4
+        )
+        try:
+            for req in stage_chunks(store, datas):
+                in_q.put(req)
+            op.start_workers()
+            done = drain_n(out_q, len(datas))
+            assert len(done) == len(datas), f"{'pipelined' if pipelined else 'serial'} run incomplete"
+        finally:
+            op.stop_workers()
+            server.close()
+        return server.frame_log()
+
+    serial = run(False)
+    pipelined = run(True)
+    assert [cid for cid, _ in serial] == [cid for cid, _ in pipelined], "frame order diverged"
+    for (cid_s, pay_s), (cid_p, pay_p) in zip(serial, pipelined):
+        assert pay_s == pay_p, f"wire bytes diverged for chunk {cid_s}"
+
+
+def test_pipelined_counters_and_window_event(tmp_path):
+    server = AckServer(ack_delay_s=0.005)
+    op, in_q, out_q, _, store = make_sender(tmp_path, server.port, dedup=False, max_streams=1)
+    try:
+        datas = [rng.integers(0, 256, 32_000, dtype=np.uint8).tobytes() for _ in range(6)]
+        for req in stage_chunks(store, datas):
+            in_q.put(req)
+        op.start_workers()
+        assert len(drain_n(out_q, 6)) == 6
+        counters = op.wire_counters()
+        assert set(SENDER_WIRE_COUNTER_ZERO) <= set(counters), "stable wire-counter schema regressed"
+        assert counters["acks_reaped"] == 6
+        assert counters["frames_sent"] == 6
+        assert counters["frames_pipelined"] >= 1, "no frame overlapped an unacked predecessor"
+        assert counters["ack_lag_ns"] > 0
+        assert counters["streams_open"] >= 1
+        events = []
+        while True:
+            try:
+                events.append(op.socket_profile_events.get_nowait())
+            except queue.Empty:
+                break
+        assert events, "no per-window profile event emitted"
+        assert all(e["wire_bytes"] > 0 and e["n_acked"] >= 1 for e in events)
+        assert sum(e["n_acked"] for e in events) == 6
+    finally:
+        op.stop_workers()
+        server.close()
+
+
+# ---------------------------------------------------- mid-stream socket death
+
+
+def test_mid_stream_socket_kill_requeues_unacked_and_commits_nothing_uncommitted(tmp_path):
+    """Socket dies after acking 2 of 5 frames; the receiver then stalls.
+    Acked chunks must be complete with fps committed; un-acked chunks must
+    re-queue (and resend), with NONE of their fps in the durable index."""
+    datas = [rng.integers(0, 256, 48_000, dtype=np.uint8).tobytes() for _ in range(5)]
+    fps = expected_fps(datas)
+    phase2 = threading.Event()
+
+    def script(i, header, payload):
+        if phase2.is_set():
+            return None  # stalled receiver: swallow resends, never respond
+        if i < 2:
+            return ACK_BYTE
+        phase2.set()
+        return "kill"
+
+    server = AckServer(script=script)
+    op, in_q, out_q, _, store = make_sender(tmp_path, server.port, max_streams=1, window=5)
+    try:
+        for req in stage_chunks(store, datas):
+            in_q.put(req)
+        op.start_workers()
+        done = drain_n(out_q, 2, timeout=15.0)
+        assert len(done) == 2
+        assert sorted(r.chunk.chunk_id for r in done) == [f"{i:032x}" for i in range(2)]
+        # wait for the re-queued chunks to be re-framed onto the new (stalled)
+        # connection, then inspect the index mid-flight
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            log = server.frame_log()
+            if phase2.is_set() and len([1 for cid, _ in log if cid == f"{4:032x}"]) >= 1:
+                break
+            time.sleep(0.05)
+        for fp in fps[0] + fps[1]:
+            assert fp in op.dedup_index, "acked chunk's fingerprints missing from the durable index"
+        for i in (2, 3, 4):
+            for fp in fps[i]:
+                assert fp not in op.dedup_index, f"un-acked chunk {i}'s fp leaked into the durable index"
+        # acked chunks were never resent
+        log = server.frame_log()
+        for i in (0, 1):
+            assert len([1 for cid, _ in log if cid == f"{i:032x}"]) == 1
+        assert server.conn_count >= 2
+    finally:
+        op.stop_workers()
+        server.close()
+
+
+# -------------------------------------------------------------- NACK rollback
+
+
+def test_nack_mid_stream_rolls_back_only_affected_fps(tmp_path):
+    """A NACK on a REF-carrying frame discards exactly the fps that frame
+    REF'd: an unrelated acked chunk's fps survive, and the nacked chunk
+    resends as pure literals."""
+    a = rng.integers(0, 256, 64_000, dtype=np.uint8).tobytes()
+    c = rng.integers(0, 256, 64_000, dtype=np.uint8).tobytes()
+    datas = [a, c, a]  # chunk 2 REFs chunk 0's segments
+    fps = expected_fps(datas)
+    ref_chunk = f"{2:032x}"
+    nacked = threading.Event()
+
+    def script(i, header, payload):
+        if header.chunk_id == ref_chunk and not nacked.is_set():
+            if any(k == dedup_mod.KIND_REF for k in recipe_kinds(payload)):
+                nacked.set()
+                return NACK_UNRESOLVED
+        return ACK_BYTE
+
+    server = AckServer(script=script)
+    op, in_q, out_q, _, store = make_sender(tmp_path, server.port, max_streams=1, window=3)
+    try:
+        for req in stage_chunks(store, datas):
+            in_q.put(req)
+        op.start_workers()
+        done = drain_n(out_q, 3)
+        assert len(done) == 3, "nacked chunk never completed after the literal resend"
+        assert nacked.is_set(), "scenario is vacuous: the REF frame was never nacked"
+        sends = [(cid, payload) for cid, payload in server.frame_log() if cid == ref_chunk]
+        assert len(sends) == 2, "nacked chunk was not resent exactly once"
+        assert any(k == dedup_mod.KIND_REF for k in recipe_kinds(sends[0][1]))
+        assert all(k == dedup_mod.KIND_LIT for k in recipe_kinds(sends[1][1])), "resend still carried REFs"
+        # unaffected chunk C's fps survived the rollback; A's are re-committed
+        # by the literal resend's ack
+        for fp in fps[1]:
+            assert fp in op.dedup_index, "rollback clobbered an unaffected chunk's fps"
+        for fp in fps[0]:
+            assert fp in op.dedup_index
+    finally:
+        op.stop_workers()
+        server.close()
+
+
+# ------------------------------------------------------ in-flight byte bound
+
+
+def test_inflight_byte_bound_respected_under_stalled_receiver(tmp_path):
+    """A receiver that never acks must stop the stream at the in-flight byte
+    bound (plus at most one frame) — the engine keeps framing ahead but the
+    pump stops transmitting, and wire_stall_ns starts accumulating."""
+    chunk_bytes = 64_000
+    limit = 256_000
+    server = AckServer(script=lambda i, h, p: None)  # stalled: never respond
+    op, in_q, out_q, _, store = make_sender(
+        tmp_path, server.port, dedup=False, max_streams=1, window=16, window_bytes=limit
+    )
+    try:
+        datas = [rng.integers(0, 256, chunk_bytes, dtype=np.uint8).tobytes() for _ in range(12)]
+        for req in stage_chunks(store, datas):
+            in_q.put(req)
+        op.start_workers()
+        time.sleep(2.0)  # give the stream every chance to overrun the bound
+        counters = op.wire_counters()
+        slack = chunk_bytes + 78 * 12
+        assert server.received_bytes <= limit + slack, (
+            f"stalled receiver saw {server.received_bytes}B — in-flight bound {limit}B not respected"
+        )
+        assert counters["wire_inflight_bytes"] <= limit + chunk_bytes
+        assert counters["wire_stall_ns"] > 0, "pump never recorded transmit-idle stall with work queued"
+        assert counters["acks_reaped"] == 0
+    finally:
+        op.stop_workers()
+        server.close()
+
+
+def test_adaptive_streams_stripe_when_saturated(tmp_path):
+    """With the in-flight window pinned full by a stalled receiver, the
+    engine opens up to max_streams striped connections — and no more."""
+    server = AckServer(script=lambda i, h, p: None)
+    op, in_q, out_q, _, store = make_sender(
+        tmp_path,
+        server.port,
+        dedup=False,
+        max_streams=3,
+        frame_ahead=1,
+        window=32,
+        window_bytes=32_000,
+    )
+    try:
+        datas = [rng.integers(0, 256, 16_000, dtype=np.uint8).tobytes() for _ in range(24)]
+        for req in stage_chunks(store, datas):
+            in_q.put(req)
+        op.start_workers()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and server.conn_count < 3:
+            time.sleep(0.05)
+        assert server.conn_count == 3, f"expected 3 striped connections, saw {server.conn_count}"
+        assert op.wire_counters()["streams_open"] == 3
+    finally:
+        op.stop_workers()
+        server.close()

@@ -159,6 +159,47 @@ class C:
     assert "blocking-under-lock" not in rules_of(src)
 
 
+def test_socket_io_under_lock_fires_with_lock_and_acquire_span():
+    """The rule the pipelined sender rewrite gates on: socket recv/sendall
+    while a lock is held — via a `with` body OR an acquire()/release() span,
+    on ANY receiver object (no sock/conn naming requirement)."""
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def a(self, peer):
+        with self._lock:
+            peer.sendall(b"x")
+    def b(self, peer):
+        self._lock.acquire()
+        try:
+            data = peer.recv(1)
+        finally:
+            self._lock.release()
+"""
+    findings = [f for f in run_source(src) if f.rule == "socket-io-under-lock"]
+    assert len(findings) == 2
+
+
+def test_socket_io_under_lock_quiet_outside_held_span():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def a(self, peer):
+        with self._lock:
+            n = self.depth + 1
+        peer.sendall(b"x")
+    def b(self, peer):
+        self._lock.acquire()
+        self._lock.release()
+        data = peer.recv(1)
+"""
+    assert "socket-io-under-lock" not in rules_of(src)
+
+
 def test_bare_except_in_loop_fires():
     src = """
 def serve(q):
