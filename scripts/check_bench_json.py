@@ -23,7 +23,15 @@ REQUIRED_TOP = (
     "decode_gbps",
     "decode_counters",
     "wire_counters",
+    "stage_latency_us",
+    "trace_overhead_pct",
 )
+# trace-derived per-stage latency breakdown (bench.py TRACE_STAGES /
+# docs/observability.md): a future perf PR proves WHERE it moved time
+REQUIRED_STAGES = ("frame", "send_stall", "ack_lag", "decode", "store")
+# acceptance bound: with tracing DISABLED the instrumentation may tax the
+# loopback wire bench by at most this much (ISSUE 5 acceptance criteria)
+MAX_TRACE_OVERHEAD_PCT = 2.0
 REQUIRED_COUNTERS = (
     "pool_hit_rate",
     "pool_hits",
@@ -101,6 +109,11 @@ def main(argv) -> int:
         missing.append("wire_counters(dict)")
     else:
         missing += [f"wire_counters.{k}" for k in REQUIRED_WIRE_COUNTERS if k not in wire]
+    stages = result.get("stage_latency_us")
+    if not isinstance(stages, dict):
+        missing.append("stage_latency_us(dict)")
+    else:
+        missing += [f"stage_latency_us.{k}" for k in REQUIRED_STAGES if k not in stages]
     if missing:
         print(f"bench-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
         return 1
@@ -123,11 +136,24 @@ def main(argv) -> int:
             file=sys.stderr,
         )
         return 1
+    # observability acceptance gate: the no-op span path (tracing disabled)
+    # must cost < MAX_TRACE_OVERHEAD_PCT of loopback wire-bench throughput —
+    # measured directly from the disabled span's per-call cost so the gate
+    # is deterministic, not wall-clock noise between two runs
+    overhead = result["trace_overhead_pct"]
+    if not isinstance(overhead, (int, float)) or overhead < 0 or overhead >= MAX_TRACE_OVERHEAD_PCT:
+        print(
+            f"bench-smoke: disabled-tracer overhead {overhead!r}% breaches the "
+            f"{MAX_TRACE_OVERHEAD_PCT}% instrumentation budget",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"bench-smoke OK: {result['value']} {result['unit']} encode, "
         f"{result['decode_gbps']} {result['unit']} decode on {result['platform']} "
         f"(device {result['device']}); wire: {wire['frames_pipelined']} frames pipelined, "
-        f"stall {wire['wire_stall_ns_per_window']}ns/window vs serial drain {wire['serial_drain_ns_per_window']}ns/window"
+        f"stall {wire['wire_stall_ns_per_window']}ns/window vs serial drain {wire['serial_drain_ns_per_window']}ns/window; "
+        f"trace overhead {overhead}%"
     )
     return 0
 

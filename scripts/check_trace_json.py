@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Validate an exported Chrome trace-event JSON file (docs/observability.md).
+
+Used by the devloop trace-smoke step on the trace bench.py exports
+(SKYPLANE_BENCH_TRACE_OUT) and by the unit tests. Checks, in order:
+
+  1. well-formed: a dict with a non-empty ``traceEvents`` list;
+  2. event schema: every event has name/ph/pid/tid/ts; complete ("X") events
+     carry a non-negative ``dur``; async begin/end ("b"/"e") events balance
+     per (pid, id);
+  3. nesting: on each (pid, tid) track, "X" spans either nest (child fully
+     inside parent, small tolerance for clock granularity) or are disjoint —
+     partial overlap means broken span scoping;
+  4. correlation: at least one chunk id appears on BOTH a sender-side span
+     (cat "sender") and a receiver-side span (cat "receiver") — the
+     cross-wire stitching the TRACED header flag exists for.
+
+Exit 0 iff all hold. A trace with zero events fails loudly: an empty export
+from a "sampled" run means the sampling/flag plumbing regressed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+
+NEST_TOLERANCE_US = 5.0  # wall-clock ts vs perf-counter dur granularity skew
+
+
+def fail(msg: str) -> int:
+    print(f"trace-smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def validate(trace: dict) -> int:
+    if not isinstance(trace, dict) or not isinstance(trace.get("traceEvents"), list):
+        return fail("not a Chrome trace: expected a dict with a traceEvents list")
+    events = trace["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    if not spans:
+        return fail("traceEvents holds no complete ('X') spans — was sampling on?")
+
+    # 2: per-event schema
+    async_balance = defaultdict(int)
+    for i, ev in enumerate(events):
+        for key in ("name", "ph", "pid", "tid"):
+            if key not in ev:
+                return fail(f"event {i} missing {key!r}: {ev!r}")
+        if ev["ph"] not in ("X", "M", "b", "e", "C", "i", "I"):
+            return fail(f"event {i} has unknown phase {ev['ph']!r}")
+        if ev["ph"] != "M" and "ts" not in ev:
+            return fail(f"event {i} missing ts: {ev!r}")
+        if ev["ph"] == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                return fail(f"X event {i} has bad dur {dur!r}")
+        if ev["ph"] in ("b", "e"):
+            if "id" not in ev:
+                return fail(f"async event {i} missing id")
+            async_balance[(ev["pid"], ev["id"])] += 1 if ev["ph"] == "b" else -1
+    unbalanced = {k: v for k, v in async_balance.items() if v != 0}
+    if unbalanced:
+        return fail(f"unbalanced async begin/end pairs: {list(unbalanced)[:5]}")
+
+    # 3: X-span nesting per (pid, tid) track
+    tracks = defaultdict(list)
+    for ev in spans:
+        tracks[(ev["pid"], ev["tid"])].append((float(ev["ts"]), float(ev["dur"]), ev["name"]))
+    for (pid, tid), track in tracks.items():
+        track.sort()
+        stack = []  # (end_ts, name)
+        for ts, dur, name in track:
+            end = ts + dur
+            while stack and ts >= stack[-1][0] - NEST_TOLERANCE_US:
+                stack.pop()
+            if stack and end > stack[-1][0] + NEST_TOLERANCE_US:
+                return fail(
+                    f"span {name!r} on track pid={pid} tid={tid} partially overlaps enclosing "
+                    f"{stack[-1][1]!r} (ends {end - stack[-1][0]:.1f}us past it) — broken span scoping"
+                )
+            stack.append((end, name))
+
+    # 4: sender<->receiver correlation by chunk id
+    sides = defaultdict(set)  # chunk_id -> {cats}
+    for ev in events:
+        cid = (ev.get("args") or {}).get("chunk_id")
+        if cid:
+            sides[cid].add(ev.get("cat", ""))
+    stitched = [cid for cid, cats in sides.items() if "sender" in cats and "receiver" in cats]
+    if not stitched:
+        return fail(
+            "no chunk id appears on both sender- and receiver-side spans — the TRACED wire-flag "
+            "propagation (or receiver force-sampling) regressed"
+        )
+
+    print(
+        f"trace-smoke OK: {len(events)} events, {len(spans)} spans on {len(tracks)} tracks, "
+        f"{len(stitched)} chunk(s) stitched across sender+receiver"
+    )
+    return 0
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: check_trace_json.py <trace.json>", file=sys.stderr)
+        return 2
+    try:
+        with open(argv[1]) as f:
+            trace = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return fail(f"cannot load {argv[1]}: {e}")
+    return validate(trace)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

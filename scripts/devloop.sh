@@ -43,6 +43,7 @@ fi
 SKYPLANE_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu \
   SKYPLANE_BENCH_CHUNK_MB=1 SKYPLANE_BENCH_SNAPSHOTS=2 SKYPLANE_BENCH_SNAP_CHUNKS=2 SKYPLANE_BENCH_REPS=1 \
   SKYPLANE_BENCH_DECODE_WORKERS=4 \
+  SKYPLANE_BENCH_TRACE_OUT="$LOGDIR/trace_smoke.json" \
   python bench.py >"$LOGDIR/bench_smoke.out" 2>"$LOGDIR/bench_smoke.err"
 BENCH_RC=$?
 if [ "$BENCH_RC" -eq 0 ]; then
@@ -53,6 +54,20 @@ if [ "$BENCH_RC" -ne 0 ]; then
   echo "[devloop] BENCH-SMOKE FAILURE (rc=$BENCH_RC) — bench.py output malformed or counter keys missing; see $LOGDIR/bench_smoke.err" >>"$LOGDIR/devloop.log"
 else
   echo "[devloop] bench-smoke clean; result at $LOGDIR/bench_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
+# Trace-smoke gate (CPU-only, part of the same bench run): the fully-sampled
+# loopback transfer inside bench.py exports Chrome trace-event JSON
+# (SKYPLANE_BENCH_TRACE_OUT above); validate schema, span nesting, and the
+# sender<->receiver chunk-id stitching (docs/observability.md). Catches a
+# tracer/export/flag-propagation regression before anyone opens Perfetto on
+# a multi-hour run and finds an empty or unstitched trace.
+python scripts/check_trace_json.py "$LOGDIR/trace_smoke.json" >>"$LOGDIR/devloop.log" 2>&1
+TRACE_RC=$?
+if [ "$TRACE_RC" -ne 0 ]; then
+  echo "[devloop] TRACE-SMOKE FAILURE (rc=$TRACE_RC) — exported trace invalid; see $LOGDIR/trace_smoke.json" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] trace-smoke clean; trace at $LOGDIR/trace_smoke.json" >>"$LOGDIR/devloop.log"
 fi
 
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only

@@ -115,11 +115,12 @@ class Checker:
 
 
 def all_checkers() -> List[Checker]:
-    # local import: concurrency/tracer import this module for the base class
+    # local import: concurrency/tracer/spans import this module for the base class
     from skyplane_tpu.analysis.concurrency import CONCURRENCY_CHECKERS
+    from skyplane_tpu.analysis.spans import SPAN_CHECKERS
     from skyplane_tpu.analysis.tracer import TRACER_CHECKERS
 
-    return [cls() for cls in (*CONCURRENCY_CHECKERS, *TRACER_CHECKERS)]
+    return [cls() for cls in (*CONCURRENCY_CHECKERS, *TRACER_CHECKERS, *SPAN_CHECKERS)]
 
 
 def iter_rules() -> List[RuleSpec]:

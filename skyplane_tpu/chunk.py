@@ -74,6 +74,7 @@ class ChunkFlags(IntEnum):
     COMPRESSED = 1 << 0
     ENCRYPTED = 1 << 1
     RECIPE = 1 << 2  # payload is a dedup recipe, not raw bytes
+    TRACED = 1 << 3  # sender sampled this chunk for tracing; receiver spans follow suit
 
 
 @total_ordering
@@ -120,6 +121,13 @@ class Chunk:
     # integrity: md5 for object-store Content-MD5; fingerprint for wire/dedup
     md5_hash: Optional[str] = None  # hex
     fingerprint: Optional[str] = None  # 32 hex chars (128-bit)
+
+    # the sender's deterministic trace-sampling decision, stamped at chunk
+    # pre-registration so destination-side operators past the receiver
+    # (write_local, obj-store writes) force their spans for the SAME chunks
+    # even when the two gateways run different sample rates — the wire
+    # header's TRACED flag covers only the socket hop (docs/observability.md)
+    traced: Optional[bool] = False
 
     def to_wire_header(
         self,
@@ -224,6 +232,10 @@ class WireProtocolHeader:
     @property
     def is_recipe(self) -> bool:
         return bool(self.flags & ChunkFlags.RECIPE)
+
+    @property
+    def is_traced(self) -> bool:
+        return bool(self.flags & ChunkFlags.TRACED)
 
     def to_bytes(self) -> bytes:
         out = b""

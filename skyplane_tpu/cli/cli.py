@@ -130,6 +130,45 @@ def lint(args):
     sys.exit(lint_main(list(args)))
 
 
+@main.group()
+def trace():
+    """Chunk-lifecycle tracing (docs/observability.md)."""
+
+
+@trace.command("export")
+@click.option("--url", default=None, help="gateway control URL, e.g. https://10.0.0.5:8081 (omit for the in-process tracer)")
+@click.option("-o", "--output", default="trace.json", help="output file (Chrome trace-event JSON)")
+@click.option("--token", default=None, help="gateway API bearer token (defaults to none)")
+def trace_export(url, output, token):
+    """Export a Chrome trace-event JSON that loads directly in Perfetto.
+
+    With --url, fetches GET /api/v1/trace from a running gateway's control
+    API; without it, dumps this process's tracer (useful after an in-process
+    harness run with SKYPLANE_TPU_TRACE_SAMPLE set). Open the file at
+    https://ui.perfetto.dev or chrome://tracing."""
+    import json
+
+    if url:
+        from skyplane_tpu.gateway.control_auth import control_session
+
+        resp = control_session(token).get(f"{url.rstrip('/')}/api/v1/trace", timeout=30)
+        resp.raise_for_status()
+        payload = resp.json()
+    else:
+        from skyplane_tpu.obs import get_tracer
+
+        payload = get_tracer().export()
+    events = payload.get("traceEvents", [])
+    with open(output, "w") as f:
+        json.dump(payload, f)
+    if len([e for e in events if e.get("ph") != "M"]) == 0:
+        click.echo(
+            f"wrote {output} with NO spans — is tracing on? (SKYPLANE_TPU_TRACE_SAMPLE, docs/observability.md)"
+        )
+    else:
+        click.echo(f"wrote {len(events)} events to {output}; open it in https://ui.perfetto.dev")
+
+
 @main.command()
 @click.option("--index", default=0, help="gateway index to connect to")
 def ssh(index):

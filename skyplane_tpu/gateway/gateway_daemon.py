@@ -173,6 +173,20 @@ class GatewayDaemon:
             )
             ssl_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
             ssl_ctx.load_cert_chain(certfile=str(cert), keyfile=str(key))
+        # unified metrics registry (skyplane_tpu/obs): absorbs the three
+        # legacy counter schemas behind one Prometheus endpoint. Layered on
+        # the process-wide registry (where the receiver/sender histograms
+        # live) so two in-process daemons — the loopback test harness —
+        # never double-register a family.
+        from skyplane_tpu.obs import get_registry, get_tracer
+        from skyplane_tpu.obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry(parent=get_registry())
+        self.metrics.register_provider("datapath", self._compression_stats)
+        self.metrics.register_provider("decode", self.receiver.decode_counters)
+        self.metrics.register_provider("sender_wire", self._sender_wire_counters)
+        self.metrics.register_provider("trace", lambda: get_tracer().counters())
+        self.metrics.gauge("gateway_operators", help_="operators running in this daemon", fn=lambda: len(self.operators))
         self.api = GatewayDaemonAPI(
             chunk_store=self.chunk_store,
             receiver=self.receiver,
@@ -186,6 +200,8 @@ class GatewayDaemon:
             port=control_port,
             compression_stats_fn=self._compression_stats,
             sender_profile_fn=self._sender_socket_events,
+            metrics_fn=self.metrics.render_prometheus,
+            trace_fn=lambda: get_tracer().export(),
             api_token=self.api_token,
             ssl_ctx=ssl_ctx,
         )
@@ -236,6 +252,20 @@ class GatewayDaemon:
                 for k in counters:
                     counters[k] += per_op.get(k, 0)
         return {"events": events, "counters": counters}
+
+    def _sender_wire_counters(self) -> dict:
+        """Wire counters only (no event-queue drain — the MetricsRegistry
+        provider must be side-effect free so a scrape never steals the
+        profile events /profile/socket/sender serves)."""
+        from skyplane_tpu.gateway.operators.sender_wire import SENDER_WIRE_COUNTER_ZERO
+
+        counters = dict(SENDER_WIRE_COUNTER_ZERO)
+        for op in self.operators:
+            if isinstance(op, GatewaySenderOperator):
+                per_op = op.wire_counters()
+                for k in counters:
+                    counters[k] += per_op.get(k, 0)
+        return counters
 
     def _compression_stats(self) -> dict:
         from skyplane_tpu.ops.pipeline import DataPathStats

@@ -21,6 +21,8 @@ directly:
   GET  /api/v1/profile/socket/sender       per-send-window events + wire counters
   GET  /api/v1/profile/compression         TPU data-path stats (ratio, dedup)
   GET  /api/v1/profile/decode              receiver decode-pool counters+events
+  GET  /api/v1/trace                       Chrome trace-event JSON (Perfetto)
+  GET  /api/v1/metrics                     Prometheus text exposition
 
 Completion accounting (the reference's most bug-prone logic, SURVEY §7 #6):
 an explicit per-chunk refcount of terminal-operator completions — a chunk is
@@ -58,6 +60,8 @@ class GatewayDaemonAPI:
         port: int = 8081,
         compression_stats_fn=None,
         sender_profile_fn=None,
+        metrics_fn=None,
+        trace_fn=None,
         api_token: Optional[str] = None,
         ssl_ctx=None,
     ):
@@ -71,6 +75,13 @@ class GatewayDaemonAPI:
         self.gateway_id = gateway_id
         self.compression_stats_fn = compression_stats_fn or (lambda: {})
         self.sender_profile_fn = sender_profile_fn or (lambda: {"events": [], "counters": {}})
+        # observability surface (skyplane_tpu/obs, docs/observability.md):
+        # default to the process-wide tracer/registry so an API constructed
+        # bare (tests, harness) still serves both routes
+        from skyplane_tpu.obs import get_registry, get_tracer
+
+        self.metrics_fn = metrics_fn or (lambda: get_registry().render_prometheus())
+        self.trace_fn = trace_fn or (lambda: get_tracer().export())
         # bearer token required on every route except GET /status (liveness
         # probes predate token distribution during provisioning). None =
         # auth disabled (local in-process harness).
@@ -97,6 +108,14 @@ class GatewayDaemonAPI:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_text(self, code: int, text: str, content_type: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -312,7 +331,10 @@ class GatewayDaemonAPI:
                     events.append(self.receiver.socket_profile_events.get_nowait())
                 except queue.Empty:
                     break
-            req._send(200, {"events": events})
+            # events_dropped: how many per-chunk events the bounded profile
+            # queue discarded since startup — a nonzero value means this
+            # drain is a SAMPLE of the traffic, not a complete record
+            req._send(200, {"events": events, "events_dropped": self.receiver.socket_events_dropped()})
         elif path == "/api/v1/profile/socket/sender":
             # {"events": [...], "counters": {...}} — the counters follow the
             # stable SENDER_WIRE_COUNTER_ZERO schema (docs/datapath-performance.md)
@@ -332,6 +354,15 @@ class GatewayDaemonAPI:
                 except queue.Empty:
                     break
             req._send(200, {"counters": self.receiver.decode_counters(), "events": events})
+        elif path == "/api/v1/trace":
+            # Chrome trace-event JSON from the process tracer: loads directly
+            # in Perfetto / chrome://tracing (docs/observability.md). Empty
+            # unless SKYPLANE_TPU_TRACE_SAMPLE > 0 on this gateway.
+            req._send(200, self.trace_fn())
+        elif path == "/api/v1/metrics":
+            # Prometheus text exposition: the unified MetricsRegistry view of
+            # the DATAPATH/DECODE/SENDER_WIRE schemas + native gauges/histograms
+            req._send_text(200, self.metrics_fn(), "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/api/v1/logs":
             # live daemon log tail (reference analog: the dozzle container log
             # viewer on :8888); ?bytes=N bounds the tail (default 64 KiB,

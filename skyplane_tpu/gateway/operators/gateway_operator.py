@@ -28,9 +28,10 @@ import requests
 
 import json
 
-from skyplane_tpu.chunk import ChunkRequest, ChunkState, WireProtocolHeader
+from skyplane_tpu.chunk import ChunkFlags, ChunkRequest, ChunkState, WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, put_drop_oldest
+from skyplane_tpu.obs import NOOP_SPAN, get_registry, get_tracer
 from skyplane_tpu.gateway.operators.sender_wire import EngineCallbacks
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
@@ -280,17 +281,18 @@ class GatewayWriteLocalOperator(GatewayOperator):
 
     def process(self, chunk_req: ChunkRequest, worker_id: int) -> bool:
         chunk = chunk_req.chunk
-        data = self.chunk_store.chunk_path(chunk.chunk_id).read_bytes()
-        dest = Path(chunk.dest_key)
-        offset = chunk.file_offset_bytes or 0
-        fd = self._acquire_fd(dest)
-        try:
-            written = 0
-            view = memoryview(data)
-            while written < len(data):
-                written += os.pwrite(fd, view[written:], offset + written)
-        finally:
-            self._release_fd(dest)
+        with get_tracer().span("chunk.write_local", trace_id=chunk.chunk_id, cat="receiver", force=bool(chunk.traced)):
+            data = self.chunk_store.chunk_path(chunk.chunk_id).read_bytes()
+            dest = Path(chunk.dest_key)
+            offset = chunk.file_offset_bytes or 0
+            fd = self._acquire_fd(dest)
+            try:
+                written = 0
+                view = memoryview(data)
+                while written < len(data):
+                    written += os.pwrite(fd, view[written:], offset + written)
+            finally:
+                self._release_fd(dest)
         return True
 
 
@@ -432,6 +434,7 @@ class _WindowStats:
             done = self.n_done >= self.n_chunks
             if not done:
                 return
+            seconds = time.perf_counter() - self.t0
             event = {
                 "handle": self.op.handle,
                 "worker_id": self.worker_id,
@@ -439,10 +442,10 @@ class _WindowStats:
                 "n_chunks": self.n_chunks,
                 "n_acked": self.n_acked,
                 "wire_bytes": self.wire_bytes,
-                "seconds": round(time.perf_counter() - self.t0, 6),
+                "seconds": round(seconds, 6),
                 "pipelined": True,
             }
-        put_drop_oldest(self.op.socket_profile_events, event)
+        self.op.note_window_event(event, seconds)
 
 
 class _SenderEngineOps(EngineCallbacks):
@@ -563,8 +566,14 @@ class GatewaySenderOperator(GatewayOperator):
         # per-window send profile events (drained by /profile/socket/sender,
         # the sender-side analog of the receiver's socket profiler). Bounded:
         # with nothing polling the endpoint, a long-lived daemon must not
-        # accumulate one dict per window forever
+        # accumulate one dict per window forever — drops are COUNTED
+        # (profile_events_dropped in wire_counters), never silent
         self.socket_profile_events: "queue.Queue[dict]" = queue.Queue(maxsize=4096)
+        self._events_dropped = 0
+        self._events_dropped_lock = threading.Lock()
+        self._window_hist = get_registry().histogram(
+            "sender_window_seconds", help_="wall time of one sender send window (submit batch)"
+        )
         self._local = threading.local()
         # pipelined wire engine config (operators/sender_wire.py); env knobs
         # documented in docs/configuration.md. Constructor args override for
@@ -677,6 +686,14 @@ class GatewaySenderOperator(GatewayOperator):
                 self._engines.append(engine)
         return engine
 
+    def note_window_event(self, event: dict, seconds: float) -> None:
+        """Emit one per-window profile event (bounded queue, counted drops)
+        and feed the unified-registry window-latency histogram."""
+        if put_drop_oldest(self.socket_profile_events, event):
+            with self._events_dropped_lock:
+                self._events_dropped += 1
+        self._window_hist.observe(seconds)
+
     def wire_counters(self) -> dict:
         """Stable-schema sender wire counters summed across worker engines
         (GET /api/v1/profile/socket/sender and bench.py's wire section)."""
@@ -689,6 +706,8 @@ class GatewaySenderOperator(GatewayOperator):
             counters = engine.counters()
             for k in out:
                 out[k] += counters.get(k, 0)
+        with self._events_dropped_lock:
+            out["profile_events_dropped"] += self._events_dropped
         return out
 
     def _drain_batch(self) -> List[ChunkRequest]:
@@ -751,6 +770,12 @@ class GatewaySenderOperator(GatewayOperator):
         # pre-register the whole window at the destination in ONE control POST
         # (reference pre-registers per chunk, :277-319). Must precede the data
         # frames so completion accounting never sees an unregistered chunk.
+        tracer = get_tracer()
+        if tracer.enabled:
+            # same deterministic decision the framer will make: rides the
+            # registration so destination operators trace the same chunks
+            for req in batch:
+                req.chunk.traced = tracer.sampled(req.chunk.chunk_id)
         regs = [req.as_dict() for req in batch]
         for attempt in range(3):
             try:
@@ -791,10 +816,22 @@ class GatewaySenderOperator(GatewayOperator):
         from skyplane_tpu.gateway.operators.sender_wire import WireFrame
 
         view = _WindowFpView(self.dedup_index, pending=pending_fps) if self.dedup_index is not None else None
+        tracer = get_tracer()
+        traced = tracer.enabled and tracer.sampled(req.chunk.chunk_id)
+        span = (
+            tracer.span("wire.frame", trace_id=req.chunk.chunk_id, cat="sender", force=True) if traced else NOOP_SPAN
+        )
         # n_left=0: the reference-compat window countdown has no meaning on a
         # continuous stream (receivers ignore it; docs/wire_protocol.md) —
         # the one header field where serial and pipelined frames differ
-        payload, wire, header = self._frame_chunk(req, view, n_left=0)
+        with span:
+            payload, wire, header = self._frame_chunk(req, view, n_left=0)
+        if traced and payload is not None:
+            # stamp the sampling decision into the wire header so the
+            # receiver's spans for this chunk record regardless of its local
+            # rate — sender and receiver stitch into one timeline. Relay
+            # frames keep their original header (opaque re-framed bytes).
+            header.flags |= ChunkFlags.TRACED
         return WireFrame(
             req,
             header,
@@ -803,6 +840,7 @@ class GatewaySenderOperator(GatewayOperator):
             ref_fps=payload.ref_fingerprints if payload is not None else (),
             relay=payload is None,
             window=window,
+            traced=traced,
         )
 
     def _process_batch_serial(self, batch: List[ChunkRequest], worker_id: int) -> List[bool]:
@@ -816,10 +854,26 @@ class GatewaySenderOperator(GatewayOperator):
             # frame-and-stream: each chunk's wire bytes are released as soon
             # as they hit the socket, so worker memory holds ONE chunk at a
             # time (plus ack bookkeeping), not the whole window
+            tracer = get_tracer()
             for i, req in enumerate(batch):
-                payload, wire, header = self._frame_chunk(req, view, n_left=len(batch) - i - 1)
-                header.to_socket(sock)
-                sock.sendall(wire)
+                traced = tracer.enabled and tracer.sampled(req.chunk.chunk_id)
+                span = (
+                    tracer.span("wire.frame", trace_id=req.chunk.chunk_id, cat="sender", force=True)
+                    if traced
+                    else NOOP_SPAN
+                )
+                with span:
+                    payload, wire, header = self._frame_chunk(req, view, n_left=len(batch) - i - 1)
+                if traced and payload is not None:
+                    header.flags |= ChunkFlags.TRACED  # receiver spans follow the sender's sample
+                send_span = (
+                    tracer.span("wire.send", trace_id=req.chunk.chunk_id, cat="sender", force=True)
+                    if traced
+                    else NOOP_SPAN
+                )
+                with send_span:
+                    header.to_socket(sock)
+                    sock.sendall(wire)
                 window_wire += len(wire)
                 del wire
                 if payload is not None:
@@ -873,6 +927,7 @@ class GatewaySenderOperator(GatewayOperator):
             logger.fs.warning(f"[{self.handle}:{worker_id}] socket error mid-window: {e}")
             self._reset_sock()
             time.sleep(0.2)
+        seconds = time.perf_counter() - t_window
         event = {
             "handle": self.handle,
             "worker_id": worker_id,
@@ -880,7 +935,7 @@ class GatewaySenderOperator(GatewayOperator):
             "n_chunks": len(batch),
             "n_acked": sum(results),
             "wire_bytes": window_wire,
-            "seconds": round(time.perf_counter() - t_window, 6),
+            "seconds": round(seconds, 6),
         }
-        put_drop_oldest(self.socket_profile_events, event)
+        self.note_window_event(event, seconds)
         return results

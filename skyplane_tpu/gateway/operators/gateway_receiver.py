@@ -48,6 +48,7 @@ from skyplane_tpu.exceptions import DedupIntegrityException, SkyplaneTpuExceptio
 from skyplane_tpu.gateway.cert import generate_self_signed_certificate
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
+from skyplane_tpu.obs import NOOP_SPAN, get_registry, get_tracer
 from skyplane_tpu.ops.dedup import PooledChunk, SegmentStore
 from skyplane_tpu.ops.pipeline import DataPathProcessor
 from skyplane_tpu.utils.logger import logger
@@ -85,26 +86,36 @@ DECODE_COUNTER_ZERO = {
     "pool_hit_rate": 0.0,
     "verify_total": 0,
     "verify_batched": 0,
+    "decode_events_dropped": 0,
+    "socket_events_dropped": 0,
 }
 
 
-def put_drop_oldest(q: "queue.Queue[dict]", event: dict) -> None:
+def put_drop_oldest(q: "queue.Queue[dict]", event: dict) -> bool:
     """Best-effort put on a bounded profile-event queue: when full, drop the
     OLDEST event so a quiet profile endpoint keeps the freshest ones (shared
-    by the receiver socket/decode profilers and the sender window profiler)."""
+    by the receiver socket/decode profilers and the sender window profiler).
+
+    Returns True when any event was lost (the oldest evicted, or — if the
+    queue refilled under us — this event itself). Callers MUST surface the
+    drop in a ``*_events_dropped`` counter: truncation used to be invisible
+    and read as "profile covered everything" when it had not."""
     try:
         q.put_nowait(event)
-        return
+        return False
     except queue.Full:
         pass
+    dropped = False
     try:
         q.get_nowait()
+        dropped = True
     except queue.Empty:
         pass
     try:
         q.put_nowait(event)
     except queue.Full:
-        pass
+        dropped = True  # refilled under us: this event is the casualty
+    return dropped
 
 
 class _DecodeTask:
@@ -250,9 +261,17 @@ class GatewayReceiver:
         # frame must not be a gateway DoS. Persistent corruption escalates.
         self._payload_error_count = 0
         self.max_payload_errors = 20
-        # bounded: a daemon nobody profiles must not accumulate events forever
+        # bounded: a daemon nobody profiles must not accumulate events forever;
+        # drops are counted (never silent) and surfaced on the endpoints
         self.socket_profile_events: "queue.Queue[dict]" = queue.Queue(maxsize=4096)
         self.decode_profile_events: "queue.Queue[dict]" = queue.Queue(maxsize=4096)
+        self._socket_events_dropped = 0
+        self._decode_events_dropped = 0
+        # unified-registry latency distribution (GET /api/v1/metrics); the
+        # ad-hoc decode_ns counter only gives a mean
+        self._decode_hist = get_registry().histogram(
+            "decode_seconds", help_="per-chunk receiver decode latency (decrypt + decode + land)"
+        )
         # unresolvable-REF nacks are an EXPECTED, recoverable condition (the
         # sender discards fps and resends literals) — budget them separately
         # from corruption, with a higher cap, also reset on any success
@@ -382,17 +401,25 @@ class GatewayReceiver:
                 except (ConnectionError, OSError):
                     break  # clean peer close
                 t0 = time.time()
+                recv_span = (
+                    get_tracer().span("frame.recv", trace_id=header.chunk_id, cat="receiver", force=header.is_traced)
+                    if get_tracer().enabled
+                    else NOOP_SPAN
+                )
                 try:
-                    payload = self._recv_exact(conn, header.data_len)
+                    with recv_span:
+                        payload = self._recv_exact(conn, header.data_len)
                 except (ConnectionError, OSError) as e:
                     # peer died mid-payload (e.g. sender resetting a broken socket
                     # before retrying) — drop the partial chunk, it will be re-sent
                     logger.fs.warning(f"[receiver:{port}] connection lost mid-chunk {header.chunk_id}: {e}")
                     break
-                put_drop_oldest(
+                if put_drop_oldest(
                     self.socket_profile_events,
                     {"port": port, "chunk_id": header.chunk_id, "bytes": header.data_len, "time_s": time.time() - t0},
-                )
+                ):
+                    with self._lock:
+                        self._socket_events_dropped += 1
                 task = _DecodeTask(header, payload, state)
                 with state.lock:
                     if state.dead:
@@ -481,8 +508,22 @@ class GatewayReceiver:
         """Decrypt/decode/land one chunk; record the outcome for the in-order
         response drain. Never raises — every failure maps to an outcome."""
         header, state = task.header, task.state
+        tracer = get_tracer()
+        # the sender's TRACED header flag forces the span past the local
+        # sampling decision: both sides of the wire trace the SAME chunks
+        span = (
+            tracer.span("decode", trace_id=header.chunk_id, cat="receiver", force=header.is_traced)
+            if tracer.enabled
+            else NOOP_SPAN
+        )
+        store_span = lambda: (  # noqa: E731 — nested under the decode span
+            tracer.span("store.write", trace_id=header.chunk_id, cat="receiver", force=header.is_traced)
+            if tracer.enabled
+            else NOOP_SPAN
+        )
         t0 = time.perf_counter_ns()
         try:
+          with span:
             with state.lock:
                 dead = state.dead
             if dead:
@@ -493,18 +534,19 @@ class GatewayReceiver:
                 return
             fpath = self.chunk_store.chunk_path(header.chunk_id)
             if self.raw_forward:
-                self._land(fpath, task.payload)
-                self._land(
-                    fpath.with_suffix(".hdr"),
-                    json.dumps(
-                        {
-                            "codec": header.codec,
-                            "flags": header.flags,
-                            "fingerprint": header.fingerprint,
-                            "raw_data_len": header.raw_data_len,
-                        }
-                    ).encode(),
-                )
+                with store_span():
+                    self._land(fpath, task.payload)
+                    self._land(
+                        fpath.with_suffix(".hdr"),
+                        json.dumps(
+                            {
+                                "codec": header.codec,
+                                "flags": header.flags,
+                                "fingerprint": header.fingerprint,
+                                "raw_data_len": header.raw_data_len,
+                            }
+                        ).encode(),
+                    )
             else:
                 # E2EE is all-or-nothing per receiver: when a key is
                 # configured, EVERY frame must be encrypted and MUST
@@ -542,10 +584,12 @@ class GatewayReceiver:
                 if isinstance(data, PooledChunk):
                     # zero-copy handoff: the pooled view goes straight to the
                     # chunk file and the buffer recycles for the next decode
-                    self._land(fpath, data.view)
+                    with store_span():
+                        self._land(fpath, data.view)
                     data.release()
                 else:
-                    self._land(fpath, data)
+                    with store_span():
+                        self._land(fpath, data)
             # .done is NOT touched here: with out-of-order decode, chunks
             # landed behind a frame whose in-order response later fails would
             # otherwise be exposed to downstream operators and then REWRITTEN
@@ -560,7 +604,8 @@ class GatewayReceiver:
                 self._decode_stats["decode_raw_bytes"] += header.raw_data_len
                 self._decode_stats["decode_wire_bytes"] += header.data_len
                 self._decode_stats["decode_ns"] += task.decode_ns
-            put_drop_oldest(
+            self._decode_hist.observe(task.decode_ns / 1e9)
+            if put_drop_oldest(
                 self.decode_profile_events,
                 {
                     "port": state.port,
@@ -569,7 +614,9 @@ class GatewayReceiver:
                     "wire_bytes": header.data_len,
                     "decode_s": round(task.decode_ns / 1e9, 6),
                 },
-            )
+            ):
+                with self._stats_lock:
+                    self._decode_events_dropped += 1
             logger.fs.debug(
                 f"[receiver:{state.port}] landed chunk {header.chunk_id} "
                 f"({header.raw_data_len}B raw, {header.data_len}B wire)"
@@ -725,6 +772,8 @@ class GatewayReceiver:
         out = dict(DECODE_COUNTER_ZERO)
         with self._stats_lock:
             out.update(self._decode_stats)
+            out["decode_events_dropped"] = self._decode_events_dropped
+        out["socket_events_dropped"] = self.socket_events_dropped()
         out["decode_workers"] = len(self._decode_threads)
         out["decode_queue_depth"] = self._work_q.qsize()
         out["decode_nacks"] = self.nacks_total
@@ -735,6 +784,12 @@ class GatewayReceiver:
             out[k] = pool[k]
         out.update(self.processor.verify_counters())
         return out
+
+    def socket_events_dropped(self) -> int:
+        """Socket profile events lost to the bounded queue (surfaced by
+        GET /api/v1/profile/socket/receiver — truncation is never silent)."""
+        with self._lock:
+            return self._socket_events_dropped
 
     def _recv_exact(self, conn: socket.socket, n: int) -> bytes:
         buf = bytearray(n)

@@ -53,6 +53,7 @@ from collections import deque
 from typing import Callable, List, Optional
 
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED
+from skyplane_tpu.obs import NOOP_SPAN, get_tracer
 from skyplane_tpu.utils.logger import logger
 
 # stable sender wire-counter schema (the sender mirror of DECODE_COUNTER_ZERO):
@@ -71,15 +72,16 @@ SENDER_WIRE_COUNTER_ZERO = {
     "nacks_reaped": 0,
     "stream_resets": 0,
     "windows": 0,  # submit batches (the _drain_batch granularity)
+    "profile_events_dropped": 0,  # per-window profile events lost to the bounded queue
 }
 
 
 class WireFrame:
     """One framed chunk flowing through the pipeline."""
 
-    __slots__ = ("req", "header", "wire", "wire_len", "new_fps", "ref_fps", "relay", "sent_ns", "window")
+    __slots__ = ("req", "header", "wire", "wire_len", "new_fps", "ref_fps", "relay", "sent_ns", "sent_wall_ns", "window", "traced")
 
-    def __init__(self, req, header, wire: bytes, new_fps=(), ref_fps=(), relay: bool = False, window=None):
+    def __init__(self, req, header, wire: bytes, new_fps=(), ref_fps=(), relay: bool = False, window=None, traced: bool = False):
         self.req = req
         self.header = header
         self.wire = wire
@@ -88,7 +90,9 @@ class WireFrame:
         self.ref_fps = list(ref_fps)  # fps discarded on an unresolvable-REF nack
         self.relay = relay  # opaque re-framed bytes: a NACK is unrecoverable
         self.sent_ns = 0
+        self.sent_wall_ns = 0
         self.window = window  # optional per-window stats carrier (profile events)
+        self.traced = traced  # chunk sampled for tracing (mirrors the header's TRACED flag)
 
 
 class EngineCallbacks:
@@ -405,9 +409,15 @@ class SenderWireEngine:
                 stream.frames_bytes -= frame.wire_len
                 stream.cond.notify_all()  # the framer may enqueue the next chunk
         if frame is not None:
+            send_span = (
+                get_tracer().span("wire.send", trace_id=frame.header.chunk_id, cat="sender", force=True)
+                if frame.traced
+                else NOOP_SPAN
+            )
             try:
-                frame.header.to_socket(stream.sock)
-                stream.sock.sendall(frame.wire)
+                with send_span:
+                    frame.header.to_socket(stream.sock)
+                    stream.sock.sendall(frame.wire)
             except (OSError, ssl.SSLError):
                 # the frame is in-hand (already popped): put it back so the
                 # reset path requeues its chunk — otherwise a socket death
@@ -417,6 +427,7 @@ class SenderWireEngine:
                     stream.frames_bytes += frame.wire_len
                 raise
             frame.sent_ns = time.perf_counter_ns()
+            frame.sent_wall_ns = time.time_ns()
             frame.wire = b""  # wire bytes are on the socket; keep only bookkeeping
             with stream.lock:
                 pipelined = bool(stream.inflight)
@@ -433,10 +444,17 @@ class SenderWireEngine:
             has_inflight = bool(stream.inflight)
         if not has_inflight:
             return  # outer loop waits for work
+        tracer = get_tracer()
         t0 = time.perf_counter_ns() if stalled else 0
+        t0_wall = time.time_ns() if (stalled and tracer.enabled) else 0
         self._drain_acks(stream, block=True)
         if stalled:
-            self._bump("wire_stall_ns", time.perf_counter_ns() - t0)
+            stall_ns = time.perf_counter_ns() - t0
+            self._bump("wire_stall_ns", stall_ns)
+            if tracer.enabled:
+                # transmit-idle with a frame READY: the stall the pipelining
+                # exists to hide — an async track (it brackets ack waits)
+                tracer.record_span("wire.send_stall", stall_ns, t0_wall, cat="sender")
 
     def _drain_acks(self, stream: _Stream, block: bool) -> None:
         """Read response bytes for the in-flight frames, oldest first. With
@@ -478,6 +496,17 @@ class SenderWireEngine:
                 stream.inflight_bytes -= frame.wire_len
                 stream.cond.notify_all()  # in-flight window opened: sends resume
             self._bump("ack_lag_ns", now - frame.sent_ns)
+            if frame.traced:
+                # frame-fully-sent -> ack-landed, correlated to the chunk; an
+                # async track because later sends overlap this interval
+                get_tracer().record_span(
+                    "wire.ack_lag",
+                    now - frame.sent_ns,
+                    frame.sent_wall_ns,
+                    trace_id=frame.header.chunk_id,
+                    cat="sender",
+                    force=True,
+                )
             with self._completion_cond:
                 self._completion_q.append((stream, frame, b))
                 self._completion_cond.notify()

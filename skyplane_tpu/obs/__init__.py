@@ -1,0 +1,36 @@
+"""Observability layer: chunk-lifecycle tracing + unified metrics registry.
+
+PRs 2-4 made the data path overlapped and concurrent, but each subsystem
+reported its own siloed counter blob (``/profile/compression``,
+``/profile/socket/sender``, ``/profile/decode``) with no way to follow ONE
+chunk across layers. This package closes that gap (Dapper-style per-request
+tracing, Sigelman et al. 2010):
+
+  * :mod:`skyplane_tpu.obs.tracer` — a sampling tracer whose spans record
+    into per-thread sharded ring buffers (bounded memory, dropped-span
+    counters, no locks on the hot path) and export as Chrome trace-event
+    JSON that loads directly in Perfetto. Off by default
+    (``SKYPLANE_TPU_TRACE_SAMPLE=0`` ⇒ no-op spans, near-zero overhead).
+  * :mod:`skyplane_tpu.obs.metrics` — a :class:`MetricsRegistry` that
+    absorbs the existing DATAPATH/DECODE/SENDER_WIRE counter schemas behind
+    one registry and adds native counters/gauges/histograms, rendered in
+    Prometheus text exposition format (``GET /api/v1/metrics``).
+
+Correlation across the wire: the sender samples per chunk id
+(deterministically), stamps :data:`ChunkFlags.TRACED` into the wire frame
+header, and the receiver honors that flag — so one chunk's sender spans
+(frame → send → ack) and receiver spans (decode → store → write) stitch into
+one timeline keyed by the chunk id (docs/observability.md).
+"""
+
+from skyplane_tpu.obs.metrics import MetricsRegistry, get_registry
+from skyplane_tpu.obs.tracer import NOOP_SPAN, Tracer, configure_tracer, get_tracer
+
+__all__ = [
+    "MetricsRegistry",
+    "NOOP_SPAN",
+    "Tracer",
+    "configure_tracer",
+    "get_registry",
+    "get_tracer",
+]

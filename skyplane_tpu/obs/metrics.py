@@ -1,0 +1,239 @@
+"""Unified metrics registry with Prometheus text exposition.
+
+Before this module each subsystem exported its own ad-hoc counter dict
+(``DataPathStats.EXTERNAL_ZERO``, ``DECODE_COUNTER_ZERO``,
+``SENDER_WIRE_COUNTER_ZERO``) behind its own endpoint. The registry absorbs
+those dict-returning providers unchanged — their stable schemas stay the
+source of truth — and adds native counters, gauges, and histograms for
+metrics that have no home in the legacy schemas (e.g. per-chunk decode
+latency distribution).
+
+Exposition is the Prometheus text format (version 0.0.4): one ``# HELP`` and
+``# TYPE`` line per family, then samples. Absorbed provider values are
+exported as gauges (several legacy "counters" are really gauges — queue
+depths, in-flight bytes — and a gauge is always scrape-safe); native metrics
+carry their true type, including full ``_bucket``/``_sum``/``_count``
+histogram series.
+
+The module-level :func:`get_registry` singleton is where long-lived
+components (receiver decode pool, sender operators) register their
+histograms; the gateway daemon layers its per-daemon providers on top via
+``MetricsRegistry(parent=get_registry())`` so two in-process daemons (the
+loopback test harness) never double-register one family.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "skyplane_"
+
+#: default latency buckets (seconds): 100 us .. 30 s, log-ish spacing
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def sanitize_metric_name(name: str) -> str:
+    name = _NAME_SANITIZE.sub("_", name)
+    if not name.startswith(_PREFIX):
+        name = _PREFIX + name
+    return name
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is locked — registry metrics sit on event
+    paths (per chunk / per window), not per-byte hot loops."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value: either ``set()`` by the owner or computed by a
+    callback at scrape time (``fn``)."""
+
+    __slots__ = ("name", "help", "fn", "_lock", "_value")
+
+    def __init__(self, name: str, help_: str, fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help_
+        self.fn = fn
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def value(self) -> float:
+        if self.fn is not None:
+            return float(self.fn())
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each ``le`` bucket
+    counts every observation <= its bound, plus ``+Inf``/``_sum``/``_count``)."""
+
+    __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum", "_count")
+
+    def __init__(self, name: str, help_: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * len(self.buckets)  # per-bucket (non-cumulative) counts
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            if i < len(self._counts):
+                self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        """(cumulative bucket counts, sum, count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._count
+        cum = []
+        acc = 0
+        for c in counts:
+            acc += c
+            cum.append(acc)
+        return cum, total, n
+
+
+class MetricsRegistry:
+    def __init__(self, parent: Optional["MetricsRegistry"] = None):
+        self._lock = threading.Lock()
+        self._metrics: "OrderedDict[str, object]" = OrderedDict()
+        self._providers: List[Tuple[str, Callable[[], dict]]] = []
+        self.parent = parent
+
+    # ---- native metrics (create-or-get: same name -> same instance) ----
+
+    def _get_or_create(self, name: str, factory):
+        name = sanitize_metric_name(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory(name)
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda n: Counter(n, help_))
+
+    def gauge(self, name: str, help_: str = "", fn: Optional[Callable[[], float]] = None) -> Gauge:
+        return self._get_or_create(name, lambda n: Gauge(n, help_, fn=fn))
+
+    def histogram(self, name: str, help_: str = "", buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda n: Histogram(n, help_, buckets=buckets))
+
+    # ---- absorbed legacy schemas ----
+
+    def register_provider(self, prefix: str, fn: Callable[[], dict]) -> None:
+        """Absorb a dict-returning counter source (the DATAPATH / DECODE /
+        SENDER_WIRE schemas). Keys render as ``skyplane_<prefix>_<key>``;
+        the provider is called at scrape time, so values are always live."""
+        with self._lock:
+            self._providers.append((prefix, fn))
+
+    # ---- exposition ----
+
+    def render_prometheus(self) -> str:
+        lines: List[str] = []
+        seen: set = set()
+        for reg in self._chain():
+            with reg._lock:
+                metrics = list(reg._metrics.values())
+                providers = list(reg._providers)
+            for m in metrics:
+                if m.name in seen:
+                    continue
+                seen.add(m.name)
+                help_ = m.help or m.name
+                if isinstance(m, Histogram):
+                    lines.append(f"# HELP {m.name} {help_}")
+                    lines.append(f"# TYPE {m.name} histogram")
+                    cum, total, n = m.snapshot()
+                    for bound, c in zip(m.buckets, cum):
+                        lines.append(f'{m.name}_bucket{{le="{_fmt(bound)}"}} {c}')
+                    lines.append(f'{m.name}_bucket{{le="+Inf"}} {n}')
+                    lines.append(f"{m.name}_sum {_fmt(total)}")
+                    lines.append(f"{m.name}_count {n}")
+                else:
+                    kind = "counter" if isinstance(m, Counter) else "gauge"
+                    lines.append(f"# HELP {m.name} {help_}")
+                    lines.append(f"# TYPE {m.name} {kind}")
+                    lines.append(f"{m.name} {_fmt(m.value())}")
+            for prefix, fn in providers:
+                try:
+                    values = fn()
+                except Exception:  # noqa: BLE001 — one broken provider must not kill the scrape
+                    continue
+                for key in sorted(values):
+                    v = values[key]
+                    if not isinstance(v, (int, float)) or isinstance(v, bool):
+                        continue
+                    name = sanitize_metric_name(f"{prefix}_{key}")
+                    if name in seen:
+                        continue
+                    seen.add(name)
+                    lines.append(f"# HELP {name} absorbed from the {prefix} counter schema")
+                    lines.append(f"# TYPE {name} gauge")
+                    lines.append(f"{name} {_fmt(v)}")
+        return "\n".join(lines) + "\n"
+
+    def _chain(self) -> List["MetricsRegistry"]:
+        out: List[MetricsRegistry] = [self]
+        reg = self.parent
+        while reg is not None:
+            out.append(reg)
+            reg = reg.parent
+        return out
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+# ---- process-wide singleton (long-lived components' histograms live here) ----
+
+_registry: Optional[MetricsRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _registry
+    r = _registry
+    if r is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = MetricsRegistry()
+            r = _registry
+    return r

@@ -51,6 +51,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from skyplane_tpu.obs import get_tracer
 from skyplane_tpu.ops.bufpool import BufferPool, bucket_size
 from skyplane_tpu.ops.cdc import CDCParams
 from skyplane_tpu.ops.fused_cdc import FusedCDCFP, finalize_row
@@ -84,8 +85,15 @@ class BatchHandle:
     def _wait(self, event: threading.Event) -> None:
         if not event.is_set():
             t0 = time.perf_counter_ns()
+            t0_wall = time.time_ns()
             event.wait(timeout=600)
-            self.wait_ns += time.perf_counter_ns() - t0
+            waited = time.perf_counter_ns() - t0
+            self.wait_ns += waited
+            tracer = get_tracer()
+            if tracer.enabled:
+                # the hot-path device stall the overlap scheduling hides;
+                # async track — many workers wait on one batch concurrently
+                tracer.record_span("batch.device_wait", waited, t0_wall, cat="device")
         if not event.is_set():
             raise TimeoutError("device batch runner stalled")
         if self._entry.error is not None:
@@ -284,21 +292,22 @@ class DeviceBatchRunner:
             deadline = time.monotonic() + self.max_wait_s
             hard_deadline = deadline + self.defer_ceiling_s
             ceiling_flush = False
-            with self._cond:
-                while True:
-                    group_now = self._open.get(bucket, [])
-                    # the window may already have been flushed by a 'full'
-                    # flush (identity check: _Entry has eq=False by design)
-                    if not any(e is entry for e in group_now):
-                        break
-                    now = time.monotonic()
-                    if now >= deadline and (self._in_flight.get(bucket, 0) == 0 or now >= hard_deadline):
-                        ceiling_flush = now >= hard_deadline and self._in_flight.get(bucket, 0) > 0
-                        self._open[bucket] = []
-                        to_run = group_now
-                        break
-                    remaining = (deadline - now) if now < deadline else (hard_deadline - now)
-                    self._cond.wait(timeout=max(remaining, 0.001))
+            with get_tracer().span("batch.window_wait", cat="device", args={"bucket": bucket}):
+                with self._cond:
+                    while True:
+                        group_now = self._open.get(bucket, [])
+                        # the window may already have been flushed by a 'full'
+                        # flush (identity check: _Entry has eq=False by design)
+                        if not any(e is entry for e in group_now):
+                            break
+                        now = time.monotonic()
+                        if now >= deadline and (self._in_flight.get(bucket, 0) == 0 or now >= hard_deadline):
+                            ceiling_flush = now >= hard_deadline and self._in_flight.get(bucket, 0) > 0
+                            self._open[bucket] = []
+                            to_run = group_now
+                            break
+                        remaining = (deadline - now) if now < deadline else (hard_deadline - now)
+                        self._cond.wait(timeout=max(remaining, 0.001))
             if to_run is not None:
                 if ceiling_flush:
                     # the previous batch blew the ceiling and may be wedged

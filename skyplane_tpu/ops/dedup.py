@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from skyplane_tpu.exceptions import CodecException, DedupIntegrityException
+from skyplane_tpu.obs.tracer import get_tracer as _get_tracer
 from skyplane_tpu.ops.bufpool import BufferPool, bucket_size
 from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
 
@@ -364,8 +365,9 @@ class SegmentStore:
             p = self._spill_path(fp)
             tmp = p.with_name(f"{p.name}.tmp{threading.get_ident()}")
             try:
-                tmp.write_bytes(data)
-                os.replace(tmp, p)
+                with _get_tracer().span("spill.write", cat="store", args={"bytes": len(data)}):
+                    tmp.write_bytes(data)
+                    os.replace(tmp, p)
             except OSError:
                 # disk failure: drop the in-transit pin, then surface (a full
                 # spill disk is daemon-fatal, same as the old in-lock write)
@@ -415,7 +417,8 @@ class SegmentStore:
             self._c_lock_held_disk_reads += 1
         p = self._spill_path(fp)
         try:
-            data = p.read_bytes()
+            with _get_tracer().span("spill.read", cat="store"):
+                data = p.read_bytes()
         except OSError:
             return None  # raced with spill eviction: treat as a miss
         self._c_spill_reads += 1

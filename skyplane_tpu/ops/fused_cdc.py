@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skyplane_tpu.obs import get_tracer
 from skyplane_tpu.ops.cdc import CDCParams, select_boundaries
 from skyplane_tpu.ops.fingerprint import (
     MAX_SEGMENT_BYTES,
@@ -174,7 +175,8 @@ class PendingBatch:
         """[B, n_slots, 8] fingerprint lanes — blocks until readback lands.
         Idempotent; releases the per-batch scratch on first completion."""
         if self._lanes is None:
-            self._lanes = np.asarray(self._lanes_dev)
+            with get_tracer().span("fused.readback", cat="device", args={"rows": self.b}):
+                self._lanes = np.asarray(self._lanes_dev)
             self._lanes_dev = None
             if self._ends_scratch is not None:
                 # safe to recycle only now: the upload backing this scratch is
@@ -307,7 +309,8 @@ class FusedCDCFP:
             dev_batch = jnp.asarray(np.stack(host_rows))  # uploaded once, shared by both calls
         else:
             dev_batch = jnp.asarray(batch)  # contiguous input passes straight through
-        packed = np.asarray(cand_fn(dev_batch, jnp.asarray(np.asarray(lens, np.int32))))  # small fetch
+        with get_tracer().span("fused.dispatch", cat="device", args={"rows": b, "bucket": bucket}):
+            packed = np.asarray(cand_fn(dev_batch, jnp.asarray(np.asarray(lens, np.int32))))  # small fetch
         ends_rows: List[Optional[np.ndarray]] = []
         fallback: List[Optional[Tuple[np.ndarray, List[bytes]]]] = []
         if self.pool is not None:

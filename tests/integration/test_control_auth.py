@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import uuid
 
+import pytest
 import requests
 
 from skyplane_tpu.gateway.control_auth import control_session
@@ -19,6 +20,7 @@ from tests.integration.harness import dispatch_file, make_pair, wait_complete
 
 
 def test_transfer_passes_while_unauthenticated_calls_rejected(tmp_path):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     token = uuid.uuid4().hex
     src_file = tmp_path / "src.bin"
     src_file.write_bytes(os.urandom(2 * 1024 * 1024))
@@ -57,6 +59,7 @@ def test_transfer_passes_while_unauthenticated_calls_rejected(tmp_path):
 
 
 def test_plain_http_refused_when_control_tls_on(tmp_path):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     src, dst = make_pair(
         tmp_path, compress="none", dedup=False, encrypt=False, use_tls=True, api_token=uuid.uuid4().hex
     )

@@ -25,6 +25,7 @@ from integration.harness import dispatch_file, make_pair, wait_complete
 
 
 def test_receiver_eviction_nack_discard_resend(tmp_path):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     rng = np.random.default_rng(42)
     block_a = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()  # shared content
     unique1 = rng.integers(0, 256, 2 << 20, dtype=np.uint8).tobytes()
@@ -84,6 +85,7 @@ def test_receiver_eviction_nack_discard_resend(tmp_path):
 
 
 def test_sender_index_rebound_to_advertised_capacity(tmp_path):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     """The designed-coherence half of the contract: the sender splits the
     receiver's advertised capacity (gateway_operator.py:427-439), so its
     index bound lands strictly below receiver retention."""

@@ -53,6 +53,8 @@ def test_plain_transfer_no_codec(pair_dirs):
 
 
 def test_zstd_tls_e2ee(pair_dirs):
+    pytest.importorskip("zstandard")  # optional deps: minimal containers ship without them
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     _run_transfer(pair_dirs, compress="zstd", dedup=False, encrypt=True, use_tls=True)
 
 

@@ -170,6 +170,7 @@ def test_windowed_sender_beats_stop_and_wait_under_latency(tmp_path, delayed_con
 
 
 def test_windowed_sender_correct_with_dedup_under_latency(tmp_path, delayed_connections):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     """Windowed recipes: later chunks REF literals still in flight on the same
     socket — correctness of the in-order window view under real latency."""
     os.environ["SKYPLANE_TPU_SENDER_WINDOW"] = "8"

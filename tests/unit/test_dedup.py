@@ -124,6 +124,7 @@ def test_paranoid_verify_catches_poisoned_store():
     from skyplane_tpu.exceptions import ChecksumMismatchException
     from skyplane_tpu.ops.pipeline import DataPathProcessor
 
+    pytest.importorskip("zstandard")  # optional dep: minimal containers ship without it
     rng2 = np.random.default_rng(77)
     data = rng2.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
     sender = DataPathProcessor(codec_name="zstd", dedup=True)

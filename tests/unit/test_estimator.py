@@ -92,6 +92,7 @@ def _iface(tmp_path, files: dict):
 
 
 def test_estimate_compressible_corpus(tmp_path):
+    pytest.importorskip("zstandard")  # estimate_corpus sample-compresses with zstd
     iface = _iface(tmp_path, {"a.bin": bytes(1 << 20), "b.bin": bytes(1 << 20)})
     est = estimate_corpus(iface)
     assert est is not None
@@ -100,6 +101,7 @@ def test_estimate_compressible_corpus(tmp_path):
 
 
 def test_estimate_incompressible_unique_corpus(tmp_path):
+    pytest.importorskip("zstandard")  # estimate_corpus sample-compresses with zstd
     iface = _iface(
         tmp_path,
         {"a.bin": rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes(), "b.bin": rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()},
@@ -144,6 +146,7 @@ def _send_ops(plan):
 
 
 def test_planner_enables_codec_for_compressible_corpus(tmp_path):
+    pytest.importorskip("zstandard")  # estimate_corpus sample-compresses with zstd
     job = _mk_job(tmp_path, {"snap.bin": bytes(4 << 20)})
     plan = MulticastDirectPlanner(TransferConfig(compress="tpu_zstd", dedup=True)).plan([job])
     sends = _send_ops(plan)
@@ -155,6 +158,7 @@ def test_planner_enables_codec_for_compressible_corpus(tmp_path):
 
 
 def test_planner_disables_codec_for_incompressible_corpus_on_cheap_edge(tmp_path):
+    pytest.importorskip("zstandard")  # estimate_corpus sample-compresses with zstd
     data = rng.integers(0, 256, 4 << 20, dtype=np.uint8).tobytes()
     job = _mk_job(tmp_path, {"noise.bin": data}, src_region="local:siteA", dst_region="local:siteB")
     plan = MulticastDirectPlanner(TransferConfig(compress="tpu_zstd", dedup=True)).plan([job])

@@ -120,6 +120,7 @@ def test_deauthorize_tolerates_already_gone(gcp):
 
 
 def test_provision_instance_spot_and_network_tier(gcp):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     provider, session = gcp
     provider.use_spot = True
     provider.premium_network = False

@@ -87,6 +87,7 @@ def test_accelerator_path_matches_host_path(monkeypatch):
 
     def run(accel: bool):
         monkeypatch.setattr(backend, "_is_accelerator", accel)
+        pytest.importorskip("zstandard")  # optional dep: minimal containers ship without it
         proc = DataPathProcessor(codec_name="zstd", dedup=True)
         p = proc.process(data, SenderDedupIndex())
         return p

@@ -183,6 +183,7 @@ def test_image_resolution_errors_when_no_candidate(provider):
 
 
 def test_keypair_conflict_reuses_existing_key_by_material(provider):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     p, fake = provider
     key_id = p.ensure_keypair("r1")  # generates PEM + registers
     assert fake.keys[0]["id"] == key_id
@@ -195,6 +196,7 @@ def test_keypair_conflict_reuses_existing_key_by_material(provider):
 
 
 def test_delete_keypair(provider):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     p, fake = provider
     p.ensure_keypair("r1")
     assert p.delete_keypair("r1") is True
@@ -203,6 +205,7 @@ def test_delete_keypair(provider):
 
 
 def test_teardown_after_partial_provision_deletes_instance(provider):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     p, fake = provider
     fake.fail_fip_create = True
     with pytest.raises(RuntimeError, match="floating IPs"):
@@ -213,6 +216,7 @@ def test_teardown_after_partial_provision_deletes_instance(provider):
 
 
 def test_provision_failure_state_raises_and_cleans_up(provider):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     p, fake = provider
     fake.instance_status = "failed"
     with pytest.raises(RuntimeError, match="state failed"):
@@ -221,6 +225,7 @@ def test_provision_failure_state_raises_and_cleans_up(provider):
 
 
 def test_provision_success_returns_server_with_floating_ip(provider):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     p, fake = provider
     server = p.provision_instance("ibmcloud:r1", vm_type="bx2-8x32")
     assert server.public_ip() == "169.1.2.3" if hasattr(server, "public_ip") else True
@@ -231,6 +236,7 @@ def test_provision_success_returns_server_with_floating_ip(provider):
 
 
 def test_terminate_instance_releases_floating_ip(provider):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     p, fake = provider
     server = p.provision_instance("ibmcloud:r1")
     assert len(fake.fips) == 1
@@ -239,6 +245,7 @@ def test_terminate_instance_releases_floating_ip(provider):
 
 
 def test_teardown_region_sweeps_in_dependency_order(provider):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     p, fake = provider
     p.provision_instance("ibmcloud:r1")
     p.provision_instance("ibmcloud:r1")

@@ -70,7 +70,7 @@ def test_native_lz_container_caps_claimed_raw_len():
 
 
 def test_zstd_decode_caps_claimed_content_size():
-    import zstandard
+    zstandard = pytest.importorskip("zstandard")  # optional dep: minimal containers ship without it
 
     # an honest tiny frame decodes fine through the capped path
     codec = get_codec("zstd")
@@ -191,6 +191,7 @@ def _send_frame(port: int, header: WireProtocolHeader, payload: bytes) -> bytes:
 
 
 def test_receiver_rejects_plaintext_frame_when_e2ee_enabled(tmp_path):
+    pytest.importorskip("cryptography")  # optional dep: minimal containers ship without it
     key = generate_key()
     r, store, ev, eq, port = _mk_receiver(tmp_path, e2ee_key=key)
     try:
@@ -207,6 +208,7 @@ def test_receiver_rejects_plaintext_frame_when_e2ee_enabled(tmp_path):
 
 
 def test_receiver_accepts_properly_encrypted_frame(tmp_path):
+    pytest.importorskip("cryptography")
     key = generate_key()
     r, store, ev, eq, port = _mk_receiver(tmp_path, e2ee_key=key)
     try:
@@ -224,6 +226,7 @@ def test_receiver_accepts_properly_encrypted_frame(tmp_path):
 
 
 def test_receiver_rejects_garbage_ciphertext(tmp_path):
+    pytest.importorskip("cryptography")
     key = generate_key()
     r, store, ev, eq, port = _mk_receiver(tmp_path, e2ee_key=key)
     try:

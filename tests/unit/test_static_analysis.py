@@ -228,6 +228,69 @@ def serve(q):
     assert "bare-except-in-loop" not in rules_of(src)
 
 
+# ------------------------------------------------------------- span rules
+
+
+def test_blocking_io_in_span_fires_in_span_exit_and_record_callback():
+    """The overhead-regression bug class the obs tracer must never grow:
+    syscalls on the span-record path (skyplane_tpu/obs/tracer.py contract)."""
+    src = """
+import os, time
+class FancySpan:
+    def __exit__(self, *exc):
+        with open("/tmp/spans.log", "a") as f:
+            f.write(self.name)
+class RingBuffer:
+    def record(self, entry):
+        self.sock.sendall(entry)
+def on_span_end(span, sink):
+    time.sleep(0.01)
+"""
+    findings = [f for f in run_source(src) if f.rule == "blocking-io-in-span"]
+    assert len(findings) == 3
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_blocking_io_in_span_fires_while_holding_ring_slot():
+    src = """
+def publish(ring, payload, peer):
+    with ring.slot() as rec:
+        peer.sendall(payload)
+        rec.value = payload
+"""
+    findings = [f for f in run_source(src) if f.rule == "blocking-io-in-span"]
+    assert len(findings) == 1
+
+
+def test_blocking_io_in_span_quiet_on_pure_record_and_instrumented_io():
+    """Pure tuple-store records are clean, and instrumenting I/O from the
+    OUTSIDE (`with tracer.span(...)` around a send) is the intended use."""
+    src = """
+import time
+class Span:
+    def __exit__(self, *exc):
+        self._ring.buf[self._i] = (self.name, time.perf_counter_ns())
+class Tracer:
+    def span(self, name):
+        return Span()
+def pump(tracer, sock, frame):
+    with tracer.span("wire.send"):
+        sock.sendall(frame)
+def helper_outside_scope(path):
+    return open(path).read()
+"""
+    assert "blocking-io-in-span" not in rules_of(src)
+
+
+def test_blocking_io_in_span_suppressible():
+    src = """
+class DebugSpan:
+    def __exit__(self, *exc):
+        print_to = open("/tmp/x", "a")  # sklint: disable=blocking-io-in-span -- debug-only span sink, not shipped
+"""
+    assert all(f.suppressed for f in run_source(src) if f.rule == "blocking-io-in-span")
+
+
 # ------------------------------------------------------------ tracer rules
 
 
