@@ -1,10 +1,18 @@
 #!/usr/bin/env python
-"""Validate a bench.py output file: exactly one well-formed JSON result line
+"""Validate a bench output file: exactly one well-formed JSON result line
 with the full perf-counter schema (docs/datapath-performance.md).
 
+Two result shapes are recognized, dispatched on the ``metric`` field:
+
+  * bench.py results (the default encode/decode/wire schema);
+  * scripts/soak_multijob.py results (``metric: multijob_gbps``): the
+    multi-tenant soak — per-tenant Gbps split, the fairness ratio gate
+    (max/min <= fairness_bound for equal weights), bounded index RSS, and
+    per-tenant accounting keys (docs/multitenancy.md).
+
 Exit 0 iff the result parses and every required key is present; used by the
-bench-smoke step in scripts/devloop.sh so a counter-schema regression is
-caught in seconds on CPU, not after a multi-hour accelerator bench run.
+bench-smoke and multijob-smoke steps in scripts/devloop.sh so a schema or
+fairness regression is caught in seconds on CPU.
 """
 
 from __future__ import annotations
@@ -68,6 +76,74 @@ REQUIRED_WIRE_COUNTERS = (
 )
 
 
+# multi-tenant soak result (scripts/soak_multijob.py)
+REQUIRED_MULTIJOB = (
+    "metric",
+    "value",
+    "unit",
+    "n_jobs",
+    "tenant_gbps",
+    "gbps_max_min_ratio",
+    "fairness_bound",
+    "index_rss_bytes",
+    "process_open_fds_start",
+    "process_open_fds_end",
+    "tenant_counters",
+)
+# every tenant's accounting entry must carry these keys
+REQUIRED_TENANT_KEYS = ("chunks_registered", "bytes_registered", "bytes_delivered")
+
+
+def check_multijob(result: dict) -> int:
+    missing = [k for k in REQUIRED_MULTIJOB if k not in result]
+    if missing:
+        print(f"multijob-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    tenant_gbps = result["tenant_gbps"]
+    if not isinstance(tenant_gbps, dict) or len(tenant_gbps) < 2:
+        print(f"multijob-smoke: tenant_gbps must map >=2 tenants, got {tenant_gbps!r}", file=sys.stderr)
+        return 1
+    if len(tenant_gbps) != result["n_jobs"]:
+        print(
+            f"multijob-smoke: {len(tenant_gbps)} tenant entries but n_jobs={result['n_jobs']}",
+            file=sys.stderr,
+        )
+        return 1
+    counters = result["tenant_counters"]
+    bad = [
+        f"tenant_counters[{t}].{k}"
+        for t in tenant_gbps
+        for k in REQUIRED_TENANT_KEYS
+        if k not in (counters.get(t) or {})
+    ]
+    if bad:
+        print(f"multijob-smoke: missing per-tenant keys: {', '.join(bad[:8])}", file=sys.stderr)
+        return 1
+    # acceptance gate: equal-weight tenants split throughput fairly
+    ratio = result["gbps_max_min_ratio"]
+    bound = result["fairness_bound"]
+    if not isinstance(ratio, (int, float)) or ratio <= 0 or ratio > bound:
+        print(
+            f"multijob-smoke: per-tenant Gbps max/min ratio {ratio!r} breaches the fairness bound {bound}",
+            file=sys.stderr,
+        )
+        return 1
+    # leak gates: bounded index RSS, no descriptor growth beyond slack
+    if result["index_rss_bytes"] < 0:
+        print(f"multijob-smoke: implausible index_rss_bytes {result['index_rss_bytes']!r}", file=sys.stderr)
+        return 1
+    fd_growth = result["process_open_fds_end"] - result["process_open_fds_start"]
+    if fd_growth > 64:
+        print(f"multijob-smoke: fd count grew by {fd_growth} across the soak (descriptor leak)", file=sys.stderr)
+        return 1
+    print(
+        f"multijob-smoke OK: {result['n_jobs']} jobs, {result['value']} {result['unit']} aggregate, "
+        f"per-tenant max/min {ratio} (bound {bound}), index RSS {result['index_rss_bytes']:.0f}B, "
+        f"fd growth {fd_growth}"
+    )
+    return 0
+
+
 def main(argv) -> int:
     if len(argv) != 2:
         print("usage: check_bench_json.py <bench-output-file>", file=sys.stderr)
@@ -93,6 +169,8 @@ def main(argv) -> int:
         print(f"bench-smoke: expected exactly ONE result line, found {len(results)}", file=sys.stderr)
         return 1
     result = results[0]
+    if result.get("metric") == "multijob_gbps":
+        return check_multijob(result)
     missing = [k for k in REQUIRED_TOP if k not in result]
     counters = result.get("datapath_counters")
     if not isinstance(counters, dict):

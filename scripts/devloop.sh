@@ -70,6 +70,25 @@ else
   echo "[devloop] trace-smoke clean; trace at $LOGDIR/trace_smoke.json" >>"$LOGDIR/devloop.log"
 fi
 
+# Multijob-smoke gate (CPU-only, ~1 min): >= 8 concurrent tenants over the
+# loopback stack (scripts/soak_multijob.py) — per-tenant Gbps split must stay
+# within the 2x fairness bound for equal weights, index RSS bounded, no fd
+# growth, and the per-tenant accounting keys present (docs/multitenancy.md).
+# Validated by the multijob branch of check_bench_json.py. Like lint/bench:
+# failures are logged LOUDLY but do not block device profiling.
+JAX_PLATFORMS=cpu SKYPLANE_SOAK_JOBS=8 SKYPLANE_SOAK_MB_PER_JOB=2 \
+  python scripts/soak_multijob.py >"$LOGDIR/multijob_smoke.out" 2>"$LOGDIR/multijob_smoke.err"
+MULTIJOB_RC=$?
+if [ "$MULTIJOB_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/multijob_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  MULTIJOB_RC=$?
+fi
+if [ "$MULTIJOB_RC" -ne 0 ]; then
+  echo "[devloop] MULTIJOB-SMOKE FAILURE (rc=$MULTIJOB_RC) — fairness split, tenant keys, or leak gates regressed; see $LOGDIR/multijob_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] multijob-smoke clean; result at $LOGDIR/multijob_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only
   # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
   # acquired but crashed mid-profile must be retried, not recorded

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Concurrent-jobs soak: N tenants share one loopback gateway pair.
+
+The multi-tenant acceptance bench (ISSUE 6 / ROADMAP open item 3): >= 8
+concurrent jobs with mixed chunk sizes but EQUAL byte totals and equal
+weights run through the full loopback stack (framed sockets, fair-share
+scheduler, per-tenant accounting), all starting together. Reports a single
+JSON result line:
+
+  metric            multijob_gbps (aggregate effective throughput)
+  tenant_gbps       per-tenant Gbps over each tenant's completion window
+  gbps_max_min_ratio  fairness: max/min per-tenant Gbps (equal weights
+                      must stay <= fairness_bound = 2.0)
+  index_rss_bytes   dedup/index resident bytes after the soak (bounded)
+  process_open_fds_start/end  descriptor-leak signal
+  tenant_counters   per-tenant chunks/bytes from GET /api/v1/tenants
+
+scripts/check_bench_json.py validates the schema and gates the fairness
+ratio; scripts/devloop.sh runs this as the multijob-smoke step.
+
+Env knobs: SKYPLANE_SOAK_JOBS (default 8), SKYPLANE_SOAK_MB_PER_JOB
+(default 8), SKYPLANE_SOAK_DEDUP=1 to run the dedup path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import numpy as np  # noqa: E402
+
+from integration.harness import dispatch_file, make_pair, wait_complete  # noqa: E402
+from skyplane_tpu.obs.metrics import open_fd_count  # noqa: E402
+from skyplane_tpu.tenancy import mint_tenant_id  # noqa: E402
+
+FAIRNESS_BOUND = 2.0  # max/min per-tenant Gbps for equal weights (acceptance)
+# mixed sizes: per-tenant chunk size cycles through this list (bytes); byte
+# TOTALS stay equal so per-tenant Gbps is directly comparable
+CHUNK_SIZES = [256 << 10, 512 << 10, 1 << 20, 2 << 20]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+def main() -> int:
+    n_jobs = _env_int("SKYPLANE_SOAK_JOBS", 8)
+    mb_per_job = _env_int("SKYPLANE_SOAK_MB_PER_JOB", 8)
+    dedup = os.environ.get("SKYPLANE_SOAK_DEDUP", "0") == "1"
+    per_job_bytes = mb_per_job << 20
+
+    fds_start = open_fd_count()
+    tmp = Path(tempfile.mkdtemp(prefix="skyplane_multijob_"))
+    src, dst = make_pair(tmp, compress="none", dedup=dedup, encrypt=False, use_tls=False, num_connections=4)
+    rng = np.random.default_rng(0)
+
+    tenants = [mint_tenant_id() for _ in range(n_jobs)]
+    (tmp / "srcfiles").mkdir()
+    files = []
+    for i, tenant in enumerate(tenants):
+        f = tmp / "srcfiles" / f"job{i}.bin"
+        f.write_bytes(rng.integers(0, 256, per_job_bytes, dtype=np.uint8).tobytes())
+        files.append(f)
+
+    # admission: one job per tenant, registered before dispatch
+    for i, tenant in enumerate(tenants):
+        resp = src.post("jobs", json={"job_id": f"soak-job-{i}", "tenant_id": tenant}, timeout=30)
+        resp.raise_for_status()
+
+    results: dict = {}
+    errors: list = []
+    start_barrier = threading.Barrier(n_jobs + 1)
+
+    def run_job(i: int) -> None:
+        tenant = tenants[i]
+        chunk_bytes = CHUNK_SIZES[i % len(CHUNK_SIZES)]
+        try:
+            start_barrier.wait()
+            t0 = time.monotonic()
+            ids = dispatch_file(src, files[i], tmp / "out" / f"job{i}.bin", chunk_bytes=chunk_bytes, tenant_id=tenant)
+            wait_complete(dst, ids, timeout=600)
+            seconds = time.monotonic() - t0
+            results[tenant] = {"seconds": seconds, "chunk_bytes": chunk_bytes, "n_chunks": len(ids)}
+        except Exception as e:  # noqa: BLE001 — surfaced as a soak failure below
+            errors.append(f"job {i} ({tenant}): {e}")
+
+    threads = [threading.Thread(target=run_job, args=(i,), daemon=True) for i in range(n_jobs)]
+    for t in threads:
+        t.start()
+    start_barrier.wait()  # all jobs dispatch together: completion-window Gbps is comparable
+    t_all = time.monotonic()
+    for t in threads:
+        t.join(timeout=900)
+    wall = time.monotonic() - t_all
+
+    if errors or len(results) != n_jobs:
+        print(json.dumps({"error": f"{len(errors)} soak jobs failed", "detail": errors[:4]}), file=sys.stderr)
+        src.stop()
+        dst.stop()
+        return 1
+
+    # verify every byte landed correctly before reporting throughput
+    for i in range(n_jobs):
+        got = (tmp / "out" / f"job{i}.bin").read_bytes()
+        if got != files[i].read_bytes():
+            print(json.dumps({"error": f"job {i} output mismatch"}), file=sys.stderr)
+            src.stop()
+            dst.stop()
+            return 1
+
+    tenant_gbps = {
+        tenant: round(per_job_bytes * 8 / r["seconds"] / 1e9, 4) for tenant, r in results.items()
+    }
+    ratio = round(max(tenant_gbps.values()) / max(min(tenant_gbps.values()), 1e-9), 3)
+    snap = src.get("tenants", timeout=30).json()
+    tenant_counters = {
+        tenant: {
+            "chunks_registered": snap["tenants"][tenant]["chunks_registered"],
+            "bytes_registered": snap["tenants"][tenant]["bytes_registered"],
+            "bytes_delivered": snap["tenants"][tenant]["bytes_delivered"],
+        }
+        for tenant in tenants
+    }
+    index_rss = 0.0
+    for line in src.get("metrics", timeout=30).text.splitlines():
+        if line.startswith("skyplane_index_rss_bytes "):
+            index_rss = float(line.split()[1])
+
+    src.stop()
+    dst.stop()
+    fds_end = open_fd_count()
+
+    result = {
+        "metric": "multijob_gbps",
+        "value": round(n_jobs * per_job_bytes * 8 / wall / 1e9, 4),
+        "unit": "Gbps",
+        "n_jobs": n_jobs,
+        "mb_per_job": mb_per_job,
+        "dedup": dedup,
+        "mixed_chunk_sizes": sorted({r["chunk_bytes"] for r in results.values()}),
+        "tenant_gbps": tenant_gbps,
+        "gbps_max_min_ratio": ratio,
+        "fairness_bound": FAIRNESS_BOUND,
+        "index_rss_bytes": index_rss,
+        "process_open_fds_start": fds_start,
+        "process_open_fds_end": fds_end,
+        "tenant_counters": tenant_counters,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -395,6 +395,83 @@ class SocketIOUnderLockChecker(Checker):
                 )
 
 
+_QUEUE_FACTORIES = {"queue.Queue", "Queue", "queue.LifoQueue", "LifoQueue", "queue.PriorityQueue", "PriorityQueue"}
+_ALWAYS_UNBOUNDED = {"queue.SimpleQueue", "SimpleQueue"}
+_DEQUE_FACTORIES = {"deque", "collections.deque"}
+
+
+class UnboundedQueueInGatewayChecker(Checker):
+    """unbounded-queue-in-gateway: a ``queue.Queue()``/``deque()`` with no
+    size bound constructed in gateway code. Unbounded queues are the
+    tenant-isolation bug class of the multi-tenant gateway: any point where
+    one tenant's backlog can buffer without limit (a NACK storm re-queueing
+    chunks, a stalled peer's profile events, a runaway status stream) turns
+    into unbounded memory that starves every OTHER tenant on the box —
+    backpressure must reach the offender, not the allocator.
+
+    Fires only under a ``gateway`` path segment (the threaded data/control
+    plane); library modules that feed it are bounded by their callers. A
+    genuinely-bounded-by-protocol structure (e.g. an in-flight deque capped
+    by a byte window) takes a justified ``# sklint: disable`` per policy.
+    Bounds the checker recognizes: any positional size argument or a
+    ``maxsize=``/``maxlen=`` keyword that is not a literal 0/None.
+    """
+
+    rules = (
+        RuleSpec(
+            "unbounded-queue-in-gateway",
+            "error",
+            "queue.Queue()/deque() in gateway code with no maxsize/maxlen bound",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        from pathlib import PurePath
+
+        if "gateway" not in PurePath(module.path).parts:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _ALWAYS_UNBOUNDED:
+                yield self.finding(
+                    module, "unbounded-queue-in-gateway", node, f"{name}() has no bound at all — use queue.Queue(maxsize=...)"
+                )
+                continue
+            if name in _QUEUE_FACTORIES:
+                if not self._bounded(node, kw="maxsize", positional_index=0):
+                    yield self.finding(
+                        module,
+                        "unbounded-queue-in-gateway",
+                        node,
+                        f"{name}() without a maxsize bound — one slow consumer buffers without limit",
+                    )
+            elif name in _DEQUE_FACTORIES:
+                if not self._bounded(node, kw="maxlen", positional_index=1):
+                    yield self.finding(
+                        module,
+                        "unbounded-queue-in-gateway",
+                        node,
+                        f"{name}() without a maxlen bound — one slow consumer buffers without limit",
+                    )
+
+    @staticmethod
+    def _bounded(call: ast.Call, kw: str, positional_index: int) -> bool:
+        """A literal 0/None bound is unbounded; a non-zero literal or any
+        dynamic expression counts as bounded (can't evaluate statically)."""
+
+        def is_unbounded_literal(node: ast.AST) -> bool:
+            return isinstance(node, ast.Constant) and (node.value == 0 or node.value is None)
+
+        for k in call.keywords:
+            if k.arg == kw:
+                return not is_unbounded_literal(k.value)
+        if len(call.args) > positional_index:
+            return not is_unbounded_literal(call.args[positional_index])
+        return False
+
+
 class BareExceptLoopChecker(Checker):
     """bare-except-in-loop: an ``except:``/``except BaseException`` that does
     not re-raise, inside a service loop, also swallows KeyboardInterrupt /
@@ -432,5 +509,6 @@ CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     ThreadLifecycleChecker,
     BlockingUnderLockChecker,
     SocketIOUnderLockChecker,
+    UnboundedQueueInGatewayChecker,
     BareExceptLoopChecker,
 )

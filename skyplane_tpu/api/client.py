@@ -23,8 +23,16 @@ class SkyplaneClient:
         gcp_config: Optional[GCPConfig] = None,
         transfer_config: Optional[TransferConfig] = None,
         log_dir: Optional[str] = None,
+        tenant_id: Optional[str] = None,
     ):
         self.clientid = uuid.uuid4().hex
+        # every client owns a tenant identity: explicit (a service embedding
+        # skyplane-tpu for its users) or minted per client. It rides every
+        # chunk this client's jobs produce, drives gateway-side admission,
+        # fair-share scheduling, and per-tenant metrics (docs/multitenancy.md)
+        from skyplane_tpu.tenancy import mint_tenant_id, validate_tenant_id
+
+        self.tenant_id = validate_tenant_id(tenant_id) if tenant_id else mint_tenant_id()
         self.aws_config = aws_config
         self.azure_config = azure_config
         self.gcp_config = gcp_config
@@ -42,6 +50,7 @@ class SkyplaneClient:
             transfer_config=self.transfer_config,
             provisioner=self.provisioner,
             debug=debug,
+            tenant_id=self.tenant_id,
         )
 
     def copy(self, src: str, dst: str, recursive: bool = False, max_instances: int = 1) -> None:

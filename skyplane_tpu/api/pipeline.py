@@ -25,9 +25,14 @@ class Pipeline:
         transfer_config: Optional[TransferConfig] = None,
         provisioner: Optional[Provisioner] = None,
         debug: bool = False,
+        tenant_id: Optional[str] = None,
     ):
         self.planning_algorithm = planning_algorithm
         self.max_instances = max_instances
+        # owning tenant for every job queued on this pipeline: rides each
+        # chunk and the v5 wire header (docs/multitenancy.md). None = the
+        # single-tenant default.
+        self.tenant_id = tenant_id
         self.transfer_config = transfer_config or TransferConfig()
         cfg = self.transfer_config
         self.provisioner = provisioner or Provisioner(
@@ -43,12 +48,12 @@ class Pipeline:
     # ---- job queueing (reference :130-175) ----
 
     def queue_copy(self, src: str, dst: str, recursive: bool = False) -> CopyJob:
-        job = CopyJob(src, [dst] if isinstance(dst, str) else dst, recursive=recursive)
+        job = CopyJob(src, [dst] if isinstance(dst, str) else dst, recursive=recursive, tenant_id=self.tenant_id)
         self.jobs_to_dispatch.append(job)
         return job
 
     def queue_sync(self, src: str, dst: str) -> SyncJob:
-        job = SyncJob(src, [dst] if isinstance(dst, str) else dst, recursive=True)
+        job = SyncJob(src, [dst] if isinstance(dst, str) else dst, recursive=True, tenant_id=self.tenant_id)
         self.jobs_to_dispatch.append(job)
         return job
 

@@ -150,12 +150,14 @@ class Chunker:
         transfer_config: TransferConfig,
         partition_id: str = "default",
         journal=None,  # TransferJournal for chunk-level resume (optional)
+        tenant_id: Optional[str] = None,  # stamped on every chunk (multitenancy)
     ):
         self.src_iface = src_iface
         self.dst_ifaces = dst_ifaces
         self.transfer_config = transfer_config
         self.partition_id = partition_id
         self.journal = journal
+        self.tenant_id = tenant_id
         self.multipart_upload_queue: "queue.Queue[GatewayMessage]" = queue.Queue()
         self.initiated_uploads: List[Tuple[StorageInterface, str, str]] = []  # (iface, dest_key, upload_id)
         self.reused_upload_ids: set = set()  # upload ids carried over from a prior run
@@ -235,6 +237,7 @@ class Chunker:
                     chunk_length_bytes=size,
                     partition_id=self.partition_id,
                     mime_type=pair.src_obj.mime_type,
+                    tenant_id=self.tenant_id,
                 )
                 if self.journal is not None:
                     self.journal.record_chunk(chunk.chunk_id, pair.src_obj.key, 0)
@@ -297,6 +300,7 @@ class Chunker:
                 part_number=part,
                 multi_part=True,
                 mime_type=pair.src_obj.mime_type,
+                tenant_id=self.tenant_id,
             )
             if self.journal is not None:
                 self.journal.record_chunk(chunk.chunk_id, pair.src_obj.key, offset)
@@ -305,13 +309,25 @@ class Chunker:
 
 
 class TransferJob:
-    """Base job (reference :453-531): lazily-bound interfaces from URIs."""
+    """Base job (reference :453-531): lazily-bound interfaces from URIs.
 
-    def __init__(self, src_path: str, dst_paths: List[str], recursive: bool = False, requester_pays: bool = False):
+    ``tenant_id`` (16 hex chars, minted by SkyplaneClient when absent) rides
+    on every chunk the job produces; gateways use it for admission, fair-share
+    scheduling, and per-tenant accounting (docs/multitenancy.md)."""
+
+    def __init__(
+        self,
+        src_path: str,
+        dst_paths: List[str],
+        recursive: bool = False,
+        requester_pays: bool = False,
+        tenant_id: Optional[str] = None,
+    ):
         self.src_path = src_path
         self.dst_paths = dst_paths if isinstance(dst_paths, list) else [dst_paths]
         self.recursive = recursive
         self.requester_pays = requester_pays
+        self.tenant_id = tenant_id
         self.uuid = str(uuid.uuid4())
         self.transfer_list: List[TransferPair] = []
         self._src_iface: Optional[StorageInterface] = None
@@ -395,7 +411,12 @@ class CopyJob(TransferJob):
         # each job's chunks to ITS operator DAG (reference: partition_id = job
         # uuid, planner.py:283-383)
         self.chunker = Chunker(
-            self.src_iface, self.dst_ifaces, transfer_config, partition_id=self.uuid, journal=self.journal
+            self.src_iface,
+            self.dst_ifaces,
+            transfer_config,
+            partition_id=self.uuid,
+            journal=self.journal,
+            tenant_id=self.tenant_id,
         )
         pairs = self.chunker.transfer_pair_generator(
             self.src_prefix, self.dst_prefixes, self.recursive, post_filter_fn=self._post_filter_fn
@@ -409,6 +430,11 @@ class CopyJob(TransferJob):
         # all gateways of a dataplane share one bearer token; any bound
         # gateway's session authenticates against all of them
         session = src_gateways[0].control_session() if src_gateways else requests.Session()
+        # job admission (docs/multitenancy.md): register this job with every
+        # source gateway BEFORE dispatching its chunks. A 429 means the
+        # gateway's concurrency envelope is full — surface it as a loud
+        # admission failure rather than dispatching unaccounted chunks.
+        self._admit_job(session, src_gateways)
 
         for batch in batch_generator(chunk_gen, self.DISPATCH_BATCH_SIZE):
             # flush any multipart upload-id mappings to every sink gateway first
@@ -431,6 +457,34 @@ class CopyJob(TransferJob):
             self._dispatched_chunks.extend(batch)
             yield from batch
         self._flush_upload_ids(session, sink_gateways)
+
+    def _admit_job(self, session, src_gateways) -> None:
+        """POST /api/v1/jobs at each source gateway; remembers admissions so
+        finalize()/abort() can release the slots. 429 raises AdmissionError;
+        a 404 (pre-multitenancy gateway) is tolerated silently."""
+        self._admitted: List[Tuple[object, str]] = getattr(self, "_admitted", [])
+        body = {"job_id": self.uuid, "tenant_id": self.tenant_id}
+        for gw in src_gateways:
+            try:
+                resp = session.post(f"{gw.control_url()}/jobs", json=body, timeout=30)
+            except requests.RequestException as e:
+                logger.fs.warning(f"job admission POST to {gw.gateway_id} failed: {e}")
+                continue
+            if resp.status_code == 429:
+                from skyplane_tpu.tenancy import AdmissionError
+
+                raise AdmissionError(f"gateway {gw.gateway_id} rejected job {self.uuid}: {resp.json().get('error')}")
+            if resp.status_code == 200:
+                self._admitted.append((session, f"{gw.control_url()}/jobs/{self.uuid}"))
+
+    def _release_admission(self) -> None:
+        """DELETE the job's admission slots (idempotent, best-effort)."""
+        for session, url in getattr(self, "_admitted", []):
+            try:
+                session.delete(url, timeout=10)
+            except requests.RequestException as e:  # noqa: PERF203 — best effort
+                logger.fs.warning(f"job admission release failed: {e}")
+        self._admitted = []
 
     def _flush_upload_ids(self, session, sink_gateways) -> None:
         assert self.chunker is not None
@@ -463,6 +517,7 @@ class CopyJob(TransferJob):
 
     def finalize(self) -> None:
         """Complete all multipart uploads in parallel (reference :719-744)."""
+        self._release_admission()  # dispatch is done: free the job's slot
         if self.chunker is None or not self.chunker.initiated_uploads:
             return
 
@@ -504,6 +559,7 @@ class CopyJob(TransferJob):
         gateways are stopped: an abort racing an in-flight UploadPart orphans
         that part permanently. With resume journaling on, aborting would
         destroy exactly the state a re-run needs — keep it."""
+        self._release_admission()  # best-effort even on the failure path
         if self.journal is not None and self.chunker is not None and self.chunker.initiated_uploads:
             logger.fs.info(
                 f"[resume] keeping {len(self.chunker.initiated_uploads)} open multipart uploads for resume"

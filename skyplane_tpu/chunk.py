@@ -1,4 +1,4 @@
-"""Chunk model and framed wire protocol (v4).
+"""Chunk model and framed wire protocol (v5).
 
 This is the shared kernel of the data plane: every byte that crosses a WAN
 socket is framed by :class:`WireProtocolHeader`, and every unit of work queued
@@ -6,8 +6,8 @@ through gateway operator DAGs is a :class:`ChunkRequest`.
 
 Reference parity (skyplane/chunk.py:9-167): ``Chunk``/``ChunkRequest``/
 ``ChunkState``/``WireProtocolHeader`` with the same lifecycle semantics. The
-wire protocol here is **version 4** and extends the reference's 53-byte v3
-frame with TPU-data-path fields:
+wire protocol here is **version 5** and extends the reference's 53-byte v3
+frame with TPU-data-path and multi-tenancy fields:
 
   * ``codec``        — codec id used on the payload (none / zstd / tpu block
                        codec / tpu+zstd hybrid), so receivers dispatch the
@@ -17,11 +17,17 @@ frame with TPU-data-path fields:
                        literal ranges) rather than raw chunk bytes.
   * ``fingerprint``  — 128-bit content fingerprint of the *raw* chunk, used
                        for end-to-end integrity and as the dedup index key.
+  * ``tenant_id``    — 64-bit tenant tag minted at the API layer (v5): the
+                       receiver attributes decode bytes, dedup-index bytes,
+                       and NACKs to the owning tenant so one gateway fleet
+                       can serve many concurrent jobs with per-tenant
+                       quotas and metrics (skyplane_tpu/tenancy/).
 
-Frame layout (big-endian, 78 bytes):
+Frame layout (big-endian, 86 bytes):
 
   magic(8) version(4) chunk_id(16) data_len(8) raw_data_len(8)
-  codec(1) flags(1) fingerprint(16) n_chunks_left_on_socket(8) hdr_crc(8)
+  codec(1) flags(1) fingerprint(16) tenant(8) n_chunks_left_on_socket(8)
+  hdr_crc(8)
 """
 
 from __future__ import annotations
@@ -36,9 +42,9 @@ from typing import Optional
 
 from skyplane_tpu.exceptions import SkyplaneTpuException
 
-MAGIC = int.from_bytes(b"SKYTPU\x00\x04", "big")
-WIRE_VERSION = 4
-HEADER_LENGTH_BYTES = 78
+MAGIC = int.from_bytes(b"SKYTPU\x00\x05", "big")
+WIRE_VERSION = 5
+HEADER_LENGTH_BYTES = 86
 
 # Hard ceiling on per-chunk sizes accepted off the wire or the control API.
 # data_len/raw_data_len are attacker-controlled u64s that feed straight into
@@ -49,6 +55,12 @@ MAX_CHUNK_BYTES = 8 << 30
 
 _CHUNK_ID_RE = re.compile(r"^[0-9a-f]{32}$")
 
+# Tenant ids are 64-bit tags rendered as 16 lowercase hex chars, minted at the
+# API layer (tenancy.mint_tenant_id). The all-zeros tenant is the implicit
+# single-tenant default: legacy clients that never set one land there.
+DEFAULT_TENANT_ID = "0" * 16
+_TENANT_ID_RE = re.compile(r"^[0-9a-f]{16}$")
+
 
 def validate_chunk_id(chunk_id: str) -> str:
     """chunk_id is joined into filesystem paths (<chunk_dir>/<id>.chunk); ids
@@ -57,6 +69,17 @@ def validate_chunk_id(chunk_id: str) -> str:
     if not isinstance(chunk_id, str) or not _CHUNK_ID_RE.match(chunk_id):
         raise SkyplaneTpuException(f"invalid chunk_id {chunk_id!r}: must be 32 lowercase hex chars")
     return chunk_id
+
+
+def validate_tenant_id(tenant_id: Optional[str]) -> str:
+    """Tenant ids arrive via the control API and are used as metric labels and
+    accounting keys; anything but the canonical 16-hex form is rejected.
+    None/empty maps to the single-tenant default."""
+    if tenant_id is None or tenant_id == "":
+        return DEFAULT_TENANT_ID
+    if not isinstance(tenant_id, str) or not _TENANT_ID_RE.match(tenant_id):
+        raise SkyplaneTpuException(f"invalid tenant_id {tenant_id!r}: must be 16 lowercase hex chars")
+    return tenant_id
 
 
 class Codec(IntEnum):
@@ -129,6 +152,11 @@ class Chunk:
     # header's TRACED flag covers only the socket hop (docs/observability.md)
     traced: Optional[bool] = False
 
+    # owning tenant (16 hex chars, minted at the API layer); rides the wire
+    # header so every gateway on the path attributes this chunk's resource
+    # use to the right tenant (docs/multitenancy.md). None = default tenant.
+    tenant_id: Optional[str] = None
+
     def to_wire_header(
         self,
         n_chunks_left_on_socket: int,
@@ -154,6 +182,7 @@ class Chunk:
             flags=flags,
             fingerprint=self.fingerprint or "0" * 32,
             n_chunks_left_on_socket=n_chunks_left_on_socket,
+            tenant_id=self.tenant_id or DEFAULT_TENANT_ID,
         )
 
     def as_dict(self) -> dict:
@@ -197,8 +226,10 @@ def _crc64(data: bytes) -> int:
 class WireProtocolHeader:
     """Framed header preceding each chunk payload on a data socket.
 
-    Reference parity: skyplane/chunk.py:96-167 (v3, 53 bytes). v4 adds codec,
-    flags, fingerprint and a header CRC; see module docstring for layout.
+    Reference parity: skyplane/chunk.py:96-167 (v3, 53 bytes). v4 added codec,
+    flags, fingerprint and a header CRC; v5 adds the 64-bit tenant tag so
+    multi-tenant gateways attribute every frame (docs/multitenancy.md). See
+    the module docstring for the layout.
     """
 
     chunk_id: str  # 128-bit uuid4 hex
@@ -208,6 +239,7 @@ class WireProtocolHeader:
     flags: int = 0
     fingerprint: str = "0" * 32  # 128-bit hex
     n_chunks_left_on_socket: int = 0
+    tenant_id: str = DEFAULT_TENANT_ID  # 64-bit hex tenant tag (v5)
 
     @staticmethod
     def magic_hex() -> int:
@@ -253,6 +285,10 @@ class WireProtocolHeader:
         if len(fp) != 16:
             raise SkyplaneTpuException(f"fingerprint must be 16 bytes hex, got {self.fingerprint!r}")
         out += fp
+        tenant = bytes.fromhex(self.tenant_id)
+        if len(tenant) != 8:
+            raise SkyplaneTpuException(f"tenant_id must be 8 bytes hex, got {self.tenant_id!r}")
+        out += tenant
         out += self.n_chunks_left_on_socket.to_bytes(8, "big")
         out += _crc64(out).to_bytes(8, "big")
         assert len(out) == HEADER_LENGTH_BYTES
@@ -268,8 +304,8 @@ class WireProtocolHeader:
         version = int.from_bytes(data[8:12], "big")
         if version != WIRE_VERSION:
             raise SkyplaneTpuException(f"unsupported wire version {version}, expected {WIRE_VERSION}")
-        crc = int.from_bytes(data[70:78], "big")
-        if crc != _crc64(data[:70]):
+        crc = int.from_bytes(data[78:86], "big")
+        if crc != _crc64(data[:78]):
             raise SkyplaneTpuException("wire header CRC mismatch")
         data_len = int.from_bytes(data[28:36], "big")
         raw_data_len = int.from_bytes(data[36:44], "big")
@@ -284,7 +320,8 @@ class WireProtocolHeader:
             codec=data[44],
             flags=data[45],
             fingerprint=data[46:62].hex(),
-            n_chunks_left_on_socket=int.from_bytes(data[62:70], "big"),
+            tenant_id=data[62:70].hex(),
+            n_chunks_left_on_socket=int.from_bytes(data[70:78], "big"),
         )
 
     @staticmethod

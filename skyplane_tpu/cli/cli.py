@@ -32,13 +32,14 @@ def main():
 @click.option("--dedup/--no-dedup", default=None, help="content-defined dedup on the TPU path")
 @click.option("--resume", is_flag=True, help="journal chunk progress; re-run continues where a killed transfer stopped")
 @click.option("--debug", is_flag=True, help="collect gateway logs on exit")
-def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, resume, debug):
+@click.option("--tenant", default=None, help="tenant id (16 hex chars) for multi-tenant gateways; minted when omitted")
+def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, resume, debug, tenant):
     """Copy objects between clouds: skyplane-tpu cp s3://a/ gs://b/ [-r]."""
     from skyplane_tpu.cli.cli_transfer import run_transfer
 
     sys.exit(run_transfer(src, list(dst), recursive=recursive, sync=False, yes=yes,
                           max_instances=max_instances, solver=solver, compress=compress, dedup=dedup,
-                          resume=resume, debug=debug))
+                          resume=resume, debug=debug, tenant=tenant))
 
 
 @main.command()
@@ -50,12 +51,14 @@ def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, resume,
 @click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz", "lz4"]))
 @click.option("--dedup/--no-dedup", default=None)
 @click.option("--debug", is_flag=True)
-def sync(src, dst, yes, max_instances, solver, compress, dedup, debug):
+@click.option("--tenant", default=None, help="tenant id (16 hex chars) for multi-tenant gateways; minted when omitted")
+def sync(src, dst, yes, max_instances, solver, compress, dedup, debug, tenant):
     """Delta-copy only new or changed objects (always recursive)."""
     from skyplane_tpu.cli.cli_transfer import run_transfer
 
     sys.exit(run_transfer(src, list(dst), recursive=True, sync=True, yes=yes,
-                          max_instances=max_instances, solver=solver, compress=compress, dedup=dedup, debug=debug))
+                          max_instances=max_instances, solver=solver, compress=compress, dedup=dedup, debug=debug,
+                          tenant=tenant))
 
 
 @main.command()
@@ -167,6 +170,34 @@ def trace_export(url, output, token):
         )
     else:
         click.echo(f"wrote {len(events)} events to {output}; open it in https://ui.perfetto.dev")
+
+
+@main.command()
+@click.option("--url", required=True, help="gateway control URL, e.g. https://10.0.0.5:8081")
+@click.option("--token", default=None, help="gateway API bearer token (defaults to none)")
+def tenants(url, token):
+    """Show a gateway's tenant/job registry: admissions, per-tenant chunk and
+    byte accounting, fair-share scheduler usage (docs/multitenancy.md)."""
+    from skyplane_tpu.gateway.control_auth import control_session
+
+    resp = control_session(token).get(f"{url.rstrip('/')}/api/v1/tenants", timeout=30)
+    resp.raise_for_status()
+    snap = resp.json()
+    tenant_map = snap.get("tenants", {})
+    if not tenant_map:
+        click.echo("no tenants registered on this gateway")
+        return
+    click.echo(f"{len(snap.get('jobs', {}))} active jobs, {len(tenant_map)} tenants "
+               f"(caps: {snap.get('max_jobs_per_tenant')}/tenant, {snap.get('max_jobs_total')} total)")
+    for tenant_id in sorted(tenant_map):
+        s = tenant_map[tenant_id]
+        click.echo(
+            f"  {tenant_id}: jobs {s['active_jobs']} active / {s['jobs_admitted']} admitted "
+            f"/ {s['jobs_rejected']} rejected · {s['chunks_registered']} chunks "
+            f"({s['bytes_registered'] / 1e6:.1f} MB) registered · "
+            f"{s['bytes_delivered'] / 1e6:.1f} MB delivered · "
+            f"{s['decode_raw_bytes'] / 1e6:.1f} MB decoded · {s['nacks']} nacks"
+        )
 
 
 @main.command()

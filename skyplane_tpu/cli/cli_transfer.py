@@ -60,6 +60,7 @@ def run_transfer(
     dedup: Optional[bool],
     resume: bool = False,
     debug: bool = False,
+    tenant: Optional[str] = None,
 ) -> int:
     try:
         src_provider, src_bucket, _ = parse_path(src)
@@ -92,7 +93,19 @@ def run_transfer(
             console.print(f"[dim]delegating to native tool: {' '.join(cmd)}[/dim]")
             return subprocess.run(cmd).returncode
 
-    pipeline = Pipeline(planning_algorithm=solver, max_instances=max_instances, transfer_config=transfer_config)
+    # tenant identity for multi-tenant gateways (docs/multitenancy.md):
+    # explicit --tenant, or minted fresh per invocation
+    from skyplane_tpu.tenancy import mint_tenant_id, validate_tenant_id
+
+    try:
+        tenant_id = validate_tenant_id(tenant) if tenant else mint_tenant_id()
+    except SkyplaneTpuException as e:
+        console.print(e.pretty_print_str())
+        return 1
+
+    pipeline = Pipeline(
+        planning_algorithm=solver, max_instances=max_instances, transfer_config=transfer_config, tenant_id=tenant_id
+    )
     for dst in dsts:
         if sync:
             pipeline.queue_sync(src, dst)

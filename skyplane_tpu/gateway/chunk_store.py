@@ -28,6 +28,7 @@ class ChunkStore:
             stale.unlink()
         # per-partition inbound queues (reference: chunk_store.py:44-49)
         self.chunk_requests: Dict[str, GatewayQueue] = {}
+        # sklint: disable=unbounded-queue-in-gateway -- sole consumer is the daemon main loop draining unconditionally at 20 Hz; a bound would DROP completion records and wedge terminal accounting
         self.chunk_status_queue: "queue.Queue[dict]" = queue.Queue()
         self._lock = threading.Lock()
 

@@ -75,6 +75,7 @@ class GatewayDaemon:
         self.cdc_params = cdc_params or CDCParams()
         self.chunk_store = ChunkStore(chunk_dir)
         self.error_event = threading.Event()
+        # sklint: disable=unbounded-queue-in-gateway -- the first error sets error_event which stops every producer; depth is bounded by the operator/thread count
         self.error_queue: "queue.Queue[str]" = queue.Queue()
         self.e2ee_key = e2ee_key
         self.use_tls = use_tls
@@ -114,6 +115,37 @@ class GatewayDaemon:
             )
         raw_forward = relay_receives > 0
 
+        # ---- multi-tenant control layer (skyplane_tpu/tenancy) ----
+        # One gateway serves many concurrent jobs: a fair-share scheduler
+        # arbitrates the scarce sender resources, a tenant/job registry does
+        # admission + accounting, and (with dedup) a persistent cross-job
+        # fingerprint index per target makes repeated corpora warm across
+        # jobs and daemon restarts (docs/multitenancy.md).
+        from skyplane_tpu.tenancy import RES_CHUNK_SLOTS, RES_WIRE_BYTES, FairShareScheduler, TenantRegistry
+
+        def _env_int(var: str, default: int, minimum: int = 1) -> int:
+            try:
+                return max(minimum, int(os.environ.get(var, str(default))))
+            except ValueError:
+                logger.fs.warning(f"ignoring malformed {var}; using {default}")
+                return default
+
+        self.scheduler = FairShareScheduler()
+        self.scheduler.configure_resource(RES_WIRE_BYTES, _env_int("SKYPLANE_TPU_TENANT_WIRE_MB", 512) << 20)
+        self.scheduler.configure_resource(RES_CHUNK_SLOTS, _env_int("SKYPLANE_TPU_TENANT_CHUNK_SLOTS", 64))
+        self.tenants = TenantRegistry(
+            scheduler=self.scheduler,
+            max_jobs_total=_env_int("SKYPLANE_TPU_MAX_JOBS", 1024),
+            max_jobs_per_tenant=_env_int("SKYPLANE_TPU_MAX_JOBS_PER_TENANT", 64),
+        )
+        # strict mode: chunks from tenants with no admitted job are rejected
+        # (off by default — the loopback harness and legacy clients dispatch
+        # chunks without a job registration)
+        self.require_admission = os.environ.get("SKYPLANE_TPU_REQUIRE_ADMISSION", "0").strip() == "1"
+        self.persist_dedup = os.environ.get("SKYPLANE_TPU_PERSIST_DEDUP", "1").strip().lower() not in ("0", "false", "off")
+        self._tenant_index_quota = _env_int("SKYPLANE_TPU_TENANT_INDEX_QUOTA_MB", 0, minimum=0) << 20
+        self._dedup_indexes: Dict[str, object] = {}  # target gateway id -> PersistentDedupIndex
+
         # one device batch runner per daemon, shared by every sender worker on
         # accelerator gateways (micro-batches CDC+fingerprint device calls).
         # Built BEFORE the receiver so paranoid recipe verification in the
@@ -152,6 +184,7 @@ class GatewayDaemon:
             raw_forward=raw_forward,
             cdc_params=self.cdc_params,
             batch_runner=self.batch_runner,
+            tenant_registry=self.tenants,
         )
 
         self.upload_id_map: Dict[str, str] = {}
@@ -187,6 +220,17 @@ class GatewayDaemon:
         self.metrics.register_provider("sender_wire", self._sender_wire_counters)
         self.metrics.register_provider("trace", lambda: get_tracer().counters())
         self.metrics.gauge("gateway_operators", help_="operators running in this daemon", fn=lambda: len(self.operators))
+        # per-tenant families (docs/multitenancy.md) + the two soak-leak
+        # gauges the eviction integration test asserts flat
+        self.metrics.register_labeled_provider("tenant", self._tenant_counters)
+        self.metrics.gauge(
+            "index_rss_bytes",
+            help_="resident bytes across dedup indexes and the segment-store memory tier",
+            fn=self._index_rss_bytes,
+        )
+        from skyplane_tpu.obs.metrics import open_fd_count
+
+        self.metrics.gauge("process_open_fds", help_="open file descriptors of the daemon process", fn=open_fd_count)
         self.api = GatewayDaemonAPI(
             chunk_store=self.chunk_store,
             receiver=self.receiver,
@@ -204,15 +248,19 @@ class GatewayDaemon:
             trace_fn=lambda: get_tracer().export(),
             api_token=self.api_token,
             ssl_ctx=ssl_ctx,
+            tenant_registry=self.tenants,
+            tenant_policy_fn=self.apply_tenant_policy,
+            require_admission=self.require_admission,
         )
         self.api.upload_id_map_update = self._update_upload_ids
 
     # ---- construction ----
 
-    @staticmethod
-    def _make_segment_store(chunk_dir: str) -> SegmentStore:
+    def _make_segment_store(self, chunk_dir: str) -> SegmentStore:
         """Receiver segment store, sized by env for small-RAM gateways and
-        eviction-pressure tests (defaults: 4 GiB memory + 32 GiB spill)."""
+        eviction-pressure tests (defaults: 4 GiB memory + 32 GiB spill).
+        With persistent dedup on, prior runs' spilled segments are adopted so
+        sender indexes recovered from their journals actually resolve."""
 
         def _mb(var: str, default_mb: int) -> int:
             try:
@@ -228,7 +276,74 @@ class GatewayDaemon:
             max_bytes=_mb("SKYPLANE_TPU_SEGSTORE_MB", 4 << 10),
             spill_dir=Path(chunk_dir) / "segments",
             spill_max_bytes=_mb("SKYPLANE_TPU_SEGSTORE_SPILL_MB", 32 << 10),
+            persistent_spill=self.persist_dedup,
         )
+
+    def _dedup_index_for(self, target_gateway_id: str):
+        """Shared persistent fingerprint index for one destination gateway:
+        every sender operator targeting it (across all jobs/partitions) uses
+        the SAME index, journaled under <chunk_dir>/dedup_index/<target> so
+        warm fingerprints survive daemon restarts. None when persistence is
+        off (the operator builds its own ephemeral SenderDedupIndex)."""
+        if not self.persist_dedup:
+            return None
+        idx = self._dedup_indexes.get(target_gateway_id)
+        if idx is None:
+            from skyplane_tpu.tenancy import PersistentDedupIndex
+
+            idx = PersistentDedupIndex(
+                Path(self.chunk_store.chunk_dir) / "dedup_index" / target_gateway_id,
+                default_tenant_quota_bytes=self._tenant_index_quota or None,
+            )
+            self._dedup_indexes[target_gateway_id] = idx
+            if idx.counters()["index_recovered_entries"]:
+                logger.fs.info(
+                    f"[daemon {self.gateway_id}] recovered {idx.counters()['index_recovered_entries']} "
+                    f"warm fingerprints for target {target_gateway_id}"
+                )
+        return idx
+
+    def apply_tenant_policy(self, tenant_id: str, weight: float = 1.0, quotas: Optional[Dict[str, int]] = None) -> str:
+        """Admission-time policy push: registry + scheduler weights/caps, and
+        per-tenant dedup-index byte quotas on every live persistent index."""
+        tenant_id = self.tenants.register_tenant(tenant_id, weight=weight, quotas=quotas)
+        index_quota = (quotas or {}).get("index_bytes")
+        if index_quota is not None:
+            for idx in self._dedup_indexes.values():
+                idx.set_tenant_quota(tenant_id, int(index_quota))
+        return tenant_id
+
+    def _tenant_counters(self) -> Dict[str, Dict[str, float]]:
+        """Labelled-provider food: {metric: {tenant: value}} merged from the
+        registry, the fair-share scheduler, and the persistent indexes —
+        rendered as skyplane_tenant_*{tenant="..."} on /api/v1/metrics."""
+        out = self.tenants.tenant_counters()
+        out.update(self.scheduler.tenant_counters())
+        idx_bytes: Dict[str, float] = {}
+        for idx in self._dedup_indexes.values():
+            for tenant, n in idx.counters()["tenant_index_bytes"].items():
+                idx_bytes[tenant] = idx_bytes.get(tenant, 0) + n
+        out["index_bytes"] = idx_bytes
+        return out
+
+    def _index_rss_bytes(self) -> float:
+        """Resident bytes across every dedup structure this daemon owns
+        (sender fingerprint indexes + receiver segment-store memory tier) —
+        the soak-flatness signal asserted in the eviction integration test."""
+        total = 0
+        seen = set()
+        for idx in self._dedup_indexes.values():
+            total += idx.counters()["index_bytes"]
+            seen.add(id(idx))
+        for op in self.operators:
+            idx = getattr(op, "dedup_index", None)
+            if idx is not None and id(idx) not in seen:
+                seen.add(id(idx))
+                total += getattr(idx, "_bytes", 0)  # plain int read (GIL-atomic)
+        store = self.receiver.segment_store
+        if store is not None:
+            total += store.counters()["store_mem_bytes"]
+        return float(total)
 
     def _update_upload_ids(self, body: Dict[str, str]) -> None:
         self.upload_id_map.update(body)
@@ -416,6 +531,7 @@ class GatewayDaemon:
             host = host or info.get("public_ip") or info.get("private_ip")
             if not host:
                 raise ValueError(f"no address for target gateway {target_id}")
+            dedup = op.get("dedup", False)
             return GatewaySenderOperator(
                 **common,
                 n_workers=op.get("num_connections", 16),
@@ -423,7 +539,7 @@ class GatewayDaemon:
                 target_host=host,
                 target_control_port=info.get("control_port", 8081),
                 codec_name=op.get("compress", "none") or "none",
-                dedup=op.get("dedup", False),
+                dedup=dedup,
                 cdc_params=self.cdc_params,
                 e2ee_key=self.e2ee_key if op.get("encrypt") else None,
                 use_tls=self.use_tls,
@@ -432,6 +548,9 @@ class GatewayDaemon:
                 api_token=self.api_token,
                 control_tls=self.control_tls,
                 source_gateway_id=self.gateway_id,
+                dedup_index=self._dedup_index_for(target_id) if dedup else None,
+                scheduler=self.scheduler,
+                tenant_registry=self.tenants,
             )
         raise ValueError(f"unknown operator type {op_type!r}")
 
@@ -461,6 +580,21 @@ class GatewayDaemon:
             for op in self.operators:
                 op.stop_workers(timeout=2.0)
             self.receiver.stop_all()
+            # flush persistent dedup journals so the next daemon recovers a
+            # clean (untorn) tail even after a prompt process exit
+            for idx in self._dedup_indexes.values():
+                try:
+                    idx.close()
+                except OSError as e:
+                    logger.fs.warning(f"[daemon {self.gateway_id}] dedup journal close failed: {e}")
+            # ... and spill the receiver's memory-tier segments to disk so
+            # recovered sender indexes resolve across the restart instead of
+            # NACK-storming their warm REFs
+            if self.persist_dedup and self.receiver.segment_store is not None:
+                try:
+                    self.receiver.segment_store.flush_to_spill()
+                except OSError as e:
+                    logger.fs.warning(f"[daemon {self.gateway_id}] segment spill flush failed: {e}")
             # keep the API up briefly so the client can collect errors/status
             time.sleep(0.2)
 

@@ -16,6 +16,11 @@ directly:
                                            (?include_log=1 adds the full
                                            transition log)
   POST /api/v1/upload_id_maps              dest_key -> multipart upload id
+  POST /api/v1/jobs                        admit a job {job_id, tenant_id,
+                                           weight?, quotas?} -> 200 | 429
+  DELETE /api/v1/jobs/<job_id>             release a job's admission slot
+  GET  /api/v1/tenants                     tenant/job registry snapshot +
+                                           scheduler usage (multitenancy)
   GET  /api/v1/errors                      operator tracebacks
   GET  /api/v1/profile/socket/receiver     per-recv socket profile events
   GET  /api/v1/profile/socket/sender       per-send-window events + wire counters
@@ -38,7 +43,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Set
 
-from skyplane_tpu.chunk import ChunkRequest, ChunkState
+from skyplane_tpu.chunk import ChunkRequest, ChunkState, validate_tenant_id
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.operators.gateway_receiver import GatewayReceiver
 from skyplane_tpu.utils.logger import logger
@@ -64,6 +69,9 @@ class GatewayDaemonAPI:
         trace_fn=None,
         api_token: Optional[str] = None,
         ssl_ctx=None,
+        tenant_registry=None,
+        tenant_policy_fn=None,
+        require_admission: bool = False,
     ):
         self.chunk_store = chunk_store
         self.receiver = receiver
@@ -86,6 +94,11 @@ class GatewayDaemonAPI:
         # probes predate token distribution during provisioning). None =
         # auth disabled (local in-process harness).
         self.api_token = api_token
+        # multi-tenant admission + accounting (docs/multitenancy.md); None
+        # keeps the API single-tenant (bare test constructions)
+        self.tenant_registry = tenant_registry
+        self.tenant_policy_fn = tenant_policy_fn
+        self.require_admission = require_admission
 
         self._lock = threading.Lock()
         self._dedup_sources: set = set()  # distinct source gateway ids seen on /servers
@@ -316,6 +329,13 @@ class GatewayDaemonAPI:
                 if include_log:
                     payload["chunk_status_log"] = list(self.chunk_status_log)
                 req._send(200, payload)
+        elif path == "/api/v1/tenants":
+            # tenant/job registry snapshot: active jobs, per-tenant chunk and
+            # byte accounting, scheduler token usage (docs/multitenancy.md)
+            if self.tenant_registry is None:
+                req._send(200, {"tenants": {}, "jobs": {}})
+            else:
+                req._send(200, self.tenant_registry.snapshot())
         elif path == "/api/v1/errors":
             while True:
                 try:
@@ -412,14 +432,55 @@ class GatewayDaemonAPI:
             if store is not None:
                 resp["dedup_capacity_bytes"] = store.capacity_bytes
             req._send(200, resp)
+        elif path == "/api/v1/jobs":
+            # job admission: the front door of the multi-tenant gateway.
+            # 429 (not 400) on a cap rejection so clients back off and retry.
+            from skyplane_tpu.tenancy import AdmissionError
+
+            if self.tenant_registry is None:
+                req._send(200, {"status": "ok", "note": "single-tenant api: admission is a no-op"})
+                return
+            body = req._read_json()
+            job_id = str(body.get("job_id") or "")
+            if not job_id:
+                req._send(400, {"error": "job_id is required"})
+                return
+            try:
+                if self.tenant_policy_fn is not None and (body.get("weight") is not None or body.get("quotas")):
+                    self.tenant_policy_fn(
+                        body.get("tenant_id"), float(body.get("weight") or 1.0), body.get("quotas") or {}
+                    )
+                tenant_id = self.tenant_registry.admit_job(
+                    body.get("tenant_id"), job_id, weight=body.get("weight"), quotas=body.get("quotas")
+                )
+            except AdmissionError as e:
+                req._send(429, {"error": str(e)})
+                return
+            req._send(200, {"status": "ok", "job_id": job_id, "tenant_id": tenant_id})
         elif path == "/api/v1/chunk_requests":
             body = req._read_json()
             if not isinstance(body, list):
                 req._send(400, {"error": "expected a json list of chunk requests"})
                 return
-            n = 0
+            # two-pass: parse and admission-check EVERY entry before anything
+            # enqueues — a rejection mid-list must not leave a silently
+            # dispatched (and unaccounted) prefix running through the data
+            # plane while the client is told the batch was refused
+            parsed = []
             for d in body:
                 cr = ChunkRequest.from_dict(d)
+                tenant_id = validate_tenant_id(cr.chunk.tenant_id)
+                if (
+                    self.require_admission
+                    and self.tenant_registry is not None
+                    and not self.tenant_registry.has_active_job(tenant_id)
+                ):
+                    req._send(403, {"error": f"tenant {tenant_id} has no admitted job (POST /api/v1/jobs first)"})
+                    return
+                parsed.append((d, cr, tenant_id))
+            n = 0
+            tenant_acct: Dict[str, List[int]] = {}  # tenant -> [chunks, bytes]
+            for d, cr, tenant_id in parsed:
                 # claim the id and enqueue under one lock so a concurrent
                 # duplicate POST can neither double-enqueue (TOCTOU) nor
                 # see a recorded-but-never-queued chunk; roll the claim back
@@ -430,7 +491,13 @@ class GatewayDaemonAPI:
                     self.chunk_store.add_chunk_request(cr, ChunkState.registered)
                     # recorded only after a successful enqueue, atomically with it
                     self.chunk_requests[cr.chunk.chunk_id] = d
+                acct = tenant_acct.setdefault(tenant_id, [0, 0])
+                acct[0] += 1
+                acct[1] += cr.chunk.chunk_length_bytes
                 n += 1
+            if self.tenant_registry is not None:
+                for tenant_id, (n_chunks, n_bytes) in tenant_acct.items():
+                    self.tenant_registry.note_chunks_registered(tenant_id, n_chunks, n_bytes)
             req._send(200, {"status": "ok", "registered": n})
         elif path == "/api/v1/upload_id_maps":
             body = req._read_json()
@@ -445,6 +512,9 @@ class GatewayDaemonAPI:
         if len(parts) == 5 and parts[:4] == ["", "api", "v1", "servers"]:
             ok = self.receiver.stop_server(int(parts[4]))
             req._send(200 if ok else 404, {"status": "ok" if ok else "unknown port"})
+        elif len(parts) == 5 and parts[:4] == ["", "api", "v1", "jobs"]:
+            ok = self.tenant_registry is not None and self.tenant_registry.finish_job(parts[4])
+            req._send(200 if ok else 404, {"status": "ok" if ok else "unknown job"})
         else:
             req._send(404, {"error": f"no route {req.path}"})
 

@@ -28,7 +28,7 @@ import requests
 
 import json
 
-from skyplane_tpu.chunk import ChunkFlags, ChunkRequest, ChunkState, WireProtocolHeader
+from skyplane_tpu.chunk import DEFAULT_TENANT_ID, ChunkFlags, ChunkRequest, ChunkState, WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, put_drop_oldest
 from skyplane_tpu.obs import NOOP_SPAN, get_registry, get_tracer
@@ -460,14 +460,19 @@ class _SenderEngineOps(EngineCallbacks):
 
     def on_delivered(self, frame) -> None:
         op = self.op
+        tenant = frame.req.chunk.tenant_id or DEFAULT_TENANT_ID
         if op.dedup_index is not None:
             # the ack means the chunk (and its dedup literals) is durably
-            # landed, so these commits are truthful (commit-after-delivery)
+            # landed, so these commits are truthful (commit-after-delivery);
+            # the tenant tag attributes the index bytes on persistent indexes
             for fp, size in frame.new_fps:
-                op.dedup_index.add(fp, size)
+                op.dedup_index.add(fp, size, tenant=tenant)
         op.chunk_store.log_chunk_state(frame.req, ChunkState.complete, op.handle, self.worker_id)
         if op.output_queue is not None:
             op.output_queue.put(frame.req)
+        if op.tenant_registry is not None:
+            op.tenant_registry.note_delivered(tenant, frame.req.chunk.chunk_length_bytes)
+        op.sched_release(frame.req)
         if frame.window is not None:
             frame.window.note(acked=True)
 
@@ -486,12 +491,16 @@ class _SenderEngineOps(EngineCallbacks):
 
     def on_requeue(self, frame) -> None:
         # transient (socket death / NACK retry): back to THIS handle's queue,
-        # state stays in_progress — the serial path's silent-requeue contract
+        # state stays in_progress — the serial path's silent-requeue contract.
+        # Scheduler tokens release NOW; the retry pass re-acquires them (a
+        # NACK-storming tenant burns its own tokens on every round trip).
+        self.op.sched_release(frame.req)
         self.op.input_queue.put_for_handle(self.op.handle, frame.req)
         if frame.window is not None:
             frame.window.note(acked=False)
 
     def on_failed(self, frame) -> None:
+        self.op.sched_release(frame.req)
         self.op.chunk_store.log_chunk_state(frame.req, ChunkState.failed, self.op.handle, self.worker_id)
         if frame.window is not None:
             frame.window.note(acked=False)
@@ -545,6 +554,9 @@ class GatewaySenderOperator(GatewayOperator):
         pipelined: Optional[bool] = None,
         max_streams: Optional[int] = None,
         frame_ahead: Optional[int] = None,
+        dedup_index: Optional[SenderDedupIndex] = None,
+        scheduler=None,
+        tenant_registry=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -557,7 +569,14 @@ class GatewaySenderOperator(GatewayOperator):
         self.processor = DataPathProcessor(
             codec_name=effective_codec_name(codec_name), dedup=dedup, cdc_params=cdc_params, batch_runner=batch_runner
         )
-        self.dedup_index = SenderDedupIndex() if dedup else None
+        # a daemon-shared (persistent, cross-job) index when injected; an
+        # ephemeral per-operator one otherwise (docs/multitenancy.md)
+        self.dedup_index = dedup_index if dedup_index is not None else (SenderDedupIndex() if dedup else None)
+        # fair-share gate (tenancy/scheduler.py): chunks acquire per-tenant
+        # wire-byte and chunk-slot tokens before framing, released as their
+        # frames resolve — None disables gating (single-tenant/bare tests)
+        self.scheduler = scheduler
+        self.tenant_registry = tenant_registry
         self.source_gateway_id = source_gateway_id
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
         self.window = max(1, int(window))
@@ -686,6 +705,33 @@ class GatewaySenderOperator(GatewayOperator):
                 self._engines.append(engine)
         return engine
 
+    def sched_acquire(self, req: ChunkRequest) -> bool:
+        """Block until this chunk's fair-share tokens are granted (wire bytes
+        sized by the chunk, one chunk slot covering its share of batch-runner
+        occupancy). False = daemon shutting down; caller re-queues."""
+        if self.scheduler is None:
+            return True
+        from skyplane_tpu.tenancy import RES_CHUNK_SLOTS, RES_WIRE_BYTES
+
+        tenant = req.chunk.tenant_id or DEFAULT_TENANT_ID
+        abort = lambda: self.exit_flag.is_set() or self.error_event.is_set()  # noqa: E731
+        if not self.scheduler.acquire(tenant, RES_CHUNK_SLOTS, 1, abort_check=abort):
+            return False
+        if not self.scheduler.acquire(tenant, RES_WIRE_BYTES, req.chunk.chunk_length_bytes, abort_check=abort):
+            self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1)
+            return False
+        return True
+
+    def sched_release(self, req: ChunkRequest) -> None:
+        """Return one chunk's tokens (its frame resolved: ack/requeue/fail)."""
+        if self.scheduler is None:
+            return
+        from skyplane_tpu.tenancy import RES_CHUNK_SLOTS, RES_WIRE_BYTES
+
+        tenant = req.chunk.tenant_id or DEFAULT_TENANT_ID
+        self.scheduler.release(tenant, RES_WIRE_BYTES, req.chunk.chunk_length_bytes)
+        self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1)
+
     def note_window_event(self, event: dict, seconds: float) -> None:
         """Emit one per-window profile event (bounded queue, counted drops)
         and feed the unified-registry window-latency histogram."""
@@ -745,6 +791,7 @@ class GatewaySenderOperator(GatewayOperator):
                 flags=meta["flags"],
                 fingerprint=meta["fingerprint"],
                 n_chunks_left_on_socket=n_left,
+                tenant_id=meta.get("tenant", DEFAULT_TENANT_ID),
             )
         data = fpath.read_bytes()
         payload = self.processor.process(data, view if view is not None else self.dedup_index)
@@ -803,6 +850,15 @@ class GatewaySenderOperator(GatewayOperator):
         engine.note_window()
         window = _WindowStats(self, worker_id, len(batch))
         for req in batch:
+            # fair-share gate BEFORE framing: a tenant over its share parks
+            # HERE (its tokens return as its own acks land), so its backlog
+            # never occupies frame-ahead buffers or batch-runner windows that
+            # other tenants' chunks could be using
+            if not self.sched_acquire(req):
+                # shutdown: silent-requeue contract, tokens never granted
+                self.input_queue.put_for_handle(self.handle, req)
+                window.note(acked=False)
+                continue
             # wire bytes counted on the frame the engine actually enqueued
             # (a saturation-striped chunk is re-framed; counting inside the
             # frame builder would double it)
@@ -847,6 +903,7 @@ class GatewaySenderOperator(GatewayOperator):
         view = _WindowFpView(self.dedup_index) if self.dedup_index is not None else None
         results = [False] * len(batch)
         sent = []  # (req, payload) for acked-frame bookkeeping only
+        acquired: List[ChunkRequest] = []  # fair-share tokens held this window
         window_wire = 0
         t_window = time.perf_counter()
         try:
@@ -856,6 +913,9 @@ class GatewaySenderOperator(GatewayOperator):
             # time (plus ack bookkeeping), not the whole window
             tracer = get_tracer()
             for i, req in enumerate(batch):
+                if not self.sched_acquire(req):
+                    break  # shutdown mid-window: un-sent chunks re-queue below
+                acquired.append(req)
                 traced = tracer.enabled and tracer.sampled(req.chunk.chunk_id)
                 span = (
                     tracer.span("wire.frame", trace_id=req.chunk.chunk_id, cat="sender", force=True)
@@ -892,7 +952,11 @@ class GatewaySenderOperator(GatewayOperator):
                 if ack == ACK_BYTE:
                     if self.dedup_index is not None and payload is not None:
                         for fp, size in payload.new_fingerprints:
-                            self.dedup_index.add(fp, size)
+                            self.dedup_index.add(fp, size, tenant=req.chunk.tenant_id or DEFAULT_TENANT_ID)
+                    if self.tenant_registry is not None:
+                        self.tenant_registry.note_delivered(
+                            req.chunk.tenant_id or DEFAULT_TENANT_ID, req.chunk.chunk_length_bytes
+                        )
                     results[i] = True
                 elif ack == NACK_UNRESOLVED:
                     if self.dedup_index is not None and payload is not None:
@@ -927,6 +991,12 @@ class GatewaySenderOperator(GatewayOperator):
             logger.fs.warning(f"[{self.handle}:{worker_id}] socket error mid-window: {e}")
             self._reset_sock()
             time.sleep(0.2)
+        finally:
+            # every frame in this window resolved (acked, failed, or about to
+            # be re-queued by the caller): the fair-share tokens come back —
+            # including on the BatchPartialFailure escalation path
+            for req in acquired:
+                self.sched_release(req)
         seconds = time.perf_counter() - t_window
         event = {
             "handle": self.handle,

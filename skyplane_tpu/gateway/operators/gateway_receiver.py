@@ -81,6 +81,7 @@ DECODE_COUNTER_ZERO = {
     "store_spill_evictions": 0,
     "store_mem_bytes": 0,
     "store_spill_bytes": 0,
+    "store_spill_adopted": 0,
     "pool_hits": 0,
     "pool_misses": 0,
     "pool_hit_rate": 0.0,
@@ -159,6 +160,7 @@ class _ConnState:
         self.port = port
         self.lock = threading.Lock()
         self.drained = threading.Condition(self.lock)
+        # sklint: disable=unbounded-queue-in-gateway -- depth is capped by the sender's byte-bounded in-flight window plus the bounded decode work queue's backpressure on the framing loop
         self.pending: "deque[_DecodeTask]" = deque()
         self.dead = False
         # wake channel (real sockets only): a completed decode nudges the
@@ -218,12 +220,17 @@ class GatewayReceiver:
         ref_wait_timeout: float = 10.0,
         batch_runner=None,
         decode_workers: Optional[int] = None,
+        tenant_registry=None,
     ):
         self.region = region
         self.chunk_store = chunk_store
         self.error_event = error_event
         self.error_queue = error_queue
         self.recv_block_size = recv_block_size
+        # multi-tenant accounting: decode bytes and NACKs are attributed to
+        # the v5 wire header's tenant tag (docs/multitenancy.md); None keeps
+        # the receiver single-tenant (bare test constructions)
+        self.tenant_registry = tenant_registry
         self.use_tls = use_tls
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
         self.segment_store = segment_store if segment_store is not None else (SegmentStore() if dedup else None)
@@ -544,6 +551,7 @@ class GatewayReceiver:
                                 "flags": header.flags,
                                 "fingerprint": header.fingerprint,
                                 "raw_data_len": header.raw_data_len,
+                                "tenant": header.tenant_id,
                             }
                         ).encode(),
                     )
@@ -579,6 +587,8 @@ class GatewayReceiver:
                     # drop the connection — that would just replay the
                     # same unresolvable recipe forever.
                     task.outcome, task.detail = "nack", str(e)
+                    if self.tenant_registry is not None:
+                        self.tenant_registry.note_nack(header.tenant_id)
                     logger.fs.warning(f"[receiver:{state.port}] nacking chunk {header.chunk_id}: {e}")
                     return
                 if isinstance(data, PooledChunk):
@@ -599,6 +609,8 @@ class GatewayReceiver:
             task.outcome = "ack"
             task.raw_len = header.raw_data_len
             task.decode_ns = time.perf_counter_ns() - t0
+            if self.tenant_registry is not None:
+                self.tenant_registry.note_decoded(header.tenant_id, header.raw_data_len)
             with self._stats_lock:
                 self._decode_stats["decode_chunks"] += 1
                 self._decode_stats["decode_raw_bytes"] += header.raw_data_len

@@ -144,8 +144,10 @@ class _Stream:
         self.idx = idx
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
+        # sklint: disable=unbounded-queue-in-gateway -- submit() blocks at frame_ahead entries; the count bound lives in the producer, not the deque
         self.frames: "deque[WireFrame]" = deque()  # framed, not yet sent
         self.frames_bytes = 0
+        # sklint: disable=unbounded-queue-in-gateway -- capped by the engine's inflight_limit byte window (sends gate on inflight_bytes, not entry count)
         self.inflight: "deque[WireFrame]" = deque()  # sent, not yet acked
         self.inflight_bytes = 0
         self.pending_fps: set = set()  # framed-on-this-stream, not yet committed/discarded
@@ -221,6 +223,7 @@ class SenderWireEngine:
         self.name = name
         self._streams: List[_Stream] = []
         self._streams_lock = threading.Lock()
+        # sklint: disable=unbounded-queue-in-gateway -- every entry is an in-flight frame, already capped by the per-stream inflight_limit byte windows
         self._completion_q: "deque" = deque()  # (stream, frame, resp byte) in ack order
         self._completion_cond = threading.Condition()
         self._counters = dict(SENDER_WIRE_COUNTER_ZERO)

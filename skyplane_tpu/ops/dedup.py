@@ -112,7 +112,10 @@ class SenderDedupIndex:
             s.lru.move_to_end(fp)
             return True
 
-    def add(self, fp: bytes, size: int = 0) -> None:
+    def add(self, fp: bytes, size: int = 0, tenant: Optional[str] = None) -> None:
+        """Insert/touch a fingerprint. ``tenant`` is accepted (and ignored)
+        here so call sites can attribute unconditionally; the persistent
+        cross-job index subclass uses it for per-tenant byte accounting."""
         s = self._stripe(fp)
         with s.lock:
             entry = s.lru.get(fp)
@@ -169,10 +172,16 @@ class SenderDedupIndex:
             with victim.lock:
                 if not victim.lru:
                     continue  # raced with a discard; rescan
-                _, (size, _) = victim.lru.popitem(last=False)
+                vfp, (size, _) = victim.lru.popitem(last=False)
                 victim.bytes -= size
             with self._budget_lock:
                 self._bytes -= size
+            self._note_evicted(vfp, size)
+
+    def _note_evicted(self, fp: bytes, size: int) -> None:
+        """Capacity-eviction hook (no locks held): the persistent cross-job
+        index (tenancy/persistent_index.py) overrides this to keep per-tenant
+        byte attribution coherent with the in-memory map."""
 
     @property
     def max_bytes(self) -> int:
@@ -225,6 +234,7 @@ class SegmentStore:
         spill_dir: Optional[Path] = None,
         spill_max_bytes: int = 32 << 30,
         stripes: int = 16,
+        persistent_spill: bool = False,
     ):
         n = 1
         while n < max(1, int(stripes)):
@@ -243,13 +253,35 @@ class SegmentStore:
         # segments popped from memory whose spill write is still in flight:
         # membership here keeps them resolvable during the off-lock disk write
         self._in_transit: Dict[bytes, bytes] = {}
+        self._adopted_spill_count = 0
         if self._spill_dir:
             self._spill_dir.mkdir(parents=True, exist_ok=True)
-            # spill is per-run state: stale files from a previous daemon would
-            # never be REF'd (fresh sender index) but would eat disk forever
-            # (*.seg* also sweeps orphaned .tmp files from a crashed writer)
-            for stale in self._spill_dir.glob("*.seg*"):
-                stale.unlink()
+            if persistent_spill:
+                # cross-restart dedup (tenancy persistent index): adopt prior
+                # runs' spilled segments — content-addressed files landed via
+                # tmp+os.replace, so anything named *.seg is complete and
+                # correct. Only orphaned .tmp files from a crashed writer are
+                # swept. Senders recovering their persistent fingerprint
+                # index REF these across a daemon restart.
+                for stale in self._spill_dir.glob("*.seg.tmp*"):
+                    stale.unlink()
+                for seg in sorted(self._spill_dir.glob("*.seg")):
+                    try:
+                        fp = bytes.fromhex(seg.stem)
+                        if len(fp) != 16:
+                            raise ValueError(seg.stem)
+                    except ValueError:
+                        seg.unlink()  # not a content-addressed segment file
+                        continue
+                    self._spill_order[fp] = seg.stat().st_size
+                    self._spill_bytes += self._spill_order[fp]
+                    self._adopted_spill_count += 1
+            else:
+                # spill is per-run state: stale files from a previous daemon
+                # would never be REF'd (fresh sender index) but would eat disk
+                # forever (*.seg* also sweeps orphaned .tmp files)
+                for stale in self._spill_dir.glob("*.seg*"):
+                    stale.unlink()
         self._tls = threading.local()  # per-thread held-lock depth (disk-read audit)
         # monitoring counters: plain ints bumped under the GIL — monotonic and
         # exact once traffic quiesces, which is all /profile needs
@@ -525,6 +557,22 @@ class SegmentStore:
         with self._hold(self._spill_lock):
             return fp in self._in_transit or fp in self._spill_order
 
+    def flush_to_spill(self) -> None:
+        """Evict the whole memory tier to the spill directory (graceful
+        shutdown with persistent dedup: the next daemon adopts the spilled
+        segments, so sender indexes recovered from their journals resolve
+        instead of NACK-storming). No-op without a spill dir."""
+        if self._spill_dir is None:
+            return
+        with self._hold(self._budget_lock):
+            old = self._max_bytes
+            self._max_bytes = 1
+        try:
+            self._evict_to_budget()
+        finally:
+            with self._hold(self._budget_lock):
+                self._max_bytes = old
+
     def set_bounds(self, max_bytes: Optional[int] = None, spill_max_bytes: Optional[int] = None) -> None:
         """Rebound the store (capacity-starvation tests, adaptive sizing).
         Shrinking the memory bound evicts immediately; the spill bound is
@@ -568,6 +616,7 @@ class SegmentStore:
             "store_spill_evictions": self._c_spill_evictions,
             "store_mem_bytes": mem_bytes,
             "store_spill_bytes": spill_bytes,
+            "store_spill_adopted": self._adopted_spill_count,
         }
 
 

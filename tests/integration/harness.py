@@ -157,7 +157,13 @@ def make_pair(
     return src, dst
 
 
-def dispatch_file(src: LocalGateway, src_path: Path, dst_path: Path, chunk_bytes: int = 4 << 20) -> List[str]:
+def dispatch_file(
+    src: LocalGateway,
+    src_path: Path,
+    dst_path: Path,
+    chunk_bytes: int = 4 << 20,
+    tenant_id: Optional[str] = None,
+) -> List[str]:
     """Split a file into chunk requests and POST them to the source gateway."""
     size = src_path.stat().st_size
     reqs = []
@@ -170,6 +176,7 @@ def dispatch_file(src: LocalGateway, src_path: Path, dst_path: Path, chunk_bytes
             chunk_id=uuid.uuid4().hex,
             chunk_length_bytes=length,
             file_offset_bytes=offset,
+            tenant_id=tenant_id,
         )
         reqs.append(ChunkRequest(chunk=chunk, src_region="local:local", dst_region="local:local", src_type="local", dst_type="local"))
         offset += length

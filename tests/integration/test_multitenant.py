@@ -1,0 +1,178 @@
+"""Multi-tenant gateway integration: tenant-labelled metrics, job admission,
+and the persistent cross-job dedup index across daemon restarts.
+
+Runs the real loopback stack (framed TLS-capable sockets, dedup, control
+API) through tests/integration/harness. Dedup persistence uses fixed chunk
+dirs under one tmp_path so a second make_pair() is a genuine restart: sender
+indexes recover from their journals, the receiver adopts its spilled
+segments, and a repeated corpus must show measured warm-fingerprint hits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from integration.harness import dispatch_file, make_pair, wait_complete
+
+T_A = "a1" * 8
+T_B = "b2" * 8
+
+
+def _corpus(tmp_path, name: str, seed: int, n_bytes: int = 2 << 20):
+    f = tmp_path / "srcfiles" / name
+    f.parent.mkdir(exist_ok=True)
+    f.write_bytes(np.random.default_rng(seed).integers(0, 256, n_bytes, dtype=np.uint8).tobytes())
+    return f
+
+
+def test_two_tenants_are_accounted_separately(tmp_path):
+    src, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+    try:
+        f_a = _corpus(tmp_path, "a.bin", 1)
+        f_b = _corpus(tmp_path, "b.bin", 2, n_bytes=1 << 20)
+        ids_a = dispatch_file(src, f_a, tmp_path / "out" / "a.bin", chunk_bytes=1 << 20, tenant_id=T_A)
+        ids_b = dispatch_file(src, f_b, tmp_path / "out" / "b.bin", chunk_bytes=1 << 20, tenant_id=T_B)
+        wait_complete(src, ids_a + ids_b, timeout=120)
+        wait_complete(dst, ids_a + ids_b, timeout=120)
+        assert (tmp_path / "out" / "a.bin").read_bytes() == f_a.read_bytes()
+        assert (tmp_path / "out" / "b.bin").read_bytes() == f_b.read_bytes()
+
+        # per-tenant registration accounting at the source gateway
+        snap = src.get("tenants", timeout=10).json()
+        assert snap["tenants"][T_A]["chunks_registered"] == len(ids_a)
+        assert snap["tenants"][T_B]["chunks_registered"] == len(ids_b)
+        assert snap["tenants"][T_A]["bytes_delivered"] == f_a.stat().st_size
+        assert snap["tenants"][T_B]["bytes_delivered"] == f_b.stat().st_size
+
+        # the destination attributes decode bytes to the tenant tag carried
+        # in the v5 wire header
+        dsnap = dst.get("tenants", timeout=10).json()
+        assert dsnap["tenants"][T_A]["decode_raw_bytes"] == f_a.stat().st_size
+        assert dsnap["tenants"][T_B]["decode_raw_bytes"] == f_b.stat().st_size
+
+        # tenant-labelled counters served on the Prometheus endpoint
+        metrics = src.get("metrics", timeout=10).text
+        assert f'skyplane_tenant_chunks_registered{{tenant="{T_A}"}} {len(ids_a)}' in metrics
+        assert f'skyplane_tenant_chunks_registered{{tenant="{T_B}"}} {len(ids_b)}' in metrics
+        assert f'skyplane_tenant_bytes_delivered{{tenant="{T_A}"}}' in metrics
+        # the scheduler's grant accounting rode the same transfer
+        assert f'skyplane_tenant_sched_grants{{tenant="{T_A}"}}' in metrics
+        # ... and the two soak-leak gauges exist
+        assert "skyplane_index_rss_bytes" in metrics
+        assert "skyplane_process_open_fds" in metrics
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_job_admission_and_429_on_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPLANE_TPU_MAX_JOBS_PER_TENANT", "3")
+    src, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=1)
+    try:
+        for i in range(3):
+            r = src.post("jobs", json={"job_id": f"job-{i}", "tenant_id": T_A}, timeout=10)
+            assert r.status_code == 200, r.text
+        r = src.post("jobs", json={"job_id": "job-3", "tenant_id": T_A}, timeout=10)
+        assert r.status_code == 429
+        # another tenant is unaffected by A's cap
+        r = src.post("jobs", json={"job_id": "job-b", "tenant_id": T_B}, timeout=10)
+        assert r.status_code == 200
+        # releasing a slot re-opens admission
+        assert src.session().delete(src.url("jobs/job-0"), timeout=10).status_code == 200
+        r = src.post("jobs", json={"job_id": "job-3", "tenant_id": T_A}, timeout=10)
+        assert r.status_code == 200
+        snap = src.get("tenants", timeout=10).json()
+        assert snap["tenants"][T_A]["jobs_rejected"] == 1
+        assert snap["tenants"][T_A]["active_jobs"] == 3
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_persistent_index_warm_across_daemon_restart(tmp_path):
+    """Acceptance: the dedup index survives a daemon restart with measured
+    warm-fingerprint hits on a repeated corpus. Same chunk dirs -> the second
+    make_pair is a genuine restart (journal recovery + spill adoption)."""
+    base = np.random.default_rng(7).integers(0, 256, 2 << 20, dtype=np.uint8).tobytes()
+    (tmp_path / "srcfiles").mkdir()
+    f1 = tmp_path / "srcfiles" / "run1.bin"
+    f2 = tmp_path / "srcfiles" / "run2.bin"
+    f1.write_bytes(base)
+    f2.write_bytes(base)  # repeated corpus (e.g. an unchanged checkpoint)
+
+    src, dst = make_pair(tmp_path, compress="none", dedup=True, encrypt=False, use_tls=False, num_connections=2)
+    try:
+        ids = dispatch_file(src, f1, tmp_path / "out" / "run1.bin", chunk_bytes=1 << 20)
+        wait_complete(src, ids, timeout=120)
+        wait_complete(dst, ids, timeout=120)
+        idx = src.daemon._dedup_indexes["gw_dst"]
+        assert idx.counters()["index_journal_appends"] > 0, "commits were not journaled"
+    finally:
+        src.stop()  # daemon shutdown flushes the journal...
+        dst.stop()  # ...and spills the receiver's memory-tier segments
+
+    # ---- restart: same dirs, fresh daemons ----
+    src2, dst2 = make_pair(tmp_path, compress="none", dedup=True, encrypt=False, use_tls=False, num_connections=2)
+    try:
+        store = dst2.daemon.receiver.segment_store
+        assert store.counters()["store_spill_adopted"] > 0, "receiver adopted no spilled segments"
+        ids2 = dispatch_file(src2, f2, tmp_path / "out" / "run2.bin", chunk_bytes=1 << 20)
+        wait_complete(src2, ids2, timeout=180)
+        wait_complete(dst2, ids2, timeout=180)
+        assert (tmp_path / "out" / "run2.bin").read_bytes() == base
+
+        idx2 = src2.daemon._dedup_indexes["gw_dst"]
+        c = idx2.counters()
+        assert c["index_recovered_entries"] > 0, "journal recovery produced no entries"
+        assert c["index_warm_fingerprint_hits"] > 0, "repeated corpus hit no warm fingerprints"
+        # the repeated corpus actually DEDUPed across the restart: the sender
+        # emitted REF segments in run 2 against run 1's fingerprints
+        sender = next(op for op in src2.daemon.operators if getattr(op, "dedup_index", None) is not None)
+        stats = sender.processor.stats.as_dict()
+        assert stats["ref_segments"] > 0, "no REF segments: the warm index was not used"
+        # cross-restart dedup showed up as wire reduction on run 2
+        assert stats["wire_bytes"] < stats["raw_bytes"], "warm REFs produced no wire reduction"
+    finally:
+        src2.stop()
+        dst2.stop()
+
+
+def test_persistent_index_mid_write_crash_recovery_e2e(tmp_path):
+    """Acceptance: recovery from a mid-write crash leaves no torn entries.
+    The 'kill mid-journal-append' is simulated exactly as a dead process
+    leaves the file: a partial trailing record appended to the journal."""
+    base = np.random.default_rng(9).integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    (tmp_path / "srcfiles").mkdir()
+    f1 = tmp_path / "srcfiles" / "c1.bin"
+    f1.write_bytes(base)
+
+    src, dst = make_pair(tmp_path, compress="none", dedup=True, encrypt=False, use_tls=False, num_connections=1)
+    try:
+        ids = dispatch_file(src, f1, tmp_path / "out" / "c1.bin", chunk_bytes=1 << 20)
+        wait_complete(src, ids, timeout=120)
+        wait_complete(dst, ids, timeout=120)
+    finally:
+        src.stop()
+        dst.stop()
+
+    journal = tmp_path / "src_chunks" / "dedup_index" / "gw_dst" / "index.journal"
+    assert journal.exists() and journal.stat().st_size > 0
+    with open(journal, "ab") as f:
+        f.write(b"\x01torn-mid-append")  # the crash landed mid-record
+
+    src2, dst2 = make_pair(tmp_path, compress="none", dedup=True, encrypt=False, use_tls=False, num_connections=1)
+    try:
+        idx = src2.daemon._dedup_indexes["gw_dst"]
+        c = idx.counters()
+        assert c["index_torn_entries_dropped"] == 1, "the torn tail was not detected"
+        assert c["index_recovered_entries"] > 0, "complete records must survive the torn tail"
+        # the daemon is fully operational after recovery: a fresh transfer works
+        f2 = tmp_path / "srcfiles" / "c2.bin"
+        f2.write_bytes(base)
+        ids2 = dispatch_file(src2, f2, tmp_path / "out" / "c2.bin", chunk_bytes=1 << 20)
+        wait_complete(src2, ids2, timeout=120)
+        wait_complete(dst2, ids2, timeout=120)
+        assert (tmp_path / "out" / "c2.bin").read_bytes() == base
+    finally:
+        src2.stop()
+        dst2.stop()

@@ -15,7 +15,7 @@ import uuid
 
 import numpy as np
 
-from skyplane_tpu.chunk import Chunk, ChunkRequest, WireProtocolHeader
+from skyplane_tpu.chunk import HEADER_LENGTH_BYTES, Chunk, ChunkRequest, WireProtocolHeader
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.gateway_queue import GatewayQueue
 from skyplane_tpu.gateway.operators.gateway_operator import GatewaySenderOperator
@@ -74,7 +74,7 @@ class AckServer:
                 with self.lock:
                     i = len(self.frames)
                     self.frames.append((header.chunk_id, payload))
-                    self.received_bytes += 78 + header.data_len
+                    self.received_bytes += HEADER_LENGTH_BYTES + header.data_len
                 action = self.script(i, header, payload) if self.script else ACK_BYTE
                 if action == "kill":
                     return
@@ -374,7 +374,7 @@ def test_inflight_byte_bound_respected_under_stalled_receiver(tmp_path):
         op.start_workers()
         time.sleep(2.0)  # give the stream every chance to overrun the bound
         counters = op.wire_counters()
-        slack = chunk_bytes + 78 * 12
+        slack = chunk_bytes + HEADER_LENGTH_BYTES * 12
         assert server.received_bytes <= limit + slack, (
             f"stalled receiver saw {server.received_bytes}B — in-flight bound {limit}B not respected"
         )

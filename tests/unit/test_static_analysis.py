@@ -200,6 +200,63 @@ class C:
     assert "socket-io-under-lock" not in rules_of(src)
 
 
+def test_unbounded_queue_in_gateway_fires_on_unbounded_constructions():
+    """The tenant-isolation bug class the multitenancy PR must not
+    reintroduce: unbounded buffering in gateway code (docs/multitenancy.md)."""
+    src = """
+import queue
+from collections import deque
+class C:
+    def __init__(self):
+        self.q = queue.Queue()
+        self.q2 = queue.Queue(maxsize=0)
+        self.d = deque()
+        self.s = queue.SimpleQueue()
+"""
+    findings = [
+        f
+        for f in run_source(src, "skyplane_tpu/gateway/fixture.py")
+        if f.rule == "unbounded-queue-in-gateway"
+    ]
+    assert len(findings) == 4
+
+
+def test_unbounded_queue_in_gateway_quiet_when_bounded_or_outside_gateway():
+    bounded = """
+import queue
+from collections import deque
+class C:
+    def __init__(self, n):
+        self.q = queue.Queue(maxsize=4096)
+        self.q2 = queue.Queue(n)
+        self.d = deque(maxlen=8)
+        self.d2 = deque([], 16)
+"""
+    assert "unbounded-queue-in-gateway" not in rules_of(bounded, "skyplane_tpu/gateway/fixture.py")
+    # the same unbounded constructions OUTSIDE a gateway path are not flagged
+    unbounded = """
+import queue
+q = queue.Queue()
+"""
+    assert "unbounded-queue-in-gateway" not in rules_of(unbounded, "skyplane_tpu/api/fixture.py")
+
+
+def test_unbounded_queue_in_gateway_suppressible():
+    src = """
+import queue
+class C:
+    def __init__(self):
+        # sklint: disable=unbounded-queue-in-gateway -- drained unconditionally by the main loop
+        self.q = queue.Queue()
+"""
+    findings = [
+        f
+        for f in run_source(src, "skyplane_tpu/gateway/fixture.py")
+        if f.rule == "unbounded-queue-in-gateway"
+    ]
+    assert findings and all(f.suppressed for f in findings)
+
+
 def test_bare_except_in_loop_fires():
     src = """
 def serve(q):
