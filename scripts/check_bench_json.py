@@ -2,17 +2,22 @@
 """Validate a bench output file: exactly one well-formed JSON result line
 with the full perf-counter schema (docs/datapath-performance.md).
 
-Two result shapes are recognized, dispatched on the ``metric`` field:
+Three result shapes are recognized, dispatched on the ``metric`` field:
 
   * bench.py results (the default encode/decode/wire schema);
   * scripts/soak_multijob.py results (``metric: multijob_gbps``): the
     multi-tenant soak — per-tenant Gbps split, the fairness ratio gate
     (max/min <= fairness_bound for equal weights), bounded index RSS, and
-    per-tenant accounting keys (docs/multitenancy.md).
+    per-tenant accounting keys (docs/multitenancy.md);
+  * scripts/soak_chaos.py results (``metric: chaos_gbps``): the chaos soak —
+    faults actually injected across >=5 armed points, byte-for-byte corpus
+    integrity, seed-replay determinism, zero leaked scheduler tokens / pool
+    buffers, bounded fd growth, and bounded recovery time
+    (docs/fault-injection.md).
 
 Exit 0 iff the result parses and every required key is present; used by the
-bench-smoke and multijob-smoke steps in scripts/devloop.sh so a schema or
-fairness regression is caught in seconds on CPU.
+bench-smoke, multijob-smoke, and chaos-smoke steps in scripts/devloop.sh so a
+schema, fairness, or recovery regression is caught in seconds on CPU.
 """
 
 from __future__ import annotations
@@ -93,6 +98,88 @@ REQUIRED_MULTIJOB = (
 # every tenant's accounting entry must carry these keys
 REQUIRED_TENANT_KEYS = ("chunks_registered", "bytes_registered", "bytes_delivered")
 
+# chaos soak result (scripts/soak_chaos.py / docs/fault-injection.md)
+REQUIRED_CHAOS = (
+    "metric",
+    "value",
+    "unit",
+    "n_jobs",
+    "chaos_seed",
+    "chaos_plan",
+    "chaos_points_armed",
+    "chaos_points_fired",
+    "chaos_faults_injected",
+    "chaos_faults_total",
+    "chaos_integrity_ok",
+    "chaos_determinism_ok",
+    "chaos_metrics_exported",
+    "chaos_slowdown_x",
+    "chaos_slowdown_bound",
+    "chaos_bound_seconds",
+    "chaos_sched_tokens_leaked",
+    "chaos_pool_buffers_leaked",
+    "chaos_fd_growth",
+    "chaos_torn_records_dropped",
+    "baseline_seconds",
+    "chaos_seconds",
+)
+#: the acceptance floor: a chaos run proves nothing unless it injected faults
+#: across at least this many distinct points of the stack
+MIN_CHAOS_POINTS = 5
+
+
+def check_chaos(result: dict) -> int:
+    missing = [k for k in REQUIRED_CHAOS if k not in result]
+    if missing:
+        print(f"chaos-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    if result["chaos_faults_total"] <= 0 or not result["chaos_faults_injected"]:
+        print("chaos-smoke: no faults were injected — the chaos run was vacuous", file=sys.stderr)
+        return 1
+    if result["chaos_points_armed"] < MIN_CHAOS_POINTS or result["chaos_points_fired"] < MIN_CHAOS_POINTS:
+        print(
+            f"chaos-smoke: {result['chaos_points_fired']} fired / {result['chaos_points_armed']} armed "
+            f"fault points; acceptance needs >= {MIN_CHAOS_POINTS} distinct points firing",
+            file=sys.stderr,
+        )
+        return 1
+    if result["chaos_integrity_ok"] is not True:
+        print("chaos-smoke: destination corpus NOT byte-identical under faults (CORRUPTION)", file=sys.stderr)
+        return 1
+    if result["chaos_determinism_ok"] is not True:
+        print("chaos-smoke: fault firing sequence did not replay from the seed", file=sys.stderr)
+        return 1
+    if result["chaos_metrics_exported"] is not True:
+        print("chaos-smoke: faults_injected counters missing from /api/v1/metrics", file=sys.stderr)
+        return 1
+    if result["chaos_sched_tokens_leaked"] != 0:
+        print(
+            f"chaos-smoke: {result['chaos_sched_tokens_leaked']} scheduler tokens leaked through recovery",
+            file=sys.stderr,
+        )
+        return 1
+    if result["chaos_pool_buffers_leaked"] != 0:
+        print(f"chaos-smoke: {result['chaos_pool_buffers_leaked']} pool buffers leaked", file=sys.stderr)
+        return 1
+    if result["chaos_fd_growth"] > 64:
+        print(f"chaos-smoke: fd count grew by {result['chaos_fd_growth']} (descriptor leak)", file=sys.stderr)
+        return 1
+    if result["chaos_seconds"] > result["chaos_bound_seconds"]:
+        print(
+            f"chaos-smoke: recovery took {result['chaos_seconds']}s, over the bound "
+            f"{result['chaos_bound_seconds']}s ({result['chaos_slowdown_x']}x the fault-free baseline)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"chaos-smoke OK: seed {result['chaos_seed']}, {result['chaos_faults_total']} faults over "
+        f"{result['chaos_points_fired']}/{result['chaos_points_armed']} points, integrity+determinism proven, "
+        f"{result['chaos_seconds']}s vs baseline {result['baseline_seconds']}s "
+        f"(bound {result['chaos_bound_seconds']}s), {result['chaos_torn_records_dropped']} torn journal "
+        f"record(s) recovered, zero token/buffer leaks, fd growth {result['chaos_fd_growth']}"
+    )
+    return 0
+
 
 def check_multijob(result: dict) -> int:
     missing = [k for k in REQUIRED_MULTIJOB if k not in result]
@@ -171,6 +258,8 @@ def main(argv) -> int:
     result = results[0]
     if result.get("metric") == "multijob_gbps":
         return check_multijob(result)
+    if result.get("metric") == "chaos_gbps":
+        return check_chaos(result)
     missing = [k for k in REQUIRED_TOP if k not in result]
     counters = result.get("datapath_counters")
     if not isinstance(counters, dict):

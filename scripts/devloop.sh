@@ -89,6 +89,27 @@ else
   echo "[devloop] multijob-smoke clean; result at $LOGDIR/multijob_smoke.out" >>"$LOGDIR/devloop.log"
 fi
 
+# Chaos-smoke gate (CPU-only, ~1 min): the deterministic fault-injection soak
+# (scripts/soak_chaos.py, fixed seed, small corpus) — >= 5 distinct fault
+# points fire across the sender wire path / receiver framing / decode pool /
+# scheduler / control API / persistent journal, and the run must finish with
+# byte-identical outputs, seed-replay determinism, zero leaked tokens/buffers,
+# and bounded recovery time (docs/fault-injection.md). Validated by the chaos
+# branch of check_bench_json.py. Like the other smokes: failures are logged
+# LOUDLY but do not block device profiling.
+JAX_PLATFORMS=cpu SKYPLANE_CHAOS_JOBS=4 SKYPLANE_CHAOS_MB_PER_JOB=2 \
+  python scripts/soak_chaos.py --seed 1337 >"$LOGDIR/chaos_smoke.out" 2>"$LOGDIR/chaos_smoke.err"
+CHAOS_RC=$?
+if [ "$CHAOS_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/chaos_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  CHAOS_RC=$?
+fi
+if [ "$CHAOS_RC" -ne 0 ]; then
+  echo "[devloop] CHAOS-SMOKE FAILURE (rc=$CHAOS_RC) — fault recovery, integrity, or leak gates regressed; see $LOGDIR/chaos_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] chaos-smoke clean; result at $LOGDIR/chaos_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only
   # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
   # acquired but crashed mid-profile must be retried, not recorded

@@ -504,6 +504,123 @@ class BareExceptLoopChecker(Checker):
                 )
 
 
+class FlatSleepInRetryLoopChecker(Checker):
+    """flat-sleep-in-retry-loop: a fixed-duration ``time.sleep`` in a retry
+    context under the gateway/ or api/ trees — the bug class the fault-
+    injection PR removed (docs/fault-injection.md). Flat sleeps in retry
+    paths have two failure modes: a fleet of workers retrying a recovered
+    endpoint re-collides in lockstep (no jitter), and compounding fixed
+    waits have no deadline. Retry pacing must come from a
+    :class:`~skyplane_tpu.utils.retry.RetryPolicy` (``policy.backoff_s(n)``
+    — a call expression, which this rule treats as clean).
+
+    Fires when the sleep sits (a) inside an ``except`` handler, or (b) inside
+    a loop that DIRECTLY contains a try/except (the hand-rolled
+    ``for attempt in range(n)`` idiom). "Flat" = a numeric literal or pure
+    arithmetic over literals/names (``0.5 * (attempt + 1)`` — a deterministic
+    ramp is still synchronized); a bare name or any call expression is not
+    flagged, since adaptive/jittered durations arrive through those.
+    """
+
+    rules = (
+        RuleSpec(
+            "flat-sleep-in-retry-loop",
+            "error",
+            "constant/arithmetic time.sleep in an except handler or retry loop — use a jittered RetryPolicy",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        from pathlib import PurePath
+
+        parts = PurePath(module.path).parts
+        if "gateway" not in parts and "api" not in parts:
+            return
+        out: List[Finding] = []
+        for fn in [n for n in ast.walk(module.tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            self._scan(module, fn.body, in_except=False, in_retry_loop=False, out=out)
+        yield from out
+
+    def _scan(self, module: ModuleInfo, stmts, in_except: bool, in_retry_loop: bool, out: List[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # scanned as its own function
+            if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                # a retry loop is one that DIRECTLY contains a try/except
+                # (not via a nested loop — a poll loop whose body has an
+                # inner drain loop with its own except is not retrying)
+                retry = self._directly_contains_except(stmt)
+                self._scan(module, stmt.body, in_except, retry, out)
+                self._scan(module, stmt.orelse, in_except, in_retry_loop, out)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._scan(module, stmt.body, in_except, in_retry_loop, out)
+                for handler in stmt.handlers:
+                    self._scan(module, handler.body, True, in_retry_loop, out)
+                self._scan(module, stmt.orelse, in_except, in_retry_loop, out)
+                self._scan(module, stmt.finalbody, in_except, in_retry_loop, out)
+                continue
+            if isinstance(stmt, (ast.If, ast.With)):
+                self._scan(module, stmt.body, in_except, in_retry_loop, out)
+                self._scan(module, getattr(stmt, "orelse", []), in_except, in_retry_loop, out)
+                continue
+            if not (in_except or in_retry_loop):
+                continue
+            for node in walk_scope(stmt):
+                if (
+                    isinstance(node, ast.Call)
+                    and dotted_name(node.func) in ("time.sleep", "sleep")
+                    and node.args
+                    and self._is_flat(node.args[0])
+                ):
+                    where = "except handler" if in_except else "retry loop"
+                    out.append(
+                        self.finding(
+                            module,
+                            "flat-sleep-in-retry-loop",
+                            node,
+                            f"flat time.sleep in an {where} — retries need jitter and a deadline (RetryPolicy)",
+                        )
+                    )
+    @staticmethod
+    def _directly_contains_except(loop: ast.AST) -> bool:
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Try) and node.handlers:
+                return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    @staticmethod
+    def _is_flat(node: ast.AST) -> bool:
+        """Literal durations and pure arithmetic ramps are flat; names and
+        call expressions (policy.backoff_s, random jitter) are not."""
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, (int, float)) and not isinstance(node.value, bool)
+        if isinstance(node, (ast.BinOp, ast.UnaryOp)):
+            has_const = False
+            stack = [node]
+            while stack:
+                sub = stack.pop()
+                if isinstance(sub, ast.BinOp):
+                    stack += [sub.left, sub.right]
+                elif isinstance(sub, ast.UnaryOp):
+                    stack.append(sub.operand)
+                elif isinstance(sub, ast.Constant):
+                    if not isinstance(sub.value, (int, float)):
+                        return False
+                    has_const = True
+                elif isinstance(sub, (ast.Name, ast.Attribute)):
+                    continue
+                else:
+                    return False  # a Call (or anything dynamic) in the tree: not flat
+            return has_const
+        return False
+
+
 CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     SharedStateChecker,
     ThreadLifecycleChecker,
@@ -511,4 +628,5 @@ CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     SocketIOUnderLockChecker,
     UnboundedQueueInGatewayChecker,
     BareExceptLoopChecker,
+    FlatSleepInRetryLoopChecker,
 )

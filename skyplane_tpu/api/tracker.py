@@ -17,6 +17,7 @@ import requests
 from skyplane_tpu.api.config import TransferConfig
 from skyplane_tpu.exceptions import GatewayException, SkyplaneTpuException, TransferFailedException
 from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.utils.retry import retry_backoff
 
 
 class TransferHook:
@@ -205,10 +206,25 @@ class TransferProgressTracker(threading.Thread):
     STATUS_FILTER_MAX_IDS = 1500
 
     def _poll_gateway_status(self, gateway, params: Optional[dict] = None) -> Dict[str, str]:
-        try:
+        def _get() -> Dict[str, str]:
             r = gateway.control_session().get(f"{gateway.control_url()}/chunk_status_log", params=params, timeout=10)
             r.raise_for_status()
             return r.json().get("chunk_status", {})
+
+        try:
+            # one jittered in-wave retry (utils/retry.py): a transient control
+            # 5xx/timeout keeps this wave's data instead of costing a full
+            # poll interval; persistent failure still degrades to {} and the
+            # unreachable-streak machinery decides whether the gateway is dead
+            return retry_backoff(
+                _get,
+                max_retries=2,
+                initial_backoff=0.25,
+                jitter=0.5,
+                deadline_s=15.0,
+                exception_class=(requests.RequestException,),
+                log_errors=False,
+            )
         except requests.RequestException as e:
             logger.fs.warning(f"[tracker] status poll failed for {gateway.gateway_id}: {e}")
             return {}

@@ -442,18 +442,24 @@ class CopyJob(TransferJob):
             reqs = [self._to_request(c, dataplane) for c in batch]
             target = min(src_gateways, key=lambda g: g.queue_depth())
             body = [r.as_dict() for r in reqs]
-            for attempt in range(4):
-                try:
-                    resp = session.post(f"{target.control_url()}/chunk_requests", json=body, timeout=60)
-                    resp.raise_for_status()
-                    break
-                except requests.RequestException as e:
-                    if attempt == 3:
-                        raise
-                    logger.fs.warning(f"chunk dispatch retry to {target.gateway_id}: {e}")
-                    import time as _time
 
-                    _time.sleep(0.5 * (attempt + 1))
+            def _post_chunk_requests() -> None:
+                resp = session.post(f"{target.control_url()}/chunk_requests", json=body, timeout=60)
+                resp.raise_for_status()
+
+            # jittered + deadline-bounded (utils/retry.py): concurrent
+            # dispatchers retrying a briefly-unavailable gateway must not
+            # re-collide, and a gateway that stays down fails the dispatch
+            # within a bounded window instead of compounding flat sleeps
+            retry_backoff(
+                _post_chunk_requests,
+                max_retries=4,
+                initial_backoff=0.5,
+                max_backoff=4.0,
+                jitter=0.5,
+                deadline_s=120.0,
+                exception_class=(requests.RequestException,),
+            )
             self._dispatched_chunks.extend(batch)
             yield from batch
         self._flush_upload_ids(session, sink_gateways)

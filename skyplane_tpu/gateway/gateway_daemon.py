@@ -219,6 +219,13 @@ class GatewayDaemon:
         self.metrics.register_provider("decode", self.receiver.decode_counters)
         self.metrics.register_provider("sender_wire", self._sender_wire_counters)
         self.metrics.register_provider("trace", lambda: get_tracer().counters())
+        # chaos visibility (docs/fault-injection.md): per-point fault firings
+        # as skyplane_faults_injected{point="..."} — empty when faults are off
+        from skyplane_tpu.faults import get_injector
+
+        self.metrics.register_labeled_provider(
+            "faults", lambda: {"injected": get_injector().counters()}, label="point"
+        )
         self.metrics.gauge("gateway_operators", help_="operators running in this daemon", fn=lambda: len(self.operators))
         # per-tenant families (docs/multitenancy.md) + the two soak-leak
         # gauges the eviction integration test asserts flat

@@ -44,6 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Set
 
 from skyplane_tpu.chunk import ChunkRequest, ChunkState, validate_tenant_id
+from skyplane_tpu.faults import get_injector
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.operators.gateway_receiver import GatewayReceiver
 from skyplane_tpu.utils.logger import logger
@@ -408,6 +409,14 @@ class GatewayDaemonAPI:
 
     def _handle_post(self, req) -> None:
         path, _ = self._split_route(req)
+        inj = get_injector()
+        if inj.enabled and path in ("/api/v1/chunk_requests", "/api/v1/servers") and inj.fire("control.api"):
+            # control-plane fault (docs/fault-injection.md): a transient 503
+            # on the data-plane POSTs — dispatch/pre-registration retries via
+            # the jittered RetryPolicy, and a sender's /servers failure rides
+            # its stream's reconnect budget
+            req._send(503, {"error": "injected control-API fault (retry)"})
+            return
         if path == "/api/v1/shutdown":
             self.shutdown_requested.set()
             req._send(200, {"status": "shutting down"})

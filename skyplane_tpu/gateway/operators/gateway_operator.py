@@ -32,7 +32,7 @@ from skyplane_tpu.chunk import DEFAULT_TENANT_ID, ChunkFlags, ChunkRequest, Chun
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, put_drop_oldest
 from skyplane_tpu.obs import NOOP_SPAN, get_registry, get_tracer
-from skyplane_tpu.gateway.operators.sender_wire import EngineCallbacks
+from skyplane_tpu.gateway.operators.sender_wire import RECONNECT_POLICY, EngineCallbacks, env_int
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
 from skyplane_tpu.gateway.gateway_queue import GatewayQueue
@@ -40,7 +40,15 @@ from skyplane_tpu.ops.cdc import CDCParams
 from skyplane_tpu.ops.dedup import SenderDedupIndex
 from skyplane_tpu.ops.pipeline import DataPathProcessor
 from skyplane_tpu.utils.logger import logger
-from skyplane_tpu.utils.retry import retry_backoff
+from skyplane_tpu.utils.retry import RetryPolicy, retry_backoff
+
+#: fair-share token releases retry transient scheduler errors (the
+#: sched.release fault point): a dropped release would leak the tenant's
+#: tokens until job teardown — cheap, fast retries make release effectively
+#: reliable, and a persistent failure still escalates loudly
+SCHED_RELEASE_POLICY = RetryPolicy(
+    max_attempts=4, initial_backoff=0.01, max_backoff=0.1, jitter=0.5, exception_class=(SkyplaneTpuException,)
+)
 
 
 class BatchPartialFailure(Exception):
@@ -494,8 +502,28 @@ class _SenderEngineOps(EngineCallbacks):
         # state stays in_progress — the serial path's silent-requeue contract.
         # Scheduler tokens release NOW; the retry pass re-acquires them (a
         # NACK-storming tenant burns its own tokens on every round trip).
-        self.op.sched_release(frame.req)
-        self.op.input_queue.put_for_handle(self.op.handle, frame.req)
+        op = self.op
+        op.sched_release(frame.req)
+        if frame.counted_retry:
+            # per-chunk retry budget: a poisoned chunk (every resend NACKs or
+            # kills its socket) must fail the job with a precise error, not
+            # cycle the queue forever. Shutdown requeues are not counted.
+            retries = getattr(frame.req, "wire_retries", 0) + 1
+            frame.req.wire_retries = retries
+            if retries > op.chunk_retry_budget:
+                msg = (
+                    f"chunk {frame.req.chunk.chunk_id} exhausted its retry budget "
+                    f"({retries - 1} resends to {op.target_gateway_id} all failed; "
+                    f"budget SKYPLANE_TPU_CHUNK_RETRY_BUDGET={op.chunk_retry_budget})"
+                )
+                logger.fs.error(f"[{op.handle}:{self.worker_id}] {msg}")
+                op.chunk_store.log_chunk_state(frame.req, ChunkState.failed, op.handle, self.worker_id)
+                if frame.window is not None:
+                    frame.window.note(acked=False)
+                op.error_queue.put(msg)
+                op.error_event.set()
+                return
+        op.input_queue.put_for_handle(op.handle, frame.req)
         if frame.window is not None:
             frame.window.note(acked=False)
 
@@ -615,6 +643,12 @@ class GatewaySenderOperator(GatewayOperator):
                 logger.fs.warning("ignoring malformed SKYPLANE_TPU_SENDER_FRAME_AHEAD; using 2")
                 frame_ahead = 2
         self.frame_ahead = max(1, int(frame_ahead))
+        # recovery budgets (docs/fault-injection.md): a chunk that keeps
+        # failing (NACK cycles, repeated socket death mid-frame) must fail the
+        # job with a precise error instead of re-queueing forever; the serial
+        # path shares the wire engine's consecutive-reset budget
+        self.chunk_retry_budget = env_int("SKYPLANE_TPU_CHUNK_RETRY_BUDGET", 32)
+        self.reset_budget = env_int("SKYPLANE_TPU_STREAM_RESET_BUDGET", 5)
         self._engines: list = []  # every worker's live engine (wire_counters aggregation)
         self._engines_lock = threading.Lock()
         from skyplane_tpu.gateway.control_auth import control_session
@@ -718,19 +752,24 @@ class GatewaySenderOperator(GatewayOperator):
         if not self.scheduler.acquire(tenant, RES_CHUNK_SLOTS, 1, abort_check=abort):
             return False
         if not self.scheduler.acquire(tenant, RES_WIRE_BYTES, req.chunk.chunk_length_bytes, abort_check=abort):
-            self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1)
+            SCHED_RELEASE_POLICY.call(lambda: self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1), log_errors=False)
             return False
         return True
 
     def sched_release(self, req: ChunkRequest) -> None:
-        """Return one chunk's tokens (its frame resolved: ack/requeue/fail)."""
+        """Return one chunk's tokens (its frame resolved: ack/requeue/fail).
+        Releases retry transient failures (SCHED_RELEASE_POLICY): a silently
+        dropped release would leak this tenant's tokens — starving its OWN
+        later chunks — until job teardown."""
         if self.scheduler is None:
             return
         from skyplane_tpu.tenancy import RES_CHUNK_SLOTS, RES_WIRE_BYTES
 
         tenant = req.chunk.tenant_id or DEFAULT_TENANT_ID
-        self.scheduler.release(tenant, RES_WIRE_BYTES, req.chunk.chunk_length_bytes)
-        self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1)
+        SCHED_RELEASE_POLICY.call(
+            lambda: self.scheduler.release(tenant, RES_WIRE_BYTES, req.chunk.chunk_length_bytes), log_errors=False
+        )
+        SCHED_RELEASE_POLICY.call(lambda: self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1), log_errors=False)
 
     def note_window_event(self, event: dict, seconds: float) -> None:
         """Emit one per-window profile event (bounded queue, counted drops)
@@ -824,16 +863,23 @@ class GatewaySenderOperator(GatewayOperator):
             for req in batch:
                 req.chunk.traced = tracer.sampled(req.chunk.chunk_id)
         regs = [req.as_dict() for req in batch]
-        for attempt in range(3):
-            try:
-                resp = self._session.post(f"{self._control_base}/chunk_requests", json=regs, timeout=30)
-                resp.raise_for_status()
-                return
-            except requests.RequestException as e:
-                if attempt == 2:
-                    raise
-                logger.fs.warning(f"[{self.handle}] chunk pre-register retry: {e}")
-                time.sleep(0.5 * (attempt + 1))
+
+        def _post_registration() -> None:
+            resp = self._session.post(f"{self._control_base}/chunk_requests", json=regs, timeout=30)
+            resp.raise_for_status()
+
+        # jittered + deadline-bounded (utils/retry.py): every sender worker
+        # pre-registers its window, so a control-API blip hits many workers at
+        # once — flat sleeps would march them back in lockstep
+        retry_backoff(
+            _post_registration,
+            max_retries=3,
+            initial_backoff=0.5,
+            max_backoff=4.0,
+            jitter=0.5,
+            deadline_s=90.0,
+            exception_class=(requests.RequestException,),
+        )
 
     def process_batch(self, batch: List[ChunkRequest], worker_id: int) -> Optional[List[bool]]:
         self._register_batch(batch)
@@ -985,12 +1031,30 @@ class GatewaySenderOperator(GatewayOperator):
                         )
                 else:
                     raise OSError(f"bad/missing chunk ack ({ack!r})")
-        except (OSError, ssl.SSLError) as e:
+            self._local.consec_sock_errors = 0  # a fully-resolved window proves the path healthy
+        except (OSError, ssl.SSLError, requests.RequestException) as e:
             # un-acked chunks stay False and are re-queued by the caller;
             # nothing uncommitted leaked into the dedup index (window view)
             logger.fs.warning(f"[{self.handle}:{worker_id}] socket error mid-window: {e}")
             self._reset_sock()
-            time.sleep(0.2)
+            # serial twin of the wire engine's circuit breaker: jittered
+            # reconnect pacing, and past the consecutive-window budget the
+            # job fails loudly — with already-acked chunks accounted
+            # truthfully. A window that delivered ANY ack before dying proves
+            # the path still works (the engine's ack-resets-the-counter
+            # semantics): a flaky-but-progressing link must keep progressing,
+            # not hard-fail after reset_budget windows.
+            errors = 1 if any(results) else getattr(self._local, "consec_sock_errors", 0) + 1
+            self._local.consec_sock_errors = errors
+            if errors >= self.reset_budget:
+                raise BatchPartialFailure(
+                    OSError(
+                        f"sender socket to {self.target_gateway_id} failed {errors} consecutive "
+                        f"windows (budget SKYPLANE_TPU_STREAM_RESET_BUDGET={self.reset_budget}): {e}"
+                    ),
+                    results,
+                )
+            time.sleep(RECONNECT_POLICY.backoff_s(errors - 1))
         finally:
             # every frame in this window resolved (acked, failed, or about to
             # be re-queued by the caller): the fair-share tokens come back —

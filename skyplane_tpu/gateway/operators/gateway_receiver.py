@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 from skyplane_tpu.chunk import WireProtocolHeader
 from skyplane_tpu.exceptions import DedupIntegrityException, SkyplaneTpuException
+from skyplane_tpu.faults import get_injector
 from skyplane_tpu.gateway.cert import generate_self_signed_certificate
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
@@ -82,6 +83,7 @@ DECODE_COUNTER_ZERO = {
     "store_mem_bytes": 0,
     "store_spill_bytes": 0,
     "store_spill_adopted": 0,
+    "store_spill_write_failures": 0,
     "pool_hits": 0,
     "pool_misses": 0,
     "pool_hit_rate": 0.0,
@@ -572,6 +574,13 @@ class GatewayReceiver:
                 elif header.is_encrypted:
                     raise SkyplaneTpuException("received encrypted chunk but no E2EE key configured")
                 try:
+                    inj = get_injector()
+                    if inj.enabled:
+                        # decode-worker fault (docs/fault-injection.md): lands
+                        # on the in-band NACK path — the sender discards the
+                        # affected fps and resends literals, the connection
+                        # stays up (the cheapest recovery contract)
+                        inj.check("receiver.decode_nack", DedupIntegrityException, "injected decode fault")
                     data = self.processor.restore(
                         payload,
                         header,
@@ -804,6 +813,12 @@ class GatewayReceiver:
             return self._socket_events_dropped
 
     def _recv_exact(self, conn: socket.socket, n: int) -> bytes:
+        inj = get_injector()
+        if inj.enabled:
+            # docs/fault-injection.md: a mid-payload disconnect at the framing
+            # boundary — the partial chunk is dropped (never landed, no ack),
+            # and the sender's socket-death path re-queues and resends it
+            inj.check("receiver.recv", ConnectionError, "injected mid-payload disconnect")
         buf = bytearray(n)
         view = memoryview(buf)
         got = 0

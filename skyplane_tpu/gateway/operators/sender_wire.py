@@ -44,6 +44,7 @@ frame-ahead queue full, i.e. the wire is the bottleneck and acks lag.
 
 from __future__ import annotations
 
+import os
 import selectors
 import socket
 import ssl
@@ -52,9 +53,26 @@ import time
 from collections import deque
 from typing import Callable, List, Optional
 
+from skyplane_tpu.faults import get_injector
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED
 from skyplane_tpu.obs import NOOP_SPAN, get_tracer
 from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.utils.retry import RetryPolicy
+
+#: reconnect pacing for a stream whose socket keeps dying: jittered
+#: exponential (docs/fault-injection.md) — every worker's streams re-dialing
+#: a recovering receiver in flat 0.2 s lockstep re-collided by design
+RECONNECT_POLICY = RetryPolicy(initial_backoff=0.1, max_backoff=2.0, jitter=0.5)
+
+
+def env_int(var: str, default: int, minimum: int = 1) -> int:
+    """Parse an integer env knob, warning (never raising) on garbage — shared
+    by the wire engine and the sender operator's recovery budgets."""
+    try:
+        return max(minimum, int(os.environ.get(var, str(default))))
+    except ValueError:
+        logger.fs.warning(f"ignoring malformed {var}; using {default}")
+        return default
 
 # stable sender wire-counter schema (the sender mirror of DECODE_COUNTER_ZERO):
 # every key always present — zeros when the pipelined engine is off — so
@@ -71,6 +89,8 @@ SENDER_WIRE_COUNTER_ZERO = {
     "acks_reaped": 0,
     "nacks_reaped": 0,
     "stream_resets": 0,
+    "streams_broken": 0,  # circuit breaker: streams declared dead past the reset budget
+    "streams_revived": 0,  # fresh streams opened after every stream broke
     "windows": 0,  # submit batches (the _drain_batch granularity)
     "profile_events_dropped": 0,  # per-window profile events lost to the bounded queue
 }
@@ -79,7 +99,20 @@ SENDER_WIRE_COUNTER_ZERO = {
 class WireFrame:
     """One framed chunk flowing through the pipeline."""
 
-    __slots__ = ("req", "header", "wire", "wire_len", "new_fps", "ref_fps", "relay", "sent_ns", "sent_wall_ns", "window", "traced")
+    __slots__ = (
+        "req",
+        "header",
+        "wire",
+        "wire_len",
+        "new_fps",
+        "ref_fps",
+        "relay",
+        "sent_ns",
+        "sent_wall_ns",
+        "window",
+        "traced",
+        "counted_retry",
+    )
 
     def __init__(self, req, header, wire: bytes, new_fps=(), ref_fps=(), relay: bool = False, window=None, traced: bool = False):
         self.req = req
@@ -93,6 +126,10 @@ class WireFrame:
         self.sent_wall_ns = 0
         self.window = window  # optional per-window stats carrier (profile events)
         self.traced = traced  # chunk sampled for tracing (mirrors the header's TRACED flag)
+        # False on shutdown-path requeues (abort/close): those are the silent
+        # requeue contract, not failures — only real retries (socket death,
+        # NACK resend) count against the chunk's retry budget
+        self.counted_retry = True
 
 
 class EngineCallbacks:
@@ -138,6 +175,8 @@ class _Stream:
         "wake_r",
         "wake_w",
         "thread",
+        "consec_resets",
+        "broken",
     )
 
     def __init__(self, idx: int):
@@ -160,6 +199,10 @@ class _Stream:
         self.wake_r.setblocking(False)
         self.wake_w.setblocking(False)
         self.thread: Optional[threading.Thread] = None
+        # circuit-breaker state, touched ONLY by this stream's pump thread:
+        # consecutive socket/connect errors with no intervening ack
+        self.consec_resets = 0
+        self.broken = False  # declared dead past the reset budget
 
     def wake(self) -> None:
         try:
@@ -209,6 +252,8 @@ class SenderWireEngine:
         ack_timeout_s: float = 30.0,
         name: str = "sender-wire",
         abort_check: Optional[Callable[[], bool]] = None,
+        reset_budget: Optional[int] = None,
+        revive_budget: Optional[int] = None,
     ):
         self.socket_factory = socket_factory
         self.callbacks = callbacks
@@ -221,6 +266,17 @@ class SenderWireEngine:
         self.max_streams = max(1, int(max_streams))
         self.ack_timeout_s = float(ack_timeout_s)
         self.name = name
+        # circuit breaker (docs/fault-injection.md): a stream is declared dead
+        # after reset_budget CONSECUTIVE socket/connect errors (an ack resets
+        # the count); its frames re-queue onto healthy/new streams. When EVERY
+        # stream is dead, up to revive_budget fresh streams are opened before
+        # the engine escalates daemon-fatal — a receiver that never comes back
+        # must fail the job loudly, not burn reconnect attempts forever.
+        self.reset_budget = reset_budget if reset_budget is not None else env_int("SKYPLANE_TPU_STREAM_RESET_BUDGET", 5)
+        self.revive_budget = (
+            revive_budget if revive_budget is not None else env_int("SKYPLANE_TPU_STREAM_REVIVE_BUDGET", 2, minimum=0)
+        )
+        self._revivals = 0  # guarded by _streams_lock
         self._streams: List[_Stream] = []
         self._streams_lock = threading.Lock()
         # sklint: disable=unbounded-queue-in-gateway -- every entry is an in-flight frame, already capped by the per-stream inflight_limit byte windows
@@ -250,6 +306,10 @@ class SenderWireEngine:
         while True:
             with stream.lock:
                 if stream.dead:
+                    # engine shutting down (or mid-break): silent requeue,
+                    # not a counted retry — the chunk did not fail, it never
+                    # got a live stream
+                    frame.counted_retry = False
                     self.callbacks.on_requeue(frame)
                     return frame
                 if len(stream.frames) < self.frame_ahead:
@@ -271,6 +331,7 @@ class SenderWireEngine:
                     frame = frame_fn(stream.pending_fps)
                     continue
             if self.abort_check is not None and self.abort_check():
+                frame.counted_retry = False  # shutdown, not a failure
                 self.callbacks.on_requeue(frame)
                 return frame
             with stream.lock:
@@ -319,6 +380,7 @@ class SenderWireEngine:
                 s.cond.notify_all()
             s.wake()
         for frame in leftovers:
+            frame.counted_retry = False  # drained shutdown, not a failure
             self.callbacks.on_requeue(frame)
         with self._completion_cond:
             self._completion_cond.notify_all()
@@ -340,7 +402,14 @@ class SenderWireEngine:
 
     def _pick_stream(self) -> _Stream:
         with self._streams_lock:
-            best = min(self._streams, key=_Stream.load_bytes)
+            live = [s for s in self._streams if not s.dead]
+            if not live:
+                # every stream broke mid-submit: _break_stream has either
+                # revived one (racing this pick) or escalated fatal. Hand back
+                # the newest stream — if it is dead, submit()'s dead branch
+                # requeues silently and the worker loop observes the error.
+                return self._streams[-1]
+            best = min(live, key=_Stream.load_bytes)
             if len(self._streams) < self.max_streams and self._saturated(best):
                 # every stream has a full in-flight window AND a full
                 # frame-ahead queue: acks lag the wire — stripe wider
@@ -372,8 +441,7 @@ class SenderWireEngine:
                 try:
                     self._pump_once(stream)
                 except (OSError, ssl.SSLError) as e:
-                    self._reset_stream(stream, str(e))
-                    time.sleep(0.2)  # same reconnect backoff as the serial path
+                    self._stream_error(stream, str(e))
         except Exception:  # noqa: BLE001 — unexpected pump error is daemon-fatal
             import traceback
 
@@ -390,16 +458,65 @@ class SenderWireEngine:
 
     def _connect(self, stream: _Stream) -> bool:
         try:
+            inj = get_injector()
+            if inj.enabled:
+                inj.check("sender.connect", OSError, "injected connect failure")
             sock = self.socket_factory()
         except Exception as e:  # noqa: BLE001 — control POST / TCP / TLS failures retry
-            self._reset_stream(stream, f"connect failed: {e}")
-            time.sleep(0.2)
+            self._stream_error(stream, f"connect failed: {e}")
             return False
         stream.sock = sock
         stream.selector = selectors.DefaultSelector()
         stream.selector.register(sock, selectors.EVENT_READ, "conn")
         stream.selector.register(stream.wake_r, selectors.EVENT_READ, "wake")
         return True
+
+    def _stream_error(self, stream: _Stream, why: str) -> None:
+        """One socket/connect failure on this stream (pump thread only):
+        reset (re-queue its frames), count it against the consecutive-reset
+        budget, and either back off jittered or trip the circuit breaker."""
+        self._reset_stream(stream, why)
+        stream.consec_resets += 1
+        if stream.consec_resets >= self.reset_budget:
+            self._break_stream(stream, why)
+            return
+        time.sleep(RECONNECT_POLICY.backoff_s(stream.consec_resets - 1))
+
+    def _break_stream(self, stream: _Stream, why: str) -> None:
+        """Circuit breaker: declare this stream dead. Its frames already
+        re-queued (the reset) and re-frame onto healthy streams as the worker
+        re-submits them. Only when EVERY stream is dead does the engine act:
+        revive one fresh stream (bounded by revive_budget) or escalate
+        daemon-fatal — partial failures self-heal, total failure is loud."""
+        stream.broken = True
+        with stream.lock:
+            stream.dead = True
+            stream.cond.notify_all()
+        stream.wake()
+        self._bump("streams_broken")
+        logger.fs.warning(
+            f"[{self.name}:stream{stream.idx}] circuit breaker: stream dead after "
+            f"{stream.consec_resets} consecutive resets ({why})"
+        )
+        with self._streams_lock:
+            if self._closed:
+                return
+            all_dead = all(s.dead for s in self._streams)
+            revive = all_dead and self._revivals < self.revive_budget
+            if revive:
+                self._revivals += 1
+                self._open_stream_locked()
+        if not all_dead:
+            return
+        if revive:
+            self._bump("streams_revived")
+            logger.fs.warning(f"[{self.name}] all streams dead; opened replacement stream "
+                              f"({self._revivals}/{self.revive_budget} revivals)")
+            return
+        self._fatal(
+            f"all {len(self._streams)} sender streams dead after {self.reset_budget} consecutive "
+            f"resets each and {self._revivals} revivals; last error: {why}"
+        )
 
     def _pump_once(self, stream: _Stream) -> None:
         frame = None
@@ -417,8 +534,17 @@ class SenderWireEngine:
                 if frame.traced
                 else NOOP_SPAN
             )
+            inj = get_injector()
             try:
                 with send_span:
+                    if inj.enabled:
+                        # docs/fault-injection.md: sender.send raises a socket
+                        # error mid-send; sender.corrupt_payload flips one wire
+                        # byte (detectable only on sealed/recipe payloads —
+                        # the receiver's auth/structure checks turn it into a
+                        # payload error and the chunk resends)
+                        inj.check("sender.send", OSError, "injected socket error before send")
+                        frame.wire = inj.corrupt("sender.corrupt_payload", frame.wire)
                     frame.header.to_socket(stream.sock)
                     stream.sock.sendall(frame.wire)
             except (OSError, ssl.SSLError):
@@ -494,6 +620,16 @@ class SenderWireEngine:
             if b not in (ACK_BYTE, NACK_UNRESOLVED):
                 raise OSError(f"bad/missing chunk ack ({b!r})")
             now = time.perf_counter_ns()
+            # a delivered response is proof the connection works: the breaker
+            # counts CONSECUTIVE failures only (pump thread owns this field),
+            # and a recovered engine earns its full revive budget back — a
+            # receiver that comes back after a total outage must not consume
+            # the budget permanently (only outages with NO recovery between
+            # them should exhaust it)
+            stream.consec_resets = 0
+            if self._revivals:
+                with self._streams_lock:
+                    self._revivals = 0
             with stream.lock:
                 frame = stream.inflight.popleft()
                 stream.inflight_bytes -= frame.wire_len
@@ -582,6 +718,15 @@ class SenderWireEngine:
                     self.callbacks.on_nack(frame)  # durable-index rollback
                     with stream.lock:
                         for fp in frame.ref_fps:
+                            stream.pending_fps.discard(fp)
+                        # the nacked frame's OWN literals are unproven too (the
+                        # receiver rejected the frame before acking): retire
+                        # them from the pending view, or the resend would REF
+                        # segments that may never have been stored and park the
+                        # receiver for a full ref-wait before a second NACK.
+                        # Worst case this costs a duplicate literal (dedup
+                        # miss) — never a stall, never corruption.
+                        for fp, _ in frame.new_fps:
                             stream.pending_fps.discard(fp)
                     self.callbacks.on_requeue(frame)  # resend with literals
         except Exception:  # noqa: BLE001 — unexpected reaper error is daemon-fatal

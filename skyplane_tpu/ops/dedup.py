@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from skyplane_tpu.exceptions import CodecException, DedupIntegrityException
+from skyplane_tpu.faults import get_injector as _get_injector
 from skyplane_tpu.obs.tracer import get_tracer as _get_tracer
 from skyplane_tpu.ops.bufpool import BufferPool, bucket_size
 from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
@@ -293,6 +294,14 @@ class SegmentStore:
         self._c_ref_timeouts = 0
         self._c_mem_evictions = 0
         self._c_spill_evictions = 0
+        self._c_spill_write_failures = 0
+        # consecutive spill-write failures before escalation (any success
+        # resets): a transient disk error degrades gracefully — the evictee is
+        # dropped and later REFs to it recover via NACK -> literal resend —
+        # but a persistently failing spill disk must surface daemon-fatal,
+        # not silently halve the dedup working set forever
+        self._spill_fail_streak = 0
+        self.max_spill_write_failures = 32
 
     # ---- lock discipline ----
 
@@ -397,20 +406,45 @@ class SegmentStore:
             p = self._spill_path(fp)
             tmp = p.with_name(f"{p.name}.tmp{threading.get_ident()}")
             try:
+                inj = _get_injector()
                 with _get_tracer().span("spill.write", cat="store", args={"bytes": len(data)}):
+                    if inj.enabled:
+                        inj.check("store.spill_write", OSError, "injected spill-write failure")
                     tmp.write_bytes(data)
                     os.replace(tmp, p)
-            except OSError:
-                # disk failure: drop the in-transit pin, then surface (a full
-                # spill disk is daemon-fatal, same as the old in-lock write)
+            except OSError as e:
+                # disk failure: drop the in-transit pin and DROP the evictee —
+                # a vanished segment is the NACK contract's job (an
+                # unresolvable REF nacks, the sender discards the fp and
+                # resends literals), so a transient spill failure degrades the
+                # dedup ratio, never correctness. A persistent failure streak
+                # still escalates: the disk is gone, say so loudly.
                 with self._hold(self._spill_lock):
                     self._in_transit.pop(fp, None)
                 try:
                     tmp.unlink()
                 except OSError:
                     pass
-                raise
+                with self._hold(self._spill_lock):
+                    # serialized: concurrent evictors racing bare += could
+                    # drop increments and defer the escalation indefinitely
+                    self._c_spill_write_failures += 1
+                    self._spill_fail_streak += 1
+                    streak = self._spill_fail_streak
+                if streak >= self.max_spill_write_failures:
+                    raise OSError(
+                        f"spill tier failed {streak} consecutive writes "
+                        f"(latest: {e}); spill disk unusable"
+                    ) from e
+                from skyplane_tpu.utils.logger import logger as _logger
+
+                _logger.fs.warning(
+                    f"[segment-store] spill write failed ({e}); dropped segment {fp.hex()} "
+                    f"(degrades to NACK/literal-resend; streak {streak}/{self.max_spill_write_failures})"
+                )
+                return
             with self._hold(self._spill_lock):
+                self._spill_fail_streak = 0
                 self._in_transit.pop(fp, None)
                 if fp in self._spill_order:
                     # raced a concurrent spill of the same fp (evict ->
@@ -449,10 +483,16 @@ class SegmentStore:
             self._c_lock_held_disk_reads += 1
         p = self._spill_path(fp)
         try:
+            inj = _get_injector()
             with _get_tracer().span("spill.read", cat="store"):
+                if inj.enabled:
+                    # a failed spill read is already a recovery contract: the
+                    # miss propagates to an unresolvable REF -> NACK ->
+                    # literal resend (docs/fault-injection.md)
+                    inj.check("store.spill_read", OSError, "injected spill-read failure")
                 data = p.read_bytes()
         except OSError:
-            return None  # raced with spill eviction: treat as a miss
+            return None  # raced with spill eviction (or the disk failed): treat as a miss
         self._c_spill_reads += 1
         return data
 
@@ -617,6 +657,7 @@ class SegmentStore:
             "store_mem_bytes": mem_bytes,
             "store_spill_bytes": spill_bytes,
             "store_spill_adopted": self._adopted_spill_count,
+            "store_spill_write_failures": self._c_spill_write_failures,
         }
 
 

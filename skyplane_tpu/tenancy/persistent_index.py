@@ -42,6 +42,7 @@ from pathlib import Path
 from typing import Dict, Optional, Tuple
 
 from skyplane_tpu.chunk import DEFAULT_TENANT_ID
+from skyplane_tpu.faults import get_injector
 from skyplane_tpu.ops.dedup import SenderDedupIndex
 from skyplane_tpu.utils.logger import logger
 
@@ -183,6 +184,25 @@ class PersistentDedupIndex(SenderDedupIndex):
 
     def _append(self, kind: int, fp: bytes, size: int, tenant: str) -> None:
         rec = _pack_record(kind, fp, size, tenant)
+        inj = get_injector()
+        if inj.enabled and inj.fire("index.journal_torn"):
+            # torn-write fault (docs/fault-injection.md): persist only a
+            # partial record AND stop journaling — exactly what a crash
+            # mid-append leaves behind (the tear is at the TAIL; a live
+            # journal appending full records after a mid-file tear would be
+            # an impossible on-disk state, and recovery truncating at the
+            # tear would silently discard them). The in-memory index stays
+            # correct for THIS run; the next recovery detects the CRC-broken
+            # tail, truncates it, and the lost warmth (the half record plus
+            # everything this run would have journaled after it) degrades to
+            # literal resends, never corruption.
+            with self._journal_lock:
+                if self._jf is not None:
+                    self._jf.write(rec[: _REC_LEN // 2])
+                    self._jf.flush()
+                    self._jf.close()
+                    self._jf = None
+            return
         compact = False
         with self._journal_lock:
             if self._jf is None:

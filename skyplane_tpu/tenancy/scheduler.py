@@ -34,6 +34,7 @@ from typing import Callable, Dict, Optional
 
 from skyplane_tpu.chunk import DEFAULT_TENANT_ID
 from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.faults import get_injector
 
 #: canonical resource names (docs/multitenancy.md). wire_bytes bounds the
 #: bytes a tenant may hold in sender frame-ahead queues + in-flight windows;
@@ -201,6 +202,13 @@ class FairShareScheduler:
         return held + amount <= entitlement
 
     def release(self, tenant: str, resource: str, amount: int) -> None:
+        inj = get_injector()
+        if inj.enabled:
+            # token-release fault (docs/fault-injection.md): raised BEFORE any
+            # usage mutation, so the caller's retry (SCHED_RELEASE_POLICY in
+            # the sender operator) re-runs release idempotently — a skipped
+            # release would leak the tenant's tokens until job teardown
+            inj.check("sched.release", SkyplaneTpuException, "injected scheduler release failure")
         tenant = tenant or DEFAULT_TENANT_ID
         amount = max(0, int(amount))
         res = self._resource(resource)

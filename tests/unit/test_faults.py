@@ -1,0 +1,508 @@
+"""Deterministic fault injection + the recovery layer it exercises.
+
+Covers the skyplane_tpu/faults decision engine (seed determinism, plan
+parsing, arming semantics), the RetryPolicy contract, and the per-subsystem
+recovery machinery: the sender wire engine's circuit breaker (streams break
+past the reset budget, the engine revives bounded replacements, total failure
+is daemon-fatal), per-chunk retry budgets, scheduler token-release retries,
+segment-store spill-failure degradation, and persistent-index torn-journal
+recovery — each driven by its real fault point (docs/fault-injection.md).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.chunk import Chunk, ChunkRequest, WireProtocolHeader
+from skyplane_tpu.exceptions import DedupIntegrityException, SkyplaneTpuException
+from skyplane_tpu.faults import (
+    FAULTS_ENV,
+    FaultInjector,
+    FaultPlan,
+    configure_injector,
+    decision_schedule,
+    get_injector,
+)
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+from skyplane_tpu.gateway.operators.gateway_operator import SCHED_RELEASE_POLICY, GatewaySenderOperator
+from skyplane_tpu.gateway.operators.gateway_receiver import NACK_UNRESOLVED
+from skyplane_tpu.ops.dedup import SegmentStore
+from skyplane_tpu.utils.retry import RetryPolicy, retry_backoff
+
+rng = np.random.default_rng(404)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    """Every test leaves the process injector as the env-derived default
+    (no-op in the test environment)."""
+    yield
+    configure_injector(None)
+
+
+def plan(points: dict, seed: int = 1337) -> FaultPlan:
+    return FaultPlan.from_dict({"seed": seed, "points": points})
+
+
+# ------------------------------------------------------------- decision engine
+
+
+def test_same_seed_same_firing_sequence():
+    p = plan({"x": {"p": 0.3}, "y": {"p": 0.9, "after": 5}})
+    a, b = FaultInjector(p), FaultInjector(p)
+    seq_a = [a.fire("x") for _ in range(200)] + [a.fire("y") for _ in range(50)]
+    seq_b = [b.fire("x") for _ in range(200)] + [b.fire("y") for _ in range(50)]
+    assert seq_a == seq_b
+    assert any(seq_a), "plan armed but nothing ever fired"
+    assert a.counters() == b.counters()
+    assert [e[1:] for e in a.firing_log()] == [e[1:] for e in b.firing_log()]
+
+
+def test_schedule_replays_live_decisions_and_seeds_differ():
+    spec = {"p": 0.25}
+    p1 = plan({"pt": spec}, seed=7)
+    inj = FaultInjector(p1)
+    live = [i for i in range(300) if inj.fire("pt")]
+    assert live == inj.schedule("pt", 300) == decision_schedule(7, "pt", p1.points["pt"], 300)
+    other = decision_schedule(8, "pt", p1.points["pt"], 300)
+    assert live != other, "different seeds produced the same schedule"
+
+
+def test_after_and_max_fires_arming():
+    inj = FaultInjector(plan({"pt": {"p": 1.0, "after": 3, "max_fires": 2}}))
+    fired = [inj.fire("pt") for _ in range(10)]
+    assert fired == [False, False, False, True, True, False, False, False, False, False]
+    assert inj.counters() == {"pt": 2}
+    assert inj.eval_counts() == {"pt": 10}
+
+
+def test_unarmed_point_and_disabled_injector_are_inert():
+    inj = FaultInjector(plan({"armed": {"p": 1.0}}))
+    assert not inj.fire("not.in.plan")
+    inj.check("not.in.plan")  # no raise
+    noop = configure_injector(None)
+    assert not noop.enabled
+    noop.check("anything")
+    assert noop.corrupt("anything", b"abc") == b"abc"
+    assert noop.counters() == {}
+
+
+def test_check_raises_chosen_exception():
+    inj = FaultInjector(plan({"pt": {"p": 1.0, "max_fires": 1}}))
+    with pytest.raises(ConnectionError, match="injected"):
+        inj.check("pt", ConnectionError, "injected disconnect")
+    inj.check("pt", ConnectionError)  # budget spent: no raise
+
+
+def test_corrupt_flips_exactly_one_byte_deterministically():
+    p = plan({"pt": {"p": 1.0, "max_fires": 1}})
+    data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    out1 = FaultInjector(p).corrupt("pt", data)
+    out2 = FaultInjector(p).corrupt("pt", data)
+    assert out1 == out2, "corruption position must replay from the seed"
+    assert out1 != data
+    assert sum(a != b for a, b in zip(out1, data)) == 1
+
+
+def test_plan_env_parsing_inline_file_and_malformed(tmp_path, monkeypatch):
+    inline = json.dumps({"seed": 5, "points": {"a": {"p": 0.5}}})
+    monkeypatch.setenv(FAULTS_ENV, inline)
+    inj = configure_injector(None)
+    assert inj.enabled and inj.plan.seed == 5 and "a" in inj.plan.points
+    f = tmp_path / "plan.json"
+    f.write_text(inline)
+    monkeypatch.setenv(FAULTS_ENV, str(f))
+    inj = configure_injector(None)
+    assert inj.enabled and inj.plan.points["a"].p == 0.5
+    monkeypatch.setenv(FAULTS_ENV, "{not json")
+    assert not configure_injector(None).enabled  # malformed stays OFF, loudly logged
+    monkeypatch.delenv(FAULTS_ENV)
+    assert not configure_injector(None).enabled
+    assert get_injector() is configure_injector(None) or True  # singleton path smoke
+
+
+def test_plan_round_trips_through_as_dict():
+    p = plan({"a": {"p": 0.25, "after": 2, "max_fires": 7}, "b": {}}, seed=99)
+    again = FaultPlan.from_dict(p.as_dict())
+    assert again == p
+
+
+# ---------------------------------------------------------------- retry policy
+
+
+def test_retry_policy_backoff_jitter_bounds():
+    pol = RetryPolicy(initial_backoff=0.2, max_backoff=1.0, jitter=0.5)
+    for attempt, base in ((0, 0.2), (1, 0.4), (2, 0.8), (3, 1.0), (8, 1.0)):
+        for _ in range(50):
+            s = pol.backoff_s(attempt)
+            assert base * 0.5 <= s <= base, f"attempt {attempt}: {s} outside jitter envelope"
+    exact = RetryPolicy(initial_backoff=0.2, jitter=0.0)
+    assert exact.backoff_s(1) == 0.4
+
+
+def test_retry_policy_recovers_then_exhausts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert RetryPolicy(max_attempts=4, initial_backoff=0.001).call(flaky, log_errors=False) == "ok"
+    with pytest.raises(OSError):
+        RetryPolicy(max_attempts=2, initial_backoff=0.001).call(
+            lambda: (_ for _ in ()).throw(OSError("always")), log_errors=False
+        )
+
+
+def test_retry_policy_deadline_cuts_attempts_short():
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        RetryPolicy(max_attempts=50, initial_backoff=0.2, jitter=0.0, deadline_s=0.3).call(
+            lambda: (_ for _ in ()).throw(OSError("always")), log_errors=False
+        )
+    assert time.monotonic() - t0 < 2.0, "deadline did not bound the retry loop"
+
+
+def test_retry_if_predicate_gates_retries():
+    calls = []
+
+    def fails_differently():
+        calls.append(1)
+        raise ValueError("fatal-class" if len(calls) == 1 else "never reached")
+
+    with pytest.raises(ValueError, match="fatal-class"):
+        RetryPolicy(max_attempts=5, initial_backoff=0.001, retry_if=lambda e: "fatal" not in str(e)).call(
+            fails_differently, log_errors=False
+        )
+    assert len(calls) == 1, "non-retryable error was retried"
+
+
+def test_retry_backoff_new_params_backward_compatible():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("x")
+        return 42
+
+    assert retry_backoff(flaky, initial_backoff=0.001, jitter=0.9, deadline_s=5.0, log_errors=False) == 42
+
+
+# ----------------------------------------------- scheduler token-release retry
+
+
+def test_sched_release_retries_through_injected_faults():
+    from skyplane_tpu.tenancy import FairShareScheduler
+
+    sched = FairShareScheduler()
+    sched.configure_resource("r", 10)
+    assert sched.acquire("t1", "r", 5)
+    inj = configure_injector(plan({"sched.release": {"p": 1.0, "max_fires": 2}}))
+    SCHED_RELEASE_POLICY.call(lambda: sched.release("t1", "r", 5), log_errors=False)
+    assert sched.usage_snapshot()["r"] == {}, "tokens leaked through the injected release failures"
+    assert inj.counters()["sched.release"] == 2
+    # past the policy's attempts a persistent failure still surfaces
+    assert sched.acquire("t1", "r", 1)
+    configure_injector(plan({"sched.release": {"p": 1.0}}))
+    with pytest.raises(SkyplaneTpuException):
+        SCHED_RELEASE_POLICY.call(lambda: sched.release("t1", "r", 1), log_errors=False)
+
+
+# ------------------------------------------------- segment-store spill faults
+
+
+def test_spill_write_failure_degrades_to_dropped_segment(tmp_path):
+    configure_injector(plan({"store.spill_write": {"p": 1.0, "max_fires": 1}}))
+    store = SegmentStore(max_bytes=1500, spill_dir=tmp_path / "spill", spill_max_bytes=1 << 20)
+    from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
+
+    segs = []
+    for _ in range(3):
+        data = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+        segs.append((segment_fingerprint_host(data), data))
+        store.put(*segs[-1])  # third put evicts the first; its spill write fails
+    counters = store.counters()
+    assert counters["store_spill_write_failures"] == 1
+    dropped = [fp for fp, _ in segs if fp not in store]
+    assert len(dropped) == 1, "exactly one evictee should have been dropped by the failed spill"
+    with pytest.raises(DedupIntegrityException):
+        store.get(dropped[0], wait_timeout=0.0)  # the NACK/literal-resend contract takes over
+    # survivors stay fully resolvable
+    for fp, data in segs:
+        if fp not in dropped:
+            assert store.get(fp, wait_timeout=0.0) == data
+
+
+def test_spill_write_failure_streak_escalates(tmp_path):
+    configure_injector(plan({"store.spill_write": {"p": 1.0}}))
+    store = SegmentStore(max_bytes=1500, spill_dir=tmp_path / "spill", spill_max_bytes=1 << 20)
+    store.max_spill_write_failures = 2
+    from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
+
+    with pytest.raises(OSError, match="spill disk unusable"):
+        for _ in range(6):
+            data = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+            store.put(segment_fingerprint_host(data), data)
+
+
+def test_spill_read_fault_is_a_miss_not_a_crash(tmp_path):
+    store = SegmentStore(max_bytes=1500, spill_dir=tmp_path / "spill", spill_max_bytes=1 << 20)
+    from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
+
+    segs = []
+    for _ in range(3):
+        data = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+        segs.append((segment_fingerprint_host(data), data))
+        store.put(*segs[-1])  # 1500B memory bound: segs 0 and 1 evict to spill
+    # one injected read failure heals WITHIN a single get(): the parked-REF
+    # re-check path retries the spill read before giving up (and promotes)
+    configure_injector(plan({"store.spill_read": {"p": 1.0, "max_fires": 1}}))
+    assert store.get(segs[0][0], wait_timeout=0.0) == segs[0][1]
+    # both read attempts of one get() failing surfaces the unresolvable-REF
+    # contract (NACK -> literal resend), and the store heals afterwards
+    configure_injector(plan({"store.spill_read": {"p": 1.0, "max_fires": 2}}))
+    with pytest.raises(DedupIntegrityException):
+        store.get(segs[1][0], wait_timeout=0.0)
+    assert store.get(segs[1][0], wait_timeout=0.0) == segs[1][1], "store did not heal after the transient read fault"
+
+
+# ------------------------------------------- persistent-index torn journal
+
+
+def test_torn_journal_append_truncated_at_recovery(tmp_path):
+    from skyplane_tpu.tenancy import PersistentDedupIndex
+
+    idx = PersistentDedupIndex(tmp_path / "idx", journal_max_bytes=1 << 20)
+    fps = [rng.integers(0, 256, 16, dtype=np.uint8).tobytes() for _ in range(4)]
+    configure_injector(plan({"index.journal_torn": {"p": 1.0, "after": 2, "max_fires": 1}}))
+    for fp in fps:
+        idx.add(fp, 100, tenant="00" * 8)
+    for fp in fps:
+        assert fp in idx  # the live index is unaffected by the torn append
+    idx.close()
+    configure_injector(None)
+    recovered = PersistentDedupIndex(tmp_path / "idx", journal_max_bytes=1 << 20)
+    counters = recovered.counters()
+    assert counters["index_torn_entries_dropped"] == 1
+    # records before the tear recover; the tear truncates everything after it
+    assert counters["index_recovered_entries"] == 2
+    assert fps[0] in recovered and fps[1] in recovered
+    assert fps[2] not in recovered and fps[3] not in recovered
+    # a torn tail degrades to cold fingerprints, and the journal is clean
+    # again: post-recovery appends recover on the NEXT restart
+    recovered.add(fps[2], 100, tenant="00" * 8)
+    recovered.close()
+    third = PersistentDedupIndex(tmp_path / "idx", journal_max_bytes=1 << 20)
+    assert fps[2] in third and third.counters()["index_torn_entries_dropped"] == 0
+
+
+# ------------------------------------------------- sender wire circuit breaker
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _make_sender_op(tmp_path, make_socket, monkeypatch, **env):
+    for var, val in env.items():
+        monkeypatch.setenv(var, str(val))
+    store = ChunkStore(str(tmp_path / f"tx_{uuid.uuid4().hex[:8]}"))
+    in_q = GatewayQueue()
+    out_q = GatewayQueue()
+    out_q.register_handle("sink")
+    error_event = threading.Event()
+    error_queue: "queue.Queue[str]" = queue.Queue()
+    op = GatewaySenderOperator(
+        handle="send",
+        region="test:r",
+        input_queue=in_q,
+        output_queue=out_q,
+        error_event=error_event,
+        error_queue=error_queue,
+        chunk_store=store,
+        n_workers=1,
+        target_gateway_id="gw_test",
+        target_host="127.0.0.1",
+        target_control_port=0,
+        codec_name="none",
+        dedup=True,
+        use_tls=False,
+        pipelined=True,
+        max_streams=1,
+    )
+    op._make_socket = make_socket
+    op._register_batch = lambda batch: None
+    return op, in_q, out_q, error_event, error_queue, store
+
+
+def _stage_one_chunk(store: ChunkStore, data: bytes) -> ChunkRequest:
+    cid = uuid.uuid4().hex
+    store.chunk_path(cid).write_bytes(data)
+    return ChunkRequest(chunk=Chunk(src_key="s", dest_key="d", chunk_id=cid, chunk_length_bytes=len(data)))
+
+
+def test_circuit_breaker_breaks_revives_then_goes_fatal(tmp_path, monkeypatch):
+    """A target that refuses every connection: each stream breaks after the
+    reset budget, the engine revives a bounded number of replacements, and
+    total failure escalates daemon-fatal with a precise error."""
+    dead_port = _free_port()  # nothing listens here: ECONNREFUSED
+
+    def refused_socket():
+        return socket.create_connection(("127.0.0.1", dead_port), timeout=2)
+
+    op, in_q, _, error_event, error_queue, store = _make_sender_op(
+        tmp_path,
+        refused_socket,
+        monkeypatch,
+        SKYPLANE_TPU_STREAM_RESET_BUDGET=2,
+        SKYPLANE_TPU_STREAM_REVIVE_BUDGET=1,
+    )
+    try:
+        in_q.put(_stage_one_chunk(store, rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()))
+        op.start_workers()
+        assert error_event.wait(timeout=30.0), "all-streams-dead never escalated daemon-fatal"
+        msg = error_queue.get(timeout=5.0)
+        assert "streams dead" in msg
+        counters = op.wire_counters()
+        assert counters["streams_broken"] == 2  # the original stream + the revived one
+        assert counters["streams_revived"] == 1
+        assert counters["stream_resets"] >= 4  # reset budget paid on each stream
+    finally:
+        op.stop_workers()
+
+
+def test_chunk_retry_budget_fails_poisoned_chunk_precisely(tmp_path, monkeypatch):
+    """A receiver that NACKs every frame: the chunk re-queues (resending
+    literals each round) until its retry budget is spent, then the job fails
+    with an error naming the chunk — never an infinite requeue cycle."""
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(8)
+    port = listener.getsockname()[1]
+
+    def nack_everything():
+        while True:
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            def serve(c):
+                try:
+                    while True:
+                        header = WireProtocolHeader.from_socket(c)
+                        remaining = header.data_len
+                        while remaining:
+                            got = c.recv(min(1 << 20, remaining))
+                            if not got:
+                                return
+                            remaining -= len(got)
+                        c.sendall(NACK_UNRESOLVED)
+                except (OSError, SkyplaneTpuException):
+                    pass
+                finally:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+            threading.Thread(target=serve, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=nack_everything, daemon=True).start()
+
+    def direct_socket():
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    op, in_q, _, error_event, error_queue, store = _make_sender_op(
+        tmp_path, direct_socket, monkeypatch, SKYPLANE_TPU_CHUNK_RETRY_BUDGET=3
+    )
+    try:
+        req = _stage_one_chunk(store, rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes())
+        in_q.put(req)
+        op.start_workers()
+        assert error_event.wait(timeout=30.0), "poisoned chunk never exhausted its retry budget"
+        msg = error_queue.get(timeout=5.0)
+        assert "retry budget" in msg and req.chunk.chunk_id in msg
+        assert req.wire_retries == 4  # budget 3 exceeded on the 4th counted requeue
+    finally:
+        op.stop_workers()
+        listener.close()
+
+
+def test_injected_connect_faults_recover_within_budget(tmp_path, monkeypatch):
+    """sender.connect faults below the reset budget: the stream backs off
+    jittered, reconnects, and the transfer completes — no breaker trip."""
+    from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE
+
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(8)
+    port = listener.getsockname()[1]
+
+    def ack_everything():
+        while True:
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            def serve(c):
+                try:
+                    while True:
+                        header = WireProtocolHeader.from_socket(c)
+                        remaining = header.data_len
+                        while remaining:
+                            got = c.recv(min(1 << 20, remaining))
+                            if not got:
+                                return
+                            remaining -= len(got)
+                        c.sendall(ACK_BYTE)
+                except (OSError, SkyplaneTpuException):
+                    pass
+            threading.Thread(target=serve, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=ack_everything, daemon=True).start()
+
+    def direct_socket():
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    inj = configure_injector(plan({"sender.connect": {"p": 1.0, "max_fires": 2}}))
+    op, in_q, out_q, error_event, _, store = _make_sender_op(
+        tmp_path, direct_socket, monkeypatch, SKYPLANE_TPU_STREAM_RESET_BUDGET=5
+    )
+    try:
+        in_q.put(_stage_one_chunk(store, rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()))
+        op.start_workers()
+        deadline = time.monotonic() + 30.0
+        done = []
+        while len(done) < 1 and time.monotonic() < deadline:
+            try:
+                done.append(out_q.pop("sink", timeout=0.25))
+            except queue.Empty:
+                continue
+        assert len(done) == 1, "chunk never delivered after transient connect faults"
+        assert not error_event.is_set()
+        assert inj.counters()["sender.connect"] == 2
+        assert op.wire_counters()["streams_broken"] == 0
+    finally:
+        op.stop_workers()
+        listener.close()

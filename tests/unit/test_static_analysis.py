@@ -285,6 +285,88 @@ def serve(q):
     assert "bare-except-in-loop" not in rules_of(src)
 
 
+def test_flat_sleep_in_retry_loop_fires_in_except_and_attempt_loop():
+    """The recovery-contract bug class the fault-injection PR removed: flat
+    reconnect sleeps (no jitter, no deadline) in gateway/api retry paths."""
+    src = """
+import time
+
+def reconnect(sock):
+    try:
+        sock.connect()
+    except OSError:
+        time.sleep(0.2)
+
+def dispatch(post):
+    for attempt in range(4):
+        try:
+            return post()
+        except Exception:
+            time.sleep(0.5 * (attempt + 1))
+"""
+    findings = [
+        f for f in run_source(src, "skyplane_tpu/gateway/fixture.py") if f.rule == "flat-sleep-in-retry-loop"
+    ]
+    assert len(findings) == 2
+
+
+def test_flat_sleep_in_retry_loop_quiet_on_policy_names_and_other_paths():
+    src = """
+import time
+
+def reconnect(sock, policy, n):
+    try:
+        sock.connect()
+    except OSError:
+        time.sleep(policy.backoff_s(n))  # jittered policy call: clean
+
+def poll(poll_interval):
+    while True:
+        try:
+            tick()
+        except OSError:
+            pass
+        time.sleep(poll_interval)  # adaptive name, not flat
+
+def pump():
+    while True:  # poll loop whose inner drain loop owns the except
+        while True:
+            try:
+                drain()
+            except Empty:
+                break
+        time.sleep(0.05)
+"""
+    assert not [f for f in run_source(src, "skyplane_tpu/gateway/fixture.py") if f.rule == "flat-sleep-in-retry-loop"]
+    # identical flat sleep outside gateway//api trees: out of scope
+    flat = """
+import time
+
+def f():
+    try:
+        go()
+    except OSError:
+        time.sleep(0.2)
+"""
+    assert not [f for f in run_source(flat, "skyplane_tpu/ops/fixture.py") if f.rule == "flat-sleep-in-retry-loop"]
+
+
+def test_flat_sleep_in_retry_loop_suppressible():
+    src = """
+import time
+
+def f():
+    try:
+        go()
+    except OSError:
+        time.sleep(0.2)  # sklint: disable=flat-sleep-in-retry-loop -- fixture: bounded one-shot wait documented here
+"""
+    findings = [
+        f for f in run_source(src, "skyplane_tpu/api/fixture.py") if f.rule == "flat-sleep-in-retry-loop"
+    ]
+    assert findings and all(f.suppressed for f in findings)
+
+
 # ------------------------------------------------------------- span rules
 
 

@@ -5,15 +5,34 @@ must surface as the codec error contract (CodecException /
 DedupIntegrityException / ChecksumMismatchException / SkyplaneTpuException),
 never as raw IndexError / struct.error / MemoryError crashes that would take
 down the connection handler in uncontrolled ways.
+
+The injector-driven cases at the bottom push the same hostile conditions
+through a LIVE GatewayReceiver at the framing boundary (short reads,
+mid-frame disconnects, corrupt payloads, injected decode faults) and assert
+the recovery contracts end to end: NACK -> literal resend, dropped
+connections -> sender resend, and NO partial chunk ever exposed (a ``.done``
+marker only ever appears on a byte-correct chunk file).
 """
+
+import queue
+import socket
+import struct
+import threading
+import time
+import uuid
 
 import numpy as np
 import pytest
 
-from skyplane_tpu.chunk import HEADER_LENGTH_BYTES, WireProtocolHeader
+from skyplane_tpu.chunk import HEADER_LENGTH_BYTES, ChunkFlags, WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.faults import FaultPlan, configure_injector
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, GatewayReceiver
 from skyplane_tpu.ops import blockpack
+from skyplane_tpu.ops import dedup as dedup_mod
 from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex, build_recipe, parse_recipe
+from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
 
 rng = np.random.default_rng(1337)
 
@@ -110,3 +129,213 @@ def test_truncated_tag_region_rejected():
     # cut inside the tag region (header is 20 bytes; zeros -> tiny container)
     with pytest.raises(ALLOWED):
         blockpack.decode_container(enc[:21])
+
+
+# ----------------------------------------------------------------------------
+# Injector-driven recovery at the receiver framing boundary
+# (docs/fault-injection.md). A live GatewayReceiver, real sockets, no TLS.
+# ----------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    yield
+    configure_injector(None)
+
+
+def _mk_receiver(tmp_path):
+    store = ChunkStore(str(tmp_path / f"rx_{uuid.uuid4().hex[:8]}"))
+    ev, eq = threading.Event(), queue.Queue()
+    r = GatewayReceiver(
+        "local:local", store, ev, eq, use_tls=False, bind_host="127.0.0.1", dedup=True, decode_workers=2
+    )
+    port = r.start_server()
+    return r, store, ev, port
+
+
+def _recipe_frame(datas, chunk_id=None):
+    """(header, wire, raw) — a recipe frame carrying ``datas`` as literals."""
+    segs = [(segment_fingerprint_host(d), d) for d in datas]
+    wire, *_ = build_recipe(segs, SenderDedupIndex(), lambda b: b)
+    raw = b"".join(datas)
+    header = WireProtocolHeader(
+        chunk_id=chunk_id or uuid.uuid4().hex,
+        data_len=len(wire),
+        raw_data_len=len(raw),
+        flags=int(ChunkFlags.RECIPE),
+    )
+    return header, wire, raw
+
+
+def _connect(port):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _send_frame(sock, header, wire):
+    header.to_socket(sock)
+    sock.sendall(wire)
+
+
+def _assert_dropped(sock) -> None:
+    """The peer dropped us without acking: clean EOF or an RST (the receiver
+    closing with unread bytes still in its buffer) — both mean the same thing
+    to a sender, which re-queues the chunk either way."""
+    sock.settimeout(5.0)
+    try:
+        got = sock.recv(1)
+    except ConnectionError:
+        return
+    assert got == b"", f"expected a dropped connection, got response byte {got!r}"
+
+
+def _assert_not_exposed(store: ChunkStore, chunk_id: str):
+    """The no-partial-exposure contract: no .done marker means downstream
+    operators never see this chunk, whatever may be staged on disk."""
+    assert not store.chunk_path(chunk_id).with_suffix(".done").exists(), (
+        f"chunk {chunk_id} exposed to downstream operators without a successful decode+ack"
+    )
+
+
+def _wait_done(store: ChunkStore, chunk_id: str, timeout=5.0) -> bool:
+    deadline = time.monotonic() + timeout
+    marker = store.chunk_path(chunk_id).with_suffix(".done")
+    while time.monotonic() < deadline:
+        if marker.exists():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_injected_mid_frame_disconnect_then_resend_recovers(tmp_path):
+    """receiver.recv fires mid-payload: the connection drops with no ack and
+    NO partial chunk exposed; the sender-side resend on a fresh connection
+    lands the identical bytes."""
+    r, store, ev, port = _mk_receiver(tmp_path)
+    header, wire, raw = _recipe_frame([rng.integers(0, 256, 3000, dtype=np.uint8).tobytes() for _ in range(3)])
+    configure_injector(FaultPlan.from_dict({"seed": 1, "points": {"receiver.recv": {"p": 1.0, "max_fires": 1}}}))
+    sock = _connect(port)
+    try:
+        _send_frame(sock, header, wire)
+        _assert_dropped(sock)
+    finally:
+        sock.close()
+    _assert_not_exposed(store, header.chunk_id)
+    # the sender's socket-death contract: re-queue + resend on a new socket
+    sock = _connect(port)
+    try:
+        _send_frame(sock, header, wire)
+        sock.settimeout(10.0)
+        assert sock.recv(1) == ACK_BYTE
+    finally:
+        sock.close()
+    assert _wait_done(store, header.chunk_id)
+    assert store.chunk_path(header.chunk_id).read_bytes() == raw
+    assert not ev.is_set()
+
+
+def test_short_read_peer_close_drops_partial_chunk(tmp_path):
+    """A peer dying mid-payload (true short read at the framing boundary):
+    the partial chunk is dropped, nothing is exposed, the daemon survives."""
+    r, store, ev, port = _mk_receiver(tmp_path)
+    header, wire, raw = _recipe_frame([rng.integers(0, 256, 8000, dtype=np.uint8).tobytes()])
+    sock = _connect(port)
+    header.to_socket(sock)
+    sock.sendall(wire[: len(wire) // 2])  # half the payload, then vanish
+    sock.close()
+    time.sleep(0.5)
+    _assert_not_exposed(store, header.chunk_id)
+    assert not ev.is_set(), "a peer disconnect mid-chunk must never be daemon-fatal"
+    # the resend completes normally
+    sock = _connect(port)
+    try:
+        _send_frame(sock, header, wire)
+        sock.settimeout(10.0)
+        assert sock.recv(1) == ACK_BYTE
+    finally:
+        sock.close()
+    assert _wait_done(store, header.chunk_id)
+    assert store.chunk_path(header.chunk_id).read_bytes() == raw
+
+
+def test_corrupt_payload_at_framing_boundary_never_exposes_partial(tmp_path):
+    """A corrupted recipe payload (bad magic — what sender.corrupt_payload
+    produces on an unsealed recipe frame): payload error, connection dropped,
+    no ack, no exposure; the clean resend recovers."""
+    r, store, ev, port = _mk_receiver(tmp_path)
+    header, wire, raw = _recipe_frame([rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()])
+    corrupt = bytes([wire[0] ^ 0xFF]) + wire[1:]  # flip a magic byte; data_len unchanged
+    sock = _connect(port)
+    try:
+        _send_frame(sock, header, corrupt)
+        _assert_dropped(sock)
+    finally:
+        sock.close()
+    _assert_not_exposed(store, header.chunk_id)
+    assert not ev.is_set()
+    sock = _connect(port)
+    try:
+        _send_frame(sock, header, wire)
+        sock.settimeout(10.0)
+        assert sock.recv(1) == ACK_BYTE
+    finally:
+        sock.close()
+    assert _wait_done(store, header.chunk_id)
+    assert store.chunk_path(header.chunk_id).read_bytes() == raw
+
+
+def test_injected_decode_nack_then_literal_resend(tmp_path):
+    """receiver.decode_nack fires: the response is an IN-BAND NACK on a live
+    connection (the cheapest recovery), nothing is exposed, and the literal
+    resend on the SAME socket acks — the NACK -> literal-resend contract."""
+    r, store, ev, port = _mk_receiver(tmp_path)
+    datas = [rng.integers(0, 256, 3000, dtype=np.uint8).tobytes() for _ in range(2)]
+    header, wire, raw = _recipe_frame(datas)
+    configure_injector(
+        FaultPlan.from_dict({"seed": 2, "points": {"receiver.decode_nack": {"p": 1.0, "max_fires": 1}}})
+    )
+    sock = _connect(port)
+    try:
+        _send_frame(sock, header, wire)
+        sock.settimeout(10.0)
+        assert sock.recv(1) == NACK_UNRESOLVED
+        _assert_not_exposed(store, header.chunk_id)
+        # sender contract after NACK: discard the affected fps and resend as
+        # pure literals — same socket, no reconnect needed
+        _send_frame(sock, header, wire)
+        assert sock.recv(1) == ACK_BYTE
+    finally:
+        sock.close()
+    assert _wait_done(store, header.chunk_id)
+    assert store.chunk_path(header.chunk_id).read_bytes() == raw
+    assert r.nacks_total == 1
+    assert not ev.is_set()
+
+
+def test_injected_ref_to_missing_segment_nacks_in_band(tmp_path):
+    """A REF whose literal never arrived (what spill faults degrade to):
+    in-band NACK, connection stays up, the literal frame then resolves it."""
+    r, store, ev, port = _mk_receiver(tmp_path)
+    data = rng.integers(0, 256, 4000, dtype=np.uint8).tobytes()
+    fp = segment_fingerprint_host(data)
+    ref_wire = dedup_mod.MAGIC + struct.pack("<BI", dedup_mod.VERSION, 1) + dedup_mod._ENTRY.pack(
+        dedup_mod.KIND_REF, fp, len(data)
+    )
+    ref_header = WireProtocolHeader(
+        chunk_id=uuid.uuid4().hex, data_len=len(ref_wire), raw_data_len=len(data), flags=int(ChunkFlags.RECIPE)
+    )
+    r.ref_wait_timeout = 0.2  # don't park the test for the full default wait
+    sock = _connect(port)
+    try:
+        _send_frame(sock, ref_header, ref_wire)
+        sock.settimeout(10.0)
+        assert sock.recv(1) == NACK_UNRESOLVED
+        _assert_not_exposed(store, ref_header.chunk_id)
+        lit_header, lit_wire, _ = _recipe_frame([data], chunk_id=ref_header.chunk_id)
+        _send_frame(sock, lit_header, lit_wire)
+        assert sock.recv(1) == ACK_BYTE
+    finally:
+        sock.close()
+    assert _wait_done(store, ref_header.chunk_id)
+    assert store.chunk_path(ref_header.chunk_id).read_bytes() == data
