@@ -122,6 +122,12 @@ REQUIRED_CHAOS = (
     "chaos_torn_records_dropped",
     "baseline_seconds",
     "chaos_seconds",
+    # gateway-death scenario (requeue-to-survivor, docs/provisioning.md)
+    "gateway_death_ok",
+    "gateway_death_detected",
+    "gateway_death_requeued_chunks",
+    "gateway_death_detect_seconds",
+    "gateway_death_sched_tokens_leaked",
 )
 #: the acceptance floor: a chaos run proves nothing unless it injected faults
 #: across at least this many distinct points of the stack
@@ -163,6 +169,16 @@ def check_chaos(result: dict) -> int:
         return 1
     if result["chaos_fd_growth"] > 64:
         print(f"chaos-smoke: fd count grew by {result['chaos_fd_growth']} (descriptor leak)", file=sys.stderr)
+        return 1
+    if result["gateway_death_ok"] is not True:
+        print(
+            "chaos-smoke: gateway-death scenario failed — "
+            f"detected={result.get('gateway_death_detected')} "
+            f"requeued={result.get('gateway_death_requeued_chunks')} "
+            f"tracker_error={result.get('gateway_death_tracker_error')} "
+            f"tokens_leaked={result.get('gateway_death_sched_tokens_leaked')}",
+            file=sys.stderr,
+        )
         return 1
     if result["chaos_seconds"] > result["chaos_bound_seconds"]:
         print(

@@ -31,6 +31,25 @@ else
   echo "[devloop] lint clean; report at $LOGDIR/lint_findings.json" >>"$LOGDIR/devloop.log"
 fi
 
+# Provisioning-test gate (CPU-only, seconds, zero network): the stubbed-SDK
+# control-plane suite — AWS instance-profile attach, GCP service-account
+# scopes, Azure identity + UnsupportedProviderError, start_gateway
+# credential staging, the provisioning state machine's retry/fallback
+# ladder, the pricing-grid MILP pin test, and the replan monitor
+# (docs/provisioning.md). Like lint: failures are logged LOUDLY but do not
+# block device profiling — the pytest gate is what blocks a merge.
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+  tests/unit/test_provision_lifecycle.py tests/unit/test_pricing_grid.py tests/unit/test_replan.py \
+  tests/unit/test_aws_provider_stubbed.py tests/unit/test_gcp_provider_stubbed.py \
+  tests/unit/test_azure_provider_stubbed.py \
+  >"$LOGDIR/provision_tests.out" 2>&1
+PROVISION_RC=$?
+if [ "$PROVISION_RC" -ne 0 ]; then
+  echo "[devloop] PROVISION-TEST FAILURES (rc=$PROVISION_RC) — control-plane contracts regressed; see $LOGDIR/provision_tests.out" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] provision-tests clean; report at $LOGDIR/provision_tests.out" >>"$LOGDIR/devloop.log"
+fi
+
 # Bench-smoke gate (CPU-only, seconds): bench.py on a tiny corpus — the
 # sender encode bench, the receiver decode bench (decode_gbps +
 # decode_counters), and the loopback sender wire bench (wire_counters:

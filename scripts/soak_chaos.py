@@ -49,7 +49,15 @@ sys.path.insert(0, str(REPO / "tests"))
 import numpy as np  # noqa: E402
 import requests  # noqa: E402
 
-from integration.harness import LocalGateway, make_pair, wait_complete  # noqa: E402
+from integration.harness import (  # noqa: E402
+    HarnessCopyJob,
+    LocalGateway,
+    StubDataplane,
+    bind_gateway,
+    make_pair,
+    start_gateway,
+    wait_complete,
+)
 from skyplane_tpu.chunk import Chunk, ChunkRequest  # noqa: E402
 from skyplane_tpu.faults import FAULTS_ENV, FaultInjector, FaultPlan, configure_injector  # noqa: E402
 from skyplane_tpu.gateway.operators.sender_wire import env_int  # noqa: E402
@@ -177,6 +185,124 @@ def _pool_outstanding(src: LocalGateway, dst: LocalGateway) -> int:
     return total
 
 
+def run_gateway_death_scenario(base: Path, seed: int) -> dict:
+    """Control-plane chaos: kill one of two source gateways mid-transfer and
+    prove requeue-to-survivor (docs/provisioning.md). One source is wedged
+    (operators stopped — its chunks register but never move) so its share of
+    the corpus is deterministically un-acked, then its daemon dies. The
+    REAL TransferProgressTracker must detect the death within the heartbeat
+    deadline, requeue the dead gateway's chunks onto the survivor, and the
+    destination output must be byte-identical — with zero scheduler tokens
+    leaked on the surviving fleet."""
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.tracker import TransferHook, TransferProgressTracker
+
+    class DeathClock(TransferHook):
+        """Stamps the moment the liveness monitor DECLARES the gateway dead —
+        joining the tracker first would fold the whole post-failover
+        re-transfer into the reported detection latency."""
+
+        def __init__(self):
+            self.detected_monotonic = None
+
+        def on_gateway_dead(self, gateway_id: str, requeued_chunks: int) -> None:
+            if self.detected_monotonic is None:
+                self.detected_monotonic = time.monotonic()
+
+    os.environ["SKYPLANE_TPU_HEARTBEAT_DEADLINE_S"] = "2.0"
+    chunk_bytes = 128 << 10
+    n_chunks = 32
+    payload = np.random.default_rng(seed).integers(0, 256, chunk_bytes * n_chunks, dtype=np.uint8).tobytes()
+    tmp = base / "gateway_death"
+    tmp.mkdir()
+    src_file = tmp / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp / "out" / "corpus.bin"
+
+    src_a, dst = make_pair(tmp, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+    info = {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}}
+    program_b = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "num_connections": 2,
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "send",
+                                "target_gateway_id": "gw_dst",
+                                "region": "local:local",
+                                "num_connections": 2,
+                                "compress": "none",
+                                "encrypt": False,
+                                "dedup": False,
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    src_b = start_gateway(program_b, info, "gw_src_b", str(tmp / "src_b_chunks"), use_tls=False)
+    out: dict = {"gateway_death_ok": False}
+    try:
+        for op in src_a.daemon.operators:  # wedge: data plane dead, control API alive
+            op.stop_workers(timeout=5)
+        dp = StubDataplane([bind_gateway(src_a), bind_gateway(src_b)], [bind_gateway(dst)])
+        job = HarnessCopyJob(src_file, out_file, chunk_bytes=chunk_bytes, batch_size=8)
+        clock = DeathClock()
+        tracker = TransferProgressTracker(dp, [job], TransferConfig(compress="none", dedup=False, encrypt_e2e=False), hooks=clock)
+        dp._trackers.append(tracker)
+        tracker.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with tracker._lock:
+                dispatched = len(tracker.dispatched_chunk_ids)
+            if dispatched == n_chunks and "gw_src" in set(job.chunk_targets.values()):
+                break
+            time.sleep(0.05)
+        kill_t0 = time.monotonic()
+        src_a.stop()  # the kill: control port refuses from here on
+        tracker.join(timeout=180)
+        detect_s = None
+        if clock.detected_monotonic is not None:
+            detect_s = round(clock.detected_monotonic - kill_t0, 2)
+        survivors_tokens = sum(
+            sum(usage.values())
+            for gw in (src_b, dst)
+            for usage in gw.daemon.scheduler.usage_snapshot().values()
+        )
+        out.update(
+            gateway_death_detected=bool(tracker.dead_gateway_ids == {"gw_src"}),
+            gateway_death_requeued_chunks=(tracker.failover_events or [{}])[0].get("requeued_chunks", 0),
+            gateway_death_detect_seconds=detect_s,
+            gateway_death_tracker_error=str(tracker.error) if tracker.error else None,
+            gateway_death_sched_tokens_leaked=survivors_tokens,
+            gateway_death_ok=bool(
+                tracker.error is None
+                and not tracker.is_alive()
+                and tracker.dead_gateway_ids == {"gw_src"}
+                and (tracker.failover_events or [{}])[0].get("requeued_chunks", 0) > 0
+                and out_file.exists()
+                and out_file.read_bytes() == payload
+                and survivors_tokens == 0
+            ),
+        )
+    finally:
+        for gw in (src_a, src_b, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — src_a is already dead
+                pass
+        os.environ.pop("SKYPLANE_TPU_HEARTBEAT_DEADLINE_S", None)
+    return out
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seed", type=int, default=1337, help="FaultPlan seed (same seed => same firing schedule)")
@@ -259,6 +385,9 @@ def main() -> int:
             torn_dropped += rec.counters()["index_torn_entries_dropped"]
             rec.close()
 
+    # ---- control-plane scenario: gateway death -> requeue-to-survivor ----
+    death = run_gateway_death_scenario(base, args.seed)
+
     fds_end = open_fd_count()
     slowdown = round(chaos_wall / max(baseline_wall, 1e-9), 3)
     # bounded-recovery gate: a multiple of the fault-free time PLUS a fixed
@@ -291,6 +420,7 @@ def main() -> int:
         "chaos_torn_records_dropped": torn_dropped,
         "baseline_seconds": round(baseline_wall, 3),
         "chaos_seconds": round(chaos_wall, 3),
+        **death,
     }
     print(json.dumps(result))
     return 0

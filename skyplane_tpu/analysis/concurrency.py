@@ -621,6 +621,72 @@ class FlatSleepInRetryLoopChecker(Checker):
         return False
 
 
+_TIME_NOW_CALLS = {"time.time", "time.monotonic", "monotonic"}
+_DEADLINEISH_FRAGMENTS = ("deadline", "timeout", "budget", "expires", "expiry")
+
+
+class UnboundedWaitInProvisionerChecker(Checker):
+    """unbounded-wait-in-provisioner: a ``while`` poll loop (one that sleeps)
+    under ``compute/`` with no deadline bound — the bug class behind the r05
+    rc=124 artifact loss (an unbounded tunnel-lock wait spun until the outer
+    timeout killed the whole run). A cloud API that never converges
+    (operation stuck, instance wedged in PENDING, SSH never up) must surface
+    as a TimeoutError with context, not hang the fleet bring-up forever.
+
+    A loop counts as BOUNDED when a deadline comparison is visible either in
+    the loop test (``while time.time() < deadline:``) or anywhere directly
+    in the loop body (``if time.time() >= deadline: raise``) — a comparison
+    involving ``time.time()``/``time.monotonic()`` or any name containing
+    deadline/timeout/budget/expires. ``for`` loops are iteration-bounded by
+    construction and never flagged; loops that do not sleep (pagination)
+    are not waits."""
+
+    rules = (
+        RuleSpec(
+            "unbounded-wait-in-provisioner",
+            "error",
+            "while-loop polling with time.sleep under compute/ and no visible deadline bound",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        from pathlib import PurePath
+
+        if "compute" not in PurePath(module.path).parts:
+            return
+        for loop in [n for n in ast.walk(module.tree) if isinstance(n, ast.While)]:
+            body_nodes = [n for n in walk_scope(loop) if n is not loop]
+            sleeps = [
+                n
+                for n in body_nodes
+                if isinstance(n, ast.Call) and dotted_name(n.func) in ("time.sleep", "sleep")
+            ]
+            if not sleeps:
+                continue
+            if self._has_deadline_compare(loop.test) or any(self._has_deadline_compare(n) for n in body_nodes):
+                continue
+            yield self.finding(
+                module,
+                "unbounded-wait-in-provisioner",
+                loop,
+                "poll loop sleeps with no deadline bound — compare against time.time()/a deadline and raise TimeoutError",
+            )
+
+    @staticmethod
+    def _has_deadline_compare(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Compare):
+                continue
+            for side in [sub.left, *sub.comparators]:
+                if isinstance(side, ast.Call) and dotted_name(side.func) in _TIME_NOW_CALLS:
+                    return True
+                name = dotted_name(side)
+                terminal = name.split(".")[-1].lower()
+                if any(frag in terminal for frag in _DEADLINEISH_FRAGMENTS):
+                    return True
+        return False
+
+
 CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     SharedStateChecker,
     ThreadLifecycleChecker,
@@ -629,4 +695,5 @@ CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     UnboundedQueueInGatewayChecker,
     BareExceptLoopChecker,
     FlatSleepInRetryLoopChecker,
+    UnboundedWaitInProvisionerChecker,
 )

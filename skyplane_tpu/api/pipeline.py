@@ -70,8 +70,24 @@ class Pipeline:
     def create_dataplane(self, debug: bool = False) -> Dataplane:
         if not self.jobs_to_dispatch:
             raise SkyplaneTpuException("no jobs queued; call queue_copy/queue_sync first")
-        topology = self.planner().plan(self.jobs_to_dispatch)
-        return Dataplane(topology, self.provisioner, self.transfer_config, debug=debug or self.debug)
+        planner = self.planner()
+        topology = planner.plan(self.jobs_to_dispatch)
+        dp = Dataplane(topology, self.provisioner, self.transfer_config, debug=debug or self.debug)
+        # overlay-planned transfers get mid-job replanning: the monitor keeps
+        # the solved MILP inputs and the tracker feeds it sender wire
+        # counters (docs/provisioning.md). Best-effort — scipy may be absent.
+        if getattr(planner, "last_problem", None) is not None:
+            try:
+                from skyplane_tpu.planner.replan import ReplanMonitor
+
+                dp.replanner = ReplanMonitor(
+                    problem=planner.last_problem,
+                    candidate_regions=planner.last_candidates or [],
+                    profile_path=getattr(planner, "profile_path", None),
+                )
+            except Exception as e:  # noqa: BLE001 - advisory subsystem
+                logger.fs.warning(f"replan monitor unavailable: {e}")
+        return dp
 
     def start(
         self,

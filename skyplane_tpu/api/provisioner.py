@@ -12,9 +12,18 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from skyplane_tpu.compute.cloud_provider import CloudProvider, get_cloud_provider
+from skyplane_tpu.compute.lifecycle import ProvisionRecord, ProvisionState, is_capacity_error, provision_candidates
+from skyplane_tpu.utils.envcfg import env_float, env_int
 from skyplane_tpu.compute.server import Server
+from skyplane_tpu.exceptions import CredentialChainException, GatewayContainerStartException, UnsupportedProviderError
+
+# configuration errors no retry can fix: re-raised with their precise type
+# (and remediation text) instead of being burned through the retry ladder
+# and re-wrapped as a generic container-start failure
+_NON_RETRYABLE = (UnsupportedProviderError, CredentialChainException)
 from skyplane_tpu.utils import do_parallel
 from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.utils.retry import RetryPolicy
 
 
 @dataclass
@@ -33,6 +42,7 @@ class Provisioner:
         self._provider_kwargs = provider_kwargs
         self.pending_tasks: List[ProvisionerTask] = []
         self.provisioned: Dict[str, Server] = {}  # task uuid -> server
+        self.records: Dict[str, ProvisionRecord] = {}  # task uuid -> lifecycle record
         self._providers: Dict[str, CloudProvider] = {}
         # (provider, region, ips) firewall authorizations to revoke on teardown
         self._fw_authorized: List[Tuple[str, str, List[str]]] = []
@@ -52,21 +62,93 @@ class Provisioner:
         providers = {t.cloud_provider for t in self.pending_tasks}
         do_parallel(lambda p: self.provider(p).setup_global(), providers, n=4)
 
+    def provision_report(self) -> Dict[str, dict]:
+        """Per-task lifecycle records (state, attempts, transitions) — the
+        timeline a failed fleet bring-up is debugged from."""
+        return {uid: rec.as_dict() for uid, rec in self.records.items()}
+
+    def _provision_one(self, task: ProvisionerTask) -> Server:
+        """One task through the lifecycle state machine: jittered retries
+        with a hard wall-clock deadline, walking the (vm_type, zone)
+        candidate ladder; a launch that boots but never answers SSH is
+        terminated best-effort before the next candidate (docs/provisioning.md).
+        """
+        from skyplane_tpu.faults import get_injector
+
+        provider = self.provider(task.cloud_provider)
+        record = self.records[task.uuid] = ProvisionRecord(task_uuid=task.uuid, region_tag=task.region_tag)
+        candidates = provision_candidates(
+            task.cloud_provider, task.vm_type, provider.fallback_zones(task.region_tag)
+        )
+        policy = RetryPolicy(
+            max_attempts=env_int("SKYPLANE_TPU_PROVISION_ATTEMPTS", 3),
+            initial_backoff=2.0,
+            max_backoff=30.0,
+            jitter=0.5,
+            deadline_s=env_float("SKYPLANE_TPU_PROVISION_DEADLINE_S", 900.0),
+            retry_if=lambda e: not isinstance(e, _NON_RETRYABLE),
+        )
+        # advances only on capacity/quota failures: a transient error (IAM
+        # propagation, throttle, slow SSH) retries the SAME candidate, so the
+        # fleet is never silently downgraded below the planner's sizing
+        candidate_idx = {"i": 0}
+
+        def launch_once() -> Server:
+            vm_type, zone = candidates[min(candidate_idx["i"], len(candidates) - 1)]
+            record.begin_attempt(vm_type, zone)
+            server: Optional[Server] = None
+            try:
+                # control-plane fault point (docs/fault-injection.md):
+                # deterministic chaos for the retry/fallback ladder
+                get_injector().check("provision.launch", exc=OSError, msg="injected fault at provision.launch")
+                kw = {"zone": zone} if zone is not None else {}
+                server = provider.provision_instance(task.region_tag, vm_type, tags=task.tags, **kw)
+                record.to(ProvisionState.BOOTING)
+                if hasattr(server, "wait_for_ssh_ready"):
+                    server.wait_for_ssh_ready()
+                if hasattr(server, "install_autoshutdown"):
+                    server.install_autoshutdown(self.autoshutdown_minutes)
+            except Exception as e:
+                if is_capacity_error(e):
+                    candidate_idx["i"] += 1
+                final = len(record.attempts) >= policy.max_attempts or isinstance(e, _NON_RETRYABLE)
+                record.fail_attempt(e, final=final)
+                if server is not None:
+                    # a VM that launched but never became reachable must not
+                    # leak (it would bill until autoshutdown, if that even
+                    # installed) — terminate best-effort before the retry
+                    try:
+                        server.terminate_instance()
+                    except Exception as te:  # noqa: BLE001
+                        logger.fs.warning(f"terminate of half-provisioned {task.region_tag} failed: {te}")
+                logger.fs.warning(
+                    f"provision attempt {len(record.attempts)} for {task.region_tag} "
+                    f"({vm_type or 'default-vm'}{'@' + zone if zone else ''}) failed: {e}"
+                )
+                raise
+            record.succeed()
+            return server
+
+        try:
+            return policy.call(launch_once, log_errors=False)
+        except _NON_RETRYABLE:
+            if record.state is not ProvisionState.FAILED:
+                record.to(ProvisionState.FAILED)
+            raise  # precise type + remediation text intact for the caller
+        except Exception as e:
+            if record.state is not ProvisionState.FAILED:
+                record.to(ProvisionState.FAILED)
+            raise GatewayContainerStartException(
+                f"provisioning {task.region_tag} failed after {len(record.attempts)} attempt(s):\n{record.history()}"
+            ) from e
+
     def provision(self) -> Dict[str, Server]:
         """Provision all pending tasks in parallel; returns task uuid -> server
         (reference :165-316)."""
         regions = {(t.cloud_provider, t.region_tag) for t in self.pending_tasks}
         do_parallel(lambda pr: self.provider(pr[0]).setup_region(pr[1].split(":", 1)[-1]), regions, n=8)
 
-        def provision_task(task: ProvisionerTask) -> Tuple[str, Server]:
-            server = self.provider(task.cloud_provider).provision_instance(task.region_tag, task.vm_type, tags=task.tags)
-            if hasattr(server, "wait_for_ssh_ready"):
-                server.wait_for_ssh_ready()
-            if hasattr(server, "install_autoshutdown"):
-                server.install_autoshutdown(self.autoshutdown_minutes)
-            return task.uuid, server
-
-        results = do_parallel(lambda t: provision_task(t), self.pending_tasks, n=16)
+        results = do_parallel(lambda t: (t.uuid, self._provision_one(t)), self.pending_tasks, n=16)
         for _, (task_uuid, server) in results:
             self.provisioned[task_uuid] = server
 

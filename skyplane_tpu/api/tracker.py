@@ -8,6 +8,7 @@ chunk status, surfaces gateway errors as GatewayException, then finalizes
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Set
@@ -16,6 +17,7 @@ import requests
 
 from skyplane_tpu.api.config import TransferConfig
 from skyplane_tpu.exceptions import GatewayException, SkyplaneTpuException, TransferFailedException
+from skyplane_tpu.utils.envcfg import env_float
 from skyplane_tpu.utils.logger import logger
 from skyplane_tpu.utils.retry import retry_backoff
 
@@ -34,6 +36,14 @@ class TransferHook:
     def on_transfer_end(self) -> None: ...
 
     def on_transfer_error(self, error: Exception) -> None: ...
+
+    def on_gateway_dead(self, gateway_id: str, requeued_chunks: int) -> None:
+        """A source gateway was declared dead and its pending chunks were
+        re-dispatched onto survivors (docs/provisioning.md)."""
+
+    def on_replan(self, decision) -> None:
+        """The replan monitor flagged a congested hop and re-solved
+        (planner/replan.py); ``decision`` is a ReplanDecision."""
 
 
 class EmptyTransferHook(TransferHook):
@@ -56,6 +66,20 @@ class TransferProgressTracker(threading.Thread):
         self.complete_chunk_ids: Set[str] = set()
         self.transfer_stats: Optional[dict] = None  # filled on success
         self._unreachable_streaks: Dict[str, Dict[str, int]] = {}  # gid -> per-class counters
+        self._unreachable_since: Dict[str, Dict[str, float]] = {}  # gid -> class -> first-failure monotonic
+        # gateway liveness / failover (docs/provisioning.md): a SOURCE
+        # gateway continuously unreachable past the heartbeat deadline is
+        # declared dead and its pending chunks requeue onto survivors
+        self.heartbeat_deadline_s = env_float("SKYPLANE_TPU_HEARTBEAT_DEADLINE_S", 30.0)
+        self.failover_enabled = os.environ.get("SKYPLANE_TPU_GATEWAY_FAILOVER", "1") != "0"
+        self.dead_gateway_ids: Set[str] = set()
+        self.failover_events: List[dict] = []
+        # trace-informed replanning (planner/replan.py): when the dataplane
+        # carries a ReplanMonitor, source-gateway wire counters are polled on
+        # a slow cadence and congested-hop decisions surface as replan_events
+        self.replan_events: List[dict] = []
+        self.replan_poll_s = env_float("SKYPLANE_TPU_REPLAN_POLL_S", 5.0)
+        self._last_replan_poll = 0.0
         self._lock = threading.Lock()
 
     # ---- queries (reference: tracker.py:372-399) ----
@@ -131,7 +155,8 @@ class TransferProgressTracker(threading.Thread):
             except requests.RequestException:
                 return None
 
-        profiles = [p for _, p in do_parallel(poll, self.dataplane.source_gateways(), n=16)]
+        sources = [g for g in self.dataplane.source_gateways() if g.gateway_id not in self.dead_gateway_ids]
+        profiles = [p for _, p in do_parallel(poll, sources, n=16)]
         if any(p is None for p in profiles):
             return None
         return {
@@ -237,7 +262,15 @@ class TransferProgressTracker(threading.Thread):
     UNREACHABLE_STREAK_LIMIT = 30
 
     def _check_gateway_errors(self) -> None:
-        errors = self.dataplane.check_error_logs()
+        # a gateway already declared dead is no longer part of the fleet:
+        # excluded BEFORE the poll (its timeouts would slow every wave), and
+        # its errors must not re-trigger detection or count toward the
+        # all-timeout denominator
+        try:
+            errors = self.dataplane.check_error_logs(exclude=self.dead_gateway_ids)
+        except TypeError:  # older stub dataplanes without the exclude param
+            errors = self.dataplane.check_error_logs()
+        errors = {gid: errs for gid, errs in errors.items() if gid not in self.dead_gateway_ids}
         real = {gid: errs for gid, errs in errors.items() if any(not e.startswith("(error endpoint") for e in errs)}
         if real:
             gid, errs = next(iter(real.items()))
@@ -247,9 +280,10 @@ class TransferProgressTracker(threading.Thread):
         # classes (markers from BoundGateway.errors):
         #   refused — definitive death signal, short streak limit
         #   timeout — ambiguous (GIL/IO-busy gateway under load, or a real
-        #             partition): 10x the limit, and never counted when EVERY
-        #             gateway times out at once (all-timeout = client-side
-        #             outage or the whole fleet busy — either way, not death)
+        #             partition): 10x the limit/deadline, and never counted
+        #             when EVERY gateway times out at once (all-timeout =
+        #             client-side outage or the whole fleet busy — either
+        #             way, not death)
         refused = {
             gid for gid, errs in errors.items() if errs and all(e.startswith("(error endpoint unreachable") for e in errs)
         }
@@ -261,23 +295,121 @@ class TransferProgressTracker(threading.Thread):
         # when EVERY gateway times out at once, skip COUNTING timeouts this
         # poll (fleet-wide busy moment or client outage) but do NOT reset
         # accumulated streaks — a partitioned gateway must still converge
-        all_timeout_moment = len(timeouts) == len(self.dataplane.bound_gateways) > 1
+        alive = len(self.dataplane.bound_gateways) - len(self.dead_gateway_ids)
+        all_timeout_moment = len(timeouts) == alive > 1
         # streaks are per failure CLASS: mixing them would let 30 timeout polls
         # plus one refused poll trip the short refused limit instantly
+        now = time.monotonic()
         for gid in list(self._unreachable_streaks):
             if gid not in refused and gid not in timeouts:
                 del self._unreachable_streaks[gid]
+                self._unreachable_since.pop(gid, None)
         for gid in refused | (set() if all_timeout_moment else timeouts):
             cls = "refused" if gid in refused else "timeout"
             streaks = self._unreachable_streaks.setdefault(gid, {"refused": 0, "timeout": 0})
             streaks[cls] += 1
             streaks["refused" if cls == "timeout" else "timeout"] = 0
+            since = self._unreachable_since.setdefault(gid, {})
+            since.setdefault(cls, now)
+            since.pop("refused" if cls == "timeout" else "timeout", None)
+            # dead when the poll-count streak trips OR the gateway has been
+            # CONTINUOUSLY unreachable past the heartbeat deadline (>=2
+            # observations so one blip can never kill) — the deadline gives
+            # a bounded detection window however slow the poll cadence is
             limit = self.UNREACHABLE_STREAK_LIMIT * (10 if cls == "timeout" else 1)
-            if streaks[cls] >= limit:
-                raise GatewayException(
-                    f"gateway {gid} unreachable ({cls}) for {streaks[cls]} consecutive polls (crashed or partitioned)",
-                    gateway_id=gid,
-                )
+            deadline = self.heartbeat_deadline_s * (10 if cls == "timeout" else 1)
+            if streaks[cls] >= limit or (streaks[cls] >= 2 and now - since[cls] >= deadline):
+                self._handle_dead_gateway(gid, cls, streaks[cls])
+
+    def _handle_dead_gateway(self, gid: str, cls: str, streak: int) -> None:
+        """A gateway is dead. A source gateway with surviving peers fails
+        over: it leaves the fleet and its un-acked chunks re-dispatch through
+        the requeue machinery; completion stays sink-measured, so chunks that
+        landed before the death are never re-sent. A dead sink (or the last
+        source) still fails the transfer loudly."""
+        source_ids = {g.gateway_id for g in self.dataplane.source_gateways()}
+        survivors = source_ids - self.dead_gateway_ids - {gid}
+        if not (self.failover_enabled and gid in source_ids and survivors):
+            raise GatewayException(
+                f"gateway {gid} unreachable ({cls}) for {streak} consecutive polls (crashed or partitioned)",
+                gateway_id=gid,
+            )
+        self.dead_gateway_ids.add(gid)
+        self._unreachable_streaks.pop(gid, None)
+        self._unreachable_since.pop(gid, None)
+        with self._lock:
+            pending = [cid for cid in self.dispatched_chunk_ids if cid not in self.complete_chunk_ids]
+        requeued = 0
+        for job in self.jobs:
+            if hasattr(job, "requeue_chunks"):
+                requeued += job.requeue_chunks(self.dataplane, pending, self.dead_gateway_ids)
+        event = {
+            "gateway_id": gid,
+            "failure_class": cls,
+            "streak": streak,
+            "requeued_chunks": requeued,
+            "survivors": sorted(survivors),
+        }
+        self.failover_events.append(event)
+        logger.fs.warning(
+            f"[tracker] source gateway {gid} declared dead ({cls}); requeued {requeued} pending chunks "
+            f"onto {len(survivors)} surviving gateway(s)"
+        )
+        self.hooks.on_gateway_dead(gid, requeued)
+
+    def _next_hop_region(self, gateway_id: str) -> str:
+        """The region this gateway's sender wire counters actually measure:
+        its program's send-op target. In an overlay (src→relay→dst) the
+        source's counters describe the src→relay hop — labeling them with
+        the final destination would make the replan monitor derate the wrong
+        edge. Falls back to the first destination region for topologies the
+        tracker cannot introspect (stub dataplanes, no send op)."""
+        fallback = self.dataplane.dst_region_tags[0]
+        topology = getattr(self.dataplane, "topology", None)
+        if topology is None:
+            return fallback
+        try:
+            for target_id in topology.get_outgoing_paths(gateway_id):
+                target = topology.gateways.get(target_id)
+                if target is not None:
+                    return target.region_tag
+        except Exception:  # noqa: BLE001 - advisory subsystem, never fatal
+            pass
+        return fallback
+
+    def _maybe_replan(self) -> None:
+        """Feed the dataplane's ReplanMonitor (if any) a wave of sender wire
+        counters from live source gateways. Congestion decisions are
+        advisory: logged, recorded, surfaced via hooks.on_replan — never a
+        transfer failure."""
+        monitor = getattr(self.dataplane, "replanner", None)
+        if monitor is None:
+            return
+        now = time.monotonic()
+        if now - self._last_replan_poll < self.replan_poll_s:
+            return
+        self._last_replan_poll = now
+        samples: Dict[str, tuple] = {}
+        for gw in self.dataplane.source_gateways():
+            if gw.gateway_id in self.dead_gateway_ids:
+                continue
+            try:
+                prof = gw.control_session().get(f"{gw.control_url()}/profile/socket/sender", timeout=5).json()
+            except (requests.RequestException, ValueError):
+                continue  # liveness is _check_gateway_errors' job
+            counters = prof.get("counters") if isinstance(prof, dict) else None
+            if isinstance(counters, dict):
+                samples[gw.gateway_id] = (gw.region_tag, self._next_hop_region(gw.gateway_id), counters)
+        if not samples:
+            return
+        try:
+            decision = monitor.observe(samples)
+        except Exception as e:  # noqa: BLE001 - advisory subsystem
+            logger.fs.warning(f"[tracker] replan monitor failed: {e}")
+            return
+        if decision is not None:
+            self.replan_events.append(decision.as_dict())
+            self.hooks.on_replan(decision)
 
     def _monitor_to_completion(self, timeout_s: float = 24 * 3600) -> None:
         """Poll sink gateways until every dispatched chunk lands at every
@@ -298,6 +430,7 @@ class TransferProgressTracker(threading.Thread):
         poll_interval = self.POLL_INTERVAL_S
         while time.time() < deadline:
             self._check_gateway_errors()
+            self._maybe_replan()
             # narrow polls to the still-pending set (one shared params dict
             # per wave, not per gateway): the daemon's cumulative status map
             # grows with every chunk it has ever seen, and full-map polls
@@ -329,6 +462,8 @@ class TransferProgressTracker(threading.Thread):
                 for job in self.jobs:
                     if hasattr(job, "journal_mark_done"):
                         job.journal_mark_done(newly)  # resume journal (no-op when off)
+                    if hasattr(job, "release_requeue_state"):
+                        job.release_requeue_state(newly)  # failover state is O(in-flight)
                 reported_complete |= newly
             if target and target <= all_complete:
                 return

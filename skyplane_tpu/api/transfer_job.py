@@ -332,6 +332,59 @@ class TransferJob:
         self.transfer_list: List[TransferPair] = []
         self._src_iface: Optional[StorageInterface] = None
         self._dst_ifaces: Optional[List[StorageInterface]] = None
+        # gateway-failover bookkeeping (docs/provisioning.md): which source
+        # gateway each pending chunk was dispatched to, and the serialized
+        # request bodies needed to re-dispatch them if that gateway dies.
+        # Entries are dropped as chunks complete (release_requeue_state), so
+        # steady-state memory is O(in-flight), not O(corpus).
+        self.chunk_targets: Dict[str, str] = {}
+        self._request_bodies: Dict[str, dict] = {}
+
+    def release_requeue_state(self, chunk_ids) -> None:
+        """Called by the tracker as chunks land at every destination: a
+        completed chunk can never need re-dispatch."""
+        for cid in chunk_ids:
+            self.chunk_targets.pop(cid, None)
+            self._request_bodies.pop(cid, None)
+
+    def requeue_chunks(self, dataplane, pending_chunk_ids, exclude_gateway_ids) -> int:
+        """Re-dispatch this job's pending chunks whose source gateway is in
+        ``exclude_gateway_ids`` onto surviving source gateways (the tracker's
+        dead-gateway failover). Chunk ids are reused verbatim — gateway
+        registration is idempotent and completion is measured at the sinks,
+        so a chunk that actually landed before the death is simply never
+        polled as pending again. Returns the number of chunks re-dispatched."""
+        mine = [
+            cid
+            for cid in pending_chunk_ids
+            if self.chunk_targets.get(cid) in exclude_gateway_ids and cid in self._request_bodies
+        ]
+        survivors = [g for g in dataplane.source_gateways() if g.gateway_id not in exclude_gateway_ids]
+        if not mine or not survivors:
+            return 0
+        session = survivors[0].control_session()
+        for start in range(0, len(mine), 100):
+            batch = mine[start : start + 100]
+            bodies = [self._request_bodies[cid] for cid in batch]
+
+            def _repost():
+                target = min(survivors, key=lambda g: g.queue_depth())
+                resp = session.post(f"{target.control_url()}/chunk_requests", json=bodies, timeout=60)
+                resp.raise_for_status()
+                return target
+
+            target = retry_backoff(
+                _repost,
+                max_retries=4,
+                initial_backoff=0.5,
+                max_backoff=4.0,
+                jitter=0.5,
+                deadline_s=120.0,
+                exception_class=(requests.RequestException,),
+            )
+            for cid in batch:
+                self.chunk_targets[cid] = target.gateway_id
+        return len(mine)
 
     @property
     def src_prefix(self) -> str:
@@ -440,18 +493,22 @@ class CopyJob(TransferJob):
             # flush any multipart upload-id mappings to every sink gateway first
             self._flush_upload_ids(session, sink_gateways)
             reqs = [self._to_request(c, dataplane) for c in batch]
-            target = min(src_gateways, key=lambda g: g.queue_depth())
             body = [r.as_dict() for r in reqs]
 
-            def _post_chunk_requests() -> None:
+            def _post_chunk_requests():
+                # target re-picked per attempt: a gateway that died between
+                # waves must not eat the whole retry budget (its queue_depth
+                # sorts unreachable gateways last)
+                target = min(src_gateways, key=lambda g: g.queue_depth())
                 resp = session.post(f"{target.control_url()}/chunk_requests", json=body, timeout=60)
                 resp.raise_for_status()
+                return target
 
             # jittered + deadline-bounded (utils/retry.py): concurrent
             # dispatchers retrying a briefly-unavailable gateway must not
             # re-collide, and a gateway that stays down fails the dispatch
             # within a bounded window instead of compounding flat sleeps
-            retry_backoff(
+            target = retry_backoff(
                 _post_chunk_requests,
                 max_retries=4,
                 initial_backoff=0.5,
@@ -460,6 +517,11 @@ class CopyJob(TransferJob):
                 deadline_s=120.0,
                 exception_class=(requests.RequestException,),
             )
+            # failover bookkeeping: remember where each chunk went and how to
+            # re-dispatch it (released as chunks complete)
+            for chunk, req_body in zip(batch, body):
+                self.chunk_targets[chunk.chunk_id] = target.gateway_id
+                self._request_bodies[chunk.chunk_id] = req_body
             self._dispatched_chunks.extend(batch)
             yield from batch
         self._flush_upload_ids(session, sink_gateways)

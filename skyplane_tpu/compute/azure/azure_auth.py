@@ -1,18 +1,28 @@
 """Azure authentication (DefaultAzureCredential + subscription binding).
 
-Reference parity: skyplane/compute/azure/azure_auth.py.
+Reference parity: skyplane/compute/azure/azure_auth.py. Earlier rounds
+carried a silent half-implementation: a provider with no subscription or SDK
+would construct fine and then fail ~10 minutes into provisioning with an
+opaque SDK error. :meth:`require` is the loud replacement — called at
+provision time, it raises :class:`UnsupportedProviderError` with precise
+remediation the moment Azure cannot actually be used.
 """
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import Optional
+
+from skyplane_tpu.exceptions import UnsupportedProviderError
 
 
 class AzureAuthentication:
     def __init__(self, config=None):
         self.config = config
-        self.subscription_id: Optional[str] = getattr(config, "azure_subscription_id", None)
+        self.subscription_id: Optional[str] = (
+            getattr(config, "azure_subscription_id", None) or os.environ.get("AZURE_SUBSCRIPTION_ID") or None
+        )
 
     @lru_cache(maxsize=1)
     def credential(self):
@@ -35,8 +45,42 @@ class AzureAuthentication:
 
         return ResourceManagementClient(self.credential(), self.subscription_id)
 
+    def authorization_client(self):
+        from azure.mgmt.authorization import AuthorizationManagementClient
+
+        return AuthorizationManagementClient(self.credential(), self.subscription_id)
+
     def enabled(self) -> bool:
         try:
             return self.subscription_id is not None and self.credential() is not None
         except Exception:  # noqa: BLE001
             return False
+
+    def require(self, action: str) -> None:
+        """Fail LOUDLY and immediately when Azure is not usable, naming the
+        missing piece — never let a half-configured client reach the SDK."""
+        if self.subscription_id is None:
+            raise UnsupportedProviderError(
+                f"cannot {action}: no Azure subscription is configured",
+                remediation=(
+                    "set AZURE_SUBSCRIPTION_ID (or azure_subscription_id via `skyplane-tpu init`); "
+                    "find yours with `az account show --query id`"
+                ),
+            )
+        try:
+            cred = self.credential()
+        except ImportError as e:
+            raise UnsupportedProviderError(
+                f"cannot {action}: the azure-identity SDK is not installed",
+                remediation="pip install azure-identity azure-mgmt-compute azure-mgmt-network azure-mgmt-resource",
+            ) from e
+        except Exception as e:  # noqa: BLE001 - DefaultAzureCredential chain failed
+            raise UnsupportedProviderError(
+                f"cannot {action}: no Azure credential resolved ({e})",
+                remediation="run `az login`, or set AZURE_CLIENT_ID/AZURE_TENANT_ID/AZURE_CLIENT_SECRET",
+            ) from e
+        if cred is None:
+            raise UnsupportedProviderError(
+                f"cannot {action}: DefaultAzureCredential resolved to nothing",
+                remediation="run `az login`, or set AZURE_CLIENT_ID/AZURE_TENANT_ID/AZURE_CLIENT_SECRET",
+            )

@@ -119,7 +119,16 @@ class AzureCloudProvider(CloudProvider):
                 },
             ).result()
 
+    def gateway_credential_payload(self, hosted_provider: str):
+        from skyplane_tpu.compute.credentials import azure_gateway_credentials
+
+        return azure_gateway_credentials(self.auth, hosted_provider)
+
     def provision_instance(self, region_tag: str, vm_type: Optional[str] = None, tags: Optional[dict] = None) -> AzureServer:
+        # loud precondition: a missing subscription/credential raises
+        # UnsupportedProviderError with remediation NOW, not as an opaque SDK
+        # error minutes into VM creation (the old 42-line auth stub's failure mode)
+        self.auth.require("provision Azure gateway VMs")
         region = region_tag.split(":")[-1]
         name = f"skyplane-tpu-{uuid.uuid4().hex[:8]}"
         key_path = self.ensure_keypair()
@@ -147,6 +156,9 @@ class AzureCloudProvider(CloudProvider):
         ).result()
         vm_params = {
             "location": region,
+            # the gateway's Blob credential: a system-assigned managed
+            # identity (role granted best-effort below; VERDICT missing #1)
+            "identity": {"type": "SystemAssigned"},
             "tags": {TAG: "true", **(tags or {})},
             "hardware_profile": {"vm_size": vm_type or "Standard_D32_v5"},
             "storage_profile": {
@@ -171,8 +183,35 @@ class AzureCloudProvider(CloudProvider):
         if self.use_spot:
             vm_params["priority"] = "Spot"
             vm_params["eviction_policy"] = "Delete"
-        compute.virtual_machines.begin_create_or_update(RESOURCE_GROUP, name, vm_params).result()
+        vm = compute.virtual_machines.begin_create_or_update(RESOURCE_GROUP, name, vm_params).result()
+        self._grant_storage_role(vm)
         return AzureServer(self.auth, region, name, ip.ip_address, nic.ip_configurations[0].private_ip_address, str(key_path))
+
+    def _grant_storage_role(self, vm) -> None:
+        """Grant the VM's managed identity Storage Blob Data Contributor on
+        the subscription (best-effort: the SDK extra may be absent, or the
+        operator may prefer a narrower storage-account-scoped grant — the
+        warning names the exact manual command either way)."""
+        principal = getattr(getattr(vm, "identity", None), "principal_id", None)
+        if not principal:
+            logger.fs.warning("azure VM has no managed-identity principal; blob access must be granted manually")
+            return
+        # Storage Blob Data Contributor built-in role definition id
+        role_def = (
+            f"/subscriptions/{self.auth.subscription_id}/providers/Microsoft.Authorization/"
+            "roleDefinitions/ba92f5b4-2d11-453d-a403-e96b0029c9fe"
+        )
+        try:
+            self.auth.authorization_client().role_assignments.create(
+                f"/subscriptions/{self.auth.subscription_id}",
+                str(uuid.uuid4()),
+                {"role_definition_id": role_def, "principal_id": principal, "principal_type": "ServicePrincipal"},
+            )
+        except Exception as e:  # noqa: BLE001 - already assigned / SDK extra missing
+            logger.fs.warning(
+                f"azure role assignment for gateway identity failed ({e}); grant manually with: "
+                f"az role assignment create --assignee {principal} --role 'Storage Blob Data Contributor'"
+            )
 
     @staticmethod
     def _peer_rule_name(ips: list) -> str:

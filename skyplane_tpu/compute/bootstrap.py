@@ -23,6 +23,7 @@ bootstrap modes now exist, mirroring the reference's docker-based
 from __future__ import annotations
 
 import hashlib
+import shlex
 import shutil
 import subprocess
 import sys
@@ -146,13 +147,20 @@ def docker_bootstrap_commands(image: str) -> list:
     ]
 
 
-def docker_run_command(image: str, daemon_args: str, tmpfs_gb: int = 8) -> str:
+def docker_run_command(image: str, daemon_args: str, tmpfs_gb: int = 8, env_file: Optional[str] = None) -> str:
     """Run the gateway container with host networking and the gateway state
-    dir mounted (program/info/key files live in REMOTE_ROOT on the host)."""
+    dir mounted (program/info/key files live in REMOTE_ROOT on the host).
+    ``env_file`` points at the 0600 credential env file staged under the
+    creds dir (credential FILES ride the REMOTE_ROOT bind mount — reference:
+    server.py:324-360). Secret VALUES must never appear in this command:
+    it is logged by run_command, embedded into exceptions on failure, and
+    visible in the remote shell's ps/cmdline."""
+    env_flags = f"--env-file {shlex.quote(env_file)} " if env_file else ""
     return (
         "sudo docker rm -f skyplane_tpu_gateway 2>/dev/null || true; "
         "sudo docker run -d --name skyplane_tpu_gateway --network=host "
         "--ulimit nofile=1048576:1048576 "
+        f"{env_flags}"
         f"--mount type=bind,source={REMOTE_ROOT},target={REMOTE_ROOT} "
         f"--tmpfs {REMOTE_ROOT}/chunks:size={tmpfs_gb}g "
         f"{image} python -m skyplane_tpu.gateway.gateway_daemon {daemon_args}"

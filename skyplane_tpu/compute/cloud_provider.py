@@ -46,6 +46,22 @@ class CloudProvider:
 
     def deauthorize_gateway_ips(self, region: str, ips: List[str]) -> None: ...
 
+    # ---- gateway credential chain (docs/provisioning.md) ----
+    def gateway_credential_payload(self, hosted_provider: str):
+        """Credential material a gateway hosted on ``hosted_provider`` needs
+        to reach THIS provider's object store. Empty when access is ambient
+        (same cloud: instance profile / SA scopes / managed identity) or the
+        provider has no object store to protect (local/test)."""
+        from skyplane_tpu.compute.credentials import EMPTY_PAYLOAD
+
+        return EMPTY_PAYLOAD
+
+    # ---- provisioning fallback surface (compute/lifecycle.py walks these) ----
+    def fallback_zones(self, region_tag: str) -> List[str]:
+        """Alternate placement zones within a region for capacity fallback
+        (empty = the provider places instances itself)."""
+        return []
+
 
 def get_cloud_provider(provider: str, **kw) -> CloudProvider:
     if provider == "local" or provider == "test":

@@ -1,0 +1,153 @@
+"""Gateway object-store credential payloads.
+
+A provisioned gateway must be able to reach the source and destination
+object stores. On its OWN cloud a gateway authenticates ambiently — the AWS
+instance profile (aws_cloud_provider.ensure_instance_profile), the GCP VM
+service-account scopes, the Azure system-assigned managed identity. For
+every OTHER storage provider in the topology, the client ships explicit
+credential material at ``start_gateway`` time (reference:
+skyplane/compute/server.py:324-360 passes per-cloud env/config into the
+gateway container): env vars and small credential files, written 0600 under
+the gateway's private ``creds/`` directory.
+
+The payload is assembled client-side in ``Dataplane.provision`` (one merged
+payload per gateway, covering exactly the storage providers its program
+touches minus its own ambient cloud) and threaded through
+``Server.start_gateway`` — SSH VMs get ``env`` exports on the daemon launch
+line plus files under ``REMOTE_ROOT/creds``; docker mode gets ``-e`` flags;
+local subprocess gateways get a merged ``os.environ``.
+
+Env values may reference ``{creds_dir}`` — resolved to the concrete
+credential directory only at start_gateway time, since the client does not
+know the remote layout.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Optional
+
+from skyplane_tpu.exceptions import CredentialChainException
+
+
+@dataclass
+class GatewayCredentialPayload:
+    """Env + file credential material for one gateway daemon."""
+
+    env: Dict[str, str] = field(default_factory=dict)
+    files: Dict[str, bytes] = field(default_factory=dict)  # relative name -> content
+
+    def is_empty(self) -> bool:
+        return not self.env and not self.files
+
+    def merge(self, other: "GatewayCredentialPayload") -> "GatewayCredentialPayload":
+        """Combine payloads for different storage providers; duplicate keys
+        are a bug (two providers must never claim the same env var/file)."""
+        dup_env = set(self.env) & set(other.env)
+        dup_files = set(self.files) & set(other.files)
+        if dup_env or dup_files:
+            raise CredentialChainException(
+                f"conflicting credential payload keys: env={sorted(dup_env)} files={sorted(dup_files)}"
+            )
+        return GatewayCredentialPayload(env={**self.env, **other.env}, files={**self.files, **other.files})
+
+    def resolved_env(self, creds_dir: str) -> Dict[str, str]:
+        """Env with ``{creds_dir}`` placeholders bound to the real path."""
+        return {k: v.replace("{creds_dir}", creds_dir) for k, v in self.env.items()}
+
+    def summary(self) -> str:
+        """Loggable description that never includes secret values."""
+        return f"env[{', '.join(sorted(self.env))}] files[{', '.join(sorted(self.files))}]"
+
+
+EMPTY_PAYLOAD = GatewayCredentialPayload()
+
+
+# ---- per-provider builders (called via CloudProvider.gateway_credential_payload) ----
+
+
+def aws_gateway_credentials(auth, hosted_provider: str) -> GatewayCredentialPayload:
+    """S3 access for a gateway hosted on ``hosted_provider``. On AWS the
+    instance profile is the credential (nothing to ship — and long-lived
+    keys must NOT ride to VMs that already have a role); elsewhere the
+    client's own session credentials are exported."""
+    if hosted_provider == "aws":
+        return EMPTY_PAYLOAD
+    creds = auth.get_boto3_session().get_credentials()
+    if creds is None:
+        raise CredentialChainException(
+            "no AWS credentials available to ship to a non-AWS gateway that must reach S3; "
+            "run `aws configure` (or set AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY) on the client"
+        )
+    frozen = creds.get_frozen_credentials()
+    env = {"AWS_ACCESS_KEY_ID": frozen.access_key, "AWS_SECRET_ACCESS_KEY": frozen.secret_key}
+    if frozen.token:
+        env["AWS_SESSION_TOKEN"] = frozen.token
+    return GatewayCredentialPayload(env=env)
+
+
+def gcp_adc_path() -> Optional[Path]:
+    """The application-default-credentials file this client would use."""
+    explicit = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS")
+    if explicit and Path(explicit).exists():
+        return Path(explicit)
+    default = Path.home() / ".config" / "gcloud" / "application_default_credentials.json"
+    return default if default.exists() else None
+
+
+def gcp_gateway_credentials(auth, hosted_provider: str) -> GatewayCredentialPayload:
+    """GCS access: ambient on GCP (the VM's service-account scopes);
+    elsewhere the client's ADC json file rides along and
+    GOOGLE_APPLICATION_CREDENTIALS points the daemon at it."""
+    if hosted_provider == "gcp":
+        return EMPTY_PAYLOAD
+    adc = gcp_adc_path()
+    if adc is None:
+        raise CredentialChainException(
+            "no GCP application-default credentials to ship to a non-GCP gateway that must reach GCS; "
+            "run `gcloud auth application-default login` (or set GOOGLE_APPLICATION_CREDENTIALS) on the client"
+        )
+    return GatewayCredentialPayload(
+        env={"GOOGLE_APPLICATION_CREDENTIALS": "{creds_dir}/gcp_adc.json"},
+        files={"gcp_adc.json": adc.read_bytes()},
+    )
+
+
+def build_provider_payload(provider, storage_provider: str, hosted_provider: str) -> GatewayCredentialPayload:
+    """One provider's payload for one gateway, through the ``provision.auth``
+    fault point (docs/fault-injection.md) — chaos plans can make credential
+    assembly fail transiently to exercise the provisioner's retry path."""
+    from skyplane_tpu.faults import get_injector
+
+    inj = get_injector()
+    if inj.enabled:
+        # OSError = transient auth-infrastructure failure (STS hiccup, ADC
+        # read error); a GENUINE missing credential raises
+        # CredentialChainException, which callers must not retry
+        inj.check("provision.auth", exc=OSError, msg=f"injected fault at provision.auth ({storage_provider})")
+    return provider.gateway_credential_payload(hosted_provider)
+
+
+_AZURE_SP_VARS = ("AZURE_CLIENT_ID", "AZURE_TENANT_ID", "AZURE_CLIENT_SECRET")
+
+
+def azure_gateway_credentials(auth, hosted_provider: str) -> GatewayCredentialPayload:
+    """Blob access: ambient on Azure (system-assigned managed identity);
+    elsewhere a service-principal triple from the client env is forwarded
+    (DefaultAzureCredential on the gateway picks it up)."""
+    if hosted_provider == "azure":
+        return EMPTY_PAYLOAD
+    present = {v: os.environ.get(v, "") for v in _AZURE_SP_VARS}
+    if all(present.values()):
+        env = dict(present)
+        sub = getattr(auth, "subscription_id", None) or os.environ.get("AZURE_SUBSCRIPTION_ID")
+        if sub:
+            env["AZURE_SUBSCRIPTION_ID"] = sub
+        return GatewayCredentialPayload(env=env)
+    raise CredentialChainException(
+        "no Azure service principal in the client environment to ship to a non-Azure gateway that must "
+        "reach Blob storage; set AZURE_CLIENT_ID/AZURE_TENANT_ID/AZURE_CLIENT_SECRET (e.g. from "
+        "`az ad sp create-for-rbac --role 'Storage Blob Data Contributor'`)"
+    )

@@ -1,0 +1,163 @@
+"""Gateway provisioning lifecycle: an explicit, inspectable state machine.
+
+Provisioning a cross-cloud fleet fails in mundane ways — a zone out of
+capacity, an IAM instance profile still propagating, an SSH daemon slow to
+come up — and the old path surfaced all of them as one opaque exception
+after an unbounded wait. The state machine makes every attempt a recorded
+transition, so a failed fleet bring-up reads as a timeline
+(``Provisioner.provision_report``), and retries walk a *candidate ladder*
+(same VM in alternate zones first — capacity errors are zone-scoped — then
+smaller VM classes) under a jittered :class:`~skyplane_tpu.utils.retry.
+RetryPolicy` with a hard wall-clock deadline per task.
+
+States::
+
+    PENDING -> LAUNCHING -> BOOTING -> READY
+                   |  ^         |
+                   v  |         v      (failed attempt: instance terminated
+                 RETRYING ------+       best-effort, next candidate tried)
+                   |
+                   v
+                 FAILED   (attempts/deadline exhausted: raises with history)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import List, Optional, Tuple
+
+
+class ProvisionState(str, Enum):
+    PENDING = "pending"
+    LAUNCHING = "launching"
+    BOOTING = "booting"
+    READY = "ready"
+    RETRYING = "retrying"
+    FAILED = "failed"
+
+
+# smaller-VM fallback ladders per provider (mirrors the planner's VCPU
+# ladder, duplicated here so the provisioning path never imports the
+# planner/jax stack). Order: first entry after the requested type is tried
+# once the zone alternatives are exhausted.
+VM_FALLBACK_LADDER = {
+    "aws": ["m5.8xlarge", "m5.4xlarge", "m5.2xlarge", "m5.xlarge"],
+    "gcp": ["n2-standard-32", "n2-standard-16", "n2-standard-8"],
+    "azure": ["Standard_D32_v5", "Standard_D16_v5", "Standard_D8_v5"],
+}
+
+# capacity/quota error markers across the three SDKs' error codes + messages.
+# Only these justify advancing the candidate ladder: a transient failure
+# (IAM propagation, API throttle, slow SSH) retried on a DIFFERENT candidate
+# would silently downgrade the fleet below what the planner sized against.
+_CAPACITY_ERROR_MARKERS = (
+    "insufficientinstancecapacity",
+    "instancelimitexceeded",
+    "vcpulimitexceeded",
+    "zone_resource_pool_exhausted",
+    "resource_pool_exhausted",
+    "resource_exhausted",
+    "zonalallocationfailed",
+    "allocationfailed",
+    "skunotavailable",
+    "quota exceeded",
+    "quotaexceeded",
+    "out of capacity",
+    "insufficient capacity",
+)
+
+
+def is_capacity_error(error: BaseException) -> bool:
+    """Whether a launch failure is capacity/quota-scoped — the only class
+    where trying the next (zone, vm_type) candidate helps."""
+    text = f"{type(error).__name__}: {error}".lower()
+    return any(marker in text for marker in _CAPACITY_ERROR_MARKERS)
+
+
+@dataclass
+class ProvisionAttempt:
+    vm_type: Optional[str]
+    zone: Optional[str]
+    started_monotonic: float
+    error: str = ""
+    seconds: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {"vm_type": self.vm_type, "zone": self.zone, "error": self.error, "seconds": round(self.seconds, 2)}
+
+
+@dataclass
+class ProvisionRecord:
+    """The full lifecycle of one provisioning task."""
+
+    task_uuid: str
+    region_tag: str
+    state: ProvisionState = ProvisionState.PENDING
+    attempts: List[ProvisionAttempt] = field(default_factory=list)
+    transitions: List[Tuple[str, float]] = field(default_factory=list)
+
+    def to(self, state: ProvisionState) -> None:
+        self.state = state
+        self.transitions.append((state.value, time.monotonic()))
+
+    def begin_attempt(self, vm_type: Optional[str], zone: Optional[str]) -> ProvisionAttempt:
+        attempt = ProvisionAttempt(vm_type=vm_type, zone=zone, started_monotonic=time.monotonic())
+        self.attempts.append(attempt)
+        self.to(ProvisionState.LAUNCHING)
+        return attempt
+
+    def fail_attempt(self, error: BaseException, final: bool) -> None:
+        attempt = self.attempts[-1]
+        attempt.error = f"{type(error).__name__}: {error}"
+        attempt.seconds = time.monotonic() - attempt.started_monotonic
+        self.to(ProvisionState.FAILED if final else ProvisionState.RETRYING)
+
+    def succeed(self) -> None:
+        attempt = self.attempts[-1]
+        attempt.seconds = time.monotonic() - attempt.started_monotonic
+        self.to(ProvisionState.READY)
+
+    def history(self) -> str:
+        """One line per attempt — the error message a FAILED task raises."""
+        lines = []
+        for i, a in enumerate(self.attempts):
+            where = f"{a.vm_type or 'default-vm'}" + (f"@{a.zone}" if a.zone else "")
+            lines.append(f"  attempt {i + 1}: {where} ({a.seconds:.1f}s) {a.error or 'ok'}")
+        return "\n".join(lines)
+
+    def as_dict(self) -> dict:
+        return {
+            "task_uuid": self.task_uuid,
+            "region_tag": self.region_tag,
+            "state": self.state.value,
+            "attempts": [a.as_dict() for a in self.attempts],
+            "transitions": [s for s, _ in self.transitions],
+        }
+
+
+def provision_candidates(
+    provider_name: str,
+    vm_type: Optional[str],
+    zones: Optional[List[str]] = None,
+    max_candidates: int = 8,
+) -> List[Tuple[Optional[str], Optional[str]]]:
+    """The fallback ladder as ``(vm_type, zone)`` pairs, requested shape
+    first. Zone alternatives for the SAME vm type come before smaller vm
+    classes (capacity exhaustion is usually zone-scoped; a smaller VM is a
+    real capability downgrade the planner sized against)."""
+    zone_list: List[Optional[str]] = list(zones) if zones else [None]
+    ladder = VM_FALLBACK_LADDER.get(provider_name, [])
+    vms: List[Optional[str]] = [vm_type]
+    if vm_type in ladder:
+        vms.extend(ladder[ladder.index(vm_type) + 1 :])
+    elif vm_type is None and ladder:
+        vms.extend(ladder[1:])  # provider default ~ ladder head
+    out: List[Tuple[Optional[str], Optional[str]]] = []
+    for vm in vms:
+        for zone in zone_list:
+            out.append((vm, zone))
+            if len(out) >= max_candidates:
+                return out
+    return out

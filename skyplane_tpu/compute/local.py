@@ -60,6 +60,7 @@ class LocalServer(Server):
         use_bbr: bool = True,
         docker_image: Optional[str] = None,  # local daemons run in-place
         tmpfs_gb: int = 8,
+        credentials=None,
     ) -> None:
         self._record_control_credentials(gateway_info, use_tls)
         # re-starting with a new program (e.g. throughput probes) replaces the
@@ -97,6 +98,18 @@ class LocalServer(Server):
         if not use_tls:
             args += ["--disable-tls"]
         env = dict(os.environ)
+        # object-store credential chain: local daemons inherit the client env
+        # anyway, but an explicit payload (tests, mixed-cloud local topologies)
+        # is staged exactly like on a remote VM — files 0600 under creds/
+        if credentials is not None and not credentials.is_empty():
+            creds_dir = self.workdir / "creds"
+            creds_dir.mkdir(parents=True, exist_ok=True)
+            creds_dir.chmod(0o700)
+            for name, content in credentials.files.items():
+                path = creds_dir / name
+                path.write_bytes(content)
+                path.chmod(0o600)
+            env.update(credentials.resolved_env(str(creds_dir)))
         env.setdefault("PYTHONPATH", "")
         repo_root = str(Path(__file__).resolve().parents[2])
         env["PYTHONPATH"] = repo_root + (os.pathsep + env["PYTHONPATH"] if env["PYTHONPATH"] else "")

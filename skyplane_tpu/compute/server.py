@@ -142,6 +142,7 @@ class Server:
         use_bbr: bool = True,
         docker_image: Optional[str] = None,
         tmpfs_gb: int = 8,
+        credentials=None,  # GatewayCredentialPayload: object-store access (docs/provisioning.md)
     ) -> None:
         raise NotImplementedError
 
@@ -257,6 +258,7 @@ class SSHServer(Server):
         use_bbr: bool = True,
         docker_image: Optional[str] = None,
         tmpfs_gb: int = 8,
+        credentials=None,
     ) -> None:
         from skyplane_tpu.compute import bootstrap
 
@@ -280,6 +282,34 @@ class SSHServer(Server):
         self.write_file(json.dumps(gateway_info).encode(), f"{root}/info.json")
         if e2ee_key:
             self.write_file(e2ee_key, f"{root}/e2ee.key")
+        # object-store credential chain (docs/provisioning.md): files land
+        # 0600 under a 0700 creds dir. Env exports are staged as 0600 files
+        # too (shell-sourceable env.sh for the venv path, docker --env-file
+        # format env.list) and delivered over the write_file stdin channel —
+        # secret VALUES must never ride a command line, which run_command
+        # logs, exceptions embed, and the VM's ps/cmdline exposes for the
+        # daemon's whole lifetime. Without credentials a cross-cloud gateway
+        # boots healthy and then fails every src/dst storage call (VERDICT
+        # missing #3).
+        cred_env_sh: Optional[str] = None
+        cred_env_list: Optional[str] = None
+        if credentials is not None and not credentials.is_empty():
+            creds_dir = f"{root}/creds"
+            self.run_checked(f"mkdir -p {creds_dir} && chmod 700 {creds_dir}")
+            for name, content in credentials.files.items():
+                path = f"{creds_dir}/{name}"
+                self.write_file(content, path)
+                self.run_checked(f"chmod 600 {shlex.quote(path)}")
+            cred_env = credentials.resolved_env(creds_dir)
+            if cred_env:
+                cred_env_sh = f"{creds_dir}/env.sh"
+                cred_env_list = f"{creds_dir}/env.list"
+                sh = "".join(f"export {k}={shlex.quote(str(v))}\n" for k, v in sorted(cred_env.items()))
+                listing = "".join(f"{k}={v}\n" for k, v in sorted(cred_env.items()))
+                for path, content in ((cred_env_sh, sh), (cred_env_list, listing)):
+                    self.write_file(content.encode(), path)
+                    self.run_checked(f"chmod 600 {shlex.quote(path)}")
+            logger.fs.info(f"[{self.host}] gateway credentials staged: {credentials.summary()}")
         args = (
             f"--region {self.region_tag} --chunk-dir {root}/chunks "
             f"--program-file {root}/program.json --info-file {root}/info.json "
@@ -296,12 +326,15 @@ class SSHServer(Server):
             # a generic readiness timeout two minutes later.
             for cmd in bootstrap.docker_bootstrap_commands(docker_image):
                 self.run_checked(cmd, timeout=600)
-            self.run_checked(bootstrap.docker_run_command(docker_image, args, tmpfs_gb=tmpfs_gb))
+            self.run_checked(bootstrap.docker_run_command(docker_image, args, tmpfs_gb=tmpfs_gb, env_file=cred_env_list))
         else:
             # venv bootstrap: ship the client's own package to the bare VM
             self._bootstrap_venv()
+            # sourcing the 0600 env file keeps secrets off the launch line
+            # (ps-visible + logged); the nohup'd daemon inherits the exports
+            source = f". {shlex.quote(cred_env_sh)} && " if cred_env_sh else ""
             self.run_command(
-                f"nohup {bootstrap.REMOTE_PY} -m skyplane_tpu.gateway.gateway_daemon {args} "
+                f"{source}nohup {bootstrap.REMOTE_PY} -m skyplane_tpu.gateway.gateway_daemon {args} "
                 f"> {root}/daemon.log 2>&1 & echo started"
             )
         self.wait_for_gateway_ready()

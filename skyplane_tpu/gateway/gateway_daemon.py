@@ -604,6 +604,11 @@ class GatewayDaemon:
                     logger.fs.warning(f"[daemon {self.gateway_id}] segment spill flush failed: {e}")
             # keep the API up briefly so the client can collect errors/status
             time.sleep(0.2)
+            # then actually release the control port: a subprocess daemon's
+            # exit closes it anyway, but an IN-PROCESS daemon (tests, the
+            # failover harness) would otherwise keep answering /status after
+            # "death", making gateway-liveness detection unobservable
+            self.api.stop()
 
     def stop(self) -> None:
         self.api.shutdown_requested.set()

@@ -374,6 +374,11 @@ class OverlayPlanner(Planner):
         self.profile_path = profile_path
         self.candidate_regions = candidate_regions
         self.required_gbps = required_gbps
+        # the most recent plan()'s MILP inputs — what a ReplanMonitor needs
+        # to re-solve mid-job (Pipeline.create_dataplane attaches one);
+        # None when plan() fell back before a problem was ever built
+        self.last_problem = None
+        self.last_candidates: Optional[List[str]] = None
 
     def plan(self, jobs: List) -> TopologyPlan:
         from skyplane_tpu.planner.solver import (
@@ -386,6 +391,8 @@ class OverlayPlanner(Planner):
 
         src_region, dst_regions = self._validate_jobs(jobs)
         self.codec_decisions = {}  # fresh per plan
+        self.last_problem = None
+        self.last_candidates = None
         direct = MulticastDirectPlanner(
             self.transfer_config, quota_limits_file=self.quota_limits_file, n_instances=self.n_instances
         )
@@ -422,6 +429,10 @@ class OverlayPlanner(Planner):
             required_throughput_gbits=required,
             instance_limit=self.n_instances,
         )
+        # even a direct outcome keeps these: mid-job congestion on the direct
+        # hop is exactly when a ReplanMonitor re-solve should consider relays
+        self.last_problem = problem
+        self.last_candidates = list(candidates)
         if self.solver_name == "ron":
             sol = solver.solve(problem, candidates)
         else:

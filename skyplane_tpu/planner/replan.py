@@ -1,0 +1,153 @@
+"""Trace-informed replanning: flag a congested hop from live obs-stage
+latencies and re-solve the overlay mid-job.
+
+The PR-5 wire counters already separate the two ways a sender hop can be
+slow (docs/observability.md, SENDER_WIRE_COUNTER_ZERO):
+
+  * ``ack_lag_ns``  — time between a frame being fully written to the
+    socket and its ack arriving: the NETWORK + far-side story. A rising
+    per-frame ack lag with healthy local send means the hop itself (WAN
+    congestion, a struggling receiver) is the bottleneck.
+  * ``wire_stall_ns`` — the pump idle with a frame ready but the in-flight
+    window full: LOCAL backpressure. High stall with proportional ack lag is
+    a saturated-but-healthy pipe; replanning away from it buys nothing.
+
+:class:`ReplanMonitor` consumes per-source-gateway counter snapshots (the
+tracker polls ``/profile/socket/sender`` on a slow cadence), computes
+per-frame deltas, and when a hop's ack lag crosses the threshold AND
+dominates its stall, re-solves the overlay with that edge's throughput
+derated — producing a :class:`ReplanDecision` whose ``solution`` is the
+cost-optimal topology avoiding (or de-weighting) the congested hop. The
+decision is surfaced through ``TransferHook.on_replan`` and the tracker's
+``replan_events``; applying it (re-provisioning mid-job) is the operator's
+call — the expensive part, detecting + re-solving with real prices, is done.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from skyplane_tpu.planner.solver import ThroughputProblem, ThroughputSolution, ThroughputSolverILP
+from skyplane_tpu.utils.envcfg import env_float as _env_float
+from skyplane_tpu.utils.logger import logger
+
+
+@dataclass
+class ReplanDecision:
+    congested_edge: Tuple[str, str]
+    gateway_id: str
+    ack_lag_ms_per_frame: float
+    stall_ms_per_frame: float
+    frames_observed: int
+    reason: str
+    solution: Optional[ThroughputSolution]
+
+    def as_dict(self) -> dict:
+        sol = self.solution
+        return {
+            "congested_edge": list(self.congested_edge),
+            "gateway_id": self.gateway_id,
+            "ack_lag_ms_per_frame": round(self.ack_lag_ms_per_frame, 3),
+            "stall_ms_per_frame": round(self.stall_ms_per_frame, 3),
+            "frames_observed": self.frames_observed,
+            "reason": self.reason,
+            "resolved": bool(sol is not None and sol.is_feasible),
+            "new_edges": sorted(f"{a}->{b}" for a, b in (sol.edge_flow_gbits if sol else {})),
+            "new_cost_total": round(sol.cost_total, 6) if sol else None,
+        }
+
+
+@dataclass
+class ReplanMonitor:
+    """Congestion detector + re-solver for one transfer's overlay.
+
+    ``observe()`` is fed ``{gateway_id: (src_region, next_region, counters)}``
+    snapshots; it keeps the previous snapshot per gateway and judges the
+    DELTA, so daemon-lifetime cumulative counters never pollute the signal.
+    """
+
+    problem: ThroughputProblem
+    candidate_regions: List[str]
+    profile_path: Optional[str] = None
+    #: per-frame ack lag above this flags the hop (ms). Default 200 ms —
+    #: an order past healthy WAN RTT, reachable only by queueing.
+    ack_lag_threshold_ms: float = field(default_factory=lambda: _env_float("SKYPLANE_TPU_REPLAN_ACK_LAG_MS", 200.0))
+    #: frames a delta must span before it is judged (noise floor)
+    min_frames: int = 32
+    #: congested edge's throughput multiplier for the re-solve
+    derate: float = field(default_factory=lambda: _env_float("SKYPLANE_TPU_REPLAN_DERATE", 0.25))
+    #: seconds between decisions (a re-solve storm helps nobody)
+    cooldown_s: float = field(default_factory=lambda: _env_float("SKYPLANE_TPU_REPLAN_COOLDOWN_S", 60.0))
+
+    _last: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    _last_decision_monotonic: Optional[float] = None
+
+    def observe(
+        self, samples: Dict[str, Tuple[str, str, Dict[str, int]]]
+    ) -> Optional[ReplanDecision]:
+        """Judge one wave of counter snapshots; returns a decision when a
+        congested hop was flagged AND the re-solve produced a topology."""
+        worst: Optional[ReplanDecision] = None
+        for gid, (src_region, next_region, counters) in samples.items():
+            prev = self._last.get(gid)
+            if prev is None:
+                # first sighting: snapshot the (daemon-lifetime cumulative)
+                # baseline, never judge it — a reused daemon's history would
+                # otherwise pollute the first delta
+                self._last[gid] = dict(counters)
+                continue
+            d_frames = counters.get("frames_sent", 0) - prev.get("frames_sent", 0)
+            if d_frames < self.min_frames:
+                # below the noise floor: KEEP the baseline so deltas
+                # accumulate across polls — severe congestion is exactly when
+                # per-poll frame throughput collapses below min_frames, and
+                # resetting here would blind the monitor to it forever
+                continue
+            self._last[gid] = dict(counters)
+            d_ack_ms = (counters.get("ack_lag_ns", 0) - prev.get("ack_lag_ns", 0)) / 1e6
+            d_stall_ms = (counters.get("wire_stall_ns", 0) - prev.get("wire_stall_ns", 0)) / 1e6
+            ack_per_frame = d_ack_ms / d_frames
+            stall_per_frame = d_stall_ms / d_frames
+            if ack_per_frame < self.ack_lag_threshold_ms:
+                continue
+            if ack_per_frame <= stall_per_frame:
+                # stall-dominant: LOCAL window backpressure — the pipe is
+                # saturated, not congested; routing around it buys nothing
+                continue
+            decision = ReplanDecision(
+                congested_edge=(src_region, next_region),
+                gateway_id=gid,
+                ack_lag_ms_per_frame=ack_per_frame,
+                stall_ms_per_frame=stall_per_frame,
+                frames_observed=d_frames,
+                reason=(
+                    f"ack lag {ack_per_frame:.0f} ms/frame over {d_frames} frames "
+                    f"(threshold {self.ack_lag_threshold_ms:.0f} ms, stall {stall_per_frame:.0f} ms/frame)"
+                ),
+                solution=None,
+            )
+            if worst is None or decision.ack_lag_ms_per_frame > worst.ack_lag_ms_per_frame:
+                worst = decision
+        if worst is None:
+            return None
+        now = time.monotonic()
+        if self._last_decision_monotonic is not None and now - self._last_decision_monotonic < self.cooldown_s:
+            return None
+        worst.solution = self.resolve(worst.congested_edge)
+        self._last_decision_monotonic = now
+        logger.fs.warning(f"[replan] congested hop {worst.congested_edge}: {worst.reason}")
+        return worst
+
+    def resolve(self, congested_edge: Tuple[str, str]) -> Optional[ThroughputSolution]:
+        """Re-solve the min-cost overlay with the congested edge derated —
+        grid prices (planner/pricing.py) keep the detour honest about what
+        it costs."""
+        solver = ThroughputSolverILP(self.profile_path, derated_edges={congested_edge: self.derate})
+        try:
+            sol = solver.solve_min_cost(self.problem, self.candidate_regions)
+        except Exception as e:  # noqa: BLE001 - a failed re-solve must not kill the transfer
+            logger.fs.warning(f"[replan] re-solve failed: {e}")
+            return None
+        return sol if sol.is_feasible else None

@@ -16,7 +16,7 @@ from __future__ import annotations
 import csv
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -51,10 +51,25 @@ class ThroughputSolution:
 
 
 class ThroughputSolver:
-    """Loads the region-pair throughput grid and answers path queries."""
+    """Loads the region-pair throughput grid and answers path queries.
 
-    def __init__(self, profile_path: Optional[str] = None):
+    ``cost_fn(src, dst) -> $/GB`` is injectable: the default is the
+    region-pair egress grid (planner/pricing.py); the pin test passes
+    :func:`~skyplane_tpu.planner.pricing.get_flat_egress_cost_per_gb` to
+    reproduce (and regress against) the old flat per-provider model.
+    ``derated_edges`` multiplies specific edges' throughput (the replan
+    monitor re-solves with a congested hop derated, planner/replan.py).
+    """
+
+    def __init__(
+        self,
+        profile_path: Optional[str] = None,
+        cost_fn: Optional[Callable[[str, str], float]] = None,
+        derated_edges: Optional[Dict[Tuple[str, str], float]] = None,
+    ):
         self.grid: Dict[Tuple[str, str], float] = {}
+        self.cost_fn: Callable[[str, str], float] = cost_fn or get_egress_cost_per_gb
+        self.derated_edges: Dict[Tuple[str, str], float] = dict(derated_edges or {})
         if profile_path and Path(profile_path).exists():
             with open(profile_path) as f:
                 for row in csv.DictReader(f):
@@ -62,20 +77,21 @@ class ThroughputSolver:
 
     def get_path_throughput(self, src: str, dst: str) -> float:
         """Single-VM achievable Gbps on src->dst."""
+        scale = self.derated_edges.get((src, dst), 1.0)
         if src == dst:
-            return min(NIC_LIMITS.get(src.split(":")[0], (5.0, 5.0)))
+            return min(NIC_LIMITS.get(src.split(":")[0], (5.0, 5.0))) * scale
         if (src, dst) in self.grid:
-            return self.grid[(src, dst)]
+            return self.grid[(src, dst)] * scale
         # fall back to NIC-limit model: min(src egress cap, dst ingress cap),
         # derated 40% for WAN (observed gap between NIC and cross-region TCP)
         src_e = NIC_LIMITS.get(src.split(":")[0], (5.0, 10.0))[0]
         dst_i = NIC_LIMITS.get(dst.split(":")[0], (5.0, 10.0))[1]
         same_provider = src.split(":")[0] == dst.split(":")[0]
         derate = 0.8 if same_provider else 0.6
-        return min(src_e, dst_i) * derate
+        return min(src_e, dst_i) * derate * scale
 
     def get_path_cost(self, src: str, dst: str) -> float:
-        return get_egress_cost_per_gb(src, dst)
+        return self.cost_fn(src, dst)
 
     def get_baseline_throughput_and_cost(self, p: ThroughputProblem) -> Tuple[float, float]:
         """Direct path with p.instance_limit VMs (reference: solver.py:144-150)."""
@@ -224,16 +240,25 @@ class ThroughputSolverILP(ThroughputSolver):
             instances_per_region=instances,
         )
 
-    def true_cost(self, sol: ThroughputSolution) -> float:
+    def true_cost(self, sol: ThroughputSolution, cost_fn: Optional[Callable[[str, str], float]] = None) -> float:
         """Deployable cost of a solution: egress $ + WHOLE instances priced
-        for the transfer duration (what you actually pay after rounding)."""
+        for the transfer duration (what you actually pay after rounding).
+        ``cost_fn`` re-prices the egress under a different model — the pin
+        test evaluates a flat-model plan at the real (grid) prices to show
+        what the mispricing actually costs."""
         p = sol.problem
         R = max(p.required_throughput_gbits, 1e-6)
         transfer_hours = max(p.gbyte_to_transfer * 8 / R / 3600, 1e-6)
         inst = sum(
             (get_instance_cost_per_hr(r, None) or 1.54) * cnt for r, cnt in sol.instances_per_region.items()
         )
-        return sum(sol.cost_egress_by_edge.values()) + transfer_hours * inst
+        if cost_fn is None:
+            egress = sum(sol.cost_egress_by_edge.values())
+        else:
+            egress = sum(
+                cost_fn(a, b) * p.gbyte_to_transfer * (f / R) for (a, b), f in sol.edge_flow_gbits.items()
+            )
+        return egress + transfer_hours * inst
 
     def _solve_min_cost_lp(
         self,

@@ -187,6 +187,137 @@ def dispatch_file(
     return [r.chunk.chunk_id for r in reqs]
 
 
+# ---- control-plane harness: drive the REAL TransferProgressTracker over
+# in-process daemons (gateway-failover tests, scripts/soak_chaos.py) ----
+
+
+class _HarnessServer:
+    """Adapts a LocalGateway to the Server surface BoundGateway needs."""
+
+    def __init__(self, gw: LocalGateway):
+        self.gw = gw
+
+    def control_url(self) -> str:
+        scheme = "https" if self.gw.daemon.control_tls else "http"
+        return f"{scheme}://127.0.0.1:{self.gw.control_port}/api/v1"
+
+    def control_session(self) -> requests.Session:
+        return self.gw.session()
+
+
+def bind_gateway(gw: LocalGateway, region_tag: str = "local:local"):
+    """Wrap an in-process daemon as a BoundGateway (the tracker's unit of
+    liveness/polling), so control-plane machinery runs unmodified."""
+    from types import SimpleNamespace
+
+    from skyplane_tpu.api.dataplane import BoundGateway
+
+    plan_gw = SimpleNamespace(gateway_id=gw.daemon.gateway_id, region_tag=region_tag)
+    return BoundGateway(plan_gw, _HarnessServer(gw))
+
+
+class StubDataplane:
+    """The Dataplane protocol surface TransferProgressTracker consumes,
+    backed by harness daemons instead of provisioned VMs."""
+
+    def __init__(self, sources, sinks, src_region_tag: str = "local:srcA", dst_region_tags=("local:dstB",)):
+        self._sources = list(sources)
+        self._sinks = list(sinks)
+        self.bound_gateways = {b.gateway_id: b for b in self._sources + self._sinks}
+        self.src_region_tag = src_region_tag
+        self.dst_region_tags = list(dst_region_tags)
+        self._trackers: List = []
+
+    def source_gateways(self):
+        return list(self._sources)
+
+    def sink_gateways(self):
+        return list(self._sinks)
+
+    def check_error_logs(self, exclude=None) -> Dict[str, List[str]]:
+        from skyplane_tpu.utils import do_parallel
+
+        targets = [b for b in self.bound_gateways.values() if not exclude or b.gateway_id not in exclude]
+        results = do_parallel(lambda b: b.errors(), targets, n=16)
+        return {b.gateway_id: errs for b, errs in results if errs}
+
+
+class HarnessCopyJob:
+    """A minimal tracker-drivable job over one local file: chunk batches
+    round-robin across source gateways (deterministic split — the daemon's
+    incomplete-chunk view updates async, so least-loaded reads stale zeros
+    on a loopback burst) and the production requeue bookkeeping rides along
+    — exactly what gateway-death failover re-dispatches. Retries advance to
+    the next gateway, so a dead target never eats the whole budget."""
+
+    def __init__(self, src_path: Path, dst_path: Path, chunk_bytes: int = 256 << 10, batch_size: int = 8, tenant_id=None):
+        from skyplane_tpu.api.transfer_job import TransferJob
+
+        self.src_file = Path(src_path)
+        self.dst_file = Path(dst_path)
+        self.chunk_bytes = chunk_bytes
+        self.batch_size = batch_size
+        self.tenant_id = tenant_id
+        self.uuid = uuid.uuid4().hex
+        self.chunk_targets: Dict[str, str] = {}
+        self._request_bodies: Dict[str, dict] = {}
+        # reuse the production requeue/release machinery verbatim
+        self.requeue_chunks = TransferJob.requeue_chunks.__get__(self)
+        self.release_requeue_state = TransferJob.release_requeue_state.__get__(self)
+
+    def _requests(self) -> List[ChunkRequest]:
+        size = self.src_file.stat().st_size
+        reqs, offset = [], 0
+        while offset < size:
+            length = min(self.chunk_bytes, size - offset)
+            chunk = Chunk(
+                src_key=str(self.src_file),
+                dest_key=str(self.dst_file),
+                chunk_id=uuid.uuid4().hex,
+                chunk_length_bytes=length,
+                file_offset_bytes=offset,
+                tenant_id=self.tenant_id,
+            )
+            reqs.append(
+                ChunkRequest(
+                    chunk=chunk, src_region="local:local", dst_region="local:local", src_type="local", dst_type="local"
+                )
+            )
+            offset += length
+        return reqs
+
+    def dispatch(self, dataplane, transfer_config):
+        from skyplane_tpu.utils.retry import retry_backoff
+
+        sources = dataplane.source_gateways()
+        session = sources[0].control_session()
+        reqs = self._requests()
+        for start in range(0, len(reqs), self.batch_size):
+            batch = reqs[start : start + self.batch_size]
+            bodies = [r.as_dict() for r in batch]
+            attempt = {"n": start // self.batch_size}
+
+            def _post():
+                target = sources[attempt["n"] % len(sources)]
+                attempt["n"] += 1
+                resp = session.post(f"{target.control_url()}/chunk_requests", json=bodies, timeout=30)
+                resp.raise_for_status()
+                return target
+
+            target = retry_backoff(
+                _post, max_retries=4, initial_backoff=0.2, max_backoff=2.0, jitter=0.5, deadline_s=60.0,
+                exception_class=(requests.RequestException,),
+            )
+            for req, body in zip(batch, bodies):
+                self.chunk_targets[req.chunk.chunk_id] = target.gateway_id
+                self._request_bodies[req.chunk.chunk_id] = body
+            yield from (r.chunk for r in batch)
+
+    def finalize(self) -> None: ...
+
+    def verify(self) -> None: ...
+
+
 def wait_complete(gw: LocalGateway, chunk_ids: List[str], timeout: float = 60.0) -> None:
     deadline = time.time() + timeout
     pending = set(chunk_ids)

@@ -1,0 +1,175 @@
+"""Gateway-death failover: the acceptance test for the fault-tolerant
+gateway lifecycle (ISSUE 8 / docs/provisioning.md).
+
+Two source daemons feed one destination through the real loopback data
+plane, driven by the REAL TransferProgressTracker over the harness
+StubDataplane. One source is wedged (its operator workers stopped — chunks
+register but never move) so its share of the corpus is deterministically
+un-acked, then its daemon is killed outright. The tracker's liveness
+monitor must detect the death within the heartbeat deadline, requeue the
+dead gateway's pending chunks onto the survivor, and the job must complete
+with byte-identical destination output and zero leaked scheduler tokens.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from integration.harness import HarnessCopyJob, StubDataplane, bind_gateway, make_pair, start_gateway
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.tracker import TransferProgressTracker
+from skyplane_tpu.exceptions import GatewayException
+
+CHUNK = 128 << 10
+N_CHUNKS = 32
+BATCH = 8
+
+
+def _start_two_source_topology(tmp_path: Path, num_connections: int = 2):
+    """dst <- (src_a, src_b): both sources run identical read->send programs
+    against the same destination daemon."""
+    src_a, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=num_connections)
+    info = {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}}
+    program_b = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "num_connections": num_connections,
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "send",
+                                "target_gateway_id": "gw_dst",
+                                "region": "local:local",
+                                "num_connections": num_connections,
+                                "compress": "none",
+                                "encrypt": False,
+                                "dedup": False,
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    src_b = start_gateway(program_b, info, "gw_src_b", str(tmp_path / "src_b_chunks"), use_tls=False)
+    return src_a, src_b, dst
+
+
+def _wedge(gw) -> None:
+    """Stop the daemon's operator workers: chunks still register at the
+    control API but never move — a gateway whose data plane died."""
+    for op in gw.daemon.operators:
+        op.stop_workers(timeout=5)
+
+
+def test_kill_one_of_two_gateways_mid_transfer(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPLANE_TPU_HEARTBEAT_DEADLINE_S", "1.5")
+    payload = np.random.default_rng(11).integers(0, 256, CHUNK * N_CHUNKS, dtype=np.uint8).tobytes()
+    src_file = tmp_path / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp_path / "out" / "corpus.bin"
+
+    src_a, src_b, dst = _start_two_source_topology(tmp_path)
+    try:
+        _wedge(src_a)  # src_a accepts chunks but never sends them
+        dp = StubDataplane([bind_gateway(src_a), bind_gateway(src_b)], [bind_gateway(dst)])
+        job = HarnessCopyJob(src_file, out_file, chunk_bytes=CHUNK, batch_size=BATCH)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig(compress="none", dedup=False, encrypt_e2e=False))
+        assert tracker.heartbeat_deadline_s == 1.5
+        dp._trackers.append(tracker)
+        tracker.start()
+
+        # wait until dispatch finished and the WEDGED gateway holds pending
+        # chunks (completed chunks are released from chunk_targets, so the
+        # survivor's entries may already be gone — the wedged ones cannot be)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with tracker._lock:
+                n_dispatched = len(tracker.dispatched_chunk_ids)
+            if n_dispatched == N_CHUNKS and "gw_src" in set(job.chunk_targets.values()):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"dispatch incomplete or wedged gateway empty: {dict.fromkeys(job.chunk_targets.values())}")
+        wedged_chunks = [cid for cid, gid in job.chunk_targets.items() if gid == "gw_src"]
+        assert wedged_chunks, "the wedged gateway must hold pending chunks at kill time"
+
+        # kill the wedged gateway outright: control API refuses from now on
+        kill_time = time.monotonic()
+        src_a.stop()
+
+        tracker.join(timeout=120)
+        assert not tracker.is_alive(), "tracker wedged after gateway death"
+        assert tracker.error is None, f"failover should complete the job, got {tracker.error!r}"
+
+        # liveness: declared dead within a bounded window of the heartbeat
+        # deadline (generous envelope: slow CI boxes still poll every wave)
+        assert tracker.dead_gateway_ids == {"gw_src"}
+        assert len(tracker.failover_events) == 1
+        event = tracker.failover_events[0]
+        assert event["failure_class"] == "refused"
+        assert event["survivors"] == ["gw_src_b"]
+        # every chunk the dead gateway held was re-dispatched to the survivor
+        assert event["requeued_chunks"] >= len(wedged_chunks)
+        assert all(job.chunk_targets.get(cid, "gw_src_b") == "gw_src_b" for cid in wedged_chunks)
+        detect_s = time.monotonic() - kill_time
+        assert detect_s < 60, f"death detection took {detect_s:.1f}s"
+
+        # byte-identical destination output through the requeue path
+        assert out_file.read_bytes() == payload
+
+        # zero leaked scheduler tokens on the surviving fleet
+        for gw in (src_b, dst):
+            held = sum(sum(usage.values()) for usage in gw.daemon.scheduler.usage_snapshot().values())
+            assert held == 0, f"{gw.daemon.gateway_id} leaked {held} scheduler tokens"
+    finally:
+        for gw in (src_a, src_b, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 - src_a is already stopped
+                pass
+
+
+def test_dead_sink_still_fails_loudly(tmp_path, monkeypatch):
+    """Failover is for SOURCE gateways only: a dead destination cannot be
+    healed by requeueing, so the transfer must fail with GatewayException
+    within the heartbeat window (no silent hang, no bogus success)."""
+    monkeypatch.setenv("SKYPLANE_TPU_HEARTBEAT_DEADLINE_S", "1.5")
+    payload = np.random.default_rng(12).integers(0, 256, CHUNK * 4, dtype=np.uint8).tobytes()
+    src_file = tmp_path / "corpus.bin"
+    src_file.write_bytes(payload)
+
+    src_a, src_b, dst = _start_two_source_topology(tmp_path)
+    try:
+        _wedge(src_a)
+        _wedge(src_b)  # nothing moves: the sink poll loop runs until detection
+        dp = StubDataplane([bind_gateway(src_a), bind_gateway(src_b)], [bind_gateway(dst)])
+        job = HarnessCopyJob(src_file, tmp_path / "out" / "x.bin", chunk_bytes=CHUNK, batch_size=BATCH)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig(compress="none", dedup=False, encrypt_e2e=False))
+        dp._trackers.append(tracker)
+        tracker.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and len(job.chunk_targets) < 4:
+            time.sleep(0.05)
+        dst.stop()
+        tracker.join(timeout=60)
+        assert not tracker.is_alive()
+        assert isinstance(tracker.error, GatewayException), f"expected GatewayException, got {tracker.error!r}"
+        assert "gw_dst" in str(tracker.error)
+    finally:
+        for gw in (src_a, src_b, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001
+                pass

@@ -90,6 +90,9 @@ def azure(monkeypatch, tmp_path):
     key.write_text("priv")
     key.with_suffix(".pub").write_text("ssh-rsa AAAB fake")
     monkeypatch.setattr(mod.AzureCloudProvider, "ensure_keypair", lambda self: key)
+    # a usable azure environment: subscription bound + credential resolvable
+    # (provision_instance now hard-requires both via auth.require)
+    monkeypatch.setenv("AZURE_SUBSCRIPTION_ID", "sub-1234")
     provider = mod.AzureCloudProvider()
     return provider, log
 
@@ -121,6 +124,30 @@ def test_provision_instance_request_shape(azure):
     assert nic_body["network_security_group"] == {"id": "nsg-id"}
     assert server.public_ip() == "9.9.9.9"
     assert server.private_ip() == "10.1.0.4"
+
+
+def test_provision_attaches_managed_identity(azure):
+    """The gateway VM's Blob credential: a system-assigned managed identity
+    requested at creation (VERDICT missing #1 — Azure leg)."""
+    provider, log = azure
+    provider.provision_instance("azure:eastus")
+    vm_body = _bodies(log, "vm.create")[0][2]
+    assert vm_body["identity"] == {"type": "SystemAssigned"}
+
+
+def test_provision_without_subscription_raises_precisely(azure, monkeypatch):
+    """No subscription -> UnsupportedProviderError with remediation AT
+    provision time, not an opaque SDK failure minutes later (the old
+    42-line auth stub's failure mode)."""
+    from skyplane_tpu.exceptions import UnsupportedProviderError
+
+    provider, log = azure
+    monkeypatch.delenv("AZURE_SUBSCRIPTION_ID")
+    provider.auth.subscription_id = None
+    with pytest.raises(UnsupportedProviderError, match="AZURE_SUBSCRIPTION_ID") as ei:
+        provider.provision_instance("azure:eastus")
+    assert "az account show" in str(ei.value)
+    assert not _bodies(log, "vm.create"), "no SDK call may happen after the precondition fails"
 
 
 def test_provision_spot(azure):

@@ -1,0 +1,400 @@
+"""Provisioning state machine + credential-chain threading, zero network.
+
+Covers the resilient-control-plane contracts (docs/provisioning.md):
+retry/fallback ladder under the jittered RetryPolicy with per-attempt
+lifecycle records, half-provisioned teardown, the ``provision.launch`` /
+``provision.auth`` fault points, per-gateway credential payload assembly in
+the dataplane, and start_gateway's env/file staging on both local and SSH
+servers — all against stubs, runnable in tier-1 with zero cloud access.
+"""
+
+from __future__ import annotations
+
+import shlex
+import types
+from typing import List, Optional
+
+import pytest
+
+from skyplane_tpu.api.provisioner import Provisioner
+from skyplane_tpu.compute.credentials import (
+    EMPTY_PAYLOAD,
+    GatewayCredentialPayload,
+    build_provider_payload,
+)
+from skyplane_tpu.compute.lifecycle import ProvisionState, provision_candidates
+from skyplane_tpu.exceptions import CredentialChainException, GatewayContainerStartException
+from skyplane_tpu.faults import FaultPlan, configure_injector
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    configure_injector(None)
+    yield
+    configure_injector(None)
+
+
+class FakeServer:
+    def __init__(self, region_tag: str, ssh_ok: bool = True):
+        self.region_tag = region_tag
+        self.terminated = False
+        self.ssh_ok = ssh_ok
+        self.autoshutdown: Optional[int] = None
+
+    def public_ip(self) -> str:
+        return "203.0.113.7"
+
+    def wait_for_ssh_ready(self, timeout: float = 300.0) -> None:
+        if not self.ssh_ok:
+            raise TimeoutError("ssh never came up")
+
+    def install_autoshutdown(self, minutes: int) -> None:
+        self.autoshutdown = minutes
+
+    def terminate_instance(self) -> None:
+        self.terminated = True
+
+
+class FlakyProvider:
+    """provision_instance fails ``fail_n`` times, then succeeds; records
+    every (vm_type, zone) it was asked for."""
+
+    provider_name = "gcp"
+
+    def __init__(self, fail_n: int = 0, zones: Optional[List[str]] = None, ssh_fail_first: bool = False):
+        self.fail_n = fail_n
+        self.zones = zones or []
+        self.calls: List[tuple] = []
+        self.ssh_fail_first = ssh_fail_first
+
+    def setup_region(self, region: str) -> None: ...
+
+    def fallback_zones(self, region_tag: str) -> List[str]:
+        return list(self.zones)
+
+    def provision_instance(self, region_tag, vm_type=None, tags=None, zone=None):
+        self.calls.append((vm_type, zone))
+        if len(self.calls) <= self.fail_n:
+            raise RuntimeError(f"ZONE_RESOURCE_POOL_EXHAUSTED in {zone}")
+        ssh_ok = not (self.ssh_fail_first and len(self.calls) == self.fail_n + 1)
+        return FakeServer(region_tag, ssh_ok=ssh_ok)
+
+    def authorize_gateway_ips(self, region, ips) -> None: ...
+
+
+def make_provisioner(provider, monkeypatch) -> Provisioner:
+    monkeypatch.setenv("SKYPLANE_TPU_PROVISION_ATTEMPTS", "3")
+    prov = Provisioner(autoshutdown_minutes=7)
+    monkeypatch.setattr(Provisioner, "provider", lambda self, name: provider)
+    # no real sleeping between candidate attempts
+    import skyplane_tpu.utils.retry as retry_mod
+
+    monkeypatch.setattr(retry_mod.time, "sleep", lambda s: None)
+    return prov
+
+
+# ---- candidate ladder ----
+
+
+def test_candidates_prefer_zone_alternatives_before_smaller_vms():
+    cands = provision_candidates("gcp", "n2-standard-32", ["us-central1-a", "us-central1-b", "us-central1-c"])
+    assert cands[:3] == [
+        ("n2-standard-32", "us-central1-a"),
+        ("n2-standard-32", "us-central1-b"),
+        ("n2-standard-32", "us-central1-c"),
+    ]
+    assert cands[3] == ("n2-standard-16", "us-central1-a")
+
+
+def test_candidates_without_zones_walk_the_vm_ladder():
+    cands = provision_candidates("aws", "m5.4xlarge", [])
+    assert cands == [("m5.4xlarge", None), ("m5.2xlarge", None), ("m5.xlarge", None)]
+
+
+def test_candidates_unknown_vm_type_is_only_itself():
+    assert provision_candidates("aws", "p4d.24xlarge", []) == [("p4d.24xlarge", None)]
+
+
+# ---- state machine ----
+
+
+def test_retry_walks_zones_and_records_transitions(monkeypatch):
+    provider = FlakyProvider(fail_n=2, zones=["us-central1-a", "us-central1-b", "us-central1-c"])
+    prov = make_provisioner(provider, monkeypatch)
+    uid = prov.add_task("gcp", "gcp:us-central1", vm_type="n2-standard-32")
+    servers = prov.provision()
+    assert servers[uid].autoshutdown == 7
+    # the two capacity failures advanced the ZONE, not the vm type
+    assert provider.calls == [
+        ("n2-standard-32", "us-central1-a"),
+        ("n2-standard-32", "us-central1-b"),
+        ("n2-standard-32", "us-central1-c"),
+    ]
+    record = prov.provision_report()[uid]
+    assert record["state"] == "ready"
+    assert [a["zone"] for a in record["attempts"]] == ["us-central1-a", "us-central1-b", "us-central1-c"]
+    assert "ZONE_RESOURCE_POOL_EXHAUSTED" in record["attempts"][0]["error"]
+    assert record["transitions"] == [
+        "launching", "retrying", "launching", "retrying", "launching", "booting", "ready",
+    ]
+
+
+def test_exhausted_attempts_fail_with_history(monkeypatch):
+    provider = FlakyProvider(fail_n=99, zones=["us-central1-a", "us-central1-b", "us-central1-c"])
+    prov = make_provisioner(provider, monkeypatch)
+    uid = prov.add_task("gcp", "gcp:us-central1", vm_type="n2-standard-32")
+    with pytest.raises(GatewayContainerStartException, match="3 attempt"):
+        prov.provision()
+    record = prov.provision_report()[uid]
+    assert record["state"] == "failed"
+    assert len(record["attempts"]) == 3
+
+
+def test_half_provisioned_instance_is_terminated_before_retry(monkeypatch):
+    """A VM that launches but never answers SSH must be terminated before
+    the next candidate — it would otherwise bill until (never-installed)
+    autoshutdown."""
+    provider = FlakyProvider(fail_n=0, zones=["us-central1-a", "us-central1-b"], ssh_fail_first=True)
+    prov = make_provisioner(provider, monkeypatch)
+    uid = prov.add_task("gcp", "gcp:us-central1", vm_type="n2-standard-32")
+    servers = prov.provision()
+    assert len(provider.calls) == 2
+    assert servers[uid].terminated is False
+    record = prov.provision_report()[uid]
+    assert "ssh never came up" in record["attempts"][0]["error"]
+    assert record["state"] == "ready"
+
+
+def test_transient_error_retries_same_candidate_no_vm_downgrade(monkeypatch):
+    """Only capacity/quota failures advance the (vm_type, zone) ladder. A
+    transient error (IAM propagation, throttle) retried on the NEXT candidate
+    would silently downgrade the fleet below the planner's sizing."""
+
+    class ThrottledProvider(FlakyProvider):
+        def provision_instance(self, region_tag, vm_type=None, tags=None, zone=None):
+            self.calls.append((vm_type, zone))
+            if len(self.calls) <= self.fail_n:
+                raise RuntimeError("RequestLimitExceeded: API throttled, try again")
+            return FakeServer(region_tag)
+
+    provider = ThrottledProvider(fail_n=2, zones=["us-central1-a", "us-central1-b"])
+    prov = make_provisioner(provider, monkeypatch)
+    uid = prov.add_task("gcp", "gcp:us-central1", vm_type="n2-standard-32")
+    prov.provision()
+    # all three attempts on the SAME candidate: no zone walk, no smaller VM
+    assert provider.calls == [("n2-standard-32", "us-central1-a")] * 3
+    assert prov.provision_report()[uid]["state"] == "ready"
+
+
+def test_capacity_error_classifier():
+    from skyplane_tpu.compute.lifecycle import is_capacity_error
+
+    assert is_capacity_error(RuntimeError("ZONE_RESOURCE_POOL_EXHAUSTED in us-central1-a"))
+    assert is_capacity_error(RuntimeError("InsufficientInstanceCapacity: no m5.8xlarge in az"))
+    assert is_capacity_error(RuntimeError("Quota exceeded for quota metric 'N2 CPUs'"))
+    assert is_capacity_error(RuntimeError("SkuNotAvailable: Standard_D32_v5 restricted"))
+    assert not is_capacity_error(RuntimeError("InvalidParameterValue: IAM profile not found"))
+    assert not is_capacity_error(TimeoutError("ssh never came up"))
+    assert not is_capacity_error(OSError("injected fault at provision.launch"))
+
+
+def test_non_retryable_config_error_raises_precisely_without_retries(monkeypatch):
+    """UnsupportedProviderError (e.g. Azure with no subscription) is the
+    'fail loudly NOW with remediation' mechanism — burning the retry ladder
+    and re-wrapping it as a generic container-start failure defeats it."""
+    from skyplane_tpu.exceptions import UnsupportedProviderError
+
+    class Unsupported(FlakyProvider):
+        def provision_instance(self, region_tag, vm_type=None, tags=None, zone=None):
+            self.calls.append((vm_type, zone))
+            raise UnsupportedProviderError("azure", remediation="set subscription_id in config")
+
+    provider = Unsupported(zones=["eastus-1", "eastus-2"])
+    prov = make_provisioner(provider, monkeypatch)
+    uid = prov.add_task("azure", "azure:eastus", vm_type="Standard_D32_v5")
+    with pytest.raises(UnsupportedProviderError, match="subscription"):
+        prov.provision()
+    assert len(provider.calls) == 1, "config errors must not retry"
+    assert prov.provision_report()[uid]["state"] == "failed"
+
+
+def test_provision_launch_fault_point_retries_deterministically(monkeypatch):
+    """The provision.launch control-plane fault point (docs/fault-injection.md)
+    drives the same retry ladder as a real launch failure."""
+    configure_injector(FaultPlan.from_dict({"seed": 7, "points": {"provision.launch": {"p": 1.0, "max_fires": 1}}}))
+    provider = FlakyProvider(fail_n=0, zones=["us-central1-a", "us-central1-b"])
+    prov = make_provisioner(provider, monkeypatch)
+    uid = prov.add_task("gcp", "gcp:us-central1", vm_type="n2-standard-32")
+    prov.provision()
+    record = prov.provision_report()[uid]
+    assert record["state"] == "ready"
+    assert len(record["attempts"]) == 2
+    assert "provision.launch" in record["attempts"][0]["error"]
+    # the injected fault fired BEFORE the SDK call: attempt 1 launched nothing
+    assert len(provider.calls) == 1
+
+
+# ---- credential payloads ----
+
+
+def test_payload_merge_and_conflict():
+    a = GatewayCredentialPayload(env={"A": "1"}, files={"a.json": b"x"})
+    b = GatewayCredentialPayload(env={"B": "2"})
+    merged = a.merge(b)
+    assert merged.env == {"A": "1", "B": "2"} and merged.files == {"a.json": b"x"}
+    with pytest.raises(CredentialChainException, match="conflicting"):
+        a.merge(GatewayCredentialPayload(env={"A": "other"}))
+
+
+def test_payload_resolves_creds_dir_placeholder():
+    p = GatewayCredentialPayload(env={"GOOGLE_APPLICATION_CREDENTIALS": "{creds_dir}/gcp_adc.json"})
+    assert p.resolved_env("/tmp/x/creds") == {"GOOGLE_APPLICATION_CREDENTIALS": "/tmp/x/creds/gcp_adc.json"}
+
+
+def test_provision_auth_fault_point_fires(monkeypatch):
+    configure_injector(FaultPlan.from_dict({"seed": 3, "points": {"provision.auth": {"p": 1.0, "max_fires": 1}}}))
+    provider = types.SimpleNamespace(gateway_credential_payload=lambda hosted: EMPTY_PAYLOAD)
+    with pytest.raises(OSError, match="provision.auth"):
+        build_provider_payload(provider, "aws", "gcp")
+    # budget exhausted: the next evaluation passes through
+    assert build_provider_payload(provider, "aws", "gcp") is EMPTY_PAYLOAD
+
+
+def test_dataplane_assembles_cross_cloud_payloads(monkeypatch):
+    """Each store-touching gateway gets material for every OTHER storage
+    provider in the topology (its own cloud stays ambient via instance
+    profile / SA scopes); a pure RELAY forwards opaque chunks and must get
+    no endpoint credentials at all — same rationale as the e2ee key."""
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.dataplane import BoundGateway, Dataplane
+    from skyplane_tpu.gateway.gateway_program import (
+        GatewayProgram,
+        GatewayReadObjectStore,
+        GatewayReceive,
+        GatewayWriteObjectStore,
+    )
+    from skyplane_tpu.planner.topology import TopologyPlan
+
+    class FakeCloud:
+        def __init__(self, name):
+            self.name = name
+
+        def gateway_credential_payload(self, hosted):
+            if hosted == self.name:
+                return EMPTY_PAYLOAD
+            return GatewayCredentialPayload(env={f"{self.name.upper()}_CRED": "v"})
+
+    def program_with(op):
+        prog = GatewayProgram()
+        prog.add_operator(op)
+        return prog
+
+    plan = TopologyPlan("aws:us-east-1", ["gcp:us-central1"])
+    gw_aws = plan.add_gateway("aws:us-east-1", program_with(GatewayReadObjectStore("b", "aws:us-east-1")))
+    gw_relay = plan.add_gateway("azure:eastus", program_with(GatewayReceive()))
+    gw_gcp = plan.add_gateway("gcp:us-central1", program_with(GatewayWriteObjectStore("b", "gcp:us-central1")))
+    provisioner = types.SimpleNamespace(provider=lambda name: FakeCloud(name))
+    dp = Dataplane(plan, provisioner, TransferConfig())
+    dp.bound_gateways = {
+        gw.gateway_id: BoundGateway(gw, server=None) for gw in (gw_aws, gw_relay, gw_gcp)
+    }
+    payloads = dp._assemble_gateway_credentials()
+    assert payloads[gw_aws.gateway_id].env == {"GCP_CRED": "v"}
+    assert payloads[gw_gcp.gateway_id].env == {"AWS_CRED": "v"}
+    assert gw_relay.gateway_id not in payloads
+
+
+def test_dataplane_local_topology_needs_no_credentials():
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.dataplane import Dataplane
+    from skyplane_tpu.planner.topology import TopologyPlan
+
+    dp = Dataplane(TopologyPlan("local:siteA", ["local:siteB"]), types.SimpleNamespace(), TransferConfig())
+    assert dp._assemble_gateway_credentials() == {}
+
+
+# ---- start_gateway staging ----
+
+
+def test_local_server_start_gateway_stages_env_and_files(tmp_path, monkeypatch):
+    from skyplane_tpu.compute.local import LocalServer
+
+    captured = {}
+
+    class FakePopen:
+        def __init__(self, args, stdout=None, stderr=None, env=None):
+            captured["args"] = args
+            captured["env"] = env
+
+        def poll(self):
+            return None
+
+    import skyplane_tpu.compute.local as local_mod
+
+    monkeypatch.setattr(local_mod.subprocess, "Popen", FakePopen)
+    server = LocalServer("local:siteA", "local-x", tmp_path / "wd")
+    monkeypatch.setattr(LocalServer, "wait_for_gateway_ready", lambda self, timeout=120.0: None)
+    payload = GatewayCredentialPayload(
+        env={"GOOGLE_APPLICATION_CREDENTIALS": "{creds_dir}/gcp_adc.json", "AWS_ACCESS_KEY_ID": "AKIA"},
+        files={"gcp_adc.json": b'{"type":"authorized_user"}'},
+    )
+    server.start_gateway({"plan": []}, {}, "gw_x", use_tls=False, credentials=payload)
+    adc = tmp_path / "wd" / "creds" / "gcp_adc.json"
+    assert adc.read_bytes() == b'{"type":"authorized_user"}'
+    assert (adc.stat().st_mode & 0o777) == 0o600
+    assert ((tmp_path / "wd" / "creds").stat().st_mode & 0o777) == 0o700
+    assert captured["env"]["GOOGLE_APPLICATION_CREDENTIALS"] == str(adc)
+    assert captured["env"]["AWS_ACCESS_KEY_ID"] == "AKIA"
+
+
+def test_ssh_server_start_gateway_stages_env_files_off_the_command_line(monkeypatch):
+    """Secret env values are delivered via write_file (stdin) into 0600
+    env files and SOURCED on the launch line — never spelled out on a
+    command, which run_command logs and ps/cmdline exposes."""
+    from skyplane_tpu.compute import bootstrap
+    from skyplane_tpu.compute.server import SSHServer
+
+    commands: List[str] = []
+    writes = {}
+
+    def fake_run(self, command, timeout=120):
+        commands.append(command)
+        self.last_rc = 0
+        return "", ""
+
+    monkeypatch.setattr(SSHServer, "run_command", fake_run)
+    monkeypatch.setattr(SSHServer, "write_file", lambda self, content, path: writes.update({str(path): content}))
+    monkeypatch.setattr(SSHServer, "tune_network", lambda self, use_bbr: None)
+    monkeypatch.setattr(SSHServer, "_bootstrap_venv", lambda self: None)
+    monkeypatch.setattr(SSHServer, "wait_for_gateway_ready", lambda self, timeout=120.0: None)
+    server = SSHServer("aws:us-east-1", "i-1", "198.51.100.3", "ubuntu", "/dev/null")
+    payload = GatewayCredentialPayload(
+        env={"GOOGLE_APPLICATION_CREDENTIALS": "{creds_dir}/gcp_adc.json", "AWS_SECRET_ACCESS_KEY": "s3cr3t"},
+        files={"gcp_adc.json": b"{}"},
+    )
+    server.start_gateway({"plan": []}, {}, "gw_y", use_tls=False, credentials=payload)
+    creds_dir = f"{bootstrap.REMOTE_ROOT}/creds"
+    assert writes[f"{creds_dir}/gcp_adc.json"] == b"{}"
+    assert any(f"chmod 700 {creds_dir}" in c for c in commands)
+    assert any(c.startswith(f"chmod 600 {shlex.quote(creds_dir + '/gcp_adc.json')}") for c in commands)
+    # the secret value appears in staged FILES only, never in any command
+    assert b"s3cr3t" in writes[f"{creds_dir}/env.sh"]
+    assert b"s3cr3t" in writes[f"{creds_dir}/env.list"]
+    assert all("s3cr3t" not in c for c in commands)
+    assert any(c.startswith(f"chmod 600 {shlex.quote(creds_dir + '/env.sh')}") for c in commands)
+    launch = next(c for c in commands if "nohup" in c)
+    # the env file is sourced before the daemon starts so exports inherit
+    assert launch.startswith(f". {creds_dir}/env.sh && ")
+    assert launch.index("env.sh") < launch.index("gateway_daemon")
+
+
+def test_docker_run_command_uses_env_file_not_inline_secrets():
+    from skyplane_tpu.compute import bootstrap
+
+    cmd = bootstrap.docker_run_command(
+        "img:1", "--region aws:us-east-1", env_file=f"{bootstrap.REMOTE_ROOT}/creds/env.list"
+    )
+    assert f"--env-file {bootstrap.REMOTE_ROOT}/creds/env.list " in cmd
+    assert cmd.index("--env-file") < cmd.index("img:1")
+    assert "-e " not in cmd
+    assert "--env-file" not in bootstrap.docker_run_command("img:1", "--region aws:us-east-1")

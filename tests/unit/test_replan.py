@@ -1,0 +1,259 @@
+"""ReplanMonitor: congestion detection from sender wire counters + re-solve.
+
+The signal separation is the contract (docs/observability.md): a hop whose
+per-frame ACK LAG explodes while local send stall stays proportional is
+congested (network/far side); a hop whose STALL dominates is merely
+saturated locally and must not trigger a detour. Decisions re-solve the
+MILP with the flagged edge derated, at real grid prices.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from skyplane_tpu.planner.replan import ReplanMonitor
+from skyplane_tpu.planner.solver import ThroughputProblem
+
+pytest.importorskip("scipy")
+
+EDGE = ("aws:ap-east-1", "gcp:us-central1")
+
+
+def make_monitor(**kw) -> ReplanMonitor:
+    problem = ThroughputProblem(src=EDGE[0], dst=EDGE[1], required_throughput_gbits=5.0, instance_limit=1)
+    monitor = ReplanMonitor(
+        problem=problem,
+        candidate_regions=["aws:us-east-1", "gcp:asia-east1"],
+        ack_lag_threshold_ms=kw.pop("ack_lag_threshold_ms", 200.0),
+        min_frames=kw.pop("min_frames", 32),
+        cooldown_s=kw.pop("cooldown_s", 60.0),
+        **kw,
+    )
+    # the pin-test throughput profile: the direct edge is thin, relays ample
+    monitor._grid = {
+        EDGE: 1.0,
+        ("aws:ap-east-1", "aws:us-east-1"): 5.0,
+        ("aws:us-east-1", "gcp:us-central1"): 5.0,
+        ("aws:ap-east-1", "gcp:asia-east1"): 5.0,
+        ("gcp:asia-east1", "gcp:us-central1"): 5.0,
+    }
+    orig_resolve = monitor.resolve
+
+    def resolve_with_grid(edge):
+        from skyplane_tpu.planner.solver import ThroughputSolverILP
+
+        solver = ThroughputSolverILP(derated_edges={edge: monitor.derate})
+        solver.grid = monitor._grid
+        sol = solver.solve_min_cost(monitor.problem, monitor.candidate_regions)
+        return sol if sol.is_feasible else None
+
+    monitor.resolve = resolve_with_grid
+    assert orig_resolve is not None
+    return monitor
+
+
+def counters(frames: int, ack_lag_ms: float, stall_ms: float = 0.0) -> dict:
+    return {"frames_sent": frames, "ack_lag_ns": int(ack_lag_ms * 1e6), "wire_stall_ns": int(stall_ms * 1e6)}
+
+
+def sample(c: dict) -> dict:
+    return {"gw_src": (EDGE[0], EDGE[1], c)}
+
+
+def test_healthy_hop_never_flags():
+    monitor = make_monitor()
+    assert monitor.observe(sample(counters(100, ack_lag_ms=100 * 20))) is None  # 20 ms/frame baseline
+    assert monitor.observe(sample(counters(200, ack_lag_ms=200 * 30))) is None  # 30 ms/frame delta
+
+
+def test_ack_lag_dominant_congestion_flags_and_resolves():
+    monitor = make_monitor()
+    assert monitor.observe(sample(counters(100, ack_lag_ms=100 * 20))) is None  # baseline snapshot
+    # delta: 100 new frames at 500 ms/frame ack lag, negligible stall
+    decision = monitor.observe(sample(counters(200, ack_lag_ms=100 * 20 + 100 * 500, stall_ms=100 * 5)))
+    assert decision is not None
+    assert decision.congested_edge == EDGE
+    assert decision.ack_lag_ms_per_frame == pytest.approx(500.0, rel=0.05)
+    assert "ack lag" in decision.reason
+    # the re-solve routed around the derated direct hop via a relay
+    assert decision.solution is not None and decision.solution.is_feasible
+    relayed = {b for (_, b) in decision.solution.edge_flow_gbits if b != EDGE[1]}
+    assert relayed, f"re-solve should relay around the congested edge: {decision.solution.edge_flow_gbits}"
+    d = decision.as_dict()
+    assert d["resolved"] is True and d["congested_edge"] == list(EDGE)
+
+
+def test_stall_dominant_saturation_does_not_flag():
+    """High ack lag WITH even higher local stall = a saturated window, not a
+    congested hop — replanning away from a full-but-healthy pipe is wrong."""
+    monitor = make_monitor()
+    assert monitor.observe(sample(counters(100, ack_lag_ms=0))) is None
+    decision = monitor.observe(
+        sample(counters(200, ack_lag_ms=100 * 500, stall_ms=100 * 900))
+    )
+    assert decision is None
+
+
+def test_min_frames_noise_floor():
+    monitor = make_monitor(min_frames=32)
+    assert monitor.observe(sample(counters(4, ack_lag_ms=4 * 10_000))) is None  # 4 frames: noise
+
+
+def test_cooldown_suppresses_decision_storm():
+    monitor = make_monitor(cooldown_s=3600.0)
+    assert monitor.observe(sample(counters(100, ack_lag_ms=0))) is None
+    first = monitor.observe(sample(counters(200, ack_lag_ms=100 * 500)))
+    assert first is not None
+    second = monitor.observe(sample(counters(300, ack_lag_ms=200 * 500 + 100 * 500)))
+    assert second is None, "a second decision inside the cooldown window must be suppressed"
+
+
+def test_first_sighting_is_baseline_never_judged():
+    """A reused daemon's counters are lifetime-cumulative: the first sample
+    per gateway must only seed the baseline, or stale history flags a
+    perfectly healthy hop."""
+    monitor = make_monitor()
+    assert monitor.observe(sample(counters(10_000, ack_lag_ms=10_000 * 900))) is None
+    # and the NEXT healthy delta is judged against that baseline, not zero
+    assert monitor.observe(sample(counters(10_100, ack_lag_ms=10_000 * 900 + 100 * 20))) is None
+
+
+def test_congested_hop_below_per_poll_noise_floor_accumulates():
+    """Severe congestion collapses per-poll frame throughput below
+    min_frames; the baseline must hold still so deltas accumulate across
+    polls instead of resetting the window every wave (which would blind the
+    monitor exactly when it matters most)."""
+    monitor = make_monitor(min_frames=32)
+    assert monitor.observe(sample(counters(100, ack_lag_ms=0))) is None  # baseline
+    total_f, total_ack = 100, 0.0
+    decision = None
+    for _ in range(3):  # ~15 frames/poll at 500 ms/frame ack lag
+        total_f += 15
+        total_ack += 15 * 500
+        decision = monitor.observe(sample(counters(total_f, ack_lag_ms=total_ack)))
+        if decision is not None:
+            break
+    assert decision is not None, "deltas must accumulate across sub-noise-floor polls"
+    assert decision.frames_observed == 45
+    assert decision.ack_lag_ms_per_frame == pytest.approx(500.0, rel=0.05)
+
+
+def test_tracker_labels_replan_samples_with_program_next_hop():
+    """In an overlay the source gateway's wire counters measure the
+    src->relay hop: the tracker must label the sample with the program's
+    send target, not the final destination — or the monitor derates an edge
+    nobody measured. Also proves the tracker->monitor->hooks wiring end to
+    end (replan_events + on_replan)."""
+    import types
+
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.tracker import TransferHook, TransferProgressTracker
+    from skyplane_tpu.planner.replan import ReplanDecision
+
+    captured = {}
+
+    class FakeMonitor:
+        def observe(self, samples):
+            captured.update(samples)
+            return ReplanDecision(
+                congested_edge=("aws:ap-east-1", "aws:us-east-1"),
+                gateway_id="gw_src",
+                ack_lag_ms_per_frame=500.0,
+                stall_ms_per_frame=1.0,
+                frames_observed=100,
+                reason="test",
+                solution=None,
+            )
+
+    class FakeSession:
+        def get(self, url, timeout=None):
+            return types.SimpleNamespace(json=lambda: {"counters": {"frames_sent": 100}})
+
+    gw = types.SimpleNamespace(
+        gateway_id="gw_src",
+        region_tag="aws:ap-east-1",
+        control_session=lambda: FakeSession(),
+        control_url=lambda: "http://gw",
+    )
+    topology = types.SimpleNamespace(
+        get_outgoing_paths=lambda gid: {"gw_relay": 2},
+        gateways={"gw_relay": types.SimpleNamespace(region_tag="aws:us-east-1")},
+    )
+    dp = types.SimpleNamespace(
+        replanner=FakeMonitor(),
+        topology=topology,
+        source_gateways=lambda: [gw],
+        dst_region_tags=["gcp:us-central1"],
+        src_region_tag="aws:ap-east-1",
+        _trackers=[],
+    )
+    hook_decisions = []
+
+    class Hook(TransferHook):
+        def on_replan(self, decision):
+            hook_decisions.append(decision)
+
+    tracker = TransferProgressTracker(dp, [], TransferConfig(), hooks=Hook())
+    tracker._maybe_replan()
+    assert captured["gw_src"][:2] == ("aws:ap-east-1", "aws:us-east-1"), "must label the relay hop, not dst[0]"
+    assert tracker.replan_events and tracker.replan_events[0]["gateway_id"] == "gw_src"
+    assert len(hook_decisions) == 1
+
+
+def test_overlay_planner_exposes_milp_inputs_and_pipeline_attaches_monitor(tmp_path):
+    """The replan integration must be REACHABLE: an overlay plan records its
+    MILP inputs and create_dataplane turns them into a live ReplanMonitor on
+    the dataplane (otherwise _maybe_replan is dead code behind a replanner
+    attribute nobody sets)."""
+    import csv
+
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.transfer_job import CopyJob
+    from skyplane_tpu.obj_store.posix_file_interface import POSIXInterface
+    from skyplane_tpu.planner.planner import OverlayPlanner
+
+    profile = tmp_path / "grid.csv"
+    with profile.open("w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["src_region", "dst_region", "gbps"])
+        w.writerow(["aws:a", "aws:b", "0.5"])
+        w.writerow(["aws:a", "aws:c", "6.0"])
+        w.writerow(["aws:c", "aws:b", "5.0"])
+    (tmp_path / "src").mkdir(exist_ok=True)
+    (tmp_path / "src" / "x").write_bytes(b"d")
+    job = CopyJob("local:///x", ["local:///x"])
+    job._src_iface = POSIXInterface(str(tmp_path / "src"), region_tag="aws:a")
+    job._dst_ifaces = [POSIXInterface(str(tmp_path / "dst"), region_tag="aws:b")]
+    planner = OverlayPlanner(TransferConfig(), solver="ilp", profile_path=str(profile))
+    planner.plan([job])
+    assert planner.last_problem is not None
+    assert planner.last_problem.src == "aws:a" and planner.last_problem.dst == "aws:b"
+    assert "aws:c" in (planner.last_candidates or [])
+
+    from skyplane_tpu.api.pipeline import Pipeline
+
+    pipe = Pipeline(planning_algorithm="ilp")
+    pipe.jobs_to_dispatch.append(job)
+    monkey_planner = planner
+
+    pipe.planner = lambda: monkey_planner
+    dp = pipe.create_dataplane()
+    assert dp.replanner is not None
+    assert dp.replanner.problem.src == "aws:a"
+
+
+def test_worst_hop_wins_across_gateways():
+    monitor = make_monitor()
+    base = {
+        "gw_a": (EDGE[0], EDGE[1], counters(100, ack_lag_ms=0)),
+        "gw_b": ("aws:us-east-1", EDGE[1], counters(100, ack_lag_ms=0)),
+    }
+    assert monitor.observe(base) is None
+    wave = {
+        "gw_a": (EDGE[0], EDGE[1], counters(200, ack_lag_ms=100 * 300)),
+        "gw_b": ("aws:us-east-1", EDGE[1], counters(200, ack_lag_ms=100 * 900)),
+    }
+    decision = monitor.observe(wave)
+    assert decision is not None
+    assert decision.gateway_id == "gw_b"
+    assert decision.congested_edge == ("aws:us-east-1", EDGE[1])

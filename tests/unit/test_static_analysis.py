@@ -367,6 +367,91 @@ def f():
     assert findings and all(f.suppressed for f in findings)
 
 
+def test_unbounded_wait_in_provisioner_fires_on_deadlineless_poll_loop():
+    """The bug class behind the r05 rc=124 artifact loss: a provisioning
+    wait that can spin forever (docs/provisioning.md)."""
+    src = """
+import time
+
+def wait_running(check):
+    while True:
+        if check():
+            break
+        time.sleep(5)
+"""
+    findings = [
+        f for f in run_source(src, "skyplane_tpu/compute/fixture.py") if f.rule == "unbounded-wait-in-provisioner"
+    ]
+    assert len(findings) == 1
+    assert "deadline" in findings[0].message
+
+
+def test_unbounded_wait_in_provisioner_quiet_when_bounded_or_elsewhere():
+    deadline_in_test = """
+import time
+
+def wait_op(url):
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if done(url):
+            return
+        time.sleep(2)
+    raise TimeoutError(url)
+"""
+    deadline_in_body = """
+import time
+
+def wait_state(get):
+    deadline = time.time() + 600
+    while True:
+        if get() == "RUNNING":
+            break
+        if time.time() >= deadline:
+            raise TimeoutError("not RUNNING after 600s")
+        time.sleep(10)
+"""
+    bounded_for = """
+import time
+
+def probe(fn):
+    for _ in range(20):
+        if fn():
+            return True
+        time.sleep(0.5)
+    return False
+"""
+    pagination = """
+def drain(api):
+    req = api.first()
+    while req is not None:
+        req = api.next(req)
+"""
+    for fixture in (deadline_in_test, deadline_in_body, bounded_for, pagination):
+        assert not [
+            f for f in run_source(fixture, "skyplane_tpu/compute/fixture.py") if f.rule == "unbounded-wait-in-provisioner"
+        ], fixture
+    # the same deadlineless loop OUTSIDE compute/ is not this rule's business
+    src = deadline_in_test.replace("deadline = time.time() + 300\n    while time.time() < deadline:", "while True:")
+    assert not [
+        f for f in run_source(src, "skyplane_tpu/gateway/fixture.py") if f.rule == "unbounded-wait-in-provisioner"
+    ]
+
+
+def test_unbounded_wait_in_provisioner_suppressible():
+    src = """
+import time
+
+def wait_forever(check):
+    # sklint: disable=unbounded-wait-in-provisioner -- fixture: caller holds the watchdog
+    while not check():
+        time.sleep(1)
+"""
+    findings = [
+        f for f in run_source(src, "skyplane_tpu/compute/fixture.py") if f.rule == "unbounded-wait-in-provisioner"
+    ]
+    assert findings and all(f.suppressed for f in findings)
+
+
 # ------------------------------------------------------------- span rules
 
 
