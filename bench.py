@@ -679,15 +679,11 @@ def bench_wire() -> dict:
 
 # trace-derived per-stage latency breakdown (docs/observability.md): where a
 # chunk's wall time goes across the lifecycle. check_bench_json.py requires
-# every key, so a future perf PR can prove WHERE it moved time.
+# every key, so a future perf PR can prove WHERE it moved time. The stage ->
+# span mapping and the arithmetic live in obs/collector.py (STAGE_SPANS /
+# stage_breakdown) — the SAME code path `skyplane-tpu bottleneck` aggregates
+# fleet traces with, so the two reconcile by construction.
 TRACE_STAGES = ("frame", "send_stall", "ack_lag", "decode", "store")
-_STAGE_SPAN = {
-    "frame": "wire.frame",
-    "send_stall": "wire.send_stall",
-    "ack_lag": "wire.ack_lag",
-    "decode": "decode",
-    "store": "store.write",
-}
 
 
 def bench_trace(untraced_wall_s: float) -> dict:
@@ -778,28 +774,24 @@ def bench_trace(untraced_wall_s: float) -> dict:
             json.dump(export, f)
         log(f"trace written to {trace_out} (loads in https://ui.perfetto.dev)")
 
-    durs = {}
+    from skyplane_tpu.obs.collector import stage_breakdown
+
+    n_spans = 0
     n_chunk_spans = 0
     for ev in export["traceEvents"]:
-        ph = ev.get("ph")
-        if ph == "X":
-            durs.setdefault(ev["name"], []).append(float(ev.get("dur", 0.0)))
-        elif ph == "b":
-            durs.setdefault(ev["name"], []).append(float(ev.get("args", {}).get("dur_us", 0.0)))
-        else:
+        if ev.get("ph") not in ("X", "b"):
             continue
+        n_spans += 1
         if ev.get("args", {}).get("chunk_id"):
             n_chunk_spans += 1
-    stage_latency_us = {}
-    for stage, span_name in _STAGE_SPAN.items():
-        vals = durs.get(span_name, [])
-        stage_latency_us[stage] = round(sum(vals) / len(vals), 3) if vals else 0.0
+    breakdown = stage_breakdown(export["traceEvents"])
+    stage_latency_us = {stage: row["mean_us"] for stage, row in breakdown.items()}
     spans_per_chunk = max(1.0, n_chunk_spans / max(1, len(frames)))
     overhead_pct = 100.0 * (noop_span_ns * spans_per_chunk * len(frames)) / max(1.0, untraced_wall_s * 1e9)
     return {
         "stage_latency_us": stage_latency_us,
         "trace_overhead_pct": round(overhead_pct, 5),
-        "trace_spans": sum(len(v) for v in durs.values()),
+        "trace_spans": n_spans,
         "noop_span_ns": round(noop_span_ns, 1),
     }
 

@@ -13,7 +13,12 @@ Three result shapes are recognized, dispatched on the ``metric`` field:
     faults actually injected across >=5 armed points, byte-for-byte corpus
     integrity, seed-replay determinism, zero leaked scheduler tokens / pool
     buffers, bounded fd growth, and bounded recovery time
-    (docs/fault-injection.md).
+    (docs/fault-injection.md);
+  * scripts/monitor_smoke.py results (``metric: fleet_telemetry``): the fleet
+    telemetry smoke — a 2-hop relay transfer collector-merged into one
+    timeline, the flight-recorder fleet log complete and ordered, bottleneck
+    attribution reconciling within 10%, and collector overhead < 2% per poll
+    cycle (docs/observability.md).
 
 Exit 0 iff the result parses and every required key is present; used by the
 bench-smoke, multijob-smoke, and chaos-smoke steps in scripts/devloop.sh so a
@@ -132,6 +137,105 @@ REQUIRED_CHAOS = (
 #: the acceptance floor: a chaos run proves nothing unless it injected faults
 #: across at least this many distinct points of the stack
 MIN_CHAOS_POINTS = 5
+
+# fleet-telemetry smoke result (scripts/monitor_smoke.py / docs/observability.md):
+# a loopback 2-hop relay transfer scraped by the TelemetryCollector — merged
+# multi-gateway timeline, tailed flight-recorder fleet log, bottleneck
+# attribution reconciliation, and the collector's own overhead
+REQUIRED_FLEET = (
+    "metric",
+    "value",
+    "unit",
+    "fleet_gateways",
+    "fleet_trace_events",
+    "fleet_gateway_rows",
+    "fleet_multihop_chunks",
+    "fleet_events_tailed",
+    "fleet_lifecycle_events",
+    "fleet_fault_events",
+    "fleet_events_in_order",
+    "fleet_log_path",
+    "fleet_log_lines",
+    "fleet_stage_latency_us",
+    "fleet_reconcile_pct",
+    "fleet_stale_gateways",
+    "collector_scrapes",
+    "collector_overhead_pct",
+    "collector_poll_interval_s",
+)
+# the bottleneck report's stage axis (obs/collector.py BOTTLENECK_STAGES)
+REQUIRED_FLEET_STAGES = ("frame", "send_stall", "ack_lag", "decode", "store", "device_wait")
+#: fleet-vs-local stage attribution must reconcile within this bound
+#: (ISSUE 9 acceptance: bottleneck totals vs bench-style stage means)
+MAX_FLEET_RECONCILE_PCT = 10.0
+#: the collector's CPU cost per poll cycle, as % of the poll interval
+MAX_COLLECTOR_OVERHEAD_PCT = 2.0
+
+
+def check_fleet(result: dict) -> int:
+    missing = [k for k in REQUIRED_FLEET if k not in result]
+    stages = result.get("fleet_stage_latency_us")
+    if not isinstance(stages, dict):
+        missing.append("fleet_stage_latency_us(dict)")
+    else:
+        missing += [f"fleet_stage_latency_us.{k}" for k in REQUIRED_FLEET_STAGES if k not in stages]
+    if missing:
+        print(f"monitor-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    if result["fleet_gateways"] < 3:
+        print(f"monitor-smoke: only {result['fleet_gateways']} gateways scraped; a 2-hop relay needs 3", file=sys.stderr)
+        return 1
+    if result["fleet_gateway_rows"] < 3:
+        print(
+            f"monitor-smoke: merged timeline shows {result['fleet_gateway_rows']} gateway rows "
+            "(need source+relay+destination)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["fleet_multihop_chunks"] < 1:
+        print("monitor-smoke: no chunk stitched across the full source->relay->destination path", file=sys.stderr)
+        return 1
+    if result["fleet_lifecycle_events"] < 2 or result["fleet_fault_events"] < 1:
+        print(
+            f"monitor-smoke: fleet log incomplete — {result['fleet_lifecycle_events']} lifecycle "
+            f"event(s), {result['fleet_fault_events']} fault event(s)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["fleet_events_in_order"] is not True:
+        print("monitor-smoke: fleet event log is not in seq order per recorder", file=sys.stderr)
+        return 1
+    if result["fleet_log_lines"] < result["fleet_events_tailed"]:
+        print(
+            f"monitor-smoke: JSONL fleet log holds {result['fleet_log_lines']} lines but "
+            f"{result['fleet_events_tailed']} events were tailed",
+            file=sys.stderr,
+        )
+        return 1
+    rec = result["fleet_reconcile_pct"]
+    if not isinstance(rec, (int, float)) or rec < 0 or rec > MAX_FLEET_RECONCILE_PCT:
+        print(
+            f"monitor-smoke: bottleneck stage attribution diverges {rec!r}% from the local trace "
+            f"(bound {MAX_FLEET_RECONCILE_PCT}%) — the merge/dedupe dropped or duplicated spans",
+            file=sys.stderr,
+        )
+        return 1
+    overhead = result["collector_overhead_pct"]
+    if not isinstance(overhead, (int, float)) or overhead < 0 or overhead >= MAX_COLLECTOR_OVERHEAD_PCT:
+        print(
+            f"monitor-smoke: collector overhead {overhead!r}% breaches the "
+            f"{MAX_COLLECTOR_OVERHEAD_PCT}% budget per poll cycle",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"monitor-smoke OK: {result['fleet_gateways']} gateways, {result['fleet_gateway_rows']} timeline rows, "
+        f"{result['fleet_multihop_chunks']} chunk(s) full-path stitched, "
+        f"{result['fleet_events_tailed']} fleet events ({result['fleet_fault_events']} fault, "
+        f"{result['fleet_lifecycle_events']} lifecycle) in order, reconcile {rec}%, "
+        f"collector overhead {overhead}%/cycle"
+    )
+    return 0
 
 
 def check_chaos(result: dict) -> int:
@@ -276,6 +380,8 @@ def main(argv) -> int:
         return check_multijob(result)
     if result.get("metric") == "chaos_gbps":
         return check_chaos(result)
+    if result.get("metric") == "fleet_telemetry":
+        return check_fleet(result)
     missing = [k for k in REQUIRED_TOP if k not in result]
     counters = result.get("datapath_counters")
     if not isinstance(counters, dict):

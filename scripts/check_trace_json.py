@@ -15,6 +15,18 @@ Used by the devloop trace-smoke step on the trace bench.py exports
      (cat "sender") and a receiver-side span (cat "receiver") — the
      cross-wire stitching the TRACED header flag exists for.
 
+With ``--multihop`` (the collector-merged fleet timeline of a relayed
+transfer, docs/observability.md), additionally:
+
+  5. gateway rows: the merged trace carries >= 3 ``process_name`` metadata
+     rows (source, relay, destination get their own Perfetto processes);
+  6. full-path stitching: at least one chunk's spans carry >= 3 distinct
+     ``args.gateway`` values, with sender-side spans at >= 2 gateways (the
+     source AND the forwarding relay) and receiver-side spans at >= 2 (the
+     relay AND the destination);
+  7. hop indices: sender spans carry ``args.hop`` values 0 and 1 — the
+     pre-registration hop propagation regresses silently otherwise.
+
 Exit 0 iff all hold. A trace with zero events fails loudly: an empty export
 from a "sampled" run means the sampling/flag plumbing regressed.
 """
@@ -33,7 +45,57 @@ def fail(msg: str) -> int:
     return 1
 
 
-def validate(trace: dict) -> int:
+def validate_multihop(trace: dict) -> int:
+    """Checks 5-7: the merged fleet timeline of a >= 2-hop relay transfer."""
+    events = trace.get("traceEvents", [])
+    process_rows = {
+        (e.get("pid"), (e.get("args") or {}).get("name"))
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    if len(process_rows) < 3:
+        return fail(
+            f"merged trace shows {len(process_rows)} gateway process rows; a 2-hop relay transfer "
+            "must produce >= 3 (source, relay, destination) — did the collector merge regroup by args.gateway?"
+        )
+    per_chunk: dict = {}
+    hops = set()
+    for ev in events:
+        args = ev.get("args") or {}
+        cid, gw = args.get("chunk_id"), args.get("gateway")
+        if ev.get("cat") == "sender" and isinstance(args.get("hop"), int):
+            hops.add(args["hop"])
+        if not cid or not gw:
+            continue
+        entry = per_chunk.setdefault(cid, {"gateways": set(), "sender": set(), "receiver": set()})
+        entry["gateways"].add(gw)
+        if ev.get("cat") in ("sender", "receiver"):
+            entry[ev["cat"]].add(gw)
+    full_path = [
+        cid
+        for cid, e in per_chunk.items()
+        if len(e["gateways"]) >= 3 and len(e["sender"]) >= 2 and len(e["receiver"]) >= 2
+    ]
+    if not full_path:
+        best = max(per_chunk.values(), key=lambda e: len(e["gateways"]), default=None)
+        return fail(
+            "no chunk's spans stitch across source, relay AND destination gateways "
+            f"(best chunk saw gateways {sorted(best['gateways']) if best else []}) — "
+            "relay TRACED propagation or gateway span args regressed"
+        )
+    if not {0, 1} <= hops:
+        return fail(
+            f"sender spans carry hop indices {sorted(hops)}; a relayed transfer must show hops 0 AND 1 "
+            "(chunk pre-registration hop propagation regressed)"
+        )
+    print(
+        f"trace-smoke multihop OK: {len(process_rows)} gateway rows, {len(full_path)} chunk(s) stitched "
+        f"across the full source->relay->destination path, sender hops {sorted(hops)}"
+    )
+    return 0
+
+
+def validate(trace: dict, multihop: bool = False) -> int:
     if not isinstance(trace, dict) or not isinstance(trace.get("traceEvents"), list):
         return fail("not a Chrome trace: expected a dict with a traceEvents list")
     events = trace["traceEvents"]
@@ -98,19 +160,29 @@ def validate(trace: dict) -> int:
         f"trace-smoke OK: {len(events)} events, {len(spans)} spans on {len(tracks)} tracks, "
         f"{len(stitched)} chunk(s) stitched across sender+receiver"
     )
+    if multihop:
+        return validate_multihop(trace)
     return 0
 
 
 def main(argv) -> int:
-    if len(argv) != 2:
-        print("usage: check_trace_json.py <trace.json>", file=sys.stderr)
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    flags = [a for a in argv[1:] if a.startswith("--")]
+    # unknown flags are a hard error: a typo'd --multihop must not silently
+    # downgrade the gate to single-hop checks and exit green
+    unknown = [f for f in flags if f != "--multihop"]
+    if len(args) != 1 or unknown:
+        if unknown:
+            print(f"unknown flag(s): {' '.join(unknown)}", file=sys.stderr)
+        print("usage: check_trace_json.py <trace.json> [--multihop]", file=sys.stderr)
         return 2
+    multihop = "--multihop" in flags
     try:
-        with open(argv[1]) as f:
+        with open(args[0]) as f:
             trace = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
-        return fail(f"cannot load {argv[1]}: {e}")
-    return validate(trace)
+        return fail(f"cannot load {args[0]}: {e}")
+    return validate(trace, multihop=multihop)
 
 
 if __name__ == "__main__":

@@ -89,6 +89,33 @@ else
   echo "[devloop] trace-smoke clean; trace at $LOGDIR/trace_smoke.json" >>"$LOGDIR/devloop.log"
 fi
 
+# Monitor-smoke gate (CPU-only, seconds): the fleet telemetry plane end to
+# end (scripts/monitor_smoke.py, docs/observability.md) — a fully-sampled
+# loopback 2-hop relay transfer (src -> relay -> dst) with one armed fault,
+# scraped live by the TelemetryCollector: the merged multi-gateway timeline
+# must pass check_trace_json --multihop (same chunk on source, relay AND
+# destination rows, sender hops 0+1), the flight-recorder fleet log must hold
+# the transfer lifecycle plus the fault firing in seq order, and the
+# bottleneck attribution must reconcile with the local trace within 10% with
+# collector overhead < 2%/cycle (fleet branch of check_bench_json.py). Like
+# the other smokes: failures are logged LOUDLY but do not block profiling.
+JAX_PLATFORMS=cpu SKYPLANE_MONITOR_TRACE_OUT="$LOGDIR/monitor_trace.json" \
+  python scripts/monitor_smoke.py >"$LOGDIR/monitor_smoke.out" 2>"$LOGDIR/monitor_smoke.err"
+MONITOR_RC=$?
+if [ "$MONITOR_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/monitor_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  MONITOR_RC=$?
+fi
+if [ "$MONITOR_RC" -eq 0 ]; then
+  python scripts/check_trace_json.py "$LOGDIR/monitor_trace.json" --multihop >>"$LOGDIR/devloop.log" 2>&1
+  MONITOR_RC=$?
+fi
+if [ "$MONITOR_RC" -ne 0 ]; then
+  echo "[devloop] MONITOR-SMOKE FAILURE (rc=$MONITOR_RC) — collector merge, multihop stitching, fleet log, or bottleneck gates regressed; see $LOGDIR/monitor_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] monitor-smoke clean; result at $LOGDIR/monitor_smoke.out, merged trace at $LOGDIR/monitor_trace.json" >>"$LOGDIR/devloop.log"
+fi
+
 # Multijob-smoke gate (CPU-only, ~1 min): >= 8 concurrent tenants over the
 # loopback stack (scripts/soak_multijob.py) — per-tenant Gbps split must stay
 # within the 2x fairness bound for equal weights, index RSS bounded, no fd

@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Fleet-telemetry smoke: 2-hop relay transfer → collector merge → bottleneck.
+
+The ISSUE 9 acceptance scenario end to end, on loopback, in seconds:
+
+  1. source → relay → destination gateways (in-process daemons, the full
+     framed-socket data plane) run one fully-sampled transfer driven by the
+     REAL TransferProgressTracker, with one fault armed (an injected
+     sender-socket error) so the flight recorder sees a firing and the
+     recovery that follows;
+  2. a TelemetryCollector scrapes all three gateways' /metrics, /trace,
+     /events and /profile/cpu endpoints while the transfer runs, tails the
+     flight recorder into a JSONL fleet log, and merges the traces into ONE
+     multi-gateway Perfetto timeline (written to SKYPLANE_MONITOR_TRACE_OUT
+     for check_trace_json.py --multihop);
+  3. the bottleneck report over the merged timeline must reconcile with the
+     local tracer's stage breakdown within 10% (the merge/dedupe proof), and
+     the collector's per-cycle CPU cost must stay under 2% of its poll
+     interval.
+
+Prints ONE JSON result line (``metric: fleet_telemetry``) validated by the
+fleet branch of scripts/check_bench_json.py; scripts/devloop.sh runs this as
+the monitor-smoke step. Env knobs: SKYPLANE_MONITOR_MB (default 2),
+SKYPLANE_MONITOR_CHUNK_KB (default 128), SKYPLANE_MONITOR_TRACE_OUT.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+sys.path.insert(0, str(REPO / "scripts"))
+
+import numpy as np  # noqa: E402
+
+import check_trace_json  # noqa: E402
+from integration.harness import HarnessCopyJob, LocalGateway, StubDataplane, bind_gateway, start_gateway  # noqa: E402
+from skyplane_tpu.api.config import TransferConfig  # noqa: E402
+from skyplane_tpu.api.tracker import TransferProgressTracker  # noqa: E402
+from skyplane_tpu.faults import FaultPlan, FaultSpec, configure_injector  # noqa: E402
+from skyplane_tpu.obs import configure_recorder, configure_tracer, get_recorder, get_tracer  # noqa: E402
+from skyplane_tpu.obs.collector import (  # noqa: E402
+    BOTTLENECK_STAGES,
+    GatewayTarget,
+    TelemetryCollector,
+    bottleneck_report,
+    format_bottleneck,
+    stage_breakdown,
+)
+
+POLL_INTERVAL_S = 0.5  # smoke cadence; overhead is judged against the 2s production default
+DEFAULT_POLL_S = 2.0
+
+
+def log(msg: str) -> None:
+    print(f"[monitor-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+def build_fleet(tmp: Path):
+    """source → relay → destination, data TLS off for smoke speed."""
+    dst = start_gateway(
+        {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "receive",
+                            "handle": "recv",
+                            "dedup": False,
+                            "children": [{"op_type": "write_local", "handle": "write", "children": []}],
+                        }
+                    ],
+                }
+            ]
+        },
+        {},
+        "gw_dst",
+        str(tmp / "dst_chunks"),
+        use_tls=False,
+    )
+    relay = start_gateway(
+        {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "receive",
+                            "handle": "recv",
+                            "dedup": False,
+                            "children": [
+                                {
+                                    "op_type": "send",
+                                    "handle": "fwd",
+                                    "target_gateway_id": "gw_dst",
+                                    "num_connections": 2,
+                                    "compress": "none",
+                                    "encrypt": False,
+                                    "dedup": False,
+                                    "children": [],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        },
+        {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}},
+        "gw_relay",
+        str(tmp / "relay_chunks"),
+        use_tls=False,
+    )
+    src = start_gateway(
+        {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "read_local",
+                            "handle": "read",
+                            "num_connections": 2,
+                            "children": [
+                                {
+                                    "op_type": "send",
+                                    "handle": "send",
+                                    "target_gateway_id": "gw_relay",
+                                    "num_connections": 2,
+                                    "compress": "none",
+                                    "encrypt": False,
+                                    "dedup": False,
+                                    "children": [],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        },
+        {"gw_relay": {"public_ip": "127.0.0.1", "control_port": relay.control_port}},
+        "gw_src",
+        str(tmp / "src_chunks"),
+        use_tls=False,
+    )
+    return src, relay, dst
+
+
+def target_for(gw: LocalGateway, region: str) -> GatewayTarget:
+    base = gw.url("").rstrip("/")
+    return GatewayTarget(gw.daemon.gateway_id, base, region=region, session_fn=gw.session)
+
+
+def main() -> int:
+    mb = _env_int("SKYPLANE_MONITOR_MB", 2)
+    chunk_kb = _env_int("SKYPLANE_MONITOR_CHUNK_KB", 128)
+    trace_out = os.environ.get("SKYPLANE_MONITOR_TRACE_OUT", "")
+
+    # fully-sampled tracing, a fresh flight recorder, and ONE armed fault:
+    # the 4th sender.send evaluation raises a socket error (stream resets,
+    # the chunk resends) — the fleet log must show the firing AND recovery
+    configure_tracer(sample=1.0)
+    configure_recorder()
+    configure_injector(
+        FaultPlan(seed=1234, points={"sender.send": FaultSpec(p=1.0, after=3, max_fires=1)})
+    )
+
+    tmp = Path(tempfile.mkdtemp(prefix="skyplane_monitor_smoke_"))
+    rng = np.random.default_rng(7)
+    payload = rng.integers(0, 256, (mb << 20) // 2, dtype=np.uint8).tobytes() + bytes((mb << 20) // 2)
+    src_file = tmp / "corpus.bin"
+    dst_file = tmp / "out" / "corpus.bin"
+    src_file.write_bytes(payload)
+
+    log(f"starting 3-gateway fleet ({mb} MiB corpus, {chunk_kb} KiB chunks)")
+    src, relay, dst = build_fleet(tmp)
+    fleet_log = str(tmp / "fleet_events.jsonl")
+    collector = TelemetryCollector(
+        [
+            target_for(src, "local:srcA"),
+            target_for(relay, "local:relayB"),
+            target_for(dst, "local:dstC"),
+        ],
+        poll_interval_s=POLL_INTERVAL_S,
+        scrape_timeout_s=5.0,
+        local_recorder=get_recorder(),
+        fleet_log_path=fleet_log,
+        label="monitor-smoke",
+    )
+    rc = 1
+    try:
+        dp = StubDataplane([bind_gateway(src, "local:srcA")], [bind_gateway(dst, "local:dstC")])
+        job = HarnessCopyJob(src_file, dst_file, chunk_bytes=chunk_kb << 10, batch_size=4)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig())
+        collector.start()
+        t0 = time.time()
+        tracker.start()
+        tracker.join(timeout=120)
+        if tracker.is_alive() or tracker.error is not None:
+            log(f"FAIL: transfer did not complete (error={tracker.error})")
+            return 1
+        log(f"transfer complete in {time.time() - t0:.2f}s; stopping collector")
+        collector.stop(final_poll=True)
+
+        if hashlib.md5(dst_file.read_bytes()).hexdigest() != hashlib.md5(payload).hexdigest():
+            log("FAIL: destination corpus is not byte-identical")
+            return 1
+
+        # ---- merged timeline + multihop validation ----
+        merged = collector.merged_trace()
+        if trace_out:
+            with open(trace_out, "w") as f:
+                json.dump(merged, f)
+            log(f"merged fleet trace written to {trace_out}")
+        # validator chatter goes to stderr: stdout carries ONLY the result line
+        import contextlib
+
+        with contextlib.redirect_stdout(sys.stderr):
+            multihop_rc = check_trace_json.validate(merged, multihop=True)
+        if multihop_rc != 0:
+            log("FAIL: merged trace failed multihop validation")
+            return 1
+        gateway_rows = len(
+            {
+                e.get("pid")
+                for e in merged["traceEvents"]
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+            }
+        )
+        per_chunk: dict = {}
+        for ev in merged["traceEvents"]:
+            args = ev.get("args") or {}
+            if args.get("chunk_id") and args.get("gateway"):
+                per_chunk.setdefault(args["chunk_id"], set()).add(args["gateway"])
+        multihop_chunks = sum(1 for gws in per_chunk.values() if len(gws) >= 3)
+
+        # ---- fleet event log ----
+        events = collector.fleet_events()
+        lifecycle = [e for e in events if str(e.get("kind", "")).startswith("transfer.")]
+        faults = [e for e in events if e.get("kind") == "fault.fired"]
+        by_recorder: dict = {}
+        for e in events:
+            by_recorder.setdefault(e.get("recorder"), []).append(e.get("seq"))
+        in_order = all(seqs == sorted(seqs) for seqs in by_recorder.values())
+        log_lines = sum(1 for ln in open(fleet_log) if ln.strip()) if os.path.exists(fleet_log) else 0
+
+        # ---- bottleneck attribution + reconciliation ----
+        report = bottleneck_report(merged, collector.cpu_profiles())
+        local = stage_breakdown(get_tracer().export()["traceEvents"])
+        reconcile_pct = 0.0
+        for stage in BOTTLENECK_STAGES:
+            a, b = report["stages"][stage]["total_us"], local[stage]["total_us"]
+            if max(a, b) > 0:
+                reconcile_pct = max(reconcile_pct, 100.0 * abs(a - b) / max(a, b))
+        print(format_bottleneck(report), file=sys.stderr)
+
+        # ---- collector overhead: CPU per poll cycle vs the production
+        # interval (deterministic — not wall-clock noise) ----
+        cycles = 5
+        cpu0 = time.process_time()
+        for _ in range(cycles):
+            collector.poll_once()
+        cycle_cpu_s = (time.process_time() - cpu0) / cycles
+        overhead_pct = 100.0 * cycle_cpu_s / DEFAULT_POLL_S
+
+        counters = collector.counters()
+        result = {
+            "metric": "fleet_telemetry",
+            "value": counters["collector_gateways"],
+            "unit": "gateways",
+            "fleet_gateways": counters["collector_gateways"],
+            "fleet_trace_events": len(merged["traceEvents"]),
+            "fleet_gateway_rows": gateway_rows,
+            "fleet_multihop_chunks": multihop_chunks,
+            "fleet_events_tailed": counters["collector_events_tailed"],
+            "fleet_lifecycle_events": len(lifecycle),
+            "fleet_fault_events": len(faults),
+            "fleet_events_in_order": in_order,
+            "fleet_log_path": fleet_log,
+            "fleet_log_lines": log_lines,
+            "fleet_stage_latency_us": {s: report["stages"][s]["mean_us"] for s in BOTTLENECK_STAGES},
+            "fleet_reconcile_pct": round(reconcile_pct, 3),
+            "fleet_stale_gateways": counters["collector_stale_gateways"],
+            "collector_scrapes": counters["collector_scrapes"],
+            "collector_scrape_failures": counters["collector_scrape_failures"],
+            "collector_overhead_pct": round(overhead_pct, 5),
+            "collector_poll_interval_s": DEFAULT_POLL_S,
+        }
+        print(json.dumps(result))
+        rc = 0
+    finally:
+        try:
+            collector.stop(final_poll=False)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        for gw in (src, relay, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        configure_injector(None)
+        configure_tracer()
+        configure_recorder()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -687,6 +687,140 @@ class UnboundedWaitInProvisionerChecker(Checker):
         return False
 
 
+_EVENTISH_FRAGMENTS = ("event", "firing", "journal", "history")
+_BOUND_MAINT_METHODS = {"pop", "popleft", "clear"}
+
+
+def _is_eventish(name: str) -> bool:
+    """Terminal attribute names that smell like an append-only event record:
+    'events', 'firing_log', 'status_journal', 'chunk_status_log', '_log'.
+    Plain '...log'-suffixed words ('catalog') and 'logger' do not match."""
+    lowered = name.lower()
+    return (
+        any(frag in lowered for frag in _EVENTISH_FRAGMENTS)
+        or lowered == "log"
+        or lowered.endswith("_log")
+    )
+
+
+class UnboundedEventLogChecker(Checker):
+    """unbounded-event-log: an event/firing/journal list under ``gateway/``
+    or ``obs/`` appended to with no visible bound. The flight-recorder /
+    fleet-log bug class (docs/observability.md): an event record nobody
+    drains grows for the daemon's lifetime, and on a multi-tenant gateway
+    that is unbounded memory charged to every tenant at once. Every journal
+    must either be structurally bounded (``deque(maxlen=...)``, a bounded
+    ``queue.Queue``) or actively trimmed with the truncation COUNTED
+    (``*_dropped`` counters — truncation is never silent).
+
+    Fires on ``<attr>.append(...)`` where the terminal attribute name smells
+    like an event record (event / firing / journal / history / *_log).
+    Bare-local appends are exempt (function-scoped lists die with the call).
+    An attribute counts as bounded when the MODULE shows any of: construction
+    as ``deque(maxlen=...)`` / ``Queue(maxsize=...)`` with a nonzero bound,
+    ``del X[...]`` trimming, ``X.pop()/popleft()/clear()``, a slice
+    assignment to ``X``, or a ``len(X)`` comparison (the cap check guarding a
+    trim). A genuinely protocol-bounded list takes a justified
+    ``# sklint: disable`` per policy."""
+
+    rules = (
+        RuleSpec(
+            "unbounded-event-log",
+            "error",
+            "event/firing/journal attribute appended in gateway//obs/ code with no visible bound or trim",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        from pathlib import PurePath
+
+        parts = PurePath(module.path).parts
+        if "gateway" not in parts and "obs" not in parts:
+            return
+        bounded = self._bounded_names(module.tree)
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"
+                and isinstance(node.func.value, ast.Attribute)  # attribute targets only
+            ):
+                continue
+            terminal = node.func.value.attr
+            if not _is_eventish(terminal) or terminal in bounded:
+                continue
+            yield self.finding(
+                module,
+                "unbounded-event-log",
+                node,
+                f"append to event record {dotted_name(node.func.value) or terminal!r} with no visible bound — "
+                "use deque(maxlen=...) or trim with a counted drop",
+            )
+
+    @staticmethod
+    def _bounded_names(tree: ast.Module) -> Set[str]:
+        """Terminal attribute names with visible bound maintenance anywhere in
+        the module (name-keyed: helper methods trimming the same attribute
+        count, wherever they live)."""
+        bounded: Set[str] = set()
+
+        def terminal_of(node: ast.AST) -> str:
+            return node.attr if isinstance(node, ast.Attribute) else (node.id if isinstance(node, ast.Name) else "")
+
+        for node in ast.walk(tree):
+            # construction with a structural bound: deque(maxlen=...) /
+            # Queue(maxsize=...) where the bound is not a literal 0/None
+            # (dynamic expressions can't be evaluated statically: bounded)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) and isinstance(node.value, ast.Call):
+                factory = dotted_name(node.value.func).split(".")[-1]
+                kw = {"deque": "maxlen"}.get(factory) or (
+                    "maxsize" if factory in ("Queue", "LifoQueue", "PriorityQueue") else None
+                )
+                if kw:
+                    for k in node.value.keywords:
+                        if k.arg == kw and not (
+                            isinstance(k.value, ast.Constant) and (k.value.value in (0, None))
+                        ):
+                            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                            for tgt in targets:
+                                name = terminal_of(tgt)
+                                if name:
+                                    bounded.add(name)
+            # active trimming: del X[...] / X.pop()/popleft()/clear() /
+            # slice assignment / len(X) comparison (the cap check)
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        name = terminal_of(tgt.value)
+                        if name:
+                            bounded.add(name)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BOUND_MAINT_METHODS
+            ):
+                name = terminal_of(node.func.value)
+                if name:
+                    bounded.add(name)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        name = terminal_of(tgt.value)
+                        if name:
+                            bounded.add(name)
+            if isinstance(node, ast.Compare):
+                for side in [node.left, *node.comparators]:
+                    if (
+                        isinstance(side, ast.Call)
+                        and dotted_name(side.func) == "len"
+                        and side.args
+                    ):
+                        name = terminal_of(side.args[0])
+                        if name:
+                            bounded.add(name)
+        return bounded
+
+
 CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     SharedStateChecker,
     ThreadLifecycleChecker,
@@ -696,4 +830,5 @@ CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     BareExceptLoopChecker,
     FlatSleepInRetryLoopChecker,
     UnboundedWaitInProvisionerChecker,
+    UnboundedEventLogChecker,
 )

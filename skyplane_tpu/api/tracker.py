@@ -11,15 +11,78 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Set
 
 import requests
 
 from skyplane_tpu.api.config import TransferConfig
 from skyplane_tpu.exceptions import GatewayException, SkyplaneTpuException, TransferFailedException
+from skyplane_tpu.obs.events import (
+    EV_DISPATCH_END,
+    EV_DISPATCH_START,
+    EV_GATEWAY_DEAD,
+    EV_REPLAN,
+    EV_TRANSFER_COMPLETE,
+    EV_TRANSFER_ERROR,
+    get_recorder,
+)
 from skyplane_tpu.utils.envcfg import env_float
 from skyplane_tpu.utils.logger import logger
 from skyplane_tpu.utils.retry import retry_backoff
+
+# ---- client-side fleet metrics (docs/observability.md) ----
+# Control-plane state used to live only in tracker attributes
+# (failover_events, replan_events, dead_gateway_ids); these providers surface
+# it on the CLIENT process's registry so fleet health is scrapeable:
+#   skyplane_gateway_alive{gateway="..."}   1 = reachable, 0 = declared dead
+#   skyplane_failover_events_total          source-gateway failovers
+#   skyplane_replan_events_total            congested-hop replan decisions
+# One provider registered once, summing over every live tracker (a client can
+# run several transfers; the registry keeps first-registered names).
+
+_live_trackers: "weakref.WeakSet" = weakref.WeakSet()
+_fleet_metrics_registered = False
+_fleet_metrics_lock = threading.Lock()
+
+
+def _tracker_totals() -> dict:
+    return {
+        "failover_events_total": sum(len(t.failover_events) for t in _live_trackers),
+        "replan_events_total": sum(len(t.replan_events) for t in _live_trackers),
+        "dead_gateways": sum(len(t.dead_gateway_ids) for t in _live_trackers),
+    }
+
+
+def _gateway_alive_families() -> dict:
+    alive: Dict[str, float] = {}
+    for t in _live_trackers:
+        try:
+            bound = getattr(t.dataplane, "bound_gateways", {}) or {}
+            for gid in bound:
+                # a gateway polled by several trackers is alive only if no
+                # tracker has declared it dead
+                dead = gid in t.dead_gateway_ids
+                alive[gid] = min(alive.get(gid, 1.0), 0.0 if dead else 1.0)
+        except Exception:  # noqa: BLE001 - scrape must survive a half-built tracker
+            continue
+    return {"gateway_alive": alive}
+
+
+def _register_fleet_metrics(tracker: "TransferProgressTracker") -> None:
+    global _fleet_metrics_registered
+    from skyplane_tpu.obs import get_registry
+
+    with _fleet_metrics_lock:
+        _live_trackers.add(tracker)
+        if _fleet_metrics_registered:
+            return
+        _fleet_metrics_registered = True
+        reg = get_registry()
+        # "skyplane" prefix keeps the exact satellite-spec names after the
+        # registry's sanitize step (skyplane_gateway_alive, ...)
+        reg.register_provider("skyplane", _tracker_totals)
+        reg.register_labeled_provider("skyplane", _gateway_alive_families, label="gateway")
 
 
 class TransferHook:
@@ -81,6 +144,13 @@ class TransferProgressTracker(threading.Thread):
         self.replan_poll_s = env_float("SKYPLANE_TPU_REPLAN_POLL_S", 5.0)
         self._last_replan_poll = 0.0
         self._lock = threading.Lock()
+        # fleet telemetry plane (docs/observability.md): client-side registry
+        # metrics are always on (cheap scrape-time callbacks); the collector
+        # thread is opt-in via SKYPLANE_TPU_COLLECT=1 (it scrapes every
+        # gateway's metrics/trace/events endpoints each interval)
+        _register_fleet_metrics(self)
+        self.collector = None
+        self.collect_enabled = os.environ.get("SKYPLANE_TPU_COLLECT", "0").strip().lower() in ("1", "true", "on")
 
     # ---- queries (reference: tracker.py:372-399) ----
 
@@ -99,8 +169,41 @@ class TransferProgressTracker(threading.Thread):
 
     # ---- main loop ----
 
+    def _start_collector(self) -> None:
+        """Attach a TelemetryCollector over this dataplane's gateways (its
+        own thread — a slow scrape never blocks the completion poll below).
+        Dead gateways are excluded via dead_gateway_ids, so PR-8 failover and
+        fleet scraping agree on who is in the fleet."""
+        try:
+            from skyplane_tpu.obs.collector import GatewayTarget, TelemetryCollector
+
+            bound = getattr(self.dataplane, "bound_gateways", None)
+            if not bound:
+                return
+            fleet_dir = os.environ.get("SKYPLANE_TPU_FLEET_DIR")
+            if not fleet_dir:
+                import tempfile
+
+                fleet_dir = os.path.join(tempfile.gettempdir(), "skyplane_tpu_fleet")
+            log_path = os.path.join(fleet_dir, f"transfer_{int(time.time())}_{os.getpid()}.events.jsonl")
+            self.collector = TelemetryCollector(
+                [GatewayTarget.from_bound_gateway(b) for b in bound.values()],
+                exclude_fn=lambda: set(self.dead_gateway_ids),
+                local_recorder=get_recorder(),
+                fleet_log_path=log_path,
+                label="tracker",
+            )
+            self.collector.start()
+            logger.fs.info(f"[tracker] telemetry collector on; fleet event log at {log_path}")
+        except Exception as e:  # noqa: BLE001 - telemetry must never fail a transfer
+            logger.fs.warning(f"[tracker] collector start failed: {e}")
+            self.collector = None
+
     def run(self) -> None:
         t0 = time.time()
+        rec = get_recorder()
+        if self.collect_enabled:
+            self._start_collector()
         try:
             # gateway compression profiles are daemon-lifetime cumulative; a
             # baseline snapshot makes the final stats per-run when a dataplane
@@ -113,8 +216,13 @@ class TransferProgressTracker(threading.Thread):
                 if first_run
                 else self._poll_profiles()
             )
+            rec.record(EV_DISPATCH_START, jobs=len(self.jobs))
             for job in self.jobs:
                 self._dispatch_job(job)
+            rec.record(
+                EV_DISPATCH_END, jobs=len(self.jobs), chunks=len(self.dispatched_chunk_ids),
+                bytes=self.query_bytes_dispatched(),
+            )
             self._monitor_to_completion()
             for job in self.jobs:
                 job.finalize()
@@ -127,11 +235,18 @@ class TransferProgressTracker(threading.Thread):
                 self.transfer_stats = self._collect_transfer_stats(time.time() - t0)
             except Exception as e:  # noqa: BLE001 - stats must never fail a delivered transfer
                 logger.fs.warning(f"[tracker] stats collection failed: {e}")
+            rec.record(
+                EV_TRANSFER_COMPLETE,
+                seconds=round(time.time() - t0, 3),
+                chunks=len(self.complete_chunk_ids),
+                bytes=self.query_bytes_dispatched(),
+            )
             self.hooks.on_transfer_end()
             self._report_usage(time.time() - t0, error=None)
         except Exception as e:  # noqa: BLE001
             self.error = e
             logger.fs.error(f"[tracker] transfer failed: {e}")
+            rec.record(EV_TRANSFER_ERROR, error=f"{type(e).__name__}: {e}"[:300])
             for job in self.jobs:
                 if hasattr(job, "journal_suspend"):
                     job.journal_suspend()  # keep resumable state, release handles
@@ -141,6 +256,11 @@ class TransferProgressTracker(threading.Thread):
             # AFTER gateways are torn down — aborting while gateway workers
             # still have UploadPart calls in flight would orphan those parts
             # (billed forever on S3, with the upload id gone)
+        finally:
+            if self.collector is not None:
+                # final poll catches the tail (last acks, the terminal
+                # transfer.* events above) before the fleet log closes
+                self.collector.stop(final_poll=True)
 
     def _poll_profiles(self) -> Optional[dict]:
         """Summed source-gateway compression counters, or None when any
@@ -351,6 +471,7 @@ class TransferProgressTracker(threading.Thread):
             "survivors": sorted(survivors),
         }
         self.failover_events.append(event)
+        get_recorder().record(EV_GATEWAY_DEAD, **event)
         logger.fs.warning(
             f"[tracker] source gateway {gid} declared dead ({cls}); requeued {requeued} pending chunks "
             f"onto {len(survivors)} surviving gateway(s)"
@@ -409,6 +530,7 @@ class TransferProgressTracker(threading.Thread):
             return
         if decision is not None:
             self.replan_events.append(decision.as_dict())
+            get_recorder().record(EV_REPLAN, **decision.as_dict())
             self.hooks.on_replan(decision)
 
     def _monitor_to_completion(self, timeout_s: float = 24 * 3600) -> None:

@@ -152,6 +152,13 @@ class Chunk:
     # header's TRACED flag covers only the socket hop (docs/observability.md)
     traced: Optional[bool] = False
 
+    # overlay hop index of the gateway this request was registered AT: 0 at
+    # the original source, incremented by every sender's pre-registration
+    # POST, so each hop's spans carry their position on the path and a merged
+    # fleet timeline orders gateways source → relay → destination
+    # (docs/observability.md multi-hop stitching)
+    hop: Optional[int] = 0
+
     # owning tenant (16 hex chars, minted at the API layer); rides the wire
     # header so every gateway on the path attributes this chunk's resource
     # use to the right tenant (docs/multitenancy.md). None = default tenant.

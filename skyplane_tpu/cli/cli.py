@@ -139,24 +139,30 @@ def trace():
 
 
 @trace.command("export")
-@click.option("--url", default=None, help="gateway control URL, e.g. https://10.0.0.5:8081 (omit for the in-process tracer)")
+@click.option(
+    "--url",
+    "urls",
+    multiple=True,
+    help="gateway control URL, e.g. https://10.0.0.5:8081; repeatable — several gateways merge into ONE timeline "
+    "(omit for the in-process tracer)",
+)
 @click.option("-o", "--output", default="trace.json", help="output file (Chrome trace-event JSON)")
 @click.option("--token", default=None, help="gateway API bearer token (defaults to none)")
-def trace_export(url, output, token):
+def trace_export(urls, output, token):
     """Export a Chrome trace-event JSON that loads directly in Perfetto.
 
-    With --url, fetches GET /api/v1/trace from a running gateway's control
-    API; without it, dumps this process's tracer (useful after an in-process
-    harness run with SKYPLANE_TPU_TRACE_SAMPLE set). Open the file at
-    https://ui.perfetto.dev or chrome://tracing."""
+    With one or more --url options, fetches GET /api/v1/trace from each
+    running gateway's control API and merges them into a single multi-process
+    timeline (one Perfetto row per gateway — the fleet view,
+    docs/observability.md); without any, dumps this process's tracer (useful
+    after an in-process harness run with SKYPLANE_TPU_TRACE_SAMPLE set).
+    Open the file at https://ui.perfetto.dev or chrome://tracing."""
     import json
 
-    if url:
-        from skyplane_tpu.gateway.control_auth import control_session
+    if urls:
+        from skyplane_tpu.obs.collector import scrape_trace_once
 
-        resp = control_session(token).get(f"{url.rstrip('/')}/api/v1/trace", timeout=30)
-        resp.raise_for_status()
-        payload = resp.json()
+        payload = scrape_trace_once(list(urls), token=token)
     else:
         from skyplane_tpu.obs import get_tracer
 
@@ -170,6 +176,129 @@ def trace_export(url, output, token):
         )
     else:
         click.echo(f"wrote {len(events)} events to {output}; open it in https://ui.perfetto.dev")
+
+
+@main.command()
+@click.option("--trace", "trace_path", default=None, help="a (merged) Chrome trace JSON file to attribute")
+@click.option("--url", "urls", multiple=True, help="gateway control URL(s) to scrape live instead of --trace")
+@click.option("--cpu", "cpu_path", default=None, help="optional JSON file of per-gateway /profile/cpu payloads")
+@click.option("--token", default=None, help="gateway API bearer token (defaults to none)")
+@click.option("--json", "as_json", is_flag=True, help="print the raw report as JSON")
+def bottleneck(trace_path, urls, cpu_path, token, as_json):
+    """Per-transfer "where did the time go": aggregate the per-stage latency
+    breakdown (frame / send-stall / ack-lag / decode / store / device-wait)
+    and per-thread CPU time across gateways (docs/observability.md).
+
+    Feed it a merged trace (`skyplane-tpu trace export --url A --url B`) or
+    let it scrape gateways live with --url."""
+    import json as json_mod
+
+    from skyplane_tpu.obs.collector import bottleneck_report, format_bottleneck, scrape_trace_once
+
+    cpu_profiles = None
+    if trace_path:
+        with open(trace_path) as f:
+            trace = json_mod.load(f)
+    elif urls:
+        from skyplane_tpu.gateway.control_auth import control_session
+        from skyplane_tpu.obs.collector import api_base_of
+
+        trace = scrape_trace_once(list(urls), token=token)
+        cpu_profiles = {}
+        for u in urls:
+            base = api_base_of(u)
+            try:
+                payload = control_session(token).get(f"{base}/profile/cpu", timeout=10).json()
+                cpu_profiles[payload.get("gateway_id") or base] = payload
+            except Exception:  # noqa: BLE001 — CPU attribution is additive
+                continue
+    else:
+        raise click.ClickException("pass --trace <file> or at least one --url")
+    if cpu_path:
+        with open(cpu_path) as f:
+            cpu_profiles = json_mod.load(f)
+    report = bottleneck_report(trace, cpu_profiles)
+    if report["n_spans"] == 0:
+        raise click.ClickException(
+            "trace holds no spans — was SKYPLANE_TPU_TRACE_SAMPLE set on the gateways? (docs/observability.md)"
+        )
+    click.echo(json_mod.dumps(report, indent=2) if as_json else format_bottleneck(report))
+
+
+@main.command()
+@click.option("--url", "urls", multiple=True, required=True, help="gateway control URL(s); repeatable")
+@click.option("--token", default=None, help="gateway API bearer token (defaults to none)")
+@click.option("--interval", default=2.0, type=float, help="refresh interval seconds")
+@click.option("--once", is_flag=True, help="one snapshot, no screen refresh loop (scripting / smoke tests)")
+@click.option("--count", default=0, type=int, help="stop after N refreshes (0 = until interrupted)")
+def monitor(urls, token, interval, once, count):
+    """Live fleet dashboard: per-gateway Gbps, in-flight bytes, dedup hit
+    rate, staleness, and the flight-recorder event tail — the scrape-merge
+    loop of the TelemetryCollector rendered for a terminal
+    (docs/observability.md)."""
+    import time as time_mod
+
+    from skyplane_tpu.gateway.control_auth import control_session
+    from skyplane_tpu.obs.collector import GatewayTarget, TelemetryCollector, api_base_of, parse_prometheus
+
+    targets = []
+    for u in urls:
+        base = api_base_of(u)
+        gid, region = base, ""
+        try:
+            status = control_session(token).get(f"{base}/status", timeout=5).json()
+            gid, region = status.get("gateway_id") or base, status.get("region") or ""
+        except Exception:  # noqa: BLE001 — identity probe best-effort; collector marks it stale
+            pass
+        targets.append(GatewayTarget(gid, base, region=region, session_fn=lambda: control_session(token)))
+    collector = TelemetryCollector(targets, poll_interval_s=interval, label="monitor")
+
+    def sample(name_sub: str, metrics: dict) -> float:
+        return sum(v for k, v in metrics.items() if k.endswith(name_sub))
+
+    prev: dict = {}
+    prev_t: dict = {}
+    rounds = 0
+    while True:
+        collector.poll_once()
+        now = time_mod.monotonic()
+        lines = [f"skyplane-tpu monitor — {len(targets)} gateway(s), interval {interval:g}s"]
+        with collector._lock:
+            states = list(collector._states.values())
+        for st in states:
+            gid = st.target.gateway_id
+            if st.stale or st.metrics_text is None:
+                lines.append(f"  {gid:<24} STALE ({st.consec_failures} failed scrapes)")
+                continue
+            samples = parse_prometheus(st.metrics_text)
+            metrics = {name: value for name, _, value in samples}
+            sent = sample("sender_wire_wire_bytes_sent", metrics) + sample("decode_decode_raw_bytes", metrics)
+            dt = now - prev_t.get(gid, now)
+            gbps = (sent - prev.get(gid, sent)) * 8 / 1e9 / dt if dt > 0 else 0.0
+            prev[gid], prev_t[gid] = sent, now
+            inflight = sample("sender_wire_wire_inflight_bytes", metrics)
+            segs = sample("datapath_segments", metrics)
+            refs = sample("datapath_ref_segments", metrics)
+            hit = f"{100.0 * refs / segs:.1f}%" if segs else "-"
+            tenants_n = len({lbl for name, lbl, _ in samples if name == "skyplane_tenant_bytes_delivered"})
+            lines.append(
+                f"  {gid:<24} {gbps:7.3f} Gbps   in-flight {inflight / 1e6:8.1f} MB   "
+                f"dedup hit {hit:>6}   nacks {int(sample('decode_decode_nacks', metrics))}"
+                + (f"   tenants {tenants_n}" if tenants_n else "")
+            )
+        events = collector.fleet_events()[-8:]
+        if events:
+            lines.append("  recent events:")
+            for ev in events:
+                detail = {k: v for k, v in ev.items() if k not in ("seq", "ts", "kind", "recorder", "gateway")}
+                lines.append(f"    [{ev.get('gateway', '?')}] {ev['kind']} {detail if detail else ''}")
+        if not once and rounds > 0:
+            click.clear()
+        click.echo("\n".join(lines))
+        rounds += 1
+        if once or (count and rounds >= count):
+            break
+        time_mod.sleep(interval)
 
 
 @main.command()

@@ -190,11 +190,16 @@ class FaultInjector:
                 del self._log[: len(self._log) - MAX_FIRING_LOG]
         # a chaos timeline is debuggable: firings land on the trace alongside
         # the spans of the work they disrupted (docs/fault-injection.md)
-        from skyplane_tpu.obs import get_tracer
+        from skyplane_tpu.obs import get_recorder, get_tracer
 
         tracer = get_tracer()
         if tracer.enabled:
             tracer.record_span(f"fault.{point}", 0, time.time_ns(), cat="fault", args={"eval": eval_index, "seq": seq})
+        # ... and on the flight recorder, so the fleet event log interleaves
+        # firings with the recoveries they triggered (docs/observability.md)
+        from skyplane_tpu.obs.events import EV_FAULT_FIRED
+
+        get_recorder().record(EV_FAULT_FIRED, point=point, eval=eval_index, fault_seq=seq)
 
     # ---- injection helpers (hot-path API) ----
 

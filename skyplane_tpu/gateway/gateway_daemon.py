@@ -185,6 +185,7 @@ class GatewayDaemon:
             cdc_params=self.cdc_params,
             batch_runner=self.batch_runner,
             tenant_registry=self.tenants,
+            gateway_id=gateway_id,
         )
 
         self.upload_id_map: Dict[str, str] = {}
@@ -219,6 +220,11 @@ class GatewayDaemon:
         self.metrics.register_provider("decode", self.receiver.decode_counters)
         self.metrics.register_provider("sender_wire", self._sender_wire_counters)
         self.metrics.register_provider("trace", lambda: get_tracer().counters())
+        # flight-recorder health (docs/observability.md): recorded/dropped/
+        # buffered event counts ride the same scrape as everything else
+        from skyplane_tpu.obs import get_recorder
+
+        self.metrics.register_provider("events", lambda: get_recorder().counters())
         # chaos visibility (docs/fault-injection.md): per-point fault firings
         # as skyplane_faults_injected{point="..."} — empty when faults are off
         from skyplane_tpu.faults import get_injector
@@ -507,6 +513,7 @@ class GatewayDaemon:
             error_event=self.error_event,
             error_queue=self.error_queue,
             chunk_store=self.chunk_store,
+            gateway_id=self.gateway_id,
         )
         if op_type == "receive":
             return GatewayWaitReceiverOperator(**common, n_workers=4)

@@ -26,8 +26,15 @@ directly:
   GET  /api/v1/profile/socket/sender       per-send-window events + wire counters
   GET  /api/v1/profile/compression         TPU data-path stats (ratio, dedup)
   GET  /api/v1/profile/decode              receiver decode-pool counters+events
+  GET  /api/v1/profile/cpu                 per-thread CPU seconds (bottleneck
+                                           attribution input)
   GET  /api/v1/trace                       Chrome trace-event JSON (Perfetto)
   GET  /api/v1/metrics                     Prometheus text exposition
+  GET  /api/v1/events?since=<seq>          flight-recorder tail (bounded,
+                                           seq-ordered fleet events)
+  GET  /api/v1/telemetry?since=<seq>&cpu=1 combined collector scrape: metrics
+                                           + trace + events (+ cpu) in ONE
+                                           round trip
 
 Completion accounting (the reference's most bug-prone logic, SURVEY §7 #6):
 an explicit per-chunk refcount of terminal-operator completions — a chunk is
@@ -105,7 +112,12 @@ class GatewayDaemonAPI:
         self._dedup_sources: set = set()  # distinct source gateway ids seen on /servers
         self.chunk_requests: Dict[str, dict] = {}  # chunk_id -> chunk request dict
         self.chunk_status: Dict[str, str] = {}  # chunk_id -> latest aggregate state
+        # full transition log, BOUNDED: it grows O(chunks x operators) and a
+        # long-lived multi-tenant daemon must not hold it forever — the tail
+        # keeps the freshest MAX_STATUS_LOG records, drops are counted and
+        # surfaced on ?include_log=1 (truncation is never silent)
         self.chunk_status_log: List[dict] = []
+        self._status_log_dropped = 0
         self._terminal_done: Dict[str, Set[str]] = {}  # chunk_id -> completed terminal handles
         self._errors: List[str] = []
         self.shutdown_requested = threading.Event()
@@ -227,6 +239,11 @@ class GatewayDaemonAPI:
 
     # ---- status-queue pump (called from the daemon main loop) ----
 
+    #: retained chunk-state transition records (the aggregate status MAP is
+    #: unbounded by design — completion accounting needs it — but the per-
+    #: operator transition LOG is debugging data and keeps only its tail)
+    MAX_STATUS_LOG = 65536
+
     def pull_chunk_status_queue(self) -> int:
         """Drain operator status records; account terminal completions; GC
         fully-complete chunk files. Returns records processed."""
@@ -239,6 +256,10 @@ class GatewayDaemonAPI:
             n += 1
             with self._lock:
                 self.chunk_status_log.append(rec)
+                if len(self.chunk_status_log) > self.MAX_STATUS_LOG:
+                    overflow = len(self.chunk_status_log) - self.MAX_STATUS_LOG
+                    del self.chunk_status_log[:overflow]
+                    self._status_log_dropped += overflow
                 chunk_id = rec["chunk_id"]
                 partition = rec.get("partition", "default")
                 state = rec["state"]
@@ -329,6 +350,7 @@ class GatewayDaemonAPI:
                 payload = {"chunk_status": status}
                 if include_log:
                     payload["chunk_status_log"] = list(self.chunk_status_log)
+                    payload["status_log_dropped"] = self._status_log_dropped
                 req._send(200, payload)
         elif path == "/api/v1/tenants":
             # tenant/job registry snapshot: active jobs, per-tenant chunk and
@@ -375,6 +397,80 @@ class GatewayDaemonAPI:
                 except queue.Empty:
                     break
             req._send(200, {"counters": self.receiver.decode_counters(), "events": events})
+        elif path == "/api/v1/events":
+            # flight-recorder tail (docs/observability.md): seq-ordered fleet
+            # events since the caller's cursor. The recorder id lets a
+            # collector de-duplicate when several in-process gateways share
+            # one recorder (the loopback harness).
+            from skyplane_tpu.obs import get_recorder
+
+            try:
+                since = int(query.get("since", ["0"])[0] or 0)
+            except ValueError:
+                since = 0
+            rec = get_recorder()
+            req._send(
+                200,
+                {
+                    "recorder": rec.recorder_id,
+                    "gateway_id": self.gateway_id,
+                    "events": rec.events_since(since),
+                    "next_since": rec.seq(),
+                    "dropped": rec.counters()["events_dropped"],
+                },
+            )
+        elif path == "/api/v1/profile/cpu":
+            # per-thread CPU seconds: the bottleneck report's "which thread
+            # burned the core" input (ROADMAP item 1's multi-core question)
+            import time as _time
+
+            from skyplane_tpu.obs.metrics import thread_cpu_seconds
+
+            req._send(
+                200,
+                {
+                    "gateway_id": self.gateway_id,
+                    "region": self.region,
+                    "threads": thread_cpu_seconds(),
+                    "process_cpu_s": round(_time.process_time(), 6),
+                },
+            )
+        elif path == "/api/v1/telemetry":
+            # combined collector scrape (docs/observability.md): every fleet-
+            # telemetry surface in ONE round trip. The TelemetryCollector
+            # polls this each interval — four separate requests per gateway
+            # per wave would spend more CPU on HTTP machinery than on the
+            # payloads (the <2% collector-overhead budget).
+            import time as _time
+
+            from skyplane_tpu.obs import get_recorder
+            from skyplane_tpu.obs.metrics import thread_cpu_seconds
+
+            try:
+                since = int(query.get("since", ["0"])[0] or 0)
+            except ValueError:
+                since = 0
+            rec = get_recorder()
+            payload = {
+                "gateway_id": self.gateway_id,
+                "region": self.region,
+                "metrics_text": self.metrics_fn(),
+                "trace": self.trace_fn(),
+                "events": {
+                    "recorder": rec.recorder_id,
+                    "events": rec.events_since(since),
+                    "next_since": rec.seq(),
+                    "dropped": rec.counters()["events_dropped"],
+                },
+            }
+            if query.get("cpu") == ["1"]:
+                payload["cpu"] = {
+                    "gateway_id": self.gateway_id,
+                    "region": self.region,
+                    "threads": thread_cpu_seconds(),
+                    "process_cpu_s": round(_time.process_time(), 6),
+                }
+            req._send(200, payload)
         elif path == "/api/v1/trace":
             # Chrome trace-event JSON from the process tracer: loads directly
             # in Perfetto / chrome://tracing (docs/observability.md). Empty
@@ -454,6 +550,8 @@ class GatewayDaemonAPI:
             if not job_id:
                 req._send(400, {"error": "job_id is required"})
                 return
+            from skyplane_tpu.obs.events import EV_ADMISSION_GRANTED, EV_ADMISSION_REJECTED, get_recorder
+
             try:
                 if self.tenant_policy_fn is not None and (body.get("weight") is not None or body.get("quotas")):
                     self.tenant_policy_fn(
@@ -463,8 +561,20 @@ class GatewayDaemonAPI:
                     body.get("tenant_id"), job_id, weight=body.get("weight"), quotas=body.get("quotas")
                 )
             except AdmissionError as e:
+                # 429s are exactly the kind of fleet event post-mortems need
+                # in ONE ordered record (docs/observability.md flight recorder)
+                get_recorder().record(
+                    EV_ADMISSION_REJECTED,
+                    gateway=self.gateway_id,
+                    job_id=job_id,
+                    tenant=str(body.get("tenant_id") or ""),
+                    error=str(e)[:200],
+                )
                 req._send(429, {"error": str(e)})
                 return
+            get_recorder().record(
+                EV_ADMISSION_GRANTED, gateway=self.gateway_id, job_id=job_id, tenant=tenant_id
+            )
             req._send(200, {"status": "ok", "job_id": job_id, "tenant_id": tenant_id})
         elif path == "/api/v1/chunk_requests":
             body = req._read_json()
@@ -523,6 +633,10 @@ class GatewayDaemonAPI:
             req._send(200 if ok else 404, {"status": "ok" if ok else "unknown port"})
         elif len(parts) == 5 and parts[:4] == ["", "api", "v1", "jobs"]:
             ok = self.tenant_registry is not None and self.tenant_registry.finish_job(parts[4])
+            if ok:
+                from skyplane_tpu.obs.events import EV_JOB_RELEASED, get_recorder
+
+                get_recorder().record(EV_JOB_RELEASED, gateway=self.gateway_id, job_id=parts[4])
             req._send(200 if ok else 404, {"status": "ok" if ok else "unknown job"})
         else:
             req._send(404, {"error": f"no route {req.path}"})

@@ -78,9 +78,14 @@ class GatewayOperator:
         error_queue: "queue.Queue[str]",
         chunk_store: ChunkStore,
         n_workers: int = 1,
+        gateway_id: Optional[str] = None,
     ):
         self.handle = handle
         self.region = region
+        # owning gateway's id: stamped into span args so a merged fleet
+        # timeline can regroup spans into per-gateway rows even when several
+        # in-process harness gateways share one tracer (docs/observability.md)
+        self.gateway_id = gateway_id
         self.input_queue = input_queue
         self.output_queue = output_queue
         self.error_event = error_event
@@ -289,7 +294,13 @@ class GatewayWriteLocalOperator(GatewayOperator):
 
     def process(self, chunk_req: ChunkRequest, worker_id: int) -> bool:
         chunk = chunk_req.chunk
-        with get_tracer().span("chunk.write_local", trace_id=chunk.chunk_id, cat="receiver", force=bool(chunk.traced)):
+        tracer = get_tracer()
+        span_args = (
+            {"gateway": self.gateway_id, "hop": chunk.hop} if (tracer.enabled and self.gateway_id) else None
+        )
+        with tracer.span(
+            "chunk.write_local", trace_id=chunk.chunk_id, cat="receiver", force=bool(chunk.traced), args=span_args
+        ):
             data = self.chunk_store.chunk_path(chunk.chunk_id).read_bytes()
             dest = Path(chunk.dest_key)
             offset = chunk.file_offset_bytes or 0
@@ -660,6 +671,14 @@ class GatewaySenderOperator(GatewayOperator):
         scheme = "https" if self.control_tls else "http"
         return f"{scheme}://{self.target_host}:{self.target_control_port}/api/v1"
 
+    def _frame_span_args(self, req: ChunkRequest) -> dict:
+        """Span args for this sender's wire spans: gateway id + overlay hop
+        index (0 at the original source, +1 per relay) — the identity a
+        merged fleet timeline regroups and orders process rows by. Called
+        only on TRACED chunks, so the per-call dict never taxes the
+        tracing-off path."""
+        return {"gateway": self.source_gateway_id or self.gateway_id, "hop": req.chunk.hop or 0}
+
     def _make_socket(self) -> socket.socket:
         # ask the remote gateway for an ephemeral data port (reference :225-246),
         # identifying this source so the sink can count distinct sources
@@ -733,6 +752,7 @@ class GatewaySenderOperator(GatewayOperator):
                 max_streams=self.max_streams,
                 name=f"{self.handle}-w{worker_id}",
                 abort_check=lambda: self.exit_flag.is_set() or self.error_event.is_set(),
+                gateway_id=self.source_gateway_id or self.gateway_id,
             )
             self._local.engine = engine
             with self._engines_lock:
@@ -859,10 +879,21 @@ class GatewaySenderOperator(GatewayOperator):
         tracer = get_tracer()
         if tracer.enabled:
             # same deterministic decision the framer will make: rides the
-            # registration so destination operators trace the same chunks
+            # registration so destination operators trace the same chunks.
+            # OR-preserve: on a relay the UPSTREAM sender's decision already
+            # arrived with the chunk request — overwriting it with a local
+            # re-sample would break multi-hop stitching when hop gateways run
+            # different (or zero) sample rates
             for req in batch:
-                req.chunk.traced = tracer.sampled(req.chunk.chunk_id)
-        regs = [req.as_dict() for req in batch]
+                req.chunk.traced = bool(req.chunk.traced) or tracer.sampled(req.chunk.chunk_id)
+        regs = []
+        for req in batch:
+            d = req.as_dict()
+            # the registration describes the chunk AT THE NEXT HOP: its hop
+            # index advances by one, so each gateway's spans carry their
+            # position on the overlay path (docs/observability.md)
+            d["chunk"]["hop"] = (req.chunk.hop or 0) + 1
+            regs.append(d)
 
         def _post_registration() -> None:
             resp = self._session.post(f"{self._control_base}/chunk_requests", json=regs, timeout=30)
@@ -919,9 +950,20 @@ class GatewaySenderOperator(GatewayOperator):
 
         view = _WindowFpView(self.dedup_index, pending=pending_fps) if self.dedup_index is not None else None
         tracer = get_tracer()
-        traced = tracer.enabled and tracer.sampled(req.chunk.chunk_id)
+        # chunk.traced covers the relay case: the upstream sender's sampling
+        # decision rides the pre-registration, so a relay whose local rate
+        # would miss this id still records its hop of the path
+        traced = tracer.enabled and (bool(req.chunk.traced) or tracer.sampled(req.chunk.chunk_id))
         span = (
-            tracer.span("wire.frame", trace_id=req.chunk.chunk_id, cat="sender", force=True) if traced else NOOP_SPAN
+            tracer.span(
+                "wire.frame",
+                trace_id=req.chunk.chunk_id,
+                cat="sender",
+                force=True,
+                args=self._frame_span_args(req),
+            )
+            if traced
+            else NOOP_SPAN
         )
         # n_left=0: the reference-compat window countdown has no meaning on a
         # continuous stream (receivers ignore it; docs/wire_protocol.md) —
@@ -962,9 +1004,15 @@ class GatewaySenderOperator(GatewayOperator):
                 if not self.sched_acquire(req):
                     break  # shutdown mid-window: un-sent chunks re-queue below
                 acquired.append(req)
-                traced = tracer.enabled and tracer.sampled(req.chunk.chunk_id)
+                traced = tracer.enabled and (bool(req.chunk.traced) or tracer.sampled(req.chunk.chunk_id))
                 span = (
-                    tracer.span("wire.frame", trace_id=req.chunk.chunk_id, cat="sender", force=True)
+                    tracer.span(
+                        "wire.frame",
+                        trace_id=req.chunk.chunk_id,
+                        cat="sender",
+                        force=True,
+                        args=self._frame_span_args(req),
+                    )
                     if traced
                     else NOOP_SPAN
                 )
@@ -973,7 +1021,13 @@ class GatewaySenderOperator(GatewayOperator):
                 if traced and payload is not None:
                     header.flags |= ChunkFlags.TRACED  # receiver spans follow the sender's sample
                 send_span = (
-                    tracer.span("wire.send", trace_id=req.chunk.chunk_id, cat="sender", force=True)
+                    tracer.span(
+                        "wire.send",
+                        trace_id=req.chunk.chunk_id,
+                        cat="sender",
+                        force=True,
+                        args=self._frame_span_args(req),
+                    )
                     if traced
                     else NOOP_SPAN
                 )

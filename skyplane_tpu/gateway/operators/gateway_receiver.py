@@ -223,8 +223,16 @@ class GatewayReceiver:
         batch_runner=None,
         decode_workers: Optional[int] = None,
         tenant_registry=None,
+        gateway_id: Optional[str] = None,
     ):
         self.region = region
+        # span identity on a merged fleet timeline: every receiver span
+        # carries its gateway id so the collector can regroup events into
+        # per-gateway Perfetto rows even when several harness gateways share
+        # one process/tracer (docs/observability.md). The dict is shared by
+        # every span (export copies args) — zero per-span allocation.
+        self.gateway_id = gateway_id
+        self._span_args = {"gateway": gateway_id} if gateway_id else None
         self.chunk_store = chunk_store
         self.error_event = error_event
         self.error_queue = error_queue
@@ -411,7 +419,13 @@ class GatewayReceiver:
                     break  # clean peer close
                 t0 = time.time()
                 recv_span = (
-                    get_tracer().span("frame.recv", trace_id=header.chunk_id, cat="receiver", force=header.is_traced)
+                    get_tracer().span(
+                        "frame.recv",
+                        trace_id=header.chunk_id,
+                        cat="receiver",
+                        force=header.is_traced,
+                        args=self._span_args,
+                    )
                     if get_tracer().enabled
                     else NOOP_SPAN
                 )
@@ -521,12 +535,16 @@ class GatewayReceiver:
         # the sender's TRACED header flag forces the span past the local
         # sampling decision: both sides of the wire trace the SAME chunks
         span = (
-            tracer.span("decode", trace_id=header.chunk_id, cat="receiver", force=header.is_traced)
+            tracer.span(
+                "decode", trace_id=header.chunk_id, cat="receiver", force=header.is_traced, args=self._span_args
+            )
             if tracer.enabled
             else NOOP_SPAN
         )
         store_span = lambda: (  # noqa: E731 — nested under the decode span
-            tracer.span("store.write", trace_id=header.chunk_id, cat="receiver", force=header.is_traced)
+            tracer.span(
+                "store.write", trace_id=header.chunk_id, cat="receiver", force=header.is_traced, args=self._span_args
+            )
             if tracer.enabled
             else NOOP_SPAN
         )

@@ -254,9 +254,14 @@ class SenderWireEngine:
         abort_check: Optional[Callable[[], bool]] = None,
         reset_budget: Optional[int] = None,
         revive_budget: Optional[int] = None,
+        gateway_id: Optional[str] = None,
     ):
         self.socket_factory = socket_factory
         self.callbacks = callbacks
+        # span identity for the merged fleet timeline (docs/observability.md):
+        # one shared dict, export copies it — zero per-span allocation
+        self.gateway_id = gateway_id
+        self._span_args = {"gateway": gateway_id} if gateway_id else None
         # polled while a submit waits on a full frame-ahead queue: lets the
         # framer (the operator worker thread) escape a stalled stream when
         # the daemon is shutting down, instead of wedging worker_loop exit
@@ -498,6 +503,19 @@ class SenderWireEngine:
             f"[{self.name}:stream{stream.idx}] circuit breaker: stream dead after "
             f"{stream.consec_resets} consecutive resets ({why})"
         )
+        # circuit-breaker trips are fleet-log events (docs/observability.md):
+        # a post-mortem must see WHEN each stream died relative to failover/
+        # replan decisions, not reconstruct it from warnings
+        from skyplane_tpu.obs.events import EV_STREAM_BREAK, get_recorder
+
+        get_recorder().record(
+            EV_STREAM_BREAK,
+            engine=self.name,
+            stream=stream.idx,
+            consec_resets=stream.consec_resets,
+            why=str(why)[:200],
+            gateway=self.gateway_id,
+        )
         with self._streams_lock:
             if self._closed:
                 return
@@ -510,6 +528,11 @@ class SenderWireEngine:
             return
         if revive:
             self._bump("streams_revived")
+            from skyplane_tpu.obs.events import EV_STREAM_REVIVE, get_recorder
+
+            get_recorder().record(
+                EV_STREAM_REVIVE, engine=self.name, revivals=self._revivals, gateway=self.gateway_id
+            )
             logger.fs.warning(f"[{self.name}] all streams dead; opened replacement stream "
                               f"({self._revivals}/{self.revive_budget} revivals)")
             return
@@ -530,7 +553,9 @@ class SenderWireEngine:
                 stream.cond.notify_all()  # the framer may enqueue the next chunk
         if frame is not None:
             send_span = (
-                get_tracer().span("wire.send", trace_id=frame.header.chunk_id, cat="sender", force=True)
+                get_tracer().span(
+                    "wire.send", trace_id=frame.header.chunk_id, cat="sender", force=True, args=self._span_args
+                )
                 if frame.traced
                 else NOOP_SPAN
             )
@@ -583,7 +608,7 @@ class SenderWireEngine:
             if tracer.enabled:
                 # transmit-idle with a frame READY: the stall the pipelining
                 # exists to hide — an async track (it brackets ack waits)
-                tracer.record_span("wire.send_stall", stall_ns, t0_wall, cat="sender")
+                tracer.record_span("wire.send_stall", stall_ns, t0_wall, cat="sender", args=self._span_args)
 
     def _drain_acks(self, stream: _Stream, block: bool) -> None:
         """Read response bytes for the in-flight frames, oldest first. With
@@ -645,6 +670,7 @@ class SenderWireEngine:
                     trace_id=frame.header.chunk_id,
                     cat="sender",
                     force=True,
+                    args=self._span_args,
                 )
             with self._completion_cond:
                 self._completion_q.append((stream, frame, b))
@@ -657,6 +683,11 @@ class SenderWireEngine:
         fps were already committed by the reaper)."""
         logger.fs.warning(f"[{self.name}:stream{stream.idx}] socket error mid-stream: {why}")
         self._bump("stream_resets")
+        from skyplane_tpu.obs.events import EV_STREAM_RESET, get_recorder
+
+        get_recorder().record(
+            EV_STREAM_RESET, engine=self.name, stream=stream.idx, why=str(why)[:200], gateway=self.gateway_id
+        )
         with stream.lock:
             doomed = list(stream.inflight) + list(stream.frames)
             stream.inflight.clear()

@@ -15,6 +15,15 @@ tracing, Sigelman et al. 2010):
     absorbs the existing DATAPATH/DECODE/SENDER_WIRE counter schemas behind
     one registry and adds native counters/gauges/histograms, rendered in
     Prometheus text exposition format (``GET /api/v1/metrics``).
+  * :mod:`skyplane_tpu.obs.events` — the flight recorder: a bounded,
+    seq-numbered journal of fleet-level events (admission, failover, replan,
+    fault firings, stream breaks, spill degradations) behind
+    ``GET /api/v1/events?since=<seq>``.
+  * :mod:`skyplane_tpu.obs.collector` — the fleet TelemetryCollector:
+    scrapes every live gateway's metrics/trace/events/cpu endpoints, merges
+    them into one labelled registry, one multi-process Perfetto timeline and
+    one ordered fleet event log, and derives the per-stage bottleneck
+    attribution (``skyplane-tpu bottleneck`` / ``monitor``).
 
 Correlation across the wire: the sender samples per chunk id
 (deterministically), stamps :data:`ChunkFlags.TRACED` into the wire frame
@@ -23,14 +32,22 @@ header, and the receiver honors that flag — so one chunk's sender spans
 one timeline keyed by the chunk id (docs/observability.md).
 """
 
+from skyplane_tpu.obs.events import FlightRecorder, configure_recorder, get_recorder
 from skyplane_tpu.obs.metrics import MetricsRegistry, get_registry
 from skyplane_tpu.obs.tracer import NOOP_SPAN, Tracer, configure_tracer, get_tracer
 
+# NOTE: skyplane_tpu.obs.collector (the fleet TelemetryCollector) is imported
+# explicitly by its users — it pulls `requests` and has no place on gateway
+# hot paths.
+
 __all__ = [
+    "FlightRecorder",
     "MetricsRegistry",
     "NOOP_SPAN",
     "Tracer",
+    "configure_recorder",
     "configure_tracer",
+    "get_recorder",
     "get_registry",
     "get_tracer",
 ]

@@ -1,0 +1,141 @@
+"""Flight recorder: a bounded, seq-numbered structured event journal.
+
+PRs 6-8 grew a set of fleet-level *events* — admission 429s, gateway
+failover, replan decisions, fault firings, circuit-breaker stream breaks,
+spill degradations — that were scattered across tracker attributes
+(``failover_events``), injector firing logs, and log lines. None of them
+were queryable as one ordered record. The flight recorder is that record:
+
+  * every event is a small dict ``{"seq", "ts", "kind", ...fields}`` with a
+    process-monotonic sequence number, appended to a bounded ring
+    (``SKYPLANE_TPU_EVENT_LOG`` entries, default 4096; overwrite-oldest with
+    a ``events_dropped`` counter — memory is bounded, truncation is never
+    silent, matching the tracer/profile-queue conventions);
+  * gateways expose it at ``GET /api/v1/events?since=<seq>`` so a collector
+    can tail incrementally (the ``since`` cursor makes repeat scrapes cheap
+    and idempotent);
+  * the recorder mints a ``recorder_id`` so a collector that scrapes several
+    gateways sharing one process (the in-process loopback harness) can
+    de-duplicate by ``(recorder_id, seq)`` instead of triple-counting.
+
+Recording sites are all COLD paths (admission decisions, socket resets,
+fault firings, transfer lifecycle transitions) — a lock per record is fine;
+nothing here may be called per chunk on the data path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional
+
+EVENT_LOG_ENV = "SKYPLANE_TPU_EVENT_LOG"
+DEFAULT_EVENT_LOG = 4096
+
+# well-known event kinds (free-form kinds are allowed; these are the ones the
+# subsystems emit and docs/observability.md documents)
+EV_DISPATCH_START = "transfer.dispatch_start"
+EV_DISPATCH_END = "transfer.dispatch_end"
+EV_TRANSFER_COMPLETE = "transfer.complete"
+EV_TRANSFER_ERROR = "transfer.error"
+EV_ADMISSION_GRANTED = "admission.granted"
+EV_ADMISSION_REJECTED = "admission.rejected"
+EV_JOB_RELEASED = "job.released"
+EV_GATEWAY_DEAD = "failover.gateway_dead"
+EV_REPLAN = "replan.decision"
+EV_FAULT_FIRED = "fault.fired"
+EV_STREAM_RESET = "stream.reset"
+EV_STREAM_BREAK = "stream.break"
+EV_STREAM_REVIVE = "stream.revive"
+EV_SPILL_DEGRADED = "spill.degraded"
+
+
+class FlightRecorder:
+    """Bounded, seq-ordered journal of structured events (see module doc)."""
+
+    def __init__(self, capacity: int = DEFAULT_EVENT_LOG, recorder_id: Optional[str] = None):
+        self.capacity = max(16, int(capacity))
+        # identifies THIS journal across scrapes: several gateway APIs in one
+        # process share one recorder, several processes never share an id
+        self.recorder_id = recorder_id or uuid.uuid4().hex[:16]
+        self._lock = threading.Lock()
+        self._events: "deque[dict]" = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._dropped = 0
+
+    def record(self, kind: str, **fields) -> int:
+        """Append one event; returns its sequence number. Cold paths only."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            if len(self._events) >= self.capacity:
+                self._dropped += 1  # deque(maxlen) evicts the oldest below
+            event = {"seq": seq, "ts": time.time(), "kind": kind}
+            event.update(fields)
+            self._events.append(event)
+        return seq
+
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def events_since(self, since: int = 0, limit: Optional[int] = None) -> List[dict]:
+        """Events with ``seq > since`` in seq order (the tail-cursor query
+        behind ``GET /api/v1/events?since=``)."""
+        with self._lock:
+            out = [dict(e) for e in self._events if e["seq"] > since]
+        if limit is not None:
+            out = out[: max(0, int(limit))]
+        return out
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "events_recorded": self._seq,
+                "events_dropped": self._dropped,
+                "events_buffered": len(self._events),
+            }
+
+    def reset(self) -> None:
+        """Drop every buffered event and restart numbering (test isolation)."""
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+            self._dropped = 0
+
+
+# ---- process-wide singleton ----
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def _from_env() -> FlightRecorder:
+    try:
+        capacity = int(os.environ.get(EVENT_LOG_ENV, str(DEFAULT_EVENT_LOG)))
+    except ValueError:
+        capacity = DEFAULT_EVENT_LOG
+    return FlightRecorder(capacity=capacity)
+
+
+def get_recorder() -> FlightRecorder:
+    global _recorder
+    rec = _recorder
+    if rec is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = _from_env()
+            rec = _recorder
+    return rec
+
+
+def configure_recorder(capacity: Optional[int] = None) -> FlightRecorder:
+    """Replace the process recorder (tests / smoke isolation); ``None``
+    re-reads the environment for the capacity."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = _from_env() if capacity is None else FlightRecorder(capacity=capacity)
+        return _recorder

@@ -311,6 +311,53 @@ def open_fd_count() -> int:
         return -1
 
 
+def thread_cpu_seconds() -> Dict[str, dict]:
+    """Per-thread CPU seconds of this process, keyed by Python thread name
+    (``GET /api/v1/profile/cpu``; the bottleneck report's CPU-attribution
+    input, docs/observability.md).
+
+    Linux: reads utime+stime from ``/proc/self/task/<tid>/stat`` and maps the
+    kernel tid back to a Python thread via ``Thread.native_id`` — the only
+    way to observe EVERY thread's CPU clock, since ``time.thread_time()``
+    measures only its caller. Non-Python threads (and any tid that raced
+    thread exit) appear as ``tid-<n>``. Elsewhere: degrades to the calling
+    thread's ``time.thread_time()`` so the schema never vanishes.
+    """
+    import os
+    import threading
+    import time
+
+    names: Dict[int, str] = {}
+    for t in threading.enumerate():
+        nid = getattr(t, "native_id", None)
+        if nid is not None:
+            names[nid] = t.name
+    out: Dict[str, dict] = {}
+    try:
+        tick = float(os.sysconf("SC_CLK_TCK"))
+        tids = os.listdir("/proc/self/task")
+    except (OSError, ValueError, AttributeError):
+        out[threading.current_thread().name] = {"tid": -1, "cpu_s": round(time.thread_time(), 6)}
+        return out
+    for tid_s in tids:
+        try:
+            with open(f"/proc/self/task/{tid_s}/stat", "rb") as f:
+                raw = f.read().decode(errors="replace")
+        except OSError:
+            continue  # thread exited between listdir and read
+        # comm may contain spaces/parens: fields 14/15 (utime/stime) are
+        # counted from AFTER the last ')'
+        rest = raw.rpartition(")")[2].split()
+        if len(rest) < 13:
+            continue
+        cpu_s = (int(rest[11]) + int(rest[12])) / tick
+        tid = int(tid_s)
+        name = names.get(tid, f"tid-{tid}")
+        key = name if name not in out else f"{name}#{tid}"  # duplicate names stay distinct
+        out[key] = {"tid": tid, "cpu_s": round(cpu_s, 6)}
+    return out
+
+
 # ---- process-wide singleton (long-lived components' histograms live here) ----
 
 _registry: Optional[MetricsRegistry] = None

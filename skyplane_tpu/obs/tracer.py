@@ -223,7 +223,6 @@ class Tracer:
         ]
         with self._rings_lock:
             rings = list(self._rings)
-        async_seq = 0
         for ring in rings:
             events.append(
                 {"name": "thread_name", "ph": "M", "pid": pid, "tid": ring.tid, "args": {"name": ring.label}}
@@ -245,8 +244,11 @@ class Tracer:
                     base["dur"] = dur_ns / 1000.0
                     events.append(base)
                 else:  # async pair
-                    async_seq += 1
-                    aid = f"{trace_id or 'span'}:{async_seq}"
+                    # the id is a pure function of the record, NOT an export
+                    # counter: a collector scraping this cumulative endpoint
+                    # twice must get the SAME pair ids both times, or its
+                    # union-dedupe would double every async event
+                    aid = f"{trace_id or 'span'}:{name}:{ring.tid}:{t0_wall}:{dur_ns}"
                     ev_args["dur_us"] = dur_ns / 1000.0  # pair duration, for trace-derived stats
                     events.append({**base, "ph": "b", "id": aid})
                     events.append(

@@ -442,6 +442,14 @@ class SegmentStore:
                     f"[segment-store] spill write failed ({e}); dropped segment {fp.hex()} "
                     f"(degrades to NACK/literal-resend; streak {streak}/{self.max_spill_write_failures})"
                 )
+                # fleet-log the degradation (docs/observability.md): a post-
+                # mortem reading NACK storms needs to see the spill failures
+                # that seeded them, in order, next to everything else
+                from skyplane_tpu.obs.events import EV_SPILL_DEGRADED, get_recorder
+
+                get_recorder().record(
+                    EV_SPILL_DEGRADED, fp=fp.hex(), streak=streak, error=str(e)[:200]
+                )
                 return
             with self._hold(self._spill_lock):
                 self._spill_fail_streak = 0

@@ -1,0 +1,195 @@
+"""Fleet telemetry acceptance slice (ISSUE 9): a loopback 2-hop relay
+transfer, fully sampled, with one armed fault — the TelemetryCollector must
+merge the three gateways' signals into ONE multi-hop Perfetto timeline
+(validated by scripts/check_trace_json.py's multihop checks), tail the flight
+recorder into an ordered fleet log containing the transfer lifecycle and the
+fault firing, and produce a bottleneck report whose stage totals reconcile
+with the local tracer's breakdown.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.tracker import TransferProgressTracker
+from skyplane_tpu.faults import FaultPlan, FaultSpec, configure_injector
+from skyplane_tpu.obs import configure_recorder, configure_tracer, get_recorder, get_tracer
+from skyplane_tpu.obs.collector import (
+    BOTTLENECK_STAGES,
+    GatewayTarget,
+    TelemetryCollector,
+    bottleneck_report,
+    stage_breakdown,
+)
+from tests.integration.harness import HarnessCopyJob, StubDataplane, bind_gateway, start_gateway
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+rng = np.random.default_rng(23)
+
+
+def _recv_program(children):
+    return {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [{"op_type": "receive", "handle": "recv", "dedup": False, "children": children}],
+            }
+        ]
+    }
+
+
+@pytest.fixture(autouse=True)
+def _restore_obs(monkeypatch):
+    yield
+    configure_injector(None)
+    configure_tracer()
+    configure_recorder()
+
+
+def test_two_hop_relay_collector_merge_and_bottleneck(tmp_path):
+    configure_tracer(sample=1.0)
+    configure_recorder()
+    # one deterministic fault: the 3rd sender.send evaluation raises; the
+    # stream resets and the chunk resends — recovery is part of the scenario
+    configure_injector(FaultPlan(seed=99, points={"sender.send": FaultSpec(p=1.0, after=2, max_fires=1)}))
+
+    dst = start_gateway(
+        _recv_program([{"op_type": "write_local", "handle": "write", "children": []}]),
+        {},
+        "gw_dst",
+        str(tmp_path / "dst_chunks"),
+        use_tls=False,
+    )
+    relay = start_gateway(
+        _recv_program(
+            [
+                {
+                    "op_type": "send",
+                    "handle": "fwd",
+                    "target_gateway_id": "gw_dst",
+                    "num_connections": 2,
+                    "compress": "none",
+                    "encrypt": False,
+                    "dedup": False,
+                    "children": [],
+                }
+            ]
+        ),
+        {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}},
+        "gw_relay",
+        str(tmp_path / "relay_chunks"),
+        use_tls=False,
+    )
+    src = start_gateway(
+        {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "read_local",
+                            "handle": "read",
+                            "num_connections": 2,
+                            "children": [
+                                {
+                                    "op_type": "send",
+                                    "handle": "send",
+                                    "target_gateway_id": "gw_relay",
+                                    "num_connections": 2,
+                                    "compress": "none",
+                                    "encrypt": False,
+                                    "dedup": False,
+                                    "children": [],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        },
+        {"gw_relay": {"public_ip": "127.0.0.1", "control_port": relay.control_port}},
+        "gw_src",
+        str(tmp_path / "src_chunks"),
+        use_tls=False,
+    )
+
+    payload = rng.integers(0, 256, 512 << 10, dtype=np.uint8).tobytes() + bytes(512 << 10)
+    src_file = tmp_path / "corpus.bin"
+    dst_file = tmp_path / "out" / "corpus.bin"
+    src_file.write_bytes(payload)
+
+    def target(gw, region):
+        return GatewayTarget(gw.daemon.gateway_id, gw.url("").rstrip("/"), region=region, session_fn=gw.session)
+
+    collector = TelemetryCollector(
+        [target(src, "local:srcA"), target(relay, "local:relayB"), target(dst, "local:dstC")],
+        scrape_timeout_s=5.0,
+        local_recorder=get_recorder(),
+        fleet_log_path=str(tmp_path / "fleet.jsonl"),
+        label="fleet-test",
+    )
+    try:
+        dp = StubDataplane([bind_gateway(src, "local:srcA")], [bind_gateway(dst, "local:dstC")])
+        job = HarnessCopyJob(src_file, dst_file, chunk_bytes=128 << 10, batch_size=4)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig())
+        tracker.start()
+        tracker.join(timeout=120)
+        assert not tracker.is_alive() and tracker.error is None, f"transfer failed: {tracker.error}"
+        collector.poll_once()
+        assert hashlib.md5(dst_file.read_bytes()).hexdigest() == hashlib.md5(payload).hexdigest()
+
+        # ---- ONE merged timeline with source, relay, destination rows ----
+        merged = collector.merged_trace()
+        sys.path.insert(0, str(REPO_ROOT / "scripts"))
+        try:
+            import check_trace_json
+
+            assert check_trace_json.validate(merged, multihop=True) == 0
+        finally:
+            sys.path.pop(0)
+        pids = merged["otherData"]["gateway_pids"]
+        assert {"gw_src", "gw_relay", "gw_dst"} <= set(pids)
+        # hop ordering: source row sorts above relay (hop 0 before hop 1)
+        assert pids["gw_src"] < pids["gw_relay"]
+
+        # ---- fleet log: lifecycle + fault, in seq order ----
+        events = collector.fleet_events()
+        kinds = [e["kind"] for e in events]
+        assert "transfer.dispatch_start" in kinds
+        assert "transfer.dispatch_end" in kinds
+        assert "transfer.complete" in kinds
+        assert "fault.fired" in kinds
+        fault = next(e for e in events if e["kind"] == "fault.fired")
+        assert fault["point"] == "sender.send"
+        by_rec = {}
+        for e in events:
+            by_rec.setdefault(e["recorder"], []).append(e["seq"])
+        assert all(seqs == sorted(seqs) for seqs in by_rec.values())
+        # lifecycle ordering within the recorder: dispatch_start < complete
+        assert kinds.index("transfer.dispatch_start") < kinds.index("transfer.complete")
+
+        # ---- bottleneck attribution reconciles with the local tracer ----
+        report = bottleneck_report(merged, collector.cpu_profiles())
+        assert set(report["stages"]) == set(BOTTLENECK_STAGES)
+        assert report["stages"]["frame"]["count"] > 0
+        assert report["stages"]["decode"]["count"] > 0
+        assert report["n_gateways"] >= 3
+        local = stage_breakdown(get_tracer().export()["traceEvents"])
+        for stage in BOTTLENECK_STAGES:
+            a, b = report["stages"][stage]["total_us"], local[stage]["total_us"]
+            if max(a, b) > 0:
+                assert abs(a - b) / max(a, b) <= 0.10, f"stage {stage}: merged {a} vs local {b}"
+        # per-gateway rows: the relay both receives AND sends
+        relay_stages = report["per_gateway"]["gw_relay"]["stages"]
+        assert relay_stages["decode"]["count"] > 0 and relay_stages["frame"]["count"] > 0
+    finally:
+        collector.stop(final_poll=False)
+        for gw in (src, relay, dst):
+            gw.stop()

@@ -1,0 +1,551 @@
+"""Fleet telemetry plane: flight recorder, collector scrape/merge/degradation,
+multi-hop trace merging, bottleneck attribution, fleet metrics labelling, and
+the tracker-side client registry metrics (ISSUE 9 / docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+import uuid
+from pathlib import Path
+
+import pytest
+
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.obs import configure_recorder, configure_tracer, get_recorder, get_registry
+from skyplane_tpu.obs.collector import (
+    BOTTLENECK_STAGES,
+    GatewayTarget,
+    TelemetryCollector,
+    bottleneck_report,
+    format_bottleneck,
+    merge_traces,
+    parse_prometheus,
+    render_fleet_metrics,
+    stage_breakdown,
+)
+from skyplane_tpu.obs.events import FlightRecorder
+from skyplane_tpu.obs.metrics import thread_cpu_seconds
+from skyplane_tpu.obs.tracer import Tracer
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture(autouse=True)
+def _restore_obs_singletons():
+    yield
+    configure_tracer()
+    configure_recorder()
+
+
+# ----------------------------------------------------------- flight recorder
+
+
+def test_recorder_seq_monotonic_and_events_since():
+    rec = FlightRecorder(capacity=64)
+    seqs = [rec.record("transfer.dispatch_start", jobs=i) for i in range(5)]
+    assert seqs == [1, 2, 3, 4, 5]
+    assert rec.seq() == 5
+    tail = rec.events_since(3)
+    assert [e["seq"] for e in tail] == [4, 5]
+    assert all(e["kind"] == "transfer.dispatch_start" and "ts" in e for e in tail)
+    assert rec.events_since(5) == []
+    assert rec.events_since(0, limit=2) == rec.events_since(0)[:2]
+
+
+def test_recorder_bound_and_drop_counter():
+    rec = FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record("fault.fired", point="p", i=i)
+    counters = rec.counters()
+    assert counters["events_recorded"] == 40
+    assert counters["events_buffered"] == 16
+    assert counters["events_dropped"] == 40 - 16
+    # the ring keeps the NEWEST events; seq numbering is unbroken
+    assert [e["seq"] for e in rec.events_since(0)] == list(range(25, 41))
+
+
+def test_recorder_distinct_ids_and_reset():
+    a, b = FlightRecorder(), FlightRecorder()
+    assert a.recorder_id != b.recorder_id
+    a.record("x")
+    a.reset()
+    assert a.seq() == 0 and a.events_since(0) == [] and a.counters()["events_dropped"] == 0
+
+
+def test_recorder_env_capacity(monkeypatch):
+    monkeypatch.setenv("SKYPLANE_TPU_EVENT_LOG", "32")
+    rec = configure_recorder()
+    assert rec.capacity == 32
+    assert get_recorder() is rec
+
+
+# ----------------------------------------------------- attribution arithmetic
+
+
+def _x(name, dur_us, gw=None, cid=None, ts=0.0):
+    args = {}
+    if gw:
+        args["gateway"] = gw
+    if cid:
+        args["chunk_id"] = cid
+    return {"name": name, "ph": "X", "pid": 1, "tid": 1, "ts": ts, "dur": dur_us, "cat": "sender", "args": args}
+
+
+def _b(name, dur_us, gw=None, ts=0.0, aid="a1"):
+    args = {"dur_us": dur_us}
+    if gw:
+        args["gateway"] = gw
+    return {"name": name, "ph": "b", "pid": 1, "tid": 1, "ts": ts, "id": aid, "cat": "sender", "args": args}
+
+
+def test_stage_breakdown_covers_x_and_async_and_zero_fills():
+    events = [
+        _x("wire.frame", 100.0),
+        _x("wire.frame", 300.0),
+        _x("decode", 50.0),
+        _b("wire.ack_lag", 1000.0),
+        _x("unrelated.span", 9999.0),
+    ]
+    out = stage_breakdown(events)
+    assert set(out) == set(BOTTLENECK_STAGES)
+    assert out["frame"] == {"count": 2, "total_us": 400.0, "mean_us": 200.0}
+    assert out["ack_lag"]["total_us"] == 1000.0
+    assert out["decode"]["count"] == 1
+    assert out["store"] == {"count": 0, "total_us": 0.0, "mean_us": 0.0}
+    assert out["device_wait"]["count"] == 0
+
+
+def test_bottleneck_report_groups_per_gateway_and_formats():
+    events = [
+        _x("wire.frame", 100.0, gw="gw_src", cid="c1"),
+        _x("decode", 80.0, gw="gw_dst", cid="c1"),
+        _x("store.write", 20.0, gw="gw_dst", cid="c1"),
+    ]
+    cpu = {"gw_src": {"threads": {"send-w0": {"tid": 5, "cpu_s": 1.25}, "main": {"tid": 1, "cpu_s": 0.5}}}}
+    report = bottleneck_report({"traceEvents": events}, cpu)
+    assert report["n_gateways"] == 2 and report["n_chunks"] == 1 and report["n_spans"] == 3
+    assert report["per_gateway"]["gw_src"]["stages"]["frame"]["total_us"] == 100.0
+    assert report["per_gateway"]["gw_dst"]["stages"]["decode"]["count"] == 1
+    assert report["per_gateway"]["gw_src"]["cpu_total_s"] == 1.75
+    text = format_bottleneck(report)
+    assert "gw_src" in text and "send-w0" in text and "frame" in text
+
+
+def test_thread_cpu_seconds_sees_current_thread():
+    # burn a little CPU so the clock is visibly nonzero
+    x = 0
+    for i in range(200_000):
+        x += i * i
+    threads = thread_cpu_seconds()
+    me = threading.current_thread().name
+    assert me in threads
+    assert threads[me]["cpu_s"] > 0.0
+
+
+# ------------------------------------------------------------- trace merging
+
+
+def _export_for(gw, cid, hop, tid=1):
+    """A miniature per-gateway tracer export (sender+receiver spans)."""
+    return {
+        "traceEvents": [
+            {"name": "thread_name", "ph": "M", "pid": 99, "tid": tid, "args": {"name": "t"}},
+            _x("wire.frame", 10.0, gw=gw, cid=cid, ts=float(hop)),
+            {**_x("decode", 5.0, gw=gw, cid=cid, ts=float(hop) + 0.5), "cat": "receiver"},
+            _b("wire.ack_lag", 7.0, gw=gw, ts=float(hop), aid=f"{cid}:{gw}"),
+            {
+                "name": "wire.ack_lag",
+                "ph": "e",
+                "pid": 1,
+                "tid": 1,
+                "ts": float(hop) + 7.0,
+                "id": f"{cid}:{gw}",
+                "args": {},
+            },
+        ]
+    }
+
+
+def test_merge_traces_dedupes_shared_process_scrapes():
+    """Three co-located gateways sharing one tracer return identical exports:
+    the union must keep each event ONCE (and async pairs must stay balanced
+    on one synthetic pid)."""
+    cid = uuid.uuid4().hex
+    shared = {
+        "traceEvents": (
+            _export_for("gw_a", cid, 0)["traceEvents"] + _export_for("gw_b", cid, 1)["traceEvents"]
+        )
+    }
+    merged = merge_traces([({"gateway": "gw_a"}, shared), ({"gateway": "gw_b"}, shared), ({"gateway": "gw_c"}, shared)])
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert len(spans) == 4  # 2 gateways x (frame + decode), each once
+    bs = [e for e in merged["traceEvents"] if e.get("ph") == "b"]
+    es = [e for e in merged["traceEvents"] if e.get("ph") == "e"]
+    assert len(bs) == len(es) == 2
+    for b in bs:
+        match = [e for e in es if e["id"] == b["id"]]
+        assert match and match[0]["pid"] == b["pid"], "async pair split across synthetic pids"
+
+
+def test_merge_traces_regroups_by_gateway_with_hop_order():
+    cid = uuid.uuid4().hex
+    scrapes = [
+        ({"gateway": "gw_relay", "region": "local:b"}, _export_for("gw_relay", cid, 1)),
+        ({"gateway": "gw_src", "region": "local:a"}, _export_for("gw_src", cid, 0)),
+    ]
+    # hop args ride only sender spans in real traces; stamp them here
+    for meta, export in scrapes:
+        for ev in export["traceEvents"]:
+            if ev.get("name") == "wire.frame":
+                ev["args"]["hop"] = 0 if meta["gateway"] == "gw_src" else 1
+    merged = merge_traces(scrapes)
+    pids = merged["otherData"]["gateway_pids"]
+    assert set(pids) == {"gw_src", "gw_relay"}
+    assert pids["gw_src"] < pids["gw_relay"], "hop 0 sorts above hop 1"
+    names = {
+        (e["pid"], e["args"]["name"])
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert (pids["gw_src"], "gw_src (local:a)") in names
+    # every span landed on its gateway's pid
+    for ev in merged["traceEvents"]:
+        gw = (ev.get("args") or {}).get("gateway")
+        if gw:
+            assert ev["pid"] == pids[gw]
+
+
+def test_merge_traces_repeat_scrape_is_idempotent():
+    """/api/v1/trace is cumulative: scraping twice (superset the second time)
+    must not duplicate the first wave's events."""
+    cid = uuid.uuid4().hex
+    first = _export_for("gw_a", cid, 0)
+    second = {"traceEvents": first["traceEvents"] + [_x("wire.frame", 99.0, gw="gw_a", cid="f" * 32, ts=50.0)]}
+    merged = merge_traces([({"gateway": "gw_a"}, first), ({"gateway": "gw_a"}, second)])
+    frames = [e for e in merged["traceEvents"] if e.get("name") == "wire.frame" and e.get("ph") == "X"]
+    assert len(frames) == 2  # one original + one new, no duplicates
+
+
+# ------------------------------------------------------------ fleet metrics
+
+
+def test_parse_prometheus_and_fleet_labels():
+    text = "# HELP skyplane_x x\n# TYPE skyplane_x gauge\nskyplane_x 3\n" 'skyplane_t{tenant="ab"} 7\n'
+    samples = parse_prometheus(text)
+    assert ("skyplane_x", "", 3.0) in samples
+    assert ("skyplane_t", '{tenant="ab"}', 7.0) in samples
+    fleet = render_fleet_metrics(
+        {
+            "gw_a": ({"gateway": "gw_a", "region": "aws:us-east-1", "provider": "aws"}, text),
+            "gw_b": ({"gateway": "gw_b", "region": "gcp:us-central1", "provider": "gcp"}, text),
+        }
+    )
+    assert 'skyplane_x{gateway="gw_a",region="aws:us-east-1",provider="aws"} 3' in fleet
+    assert 'skyplane_t{gateway="gw_b",region="gcp:us-central1",provider="gcp",tenant="ab"} 7' in fleet
+
+
+# ------------------------------------------------- live scrape + degradation
+
+
+class _FakeReceiver:
+    socket_profile_events = queue.Queue()
+
+    def socket_events_dropped(self):
+        return 0
+
+
+def _bare_api(tmp_path, gateway_id="gw_test", region="test:r"):
+    from skyplane_tpu.gateway.gateway_daemon_api import GatewayDaemonAPI
+    from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+
+    # the bare API serves the process registry; make sure it is non-empty so
+    # scrape assertions have a family to find (a real daemon always registers)
+    get_registry().counter("collector_test_probe").inc()
+    store = ChunkStore(str(tmp_path / f"chunks_{gateway_id}"))
+    store.add_partition("default", GatewayQueue())
+    api = GatewayDaemonAPI(
+        chunk_store=store,
+        receiver=_FakeReceiver(),
+        error_event=threading.Event(),
+        error_queue=queue.Queue(),
+        terminal_operators={"default": []},
+        handle_to_group={"default": {}},
+        region=region,
+        gateway_id=gateway_id,
+        host="127.0.0.1",
+        port=0,
+    )
+    api.start()
+    return api
+
+
+def test_events_and_telemetry_routes_over_http(tmp_path):
+    import urllib.request
+
+    rec = configure_recorder()
+    rec.record("admission.granted", job_id="j1", tenant="t" * 16)
+    rec.record("fault.fired", point="sender.send")
+    api = _bare_api(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{api.port}/api/v1"
+        payload = json.loads(urllib.request.urlopen(f"{base}/events?since=0", timeout=5).read())
+        assert payload["recorder"] == rec.recorder_id
+        assert [e["kind"] for e in payload["events"]] == ["admission.granted", "fault.fired"]
+        assert payload["next_since"] == 2 and payload["dropped"] == 0
+        # cursor semantics: since=next returns nothing new
+        tail = json.loads(urllib.request.urlopen(f"{base}/events?since=2", timeout=5).read())
+        assert tail["events"] == []
+        cpu = json.loads(urllib.request.urlopen(f"{base}/profile/cpu", timeout=5).read())
+        assert cpu["gateway_id"] == "gw_test" and isinstance(cpu["threads"], dict)
+        combined = json.loads(urllib.request.urlopen(f"{base}/telemetry?since=0&cpu=1", timeout=5).read())
+        assert combined["gateway_id"] == "gw_test"
+        assert "traceEvents" in combined["trace"]
+        assert combined["events"]["next_since"] == 2
+        assert "skyplane_" in combined["metrics_text"]
+        assert isinstance(combined["cpu"]["threads"], dict)
+    finally:
+        api.stop()
+
+
+def _hanging_server():
+    """Accepts connections and never responds (a black-holed gateway)."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(8)
+    conns = []
+
+    def loop():
+        while True:
+            try:
+                conn, _ = sock.accept()
+                conns.append(conn)  # keep open, never answer
+            except OSError:
+                return
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return sock, sock.getsockname()[1], conns
+
+
+def test_collector_marks_dead_and_hanging_stale_without_blocking(tmp_path):
+    configure_recorder()
+    api = _bare_api(tmp_path, gateway_id="gw_live")
+    hang_sock, hang_port, _conns = _hanging_server()
+    # a port with nothing listening: connection refused (definitively dead)
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    try:
+        collector = TelemetryCollector(
+            [
+                GatewayTarget("gw_live", f"http://127.0.0.1:{api.port}/api/v1"),
+                GatewayTarget("gw_hang", f"http://127.0.0.1:{hang_port}/api/v1"),
+                GatewayTarget("gw_dead", f"http://127.0.0.1:{dead_port}/api/v1"),
+            ],
+            scrape_timeout_s=0.5,
+            stale_after=2,
+            label="degradation-test",
+        )
+        t0 = time.monotonic()
+        first = collector.poll_once()
+        second = collector.poll_once()
+        elapsed = time.monotonic() - t0
+        # the hanging gateway is bounded by the scrape timeout and scrapes run
+        # in parallel: two full waves must come back well under the time three
+        # serial timeouts would take
+        assert elapsed < 4 * 0.5 + 2.0, f"poll blocked for {elapsed:.1f}s"
+        assert first["gw_live"] is True and second["gw_live"] is True
+        assert first["gw_hang"] is False and first["gw_dead"] is False
+        assert sorted(collector.stale_gateways()) == ["gw_dead", "gw_hang"]
+        counters = collector.counters()
+        assert counters["collector_stale_gateways"] == 2
+        assert counters["collector_scrape_failures"] >= 4
+        # the live gateway's data still arrived despite its dead peers
+        assert "skyplane_" in collector.fleet_metrics_text()
+    finally:
+        hang_sock.close()
+        api.stop()
+
+
+def test_collector_recovers_when_gateway_returns(tmp_path):
+    configure_recorder()
+    # phase 1: nothing listening -> stale
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    collector = TelemetryCollector(
+        [GatewayTarget("gw_flaky", f"http://127.0.0.1:{port}/api/v1")],
+        scrape_timeout_s=0.5,
+        stale_after=2,
+        label="recovery-test",
+    )
+    collector.poll_once()
+    collector.poll_once()
+    assert collector.stale_gateways() == ["gw_flaky"]
+    # phase 2: a real API comes up on another port; retarget (simulates the
+    # gateway process returning) and the next successful scrape recovers it
+    api = _bare_api(tmp_path, gateway_id="gw_flaky")
+    try:
+        with collector._lock:
+            collector._states["gw_flaky"].target = GatewayTarget(
+                "gw_flaky", f"http://127.0.0.1:{api.port}/api/v1"
+            )
+        result = collector.poll_once()
+        assert result["gw_flaky"] is True
+        assert collector.stale_gateways() == []
+        assert collector.counters()["collector_recoveries"] == 1
+    finally:
+        api.stop()
+
+
+def test_collector_tails_events_dedupes_and_persists_jsonl(tmp_path):
+    rec = configure_recorder()
+    rec.record("transfer.dispatch_start", jobs=1)
+    api_a = _bare_api(tmp_path, gateway_id="gw_a")
+    api_b = _bare_api(tmp_path, gateway_id="gw_b")  # same process: SAME recorder
+    log_path = tmp_path / "fleet.jsonl"
+    try:
+        collector = TelemetryCollector(
+            [
+                GatewayTarget("gw_a", f"http://127.0.0.1:{api_a.port}/api/v1"),
+                GatewayTarget("gw_b", f"http://127.0.0.1:{api_b.port}/api/v1"),
+            ],
+            scrape_timeout_s=2.0,
+            fleet_log_path=str(log_path),
+            label="events-test",
+        )
+        collector.poll_once()
+        rec.record("failover.gateway_dead", gateway_id="gw_x", requeued_chunks=3)
+        collector.poll_once()
+        collector.poll_once()  # nothing new: must not re-ingest
+        events = collector.fleet_events()
+        # both gateways serve the SAME shared recorder: each event ONCE
+        assert [e["kind"] for e in events] == ["transfer.dispatch_start", "failover.gateway_dead"]
+        assert collector.counters()["collector_events_tailed"] == 2
+        lines = [json.loads(ln) for ln in log_path.read_text().splitlines() if ln.strip()]
+        assert [e["kind"] for e in lines] == ["transfer.dispatch_start", "failover.gateway_dead"]
+        assert all(e["recorder"] == rec.recorder_id for e in lines)
+        # seq order per recorder holds in the merged fleet log
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)
+    finally:
+        api_a.stop()
+        api_b.stop()
+
+
+def test_scrape_trace_once_merges_multiple_urls(tmp_path):
+    """The `trace export --url A --url B` satellite: one merged timeline."""
+    from skyplane_tpu.obs.collector import scrape_trace_once
+
+    tracer = configure_tracer(sample=1.0)
+    cid = uuid.uuid4().hex
+    with tracer.span("wire.frame", trace_id=cid, cat="sender", args={"gateway": "gw_a", "hop": 0}):
+        pass
+    with tracer.span("decode", trace_id=cid, cat="receiver", args={"gateway": "gw_b"}):
+        pass
+    api_a = _bare_api(tmp_path, gateway_id="gw_a")
+    api_b = _bare_api(tmp_path, gateway_id="gw_b")
+    try:
+        merged = scrape_trace_once(
+            [f"http://127.0.0.1:{api_a.port}", f"http://127.0.0.1:{api_b.port}"], timeout=5
+        )
+        pids = merged["otherData"]["gateway_pids"]
+        assert {"gw_a", "gw_b"} <= set(pids)
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        # both gateways serve the same process tracer; dedupe keeps each once
+        assert len([e for e in spans if e["name"] == "wire.frame"]) == 1
+        assert len([e for e in spans if e["name"] == "decode"]) == 1
+        for ev in spans:
+            assert ev["pid"] == pids[ev["args"]["gateway"]]
+    finally:
+        api_a.stop()
+        api_b.stop()
+
+
+# ------------------------------------------- tracker-side client registry
+
+
+def test_tracker_registers_fleet_health_metrics():
+    from types import SimpleNamespace
+
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.tracker import TransferProgressTracker
+
+    dataplane = SimpleNamespace(
+        bound_gateways={"gw_a": object(), "gw_b": object()},
+        _trackers=[],
+        src_region_tag="local:a",
+        dst_region_tags=["local:b"],
+    )
+    tracker = TransferProgressTracker(dataplane, [], TransferConfig())
+    tracker.dead_gateway_ids.add("gw_b")
+    tracker.failover_events.append({"gateway_id": "gw_b"})
+    tracker.replan_events.append({"reason": "test"})
+    text = get_registry().render_prometheus()
+    assert 'skyplane_gateway_alive{gateway="gw_a"} 1' in text
+    assert 'skyplane_gateway_alive{gateway="gw_b"} 0' in text
+    assert "skyplane_failover_events_total 1" in text
+    assert "skyplane_replan_events_total 1" in text
+    # keep the tracker alive until after the render (WeakSet registration)
+    assert tracker is not None
+
+
+def test_tracker_lifecycle_events_reach_recorder():
+    """The tracker's run() journals dispatch/complete into the process
+    recorder; verify via the events the failover handler records (unit-level:
+    call the handler surface directly)."""
+    rec = configure_recorder()
+    from types import SimpleNamespace
+
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.tracker import TransferProgressTracker
+
+    class _Job:
+        def requeue_chunks(self, dataplane, pending, dead):
+            return 7
+
+    src = SimpleNamespace(gateway_id="gw_a")
+    srcb = SimpleNamespace(gateway_id="gw_b")
+    dataplane = SimpleNamespace(
+        bound_gateways={"gw_a": src, "gw_b": srcb},
+        _trackers=[],
+        src_region_tag="local:a",
+        dst_region_tags=["local:b"],
+        source_gateways=lambda: [src, srcb],
+    )
+    tracker = TransferProgressTracker(dataplane, [_Job()], TransferConfig())
+    tracker._handle_dead_gateway("gw_a", "refused", 30)
+    kinds = [e["kind"] for e in rec.events_since(0)]
+    assert "failover.gateway_dead" in kinds
+    ev = next(e for e in rec.events_since(0) if e["kind"] == "failover.gateway_dead")
+    assert ev["gateway_id"] == "gw_a" and ev["requeued_chunks"] == 7
+
+
+# --------------------------------------------------------- tracer span args
+
+
+def test_span_args_ride_export_with_gateway_and_hop():
+    t = Tracer(sample=1.0)
+    cid = uuid.uuid4().hex
+    with t.span("wire.frame", trace_id=cid, cat="sender", args={"gateway": "gw_z", "hop": 2}):
+        pass
+    export = t.export()
+    ev = next(e for e in export["traceEvents"] if e.get("ph") == "X")
+    assert ev["args"] == {"gateway": "gw_z", "hop": 2, "chunk_id": cid}
+
+
+def test_async_pair_ids_deterministic_across_exports():
+    """Two exports of the same ring must produce identical async ids — the
+    property the collector's union-dedupe depends on."""
+    t = Tracer(sample=1.0)
+    t.record_span("wire.ack_lag", 5_000_000, time.time_ns(), trace_id="ab" * 16, cat="sender")
+    ids1 = sorted(e["id"] for e in t.export()["traceEvents"] if e.get("ph") in ("b", "e"))
+    ids2 = sorted(e["id"] for e in t.export()["traceEvents"] if e.get("ph") in ("b", "e"))
+    assert ids1 == ids2 and len(ids1) == 2

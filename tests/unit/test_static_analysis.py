@@ -452,6 +452,76 @@ def wait_forever(check):
     assert findings and all(f.suppressed for f in findings)
 
 
+def test_unbounded_event_log_fires_on_untrimmed_event_append():
+    """The flight-recorder bug class (docs/observability.md): an event record
+    appended forever in gateway code is unbounded memory charged to every
+    tenant on the box."""
+    src = """
+class Recorder:
+    def __init__(self):
+        self.events = []
+        self.firing_log = []
+
+    def loop(self, q):
+        while True:
+            self.events.append(q.get())
+            self.firing_log.append({"fired": True})
+"""
+    findings = [
+        f for f in run_source(src, "skyplane_tpu/gateway/fixture.py") if f.rule == "unbounded-event-log"
+    ]
+    assert len(findings) == 2, findings
+    assert all(not f.suppressed for f in findings)
+    # same source under obs/ also fires; under api/ it is out of scope
+    assert "unbounded-event-log" in rules_of(src, "skyplane_tpu/obs/fixture.py")
+    assert "unbounded-event-log" not in rules_of(src, "skyplane_tpu/api/fixture.py")
+
+
+def test_unbounded_event_log_quiet_when_bounded_trimmed_or_local():
+    src = """
+from collections import deque
+
+class Recorder:
+    CAP = 100
+
+    def __init__(self):
+        self.events = deque(maxlen=4096)          # structural bound
+        self.status_journal = []                  # trimmed below, drop counted
+        self.journal_dropped = 0
+
+    def record(self, ev):
+        self.events.append(ev)
+        self.status_journal.append(ev)
+        if len(self.status_journal) > self.CAP:
+            overflow = len(self.status_journal) - self.CAP
+            del self.status_journal[:overflow]
+            self.journal_dropped += overflow
+
+def export(ring):
+    events = []                                   # local: dies with the call
+    for slot in ring:
+        events.append(slot)
+    return events
+"""
+    assert "unbounded-event-log" not in rules_of(src, "skyplane_tpu/gateway/fixture.py")
+
+
+def test_unbounded_event_log_suppressible_with_reason():
+    src = """
+class Window:
+    def __init__(self):
+        self.frame_events = []
+
+    def note(self, ev):
+        # sklint: disable=unbounded-event-log -- fixture: one entry per in-flight frame, capped by the byte window
+        self.frame_events.append(ev)
+"""
+    findings = [
+        f for f in run_source(src, "skyplane_tpu/gateway/fixture.py") if f.rule == "unbounded-event-log"
+    ]
+    assert findings and all(f.suppressed for f in findings)
+
+
 # ------------------------------------------------------------- span rules
 
 
