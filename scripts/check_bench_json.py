@@ -133,7 +133,27 @@ REQUIRED_CHAOS = (
     "gateway_death_requeued_chunks",
     "gateway_death_detect_seconds",
     "gateway_death_sched_tokens_leaked",
+    # capacity-repair scenarios (docs/provisioning.md "Repair & drain"):
+    # replacement provisioning, graceful spot drain, applied replans
+    "replacement_ok",
+    "replacement_provisioned",
+    "replacement_resharded_chunks",
+    "replacement_recovery_ratio",
+    "replacement_detect_to_ready_seconds",
+    "drain_ok",
+    "drain_seconds",
+    "drain_deadline_s",
+    "drain_remaining_chunks",
+    "drain_acked_chunks_lost",
+    "drain_admission_rejected",
+    "replan_applied_ok",
+    "replan_applied_events",
+    "replan_retargeted_ops",
+    "replan_stream_retargets",
 )
+#: post-recovery completion rate must reach this fraction of the pre-kill
+#: rate once the replacement joins ("within 20%" of pre-kill throughput)
+MIN_REPLACEMENT_RECOVERY_RATIO = 0.8
 #: the acceptance floor: a chaos run proves nothing unless it injected faults
 #: across at least this many distinct points of the stack
 MIN_CHAOS_POINTS = 5
@@ -284,6 +304,61 @@ def check_chaos(result: dict) -> int:
             file=sys.stderr,
         )
         return 1
+    if result["replacement_ok"] is not True:
+        print(
+            "chaos-smoke: replacement scenario failed — "
+            f"provisioned={result.get('replacement_provisioned')} "
+            f"resharded={result.get('replacement_resharded_chunks')} "
+            f"ratio={result.get('replacement_recovery_ratio')} "
+            f"tracker_error={result.get('replacement_tracker_error')}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["replacement_resharded_chunks"] <= 0:
+        print("chaos-smoke: replacement joined the fleet but carried zero re-sharded chunks (idle)", file=sys.stderr)
+        return 1
+    ratio = result["replacement_recovery_ratio"]
+    if not isinstance(ratio, (int, float)) or ratio < MIN_REPLACEMENT_RECOVERY_RATIO:
+        print(
+            f"chaos-smoke: post-replacement throughput recovered to only {ratio!r}x the pre-kill rate "
+            f"(floor {MIN_REPLACEMENT_RECOVERY_RATIO})",
+            file=sys.stderr,
+        )
+        return 1
+    if result["drain_ok"] is not True:
+        print(
+            "chaos-smoke: drain scenario failed — "
+            f"seconds={result.get('drain_seconds')} (deadline {result.get('drain_deadline_s')}) "
+            f"remaining={result.get('drain_remaining_chunks')} "
+            f"acked_lost={result.get('drain_acked_chunks_lost')} "
+            f"admission_rejected={result.get('drain_admission_rejected')} "
+            f"error={result.get('drain_error')}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["drain_acked_chunks_lost"] != 0:
+        print(f"chaos-smoke: drain lost {result['drain_acked_chunks_lost']} acked chunk(s)", file=sys.stderr)
+        return 1
+    if result["drain_seconds"] is None or result["drain_seconds"] > result["drain_deadline_s"]:
+        print(
+            f"chaos-smoke: drain took {result['drain_seconds']}s, over its deadline {result['drain_deadline_s']}s",
+            file=sys.stderr,
+        )
+        return 1
+    if result["replan_applied_ok"] is not True or result["replan_applied_events"] < 1:
+        print(
+            "chaos-smoke: applied-replan scenario failed — "
+            f"applied={result.get('replan_applied_events')} "
+            f"retargeted={result.get('replan_retargeted_ops')} "
+            f"stream_retargets={result.get('replan_stream_retargets')} "
+            f"tracker_error={result.get('replan_tracker_error')} "
+            f"byte_identical={result.get('replan_byte_identical')}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["replan_stream_retargets"] < 1:
+        print("chaos-smoke: replan applied but no wire stream performed a cutover reset", file=sys.stderr)
+        return 1
     if result["chaos_seconds"] > result["chaos_bound_seconds"]:
         print(
             f"chaos-smoke: recovery took {result['chaos_seconds']}s, over the bound "
@@ -296,7 +371,11 @@ def check_chaos(result: dict) -> int:
         f"{result['chaos_points_fired']}/{result['chaos_points_armed']} points, integrity+determinism proven, "
         f"{result['chaos_seconds']}s vs baseline {result['baseline_seconds']}s "
         f"(bound {result['chaos_bound_seconds']}s), {result['chaos_torn_records_dropped']} torn journal "
-        f"record(s) recovered, zero token/buffer leaks, fd growth {result['chaos_fd_growth']}"
+        f"record(s) recovered, zero token/buffer leaks, fd growth {result['chaos_fd_growth']}; "
+        f"repair loop: replacement ready {result['replacement_detect_to_ready_seconds']}s after detection "
+        f"({result['replacement_resharded_chunks']} chunk(s) re-sharded, recovery {ratio}x pre-kill), "
+        f"drain {result['drain_seconds']}s/{result['drain_deadline_s']}s with 0 acked chunks lost, "
+        f"{result['replan_applied_events']} replan(s) applied over {result['replan_stream_retargets']} stream cutover(s)"
     )
     return 0
 

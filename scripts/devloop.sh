@@ -135,7 +135,14 @@ else
   echo "[devloop] multijob-smoke clean; result at $LOGDIR/multijob_smoke.out" >>"$LOGDIR/devloop.log"
 fi
 
-# Chaos-smoke gate (CPU-only, ~1 min): the deterministic fault-injection soak
+# Chaos-smoke gate (CPU-only, ~1-2 min): the deterministic fault-injection soak
+# plus the capacity-repair scenarios (docs/provisioning.md "Repair & drain"):
+# gateway death -> requeue-to-survivor, kill-one-of-two -> replacement
+# provisioned + re-sharded with throughput recovery gated >= 0.8x pre-kill,
+# preempt notice -> graceful drain under its deadline with zero acked-chunk
+# loss, and an injected ack-lag-dominant hop -> replan APPLIED over a clean
+# stream cutover (replacement_*/drain_*/replan_* keys required by the chaos
+# branch of check_bench_json.py)
 # (scripts/soak_chaos.py, fixed seed, small corpus) — >= 5 distinct fault
 # points fire across the sender wire path / receiver framing / decode pool /
 # scheduler / control API / persistent journal, and the run must finish with

@@ -303,6 +303,508 @@ def run_gateway_death_scenario(base: Path, seed: int) -> dict:
     return out
 
 
+def _two_source_topology(tmp: Path, num_connections: int = 2):
+    """dst <- (src_a, src_b), plus the program/info needed to spawn more
+    identical sources (replacement factory)."""
+    src_a, dst = make_pair(tmp, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=num_connections)
+    info = {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}}
+
+    def source_program():
+        return {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "read_local",
+                            "handle": "read",
+                            "num_connections": num_connections,
+                            "children": [
+                                {
+                                    "op_type": "send",
+                                    "handle": "send",
+                                    "target_gateway_id": "gw_dst",
+                                    "region": "local:local",
+                                    "num_connections": num_connections,
+                                    "compress": "none",
+                                    "encrypt": False,
+                                    "dedup": False,
+                                    "children": [],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+
+    src_b = start_gateway(source_program(), info, "gw_src_b", str(tmp / "src_b_chunks"), use_tls=False)
+    return src_a, src_b, dst, info, source_program
+
+
+def run_replacement_scenario(base: Path, seed: int) -> dict:
+    """Self-healing capacity: kill one of two sources mid-transfer, let the
+    RepairController provision a replacement through the stubbed factory
+    (exercising the same request/ready/reshard path the real Dataplane
+    drives), and prove byte-identical completion with throughput recovering
+    to within 20% of the pre-kill rate (docs/provisioning.md)."""
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.tracker import TransferHook, TransferProgressTracker
+    from skyplane_tpu.compute.repair import RepairController
+
+    os.environ["SKYPLANE_TPU_HEARTBEAT_DEADLINE_S"] = "2.0"
+    chunk_bytes = 256 << 10
+    n_chunks = (env_int("SKYPLANE_CHAOS_REPLACE_MB", 96) << 20) // chunk_bytes
+    payload = np.random.default_rng(seed + 1).integers(0, 256, chunk_bytes * n_chunks, dtype=np.uint8).tobytes()
+    tmp = base / "replacement"
+    tmp.mkdir()
+    src_file = tmp / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp / "out" / "corpus.bin"
+
+    src_a, src_b, dst, info, source_program = _two_source_topology(tmp)
+    replacements: list = []
+    out: dict = {"replacement_ok": False}
+
+    class Clock(TransferHook):
+        def __init__(self):
+            self.ready_monotonic = None
+            self.dead_monotonic = None
+
+        def on_gateway_dead(self, gateway_id, requeued):
+            if self.dead_monotonic is None:
+                self.dead_monotonic = time.monotonic()
+
+        def on_replacement_ready(self, dead_gateway_id, replacement_id, resharded):
+            self.ready_monotonic = time.monotonic()
+
+    # completion-rate sampler: the tracker's poll interval backs off toward
+    # 2 s, so hook timestamps quantize into bursts — sample the DESTINATION's
+    # completion count directly on a fast fixed cadence instead
+    samples: list = []  # (monotonic, chunks complete at dst)
+    sampler_stop = threading.Event()
+
+    def _sample_dst():
+        session = dst.session()
+        while not sampler_stop.wait(0.05):
+            try:
+                status = session.get(dst.url("chunk_status_log"), timeout=5).json()["chunk_status"]
+            except Exception:  # noqa: BLE001 — sampling must never fail the scenario
+                continue
+            samples.append((time.monotonic(), sum(1 for st in status.values() if st == "complete")))
+
+    def _peak_rate(t_start, t_stop, win_s: float = 0.4):
+        """Best sustained completion rate (chunks/s over ~win_s sliding
+        windows) inside [t_start, t_stop]: the phase's CAPACITY, insensitive
+        to ramp-up/wind-down tails and detection gaps that pollute a plain
+        endpoint-to-endpoint slope on a short loopback run."""
+        window = [(t, c) for t, c in samples if t_start <= t <= t_stop]
+        best = None
+        for i, (t_i, c_i) in enumerate(window):
+            j = next((k for k in range(i + 1, len(window)) if window[k][0] >= t_i + win_s), None)
+            if j is None:
+                break
+            t_j, c_j = window[j]
+            if c_j - c_i < 8:
+                continue
+            rate = (c_j - c_i) / (t_j - t_i)
+            if best is None or rate > best:
+                best = rate
+        return best
+
+    try:
+        for op in src_a.daemon.operators:  # wedge: its share of the corpus stays pending
+            op.stop_workers(timeout=5)
+        dp = StubDataplane([bind_gateway(src_a), bind_gateway(src_b)], [bind_gateway(dst)])
+
+        def factory(dead_gateway_id):
+            gw = start_gateway(
+                source_program(), info, f"{dead_gateway_id}-r1", str(tmp / "replacement_chunks"), use_tls=False
+            )
+            replacements.append(gw)
+            return bind_gateway(gw)
+
+        dp.replacement_factory = factory
+        dp.repairer = RepairController(dp, max_replacements=2, deadline_s=60.0, launch_attempts=3)
+        clock = Clock()
+        job = HarnessCopyJob(src_file, out_file, chunk_bytes=chunk_bytes, batch_size=16)
+        tracker = TransferProgressTracker(
+            dp, [job], TransferConfig(compress="none", dedup=False, encrypt_e2e=False), hooks=clock
+        )
+        dp._trackers.append(tracker)
+        sampler = threading.Thread(target=_sample_dst, name="dst-sampler", daemon=True)
+        sampler.start()
+        tracker.start()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with tracker._lock:
+                dispatched = len(tracker.dispatched_chunk_ids)
+            if dispatched == n_chunks and "gw_src" in set(job.chunk_targets.values()):
+                break
+            time.sleep(0.05)
+        # a measurable PRE-KILL window: let the survivor land a real slice of
+        # its own half first, or the pre-kill completion rate is meaningless
+        deadline = time.time() + 60
+        while time.time() < deadline and (not samples or samples[-1][1] < max(16, n_chunks // 4)):
+            time.sleep(0.02)
+        kill_t0 = time.monotonic()
+        src_a.stop()
+        tracker.join(timeout=300)
+        dp.repairer.close(timeout=30)
+        sampler_stop.set()
+        sampler.join(timeout=5)
+
+        t_first = next((t for t, c in samples if c > 0), kill_t0)
+        t_last = samples[-1][0] if samples else kill_t0
+        rate_pre = _peak_rate(t_first, kill_t0)
+        ready = clock.ready_monotonic
+        rate_post = _peak_rate(ready, t_last) if ready is not None else None
+        if rate_pre and rate_post:
+            # capped: "recovered past pre-kill" is the claim, not the multiple
+            ratio = round(min(rate_post / rate_pre, 10.0), 3)
+        else:
+            # too little work remained after the replacement joined (or
+            # before the kill) for a meaningful slope — nothing left to
+            # recover is not a regression
+            ratio = 1.0
+        win_post = round(t_last - ready, 2) if ready is not None else 0.0
+        resharded = (tracker.replacement_events or [{}])[0].get("resharded_chunks", 0)
+        detect_to_ready = None
+        if ready is not None and clock.dead_monotonic is not None:
+            detect_to_ready = round(ready - clock.dead_monotonic, 2)
+        out.update(
+            replacement_provisioned=bool(tracker.replacement_events),
+            replacement_resharded_chunks=resharded,
+            replacement_recovery_ratio=ratio,
+            replacement_rate_pre=round(rate_pre, 1) if rate_pre else None,
+            replacement_rate_post=round(rate_post, 1) if rate_post else None,
+            replacement_recovery_window_s=win_post,
+            replacement_detect_to_ready_seconds=detect_to_ready,
+            replacement_tracker_error=str(tracker.error) if tracker.error else None,
+            replacement_ratio_measured=bool(rate_pre and rate_post),
+            replacement_ok=bool(
+                tracker.error is None
+                and not tracker.is_alive()
+                and tracker.replacement_events
+                # the replacement must actually CARRY load — the ratio's
+                # too-small-window fallback must not mask an idle replacement
+                and resharded > 0
+                and out_file.exists()
+                and out_file.read_bytes() == payload
+                and ratio >= 0.8
+            ),
+        )
+    finally:
+        for gw in [src_a, src_b, dst] + replacements:
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — some already stopped
+                pass
+        os.environ.pop("SKYPLANE_TPU_HEARTBEAT_DEADLINE_S", None)
+    return out
+
+
+def run_drain_scenario(base: Path, seed: int) -> dict:
+    """Graceful spot drain: the preemption watcher (driven by the injected
+    ``gateway.preempt_notice`` fault) flips one of two sources DRAINING
+    mid-transfer — admission 503s, the admitted backlog flushes under the
+    drain deadline, the daemon stops itself, and zero acked chunks are lost
+    (byte-identical corpus)."""
+    from skyplane_tpu.gateway.preempt import PreemptionWatcher
+    from skyplane_tpu.obs.events import EV_DRAIN_COMPLETE, EV_DRAIN_START, get_recorder
+
+    os.environ["SKYPLANE_TPU_PREEMPT_POLL_S"] = "0.05"
+    drain_deadline_s = 20.0
+    os.environ["SKYPLANE_TPU_DRAIN_DEADLINE_S"] = str(drain_deadline_s)
+    configure_injector(
+        FaultPlan.from_dict(
+            {"seed": seed, "points": {"gateway.preempt_notice": {"p": 1.0, "after": 4, "max_fires": 1}}}
+        )
+    )
+    chunk_bytes = 128 << 10
+    n_chunks = 64
+    payload = np.random.default_rng(seed + 2).integers(0, 256, chunk_bytes * n_chunks, dtype=np.uint8).tobytes()
+    tmp = base / "drain"
+    tmp.mkdir()
+    src_file = tmp / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp / "out" / "corpus.bin"
+    seq0 = get_recorder().seq()
+
+    src_a, src_b, dst, _info, _prog = _two_source_topology(tmp)
+    out: dict = {"drain_ok": False, "drain_deadline_s": drain_deadline_s}
+    try:
+        # only src_a watches for the (single) injected preemption notice
+        src_a.daemon._preempt_watcher = PreemptionWatcher(
+            lambda reason: src_a.daemon.begin_drain(reason=reason), name="preempt-watcher-chaos"
+        )
+        src_a.daemon._preempt_watcher.start()
+        # split the corpus across both sources, then watch src_a drain:
+        # byte ranges [0, half) -> src_a, [half, end) -> src_b
+        half = (n_chunks // 2) * chunk_bytes
+        ids_a = _dispatch_range(src_a, src_file, out_file, chunk_bytes, 0, half)
+        ids_b = _dispatch_range(src_b, src_file, out_file, chunk_bytes, half, len(payload))
+        all_ids = ids_a + ids_b
+
+        def drain_events(kind):
+            return [e for e in get_recorder().events_since(seq0) if e["kind"] == kind and e.get("gateway") == "gw_src"]
+
+        deadline = time.time() + 15
+        while time.time() < deadline and not drain_events(EV_DRAIN_START):
+            time.sleep(0.02)
+        if not drain_events(EV_DRAIN_START):
+            out["drain_error"] = "preempt notice never started a drain"
+            return out
+        complete_at_drain = {
+            cid
+            for cid, st in dst.get("chunk_status_log", timeout=15).json()["chunk_status"].items()
+            if st == "complete"
+        }
+        # admission is stopped: a fresh chunk 503s (or the daemon already
+        # finished its drain and refuses the connection)
+        rejected = 0
+        try:
+            probe = ChunkRequest(
+                chunk=Chunk(
+                    src_key=str(src_file),
+                    dest_key=str(tmp / "out" / "probe.bin"),
+                    chunk_id=uuid.uuid4().hex,
+                    chunk_length_bytes=chunk_bytes,
+                    file_offset_bytes=0,
+                )
+            )
+            resp = src_a.session().post(src_a.url("chunk_requests"), json=[probe.as_dict()], timeout=10)
+            rejected = 1 if resp.status_code == 503 else 0
+        except requests.RequestException:
+            rejected = 1  # drain already completed; connection refused counts
+        wait_complete(dst, all_ids, timeout=120)
+        src_a.thread.join(timeout=int(drain_deadline_s) + 10)
+        completes = drain_events(EV_DRAIN_COMPLETE)
+        final = {
+            cid
+            for cid, st in dst.get("chunk_status_log", timeout=15).json()["chunk_status"].items()
+            if st == "complete"
+        }
+        acked_lost = len(complete_at_drain - final)
+        done = completes[0] if completes else {}
+        out.update(
+            drain_seconds=done.get("seconds"),
+            drain_remaining_chunks=done.get("remaining_chunks"),
+            drain_flushed_chunks=done.get("flushed_chunks"),
+            drain_admission_rejected=rejected,
+            drain_acked_chunks_lost=acked_lost,
+            drain_byte_identical=bool(out_file.exists() and out_file.read_bytes() == payload),
+            drain_ok=bool(
+                completes
+                and done.get("remaining_chunks") == 0
+                and done.get("seconds") is not None
+                and done["seconds"] <= drain_deadline_s
+                and acked_lost == 0
+                and rejected == 1
+                and not src_a.thread.is_alive()
+                and out_file.read_bytes() == payload
+            ),
+        )
+    finally:
+        for gw in (src_a, src_b, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — src_a stopped itself
+                pass
+        configure_injector(None)
+        os.environ.pop("SKYPLANE_TPU_PREEMPT_POLL_S", None)
+        os.environ.pop("SKYPLANE_TPU_DRAIN_DEADLINE_S", None)
+    return out
+
+
+def _dispatch_range(src: LocalGateway, src_path: Path, dst_path: Path, chunk_bytes: int, start: int, end: int):
+    """dispatch_file for one byte range of the source file (chunk split
+    across two gateways)."""
+    reqs = []
+    offset = start
+    while offset < end:
+        length = min(chunk_bytes, end - offset)
+        reqs.append(
+            ChunkRequest(
+                chunk=Chunk(
+                    src_key=str(src_path),
+                    dest_key=str(dst_path),
+                    chunk_id=uuid.uuid4().hex,
+                    chunk_length_bytes=length,
+                    file_offset_bytes=offset,
+                )
+            )
+        )
+        offset += length
+    resp = src.post("chunk_requests", json=[r.as_dict() for r in reqs], timeout=30)
+    resp.raise_for_status()
+    return [r.chunk.chunk_id for r in reqs]
+
+
+def run_replan_scenario(base: Path, seed: int) -> dict:
+    """Applied replan: an injected ack-lag-dominant hop (the
+    ``receiver.ack_delay`` fault holds every relay/dst ack 50 ms) makes the
+    real ReplanMonitor detector flag the src->relay edge; the stubbed
+    re-solve routes direct to dst; the tracker must EXECUTE the decision
+    (POST /retarget) and the post-cutover stream must carry the remaining
+    frames with no pending-fp contract violation (byte-identical corpus,
+    zero failed chunks)."""
+    from types import SimpleNamespace
+
+    from skyplane_tpu.api.config import TransferConfig
+    from skyplane_tpu.api.tracker import TransferProgressTracker
+    from skyplane_tpu.gateway.operators.gateway_operator import GatewaySenderOperator
+    from skyplane_tpu.planner.replan import ReplanMonitor
+    from skyplane_tpu.planner.solver import ThroughputSolution
+
+    os.environ["SKYPLANE_TPU_REPLAN_POLL_S"] = "0.2"
+    os.environ["SKYPLANE_TPU_SENDER_WINDOW_MB"] = "1"
+    os.environ["SKYPLANE_TPU_SENDER_STREAMS"] = "0"
+    configure_injector(
+        FaultPlan.from_dict({"seed": seed, "points": {"receiver.ack_delay": {"p": 1.0, "after": 4, "max_fires": 400}}})
+    )
+    chunk_bytes = 64 << 10
+    n_chunks = 96
+    payload = np.random.default_rng(seed + 3).integers(0, 256, chunk_bytes * n_chunks, dtype=np.uint8).tobytes()
+    tmp = base / "replan"
+    tmp.mkdir()
+    src_file = tmp / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp / "out" / "corpus.bin"
+
+    def receive_program(children):
+        return {
+            "plan": [
+                {"partitions": ["default"], "value": [{"op_type": "receive", "handle": "recv", "decrypt": False, "dedup": False, "children": children}]}
+            ]
+        }
+
+    dst = start_gateway(
+        receive_program([{"op_type": "write_local", "handle": "write", "children": []}]),
+        {},
+        "gw_dst",
+        str(tmp / "dst_chunks"),
+        use_tls=False,
+    )
+    info_dst = {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}}
+    relay = start_gateway(
+        receive_program(
+            [
+                {
+                    "op_type": "send",
+                    "handle": "fwd",
+                    "target_gateway_id": "gw_dst",
+                    "region": "local:local",
+                    "num_connections": 2,
+                    "compress": "none",
+                    "encrypt": False,
+                    "dedup": False,
+                    "children": [],
+                }
+            ]
+        ),
+        info_dst,
+        "gw_relay",
+        str(tmp / "relay_chunks"),
+        use_tls=False,
+    )
+    src_program = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "num_connections": 2,
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "send",
+                                "target_gateway_id": "gw_relay",
+                                "region": "local:local",
+                                "num_connections": 2,
+                                "compress": "none",
+                                "encrypt": False,
+                                "dedup": False,
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    src = start_gateway(
+        src_program,
+        {
+            "gw_relay": {"public_ip": "127.0.0.1", "control_port": relay.control_port},
+            "gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port},
+        },
+        "gw_src",
+        str(tmp / "src_chunks"),
+        use_tls=False,
+    )
+
+    class StubResolveMonitor(ReplanMonitor):
+        def resolve(self, congested_edge):
+            return ThroughputSolution(
+                problem=None, is_feasible=True, edge_flow_gbits={("local:srcA", "local:dstB"): 1.0}
+            )
+
+    out: dict = {"replan_applied_ok": False}
+    try:
+        dp = StubDataplane(
+            [bind_gateway(src, "local:srcA")], [bind_gateway(dst, "local:dstB")], src_region_tag="local:srcA"
+        )
+        relay_bound = bind_gateway(relay, "local:relayR")
+        dp.bound_gateways[relay_bound.gateway_id] = relay_bound
+        dp.topology = SimpleNamespace(
+            get_outgoing_paths=lambda gid: {"gw_relay": 2} if gid == "gw_src" else {},
+            gateways={"gw_relay": SimpleNamespace(region_tag="local:relayR")},
+        )
+        dp.replanner = StubResolveMonitor(
+            problem=None, candidate_regions=[], ack_lag_threshold_ms=5.0, min_frames=4
+        )
+        job = HarnessCopyJob(src_file, out_file, chunk_bytes=chunk_bytes, batch_size=8)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig(compress="none", dedup=False, encrypt_e2e=False))
+        dp._trackers.append(tracker)
+        tracker.start()
+        tracker.join(timeout=240)
+        senders = [op for op in src.daemon.operators if isinstance(op, GatewaySenderOperator)]
+        retargets = sum(op.wire_counters()["stream_retargets"] for op in senders)
+        applied = tracker.replan_applied_events[:1]
+        src_errors = src.get("errors", timeout=10).json()["errors"]
+        out.update(
+            replan_applied_events=len(tracker.replan_applied_events),
+            replan_retargeted_ops=(applied[0]["retargeted_ops"] if applied else 0),
+            replan_stream_retargets=retargets,
+            replan_ack_lag_ms=(tracker.replan_events[0]["ack_lag_ms_per_frame"] if tracker.replan_events else None),
+            replan_tracker_error=str(tracker.error) if tracker.error else None,
+            replan_byte_identical=bool(out_file.exists() and out_file.read_bytes() == payload),
+            replan_applied_ok=bool(
+                tracker.error is None
+                and not tracker.is_alive()
+                and applied
+                and applied[0]["new_next_hop_gateway"] == "gw_dst"
+                and retargets >= 1
+                and all(op.target_gateway_id == "gw_dst" for op in senders)
+                and not src_errors
+                and out_file.read_bytes() == payload
+            ),
+        )
+    finally:
+        for gw in (src, relay, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        configure_injector(None)
+        for var in ("SKYPLANE_TPU_REPLAN_POLL_S", "SKYPLANE_TPU_SENDER_WINDOW_MB", "SKYPLANE_TPU_SENDER_STREAMS"):
+            os.environ.pop(var, None)
+    return out
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seed", type=int, default=1337, help="FaultPlan seed (same seed => same firing schedule)")
@@ -385,8 +887,14 @@ def main() -> int:
             torn_dropped += rec.counters()["index_torn_entries_dropped"]
             rec.close()
 
-    # ---- control-plane scenario: gateway death -> requeue-to-survivor ----
+    # ---- control-plane scenarios (docs/provisioning.md "Repair & drain"):
+    # gateway death -> requeue-to-survivor; kill -> replacement provisioned
+    # and re-sharded; preempt notice -> graceful drain; ack-lag-dominant hop
+    # -> replan decision APPLIED with a clean stream cutover ----
     death = run_gateway_death_scenario(base, args.seed)
+    replacement = run_replacement_scenario(base, args.seed)
+    drain = run_drain_scenario(base, args.seed)
+    replan = run_replan_scenario(base, args.seed)
 
     fds_end = open_fd_count()
     slowdown = round(chaos_wall / max(baseline_wall, 1e-9), 3)
@@ -421,6 +929,9 @@ def main() -> int:
         "baseline_seconds": round(baseline_wall, 3),
         "chaos_seconds": round(chaos_wall, 3),
         **death,
+        **replacement,
+        **drain,
+        **replan,
     }
     print(json.dumps(result))
     return 0

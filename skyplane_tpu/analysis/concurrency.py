@@ -621,6 +621,97 @@ class FlatSleepInRetryLoopChecker(Checker):
         return False
 
 
+class UnjoinedThreadInGatewayChecker(Checker):
+    """unjoined-thread-in-gateway: a thread started under ``gateway/`` or
+    ``compute/`` with neither ``daemon=`` at construction nor a visible
+    joined stop path. The drain/repair work added several long-lived
+    control threads (preemption watcher, drain flusher, repair workers) and
+    NONE may outlive shutdown: a non-daemon thread nobody joins wedges
+    process exit, and even a daemon thread without a join in its owner's
+    stop path can race teardown (docs/static-analysis.md).
+
+    Stricter than ``thread-no-daemon`` on scope (error, not warning) but
+    wider on evidence: the join may live anywhere in the MODULE, keyed by
+    the name the Thread is bound to (``self._watcher = Thread(...)`` +
+    ``self._watcher.join()`` in ``stop()`` counts; so does a loop variable
+    joined over a collected list). A Thread constructed and started without
+    any binding (``Thread(target=...).start()``) can never be joined and
+    always fires unless it is a daemon."""
+
+    rules = (
+        RuleSpec(
+            "unjoined-thread-in-gateway",
+            "error",
+            "Thread under gateway//compute/ with neither daemon= nor a module-visible join on its binding",
+        ),
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        from pathlib import PurePath
+
+        parts = PurePath(module.path).parts
+        if "gateway" not in parts and "compute" not in parts:
+            return
+        joined = self._joined_names(module.tree)
+        bound_calls: Set[ast.Call] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) and _is_thread_call(node.value):
+                bound_calls.add(node.value)
+                if any(kw.arg == "daemon" for kw in node.value.keywords):
+                    continue
+                names = {self._terminal_of(t) for t in node.targets} - {""}
+                if names & joined:
+                    continue
+                yield self.finding(
+                    module,
+                    "unjoined-thread-in-gateway",
+                    node.value,
+                    f"Thread bound to {', '.join(sorted(names)) or 'unnamed target'} has no daemon= and "
+                    "no join() anywhere in this module — it outlives shutdown",
+                )
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_call(node) and node not in bound_calls):
+                continue
+            if any(kw.arg == "daemon" for kw in node.keywords):
+                continue
+            yield self.finding(
+                module,
+                "unjoined-thread-in-gateway",
+                node,
+                "Thread constructed without a binding and without daemon= — it can never be joined",
+            )
+
+    @staticmethod
+    def _terminal_of(node: ast.AST) -> str:
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return ""
+
+    @staticmethod
+    def _joined_names(tree: ast.Module) -> Set[str]:
+        """Names with lifecycle handling anywhere in the module: ``X.join()``
+        calls and ``X.daemon = True`` assignments, keyed by terminal name."""
+        joined: Set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                name = UnjoinedThreadInGatewayChecker._terminal_of(node.func.value)
+                if name:
+                    joined.add(name)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon":
+                        name = UnjoinedThreadInGatewayChecker._terminal_of(tgt.value)
+                        if name:
+                            joined.add(name)
+        return joined
+
+
 _TIME_NOW_CALLS = {"time.time", "time.monotonic", "monotonic"}
 _DEADLINEISH_FRAGMENTS = ("deadline", "timeout", "budget", "expires", "expiry")
 
@@ -831,4 +922,5 @@ CONCURRENCY_CHECKERS: Tuple[type, ...] = (
     FlatSleepInRetryLoopChecker,
     UnboundedWaitInProvisionerChecker,
     UnboundedEventLogChecker,
+    UnjoinedThreadInGatewayChecker,
 )

@@ -8,6 +8,7 @@ polling, log collection, auto_deprovision context manager.
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -73,6 +74,22 @@ class BoundGateway:
             return [f"(error endpoint unreachable: {e})"]
 
 
+def _program_touches_key_material(plan_gateway) -> bool:
+    """Relays forward opaque ciphertext and must never hold key material
+    (reference relay semantics): only gateways whose program actually
+    encrypts or decrypts get the E2EE key."""
+
+    def walk(ops) -> bool:
+        for op in ops:
+            if op.get("encrypt") or op.get("decrypt"):
+                return True
+            if walk(op.get("children", [])):
+                return True
+        return False
+
+    return walk(plan_gateway.program_ops())
+
+
 class Dataplane:
     def __init__(self, topology: TopologyPlan, provisioner: Provisioner, transfer_config: TransferConfig, debug: bool = False):
         self.topology = topology
@@ -89,6 +106,18 @@ class Dataplane:
         # only the planning caller knows — and the tracker feeds it sender
         # wire counters every SKYPLANE_TPU_REPLAN_POLL_S. None = disabled.
         self.replanner = None
+        # capacity repair (compute/repair.py): a RepairController attached
+        # here provisions replacement gateways when the tracker declares one
+        # dead (or draining on a preemption notice). None = failover-only.
+        self.repairer = None
+        # kept from provision() so provision_replacement can stage the same
+        # info map / credential payloads on a replacement mid-job
+        self._gateway_info: Optional[Dict[str, dict]] = None
+        self._credential_payloads: Dict[str, object] = {}
+        # serializes mid-job replacement provisioning: the Provisioner's
+        # pending-task list is not thread-safe, and concurrent repair threads
+        # (a correlated spot reclaim) would race add_task/provision/clear
+        self._replacement_lock = threading.Lock()
 
     @property
     def src_region_tag(self) -> str:
@@ -138,37 +167,74 @@ class Dataplane:
             )
 
         credential_payloads = self._assemble_gateway_credentials()
-
-        def _needs_e2ee_key(bound: BoundGateway) -> bool:
-            """Relays forward opaque ciphertext and must never hold key
-            material (reference relay semantics): only gateways whose program
-            actually encrypts or decrypts get the key."""
-
-            def walk(ops) -> bool:
-                for op in ops:
-                    if op.get("encrypt") or op.get("decrypt"):
-                        return True
-                    if walk(op.get("children", [])):
-                        return True
-                return False
-
-            return walk(bound.plan_gateway.program_ops())
+        # kept for mid-job replacement provisioning (compute/repair.py): a
+        # replacement gateway must boot with the same peer map and the same
+        # credential material its predecessor held
+        self._gateway_info = gateway_info
+        self._credential_payloads = credential_payloads
 
         def start(bound: BoundGateway) -> None:
-            bound.server.start_gateway(
-                gateway_program=bound.plan_gateway.gateway_program.to_dict(),
-                gateway_info=gateway_info,
-                gateway_id=bound.gateway_id,
-                e2ee_key=self._e2ee_key if _needs_e2ee_key(bound) else None,
-                use_tls=self.transfer_config.encrypt_socket_tls,
-                use_bbr=self.transfer_config.use_bbr,
-                docker_image=self.transfer_config.gateway_docker_image,
-                tmpfs_gb=self.transfer_config.gateway_tmpfs_gb,
-                credentials=credential_payloads.get(bound.gateway_id),
-            )
+            self._start_bound_gateway(bound, credential_payloads.get(bound.gateway_id))
 
         do_parallel(start, list(self.bound_gateways.values()), n=16, desc="starting gateways", spinner=spinner)
         self.provisioned = True
+
+    def _start_bound_gateway(self, bound: BoundGateway, credentials) -> None:
+        bound.server.start_gateway(
+            gateway_program=bound.plan_gateway.gateway_program.to_dict(),
+            gateway_info=self._gateway_info,
+            gateway_id=bound.gateway_id,
+            e2ee_key=self._e2ee_key if _program_touches_key_material(bound.plan_gateway) else None,
+            use_tls=self.transfer_config.encrypt_socket_tls,
+            use_bbr=self.transfer_config.use_bbr,
+            docker_image=self.transfer_config.gateway_docker_image,
+            tmpfs_gb=self.transfer_config.gateway_tmpfs_gb,
+            credentials=credentials,
+        )
+
+    def provision_replacement(self, dead_gateway_id: str) -> BoundGateway:
+        """Provision + start a like-for-like replacement for one dead (or
+        draining) gateway: same region, VM type, program and credential
+        payload, walked through the same lifecycle ladder as the original
+        fleet (compute/lifecycle.py). The replacement gets a FRESH gateway id
+        (``<dead>+rN``) — the dead id stays on the tracker's exclusion lists —
+        and is registered in the topology + bound_gateways so
+        ``source_gateways()`` / liveness polling / telemetry all see it.
+        Called from the RepairController's repair thread."""
+        import copy
+
+        dead_plan = self.topology.gateways.get(dead_gateway_id)
+        if dead_plan is None:
+            raise SkyplaneTpuException(f"no topology gateway {dead_gateway_id!r} to replace")
+        provider = dead_plan.region_tag.split(":")[0]
+        with self._replacement_lock:
+            task_uuid = self.provisioner.add_task(provider, dead_plan.region_tag, dead_plan.vm_type)
+            server = self.provisioner.provision()[task_uuid]
+            n = 1
+            while f"{dead_gateway_id}+r{n}" in self.topology.gateways:
+                n += 1
+            new_id = f"{dead_gateway_id}+r{n}"
+            clone = copy.copy(dead_plan)
+            clone.gateway_id = new_id
+            clone.public_ip = server.public_ip()
+            clone.private_ip = server.private_ip()
+            clone.control_port = server.control_port
+            self.topology.gateways[new_id] = clone
+            bound = BoundGateway(clone, server)
+            # the peer map gains the replacement (future replacements of OTHER
+            # gateways must be able to address it); already-running daemons
+            # keep their original info file — they never dial a source gateway
+            if self._gateway_info is not None:
+                self._gateway_info[new_id] = {
+                    "region_tag": clone.region_tag,
+                    "public_ip": clone.public_ip,
+                    "private_ip": clone.private_ip,
+                    "control_port": clone.control_port,
+                }
+            self._start_bound_gateway(bound, self._credential_payloads.get(dead_gateway_id))
+            self.bound_gateways[new_id] = bound
+        logger.fs.info(f"[dataplane] replacement gateway {new_id} provisioned for {dead_gateway_id}")
+        return bound
 
     def _storage_providers(self) -> List[str]:
         """Providers whose object stores this topology touches (src + dsts);
@@ -231,6 +297,10 @@ class Dataplane:
         for t in self._trackers:
             if t.is_alive():
                 t.join(timeout=5)
+        if self.repairer is not None:
+            # a repair mid-launch must finish (or fail) before teardown sweeps
+            # — deprovisioning under a half-provisioned replacement leaks it
+            self.repairer.close()
         self.provisioner.deprovision()
         self.provisioned = False
         # gateways are down: now it is safe to abort incomplete multipart

@@ -87,6 +87,15 @@ class Pipeline:
                 )
             except Exception as e:  # noqa: BLE001 - advisory subsystem
                 logger.fs.warning(f"replan monitor unavailable: {e}")
+        # capacity repair (compute/repair.py, docs/provisioning.md "Repair &
+        # drain"): dead/draining gateways get replacement capacity mid-job.
+        # SKYPLANE_TPU_REPAIR=0 reverts to PR-8 survivors-only failover.
+        import os
+
+        if os.environ.get("SKYPLANE_TPU_REPAIR", "1").strip() != "0":
+            from skyplane_tpu.compute.repair import RepairController
+
+            dp.repairer = RepairController(dp)
         return dp
 
     def start(

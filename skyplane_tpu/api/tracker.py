@@ -23,6 +23,7 @@ from skyplane_tpu.obs.events import (
     EV_DISPATCH_START,
     EV_GATEWAY_DEAD,
     EV_REPLAN,
+    EV_REPLAN_APPLIED,
     EV_TRANSFER_COMPLETE,
     EV_TRANSFER_ERROR,
     get_recorder,
@@ -51,6 +52,13 @@ def _tracker_totals() -> dict:
         "failover_events_total": sum(len(t.failover_events) for t in _live_trackers),
         "replan_events_total": sum(len(t.replan_events) for t in _live_trackers),
         "dead_gateways": sum(len(t.dead_gateway_ids) for t in _live_trackers),
+        # capacity-repair loop (docs/provisioning.md "Repair & drain"):
+        # skyplane_replacements_total / skyplane_drains_total et al. —
+        # `skyplane-tpu monitor` shows repair activity live off these
+        "replacements_total": sum(len(t.replacement_events) for t in _live_trackers),
+        "replacement_failures_total": sum(len(t.replacement_failures) for t in _live_trackers),
+        "drains_total": sum(len(t.drain_events) for t in _live_trackers),
+        "replans_applied_total": sum(len(t.replan_applied_events) for t in _live_trackers),
     }
 
 
@@ -108,6 +116,23 @@ class TransferHook:
         """The replan monitor flagged a congested hop and re-solved
         (planner/replan.py); ``decision`` is a ReplanDecision."""
 
+    def on_replan_applied(self, event: dict) -> None:
+        """A replan decision was EXECUTED: the flagged gateway's sender
+        streams were retargeted onto the new next hop (docs/provisioning.md
+        "Repair & drain")."""
+
+    def on_gateway_draining(self, gateway_id: str) -> None:
+        """A source gateway announced a graceful drain (spot preemption
+        notice): admission there stopped, its replacement is pre-warming."""
+
+    def on_replacement_ready(self, dead_gateway_id: str, replacement_id: str, resharded_chunks: int) -> None:
+        """The repair loop provisioned a replacement for a dead/draining
+        gateway and re-sharded pending load onto it."""
+
+    def on_replacement_failed(self, dead_gateway_id: str, reason: str) -> None:
+        """Replacement provisioning failed (ladder/budget/deadline): the
+        fleet continues degraded to survivors-only."""
+
 
 class EmptyTransferHook(TransferHook):
     pass
@@ -143,6 +168,24 @@ class TransferProgressTracker(threading.Thread):
         self.replan_events: List[dict] = []
         self.replan_poll_s = env_float("SKYPLANE_TPU_REPLAN_POLL_S", 5.0)
         self._last_replan_poll = 0.0
+        # applied replans (docs/provisioning.md "Repair & drain"): decisions
+        # go from surfaced to EXECUTED — the flagged gateway's sender streams
+        # retarget onto the re-solved next hop. SKYPLANE_TPU_REPLAN_APPLY=0
+        # reverts to advisory-only.
+        self.replan_apply_enabled = os.environ.get("SKYPLANE_TPU_REPLAN_APPLY", "1").strip() != "0"
+        self.replan_applied_events: List[dict] = []
+        # executed cutovers override the (static) topology's next-hop view:
+        # post-cutover wire counters describe the NEW edge, and a later
+        # retarget must name the CURRENT target or it matches zero senders
+        self._applied_next_hop: Dict[str, tuple] = {}  # gid -> (region, gateway_id)
+        # capacity repair: replacement gateways (compute/repair.py, attached
+        # as dataplane.repairer) + graceful-drain observation. A gateway seen
+        # DRAINING stops receiving requeues/reshards and pre-warms its
+        # replacement before the actual death.
+        self.draining_gateway_ids: Set[str] = set()
+        self.drain_events: List[dict] = []
+        self.replacement_events: List[dict] = []
+        self.replacement_failures: List[dict] = []
         self._lock = threading.Lock()
         # fleet telemetry plane (docs/observability.md): client-side registry
         # metrics are always on (cheap scrape-time callbacks); the collector
@@ -462,13 +505,21 @@ class TransferProgressTracker(threading.Thread):
         requeued = 0
         for job in self.jobs:
             if hasattr(job, "requeue_chunks"):
-                requeued += job.requeue_chunks(self.dataplane, pending, self.dead_gateway_ids)
+                # draining gateways are closed to new chunks (503): never a
+                # requeue target, but their OWN chunks stay theirs to flush
+                try:
+                    requeued += job.requeue_chunks(
+                        self.dataplane, pending, self.dead_gateway_ids, avoid_gateway_ids=self.draining_gateway_ids
+                    )
+                except TypeError:  # older job stubs without the avoid param
+                    requeued += job.requeue_chunks(self.dataplane, pending, self.dead_gateway_ids)
         event = {
             "gateway_id": gid,
             "failure_class": cls,
             "streak": streak,
             "requeued_chunks": requeued,
             "survivors": sorted(survivors),
+            "was_draining": gid in self.draining_gateway_ids,
         }
         self.failover_events.append(event)
         get_recorder().record(EV_GATEWAY_DEAD, **event)
@@ -477,6 +528,86 @@ class TransferProgressTracker(threading.Thread):
             f"onto {len(survivors)} surviving gateway(s)"
         )
         self.hooks.on_gateway_dead(gid, requeued)
+        # capacity repair (compute/repair.py): survivors absorb the load while
+        # a replacement provisions; idempotent — a drain already pre-warmed
+        # one, and a second death report mid-repair is a no-op
+        repairer = getattr(self.dataplane, "repairer", None)
+        if repairer is not None:
+            repairer.request_replacement(gid, tracker=self, reason=f"gateway death ({cls})")
+
+    # ---- capacity repair: replacement registration + drain observation ----
+
+    def note_replacement_ready(self, dead_gateway_id: str, bound, repair_seconds: float) -> None:
+        """RepairController callback (repair thread): a replacement gateway is
+        READY and registered with the dataplane. Re-shard the requeued-plus-
+        future pending load onto it, add it to the telemetry collector, and
+        surface the event; the ready flight-recorder event is the
+        controller's."""
+        with self._lock:
+            pending = [cid for cid in self.dispatched_chunk_ids if cid not in self.complete_chunk_ids]
+        resharded = 0
+        for job in self.jobs:
+            if hasattr(job, "reshard_chunks"):
+                try:
+                    resharded += job.reshard_chunks(
+                        self.dataplane,
+                        pending,
+                        bound,
+                        exclude_gateway_ids=self.dead_gateway_ids | self.draining_gateway_ids,
+                    )
+                except Exception as e:  # noqa: BLE001 — survivors still own every unmoved chunk
+                    logger.fs.warning(f"[tracker] reshard onto {bound.gateway_id} failed: {e}")
+        event = {
+            "dead_gateway_id": dead_gateway_id,
+            "replacement_id": bound.gateway_id,
+            "repair_seconds": round(repair_seconds, 3),
+            "resharded_chunks": resharded,
+        }
+        self.replacement_events.append(event)
+        if self.collector is not None:
+            try:
+                from skyplane_tpu.obs.collector import GatewayTarget
+
+                self.collector.add_target(GatewayTarget.from_bound_gateway(bound))
+            except Exception as e:  # noqa: BLE001 — telemetry must never fail a transfer
+                logger.fs.warning(f"[tracker] collector add_target failed: {e}")
+        logger.fs.warning(
+            f"[tracker] replacement {bound.gateway_id} joined the fleet for {dead_gateway_id} "
+            f"({repair_seconds:.1f}s); {resharded} pending chunk(s) re-sharded onto it"
+        )
+        self.hooks.on_replacement_ready(dead_gateway_id, bound.gateway_id, resharded)
+
+    def note_replacement_failed(self, dead_gateway_id: str, reason: str) -> None:
+        """RepairController callback: no replacement is coming (budget,
+        deadline, or ladder exhaustion) — the fleet continues degraded."""
+        self.replacement_failures.append({"dead_gateway_id": dead_gateway_id, "reason": str(reason)[:300]})
+        self.hooks.on_replacement_failed(dead_gateway_id, reason)
+
+    def _poll_drain_status(self) -> None:
+        """Notice gateways that flipped DRAINING (spot preemption): stop
+        routing requeues/reshards at them and pre-warm their replacement —
+        an ANNOUNCED preemption should cost a dip, not a detection window."""
+        from skyplane_tpu.obs.events import EV_DRAIN_OBSERVED
+
+        for gw in self.dataplane.source_gateways():
+            gid = gw.gateway_id
+            if gid in self.dead_gateway_ids or gid in self.draining_gateway_ids:
+                continue
+            try:
+                status = gw.control_session().get(f"{gw.control_url()}/status", timeout=5).json()
+            except (requests.RequestException, ValueError):
+                continue  # liveness is _check_gateway_errors' job
+            if not (isinstance(status, dict) and status.get("draining")):
+                continue
+            self.draining_gateway_ids.add(gid)
+            event = {"gateway_id": gid, "region": status.get("region", "")}
+            self.drain_events.append(event)
+            get_recorder().record(EV_DRAIN_OBSERVED, **event)
+            logger.fs.warning(f"[tracker] source gateway {gid} is DRAINING (preemption notice); pre-warming replacement")
+            self.hooks.on_gateway_draining(gid)
+            repairer = getattr(self.dataplane, "repairer", None)
+            if repairer is not None:
+                repairer.request_replacement(gid, tracker=self, reason="preemption drain notice")
 
     def _next_hop_region(self, gateway_id: str) -> str:
         """The region this gateway's sender wire counters actually measure:
@@ -485,6 +616,9 @@ class TransferProgressTracker(threading.Thread):
         the final destination would make the replan monitor derate the wrong
         edge. Falls back to the first destination region for topologies the
         tracker cannot introspect (stub dataplanes, no send op)."""
+        override = self._applied_next_hop.get(gateway_id)
+        if override is not None:
+            return override[0]
         fallback = self.dataplane.dst_region_tags[0]
         topology = getattr(self.dataplane, "topology", None)
         if topology is None:
@@ -498,18 +632,30 @@ class TransferProgressTracker(threading.Thread):
             pass
         return fallback
 
-    def _maybe_replan(self) -> None:
-        """Feed the dataplane's ReplanMonitor (if any) a wave of sender wire
-        counters from live source gateways. Congestion decisions are
-        advisory: logged, recorded, surfaced via hooks.on_replan — never a
-        transfer failure."""
-        monitor = getattr(self.dataplane, "replanner", None)
-        if monitor is None:
-            return
+    def _control_plane_poll(self) -> None:
+        """Slow-cadence (replan_poll_s) control-plane work off the completion
+        loop: drain observation + the replan monitor. Everything here is
+        best-effort — it can improve the transfer, never fail it."""
         now = time.monotonic()
         if now - self._last_replan_poll < self.replan_poll_s:
             return
         self._last_replan_poll = now
+        try:
+            self._poll_drain_status()
+        except Exception as e:  # noqa: BLE001 — advisory subsystem
+            logger.fs.warning(f"[tracker] drain poll failed: {e}")
+        self._maybe_replan()
+
+    def _maybe_replan(self) -> None:
+        """Feed the dataplane's ReplanMonitor (if any) a wave of sender wire
+        counters from live source gateways. A congestion decision is logged,
+        recorded and surfaced via hooks.on_replan; with
+        SKYPLANE_TPU_REPLAN_APPLY (default on) it is then EXECUTED — the
+        flagged gateway's sender streams cut over to the re-solved next hop.
+        Never a transfer failure."""
+        monitor = getattr(self.dataplane, "replanner", None)
+        if monitor is None:
+            return
         samples: Dict[str, tuple] = {}
         for gw in self.dataplane.source_gateways():
             if gw.gateway_id in self.dead_gateway_ids:
@@ -528,10 +674,108 @@ class TransferProgressTracker(threading.Thread):
         except Exception as e:  # noqa: BLE001 - advisory subsystem
             logger.fs.warning(f"[tracker] replan monitor failed: {e}")
             return
-        if decision is not None:
-            self.replan_events.append(decision.as_dict())
-            get_recorder().record(EV_REPLAN, **decision.as_dict())
-            self.hooks.on_replan(decision)
+        if decision is None:
+            return
+        self.replan_events.append(decision.as_dict())
+        get_recorder().record(EV_REPLAN, **decision.as_dict())
+        self.hooks.on_replan(decision)
+        if not self.replan_apply_enabled:
+            return
+        try:
+            applied = self._apply_replan(decision)
+        except Exception as e:  # noqa: BLE001 — a failed cutover leaves the old (working) route in place
+            logger.fs.warning(f"[tracker] replan apply failed (route unchanged): {e}")
+            return
+        if applied is None:
+            return
+        self.replan_applied_events.append(applied)
+        get_recorder().record(EV_REPLAN_APPLIED, **applied)
+        logger.fs.warning(
+            f"[tracker] replan APPLIED: {applied['gateway_id']} now sends to "
+            f"{applied['new_next_hop_gateway']} ({applied['new_next_hop_region']}); "
+            f"{applied['retargeted_ops']} sender op(s) cut over"
+        )
+        self.hooks.on_replan_applied(applied)
+
+    def _next_hop_gateway_id(self, gateway_id: str) -> Optional[str]:
+        override = self._applied_next_hop.get(gateway_id)
+        if override is not None:
+            return override[1]
+        topology = getattr(self.dataplane, "topology", None)
+        if topology is None:
+            return None
+        try:
+            for target_id in topology.get_outgoing_paths(gateway_id):
+                return target_id
+        except Exception:  # noqa: BLE001 — advisory subsystem
+            pass
+        return None
+
+    def _apply_replan(self, decision) -> Optional[dict]:
+        """Execute one ReplanDecision: pick the re-solved topology's best
+        alternative edge out of the congested hop's source region, map it to
+        a live bound gateway, and POST /retarget to the flagged gateway so
+        its sender streams cut over (docs/provisioning.md "Repair & drain").
+        The cutover preserves the per-stream pending-fp contract: the wire
+        engine resets each stream exactly like a stream break — un-acked
+        frames re-frame onto the new route, acked chunks stay truthful.
+        Returns the applied-event dict, or None when the decision cannot be
+        mapped onto the live fleet (stays advisory)."""
+        sol = decision.solution
+        edges = getattr(sol, "edge_flow_gbits", None) if sol is not None else None
+        if not edges:
+            return None
+        src_region, congested_next = decision.congested_edge
+        alternatives = [
+            (flow, dst) for (a, dst), flow in edges.items() if a == src_region and dst != congested_next and flow > 0
+        ]
+        if not alternatives:
+            return None
+        _, new_region = max(alternatives)
+        flagged = self.dataplane.bound_gateways.get(decision.gateway_id)
+        if flagged is None:
+            return None
+        new_hop = next(
+            (
+                bound
+                for gid, bound in self.dataplane.bound_gateways.items()
+                if gid != decision.gateway_id
+                and gid not in self.dead_gateway_ids
+                and gid not in self.draining_gateway_ids
+                and bound.region_tag == new_region
+            ),
+            None,
+        )
+        if new_hop is None:
+            return None  # the re-solved region has no live gateway: advisory only
+        from urllib.parse import urlparse
+
+        parsed = urlparse(new_hop.control_url())
+        if not parsed.hostname or not parsed.port:
+            return None
+        resp = flagged.control_session().post(
+            f"{flagged.control_url()}/retarget",
+            json={
+                "new_target_gateway_id": new_hop.gateway_id,
+                "host": parsed.hostname,
+                "control_port": parsed.port,
+                "old_target_gateway_id": self._next_hop_gateway_id(decision.gateway_id),
+            },
+            timeout=10,
+        )
+        resp.raise_for_status()
+        retargeted = int(resp.json().get("retargeted", 0))
+        if retargeted == 0:
+            return None  # nothing matched (e.g. already cut over): advisory
+        # future samples/retargets for this gateway describe the NEW edge
+        self._applied_next_hop[decision.gateway_id] = (new_region, new_hop.gateway_id)
+        return {
+            "gateway_id": decision.gateway_id,
+            "congested_edge": list(decision.congested_edge),
+            "new_next_hop_gateway": new_hop.gateway_id,
+            "new_next_hop_region": new_region,
+            "retargeted_ops": retargeted,
+        }
 
     def _monitor_to_completion(self, timeout_s: float = 24 * 3600) -> None:
         """Poll sink gateways until every dispatched chunk lands at every
@@ -552,7 +796,7 @@ class TransferProgressTracker(threading.Thread):
         poll_interval = self.POLL_INTERVAL_S
         while time.time() < deadline:
             self._check_gateway_errors()
-            self._maybe_replan()
+            self._control_plane_poll()
             # narrow polls to the still-pending set (one shared params dict
             # per wave, not per gateway): the daemon's cumulative status map
             # grows with every chunk it has ever seen, and full-map polls

@@ -347,19 +347,25 @@ class TransferJob:
             self.chunk_targets.pop(cid, None)
             self._request_bodies.pop(cid, None)
 
-    def requeue_chunks(self, dataplane, pending_chunk_ids, exclude_gateway_ids) -> int:
+    def requeue_chunks(self, dataplane, pending_chunk_ids, exclude_gateway_ids, avoid_gateway_ids=()) -> int:
         """Re-dispatch this job's pending chunks whose source gateway is in
         ``exclude_gateway_ids`` onto surviving source gateways (the tracker's
         dead-gateway failover). Chunk ids are reused verbatim — gateway
         registration is idempotent and completion is measured at the sinks,
         so a chunk that actually landed before the death is simply never
-        polled as pending again. Returns the number of chunks re-dispatched."""
+        polled as pending again. ``avoid_gateway_ids`` removes gateways from
+        the TARGET pool only (a DRAINING gateway 503s new chunks but still
+        flushes its own). Returns the number of chunks re-dispatched."""
         mine = [
             cid
             for cid in pending_chunk_ids
             if self.chunk_targets.get(cid) in exclude_gateway_ids and cid in self._request_bodies
         ]
-        survivors = [g for g in dataplane.source_gateways() if g.gateway_id not in exclude_gateway_ids]
+        survivors = [
+            g
+            for g in dataplane.source_gateways()
+            if g.gateway_id not in exclude_gateway_ids and g.gateway_id not in set(avoid_gateway_ids)
+        ]
         if not mine or not survivors:
             return 0
         session = survivors[0].control_session()
@@ -384,6 +390,60 @@ class TransferJob:
             )
             for cid in batch:
                 self.chunk_targets[cid] = target.gateway_id
+        return len(mine)
+
+    def reshard_chunks(self, dataplane, pending_chunk_ids, new_gateway, exclude_gateway_ids=()) -> int:
+        """Move a fair share of this job's pending chunk load onto a freshly
+        provisioned replacement gateway (compute/repair.py): without this the
+        replacement sits idle while survivors grind through the requeued
+        backlog. The replacement's share is ``pending / n_sources``, taken
+        from the TAIL of the pending order (the chunks farthest from being
+        picked up by a survivor). Chunk ids are reused verbatim — a chunk a
+        survivor completes concurrently is simply completed once at the sink
+        (registration is idempotent, completion sink-measured, and a
+        duplicate send writes identical bytes at an identical offset), so a
+        reshard can cost duplicate wire bytes but never correctness. Returns
+        the number of chunks moved."""
+        movable = [
+            cid
+            for cid in pending_chunk_ids
+            if cid in self._request_bodies
+            and self.chunk_targets.get(cid) != new_gateway.gateway_id
+            and self.chunk_targets.get(cid) not in exclude_gateway_ids  # dead targets requeue, not reshard
+        ]
+        sources = [g for g in dataplane.source_gateways() if g.gateway_id not in exclude_gateway_ids]
+        if not movable or not sources:
+            return 0
+        share = len(movable) // max(1, len(sources))
+        if share <= 0:
+            return 0
+        mine = movable[-share:]
+        session = new_gateway.control_session()
+        for start in range(0, len(mine), 100):
+            batch = mine[start : start + 100]
+            bodies = [self._request_bodies[cid] for cid in batch]
+
+            def _post():
+                resp = session.post(f"{new_gateway.control_url()}/chunk_requests", json=bodies, timeout=60)
+                resp.raise_for_status()
+
+            try:
+                retry_backoff(
+                    _post,
+                    max_retries=4,
+                    initial_backoff=0.5,
+                    max_backoff=4.0,
+                    jitter=0.5,
+                    deadline_s=60.0,
+                    exception_class=(requests.RequestException,),
+                )
+            except requests.RequestException as e:
+                # best-effort: survivors already own every chunk we failed to
+                # move — a flaky replacement must not fail the transfer
+                logger.fs.warning(f"[reshard] moving {len(batch)} chunk(s) to {new_gateway.gateway_id} failed: {e}")
+                return start
+            for cid in batch:
+                self.chunk_targets[cid] = new_gateway.gateway_id
         return len(mine)
 
     @property

@@ -1,0 +1,248 @@
+"""Capacity repair: automatic replacement gateways for a mid-job fleet.
+
+PR 8's failover keeps a transfer ALIVE through a gateway death — survivors
+absorb the dead gateway's chunks — but the fleet stays permanently smaller:
+losing 1-of-N gateways costs 1/N of throughput for the rest of the job. The
+:class:`RepairController` closes that gap (ROADMAP item 4 "automatic
+REPLACEMENT gateways"): when the tracker declares a gateway dead (or observes
+it DRAINING on a preemption notice, which pre-warms the replacement before
+the death), the controller provisions a like-for-like replacement through the
+provisioning lifecycle state machine and its (zone, VM-type) candidate ladder
+(``dataplane.provision_replacement``), under a repair budget:
+
+  * ``SKYPLANE_TPU_REPAIR_MAX`` (default 2) — replacement launches per
+    dataplane; past it the fleet loudly degrades to survivors-only;
+  * ``SKYPLANE_TPU_REPAIR_DEADLINE_S`` (default 600) — wall-clock bound per
+    repair, shared by the launch retry ladder.
+
+The replacement runs the dead gateway's program with the same credential
+payload (``Dataplane.provision_replacement`` stages both), registers with the
+tracker/collector, and the tracker re-shards the requeued-plus-future chunk
+load onto it. Survivors carry the load during the repair window, so a failed
+or slow repair never makes the transfer worse than PR-8 failover.
+
+Idempotency contract: one repair per dead gateway id, however many times the
+tracker re-reports the death; a replacement that itself dies is a NEW dead
+id and gets its own repair (the budget bounds the cascade). The fault point
+``provision.replace`` (docs/fault-injection.md) fires before each launch
+attempt, so chaos runs exercise the retry ladder and the budget-exhausted
+degrade path deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from skyplane_tpu.exceptions import CredentialChainException, UnsupportedProviderError
+from skyplane_tpu.faults import get_injector
+from skyplane_tpu.obs.events import (
+    EV_REPLACEMENT_FAILED,
+    EV_REPLACEMENT_READY,
+    EV_REPLACEMENT_REQUESTED,
+    get_recorder,
+)
+from skyplane_tpu.utils.envcfg import env_float, env_int
+from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.utils.retry import RetryPolicy
+
+class _RepairAborted(Exception):
+    """The controller is closing: stop the repair before (another) launch."""
+
+
+# configuration errors no relaunch can fix (mirrors Provisioner._NON_RETRYABLE)
+_NON_RETRYABLE = (UnsupportedProviderError, CredentialChainException, _RepairAborted)
+
+
+class RepairController:
+    """One dataplane's capacity-repair loop (see module docstring).
+
+    ``dataplane`` must provide ``provision_replacement(dead_gateway_id)``
+    returning a registered BoundGateway — the real
+    :class:`~skyplane_tpu.api.dataplane.Dataplane` provisions a VM through
+    the lifecycle ladder; the test harness's StubDataplane spawns a loopback
+    daemon. Attach as ``dataplane.repairer`` so the tracker finds it.
+    """
+
+    def __init__(
+        self,
+        dataplane,
+        *,
+        max_replacements: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        launch_attempts: Optional[int] = None,
+    ):
+        self.dataplane = dataplane
+        self.max_replacements = (
+            max_replacements if max_replacements is not None else env_int("SKYPLANE_TPU_REPAIR_MAX", 2)
+        )
+        self.deadline_s = deadline_s if deadline_s is not None else env_float("SKYPLANE_TPU_REPAIR_DEADLINE_S", 600.0)
+        self.launch_attempts = (
+            launch_attempts if launch_attempts is not None else env_int("SKYPLANE_TPU_PROVISION_ATTEMPTS", 3)
+        )
+        self._lock = threading.Lock()
+        #: dead gateway id -> repair record (state machine: requested ->
+        #: ready | failed). An id present here is never repaired twice.
+        self._repairs: Dict[str, dict] = {}
+        self._budget_used = 0
+        self._threads: List[threading.Thread] = []
+        # set by close(): new repairs decline, waiting launches abort, and a
+        # launch that lands after the teardown sweep terminates its own VM
+        self._closing = False
+
+    # ---- entry point (tracker hook thread) ----
+
+    def request_replacement(self, dead_gateway_id: str, tracker=None, reason: str = "gateway death") -> bool:
+        """Start (or decline) a repair for one dead/draining gateway; returns
+        True when a repair thread was launched. Idempotent per dead id —
+        a second death report mid-repair (or a drain notice followed by the
+        actual death) is a no-op. Budget exhaustion records a loud
+        ``replacement.failed`` event and degrades to survivors-only."""
+        with self._lock:
+            if self._closing:
+                return False  # teardown in progress: a new VM now would leak
+            if dead_gateway_id in self._repairs:
+                return False  # repair already in flight / resolved: idempotent
+            if self._budget_used >= self.max_replacements:
+                record = {"state": "failed", "error": "repair budget exhausted", "reason": reason}
+                self._repairs[dead_gateway_id] = record
+                budget_msg = (
+                    f"repair budget exhausted ({self._budget_used}/{self.max_replacements} replacements "
+                    f"used, SKYPLANE_TPU_REPAIR_MAX); fleet degrades to survivors-only"
+                )
+            else:
+                budget_msg = None
+                self._budget_used += 1
+                self._repairs[dead_gateway_id] = {"state": "requested", "reason": reason}
+        if budget_msg is not None:
+            logger.fs.error(f"[repair] {dead_gateway_id}: {budget_msg}")
+            get_recorder().record(
+                EV_REPLACEMENT_FAILED, dead_gateway=dead_gateway_id, error=budget_msg, reason=reason
+            )
+            if tracker is not None:
+                tracker.note_replacement_failed(dead_gateway_id, budget_msg)
+            return False
+        get_recorder().record(
+            EV_REPLACEMENT_REQUESTED,
+            dead_gateway=dead_gateway_id,
+            reason=reason,
+            budget_used=self._budget_used,
+            budget_max=self.max_replacements,
+        )
+        logger.fs.warning(
+            f"[repair] provisioning replacement for {dead_gateway_id} ({reason}); "
+            f"budget {self._budget_used}/{self.max_replacements}, deadline {self.deadline_s:.0f}s"
+        )
+        thread = threading.Thread(
+            target=self._repair, args=(dead_gateway_id, tracker, reason), name=f"repair-{dead_gateway_id}", daemon=True
+        )
+        with self._lock:
+            self._threads.append(thread)
+        thread.start()
+        return True
+
+    # ---- repair worker (its own thread: provisioning takes minutes) ----
+
+    def _repair(self, dead_gateway_id: str, tracker, reason: str) -> None:
+        t0 = time.monotonic()
+        policy = RetryPolicy(
+            max_attempts=self.launch_attempts,
+            initial_backoff=1.0,
+            max_backoff=30.0,
+            jitter=0.5,
+            deadline_s=self.deadline_s,
+            retry_if=lambda e: not isinstance(e, _NON_RETRYABLE),
+        )
+
+        def launch_once():
+            if self._closing:
+                # teardown started while this repair waited its backoff: stop
+                # BEFORE the SDK call instead of launching a doomed VM
+                raise _RepairAborted("repair controller closing (dataplane teardown)")
+            # deterministic chaos for the replacement path: the ladder, then
+            # the survivors-only degrade, replay from the plan seed
+            get_injector().check("provision.replace", OSError, "injected fault at provision.replace")
+            return self.dataplane.provision_replacement(dead_gateway_id)
+
+        try:
+            bound = policy.call(launch_once, log_errors=False)
+        except Exception as e:  # noqa: BLE001 — every failure class degrades to survivors-only
+            msg = (
+                f"replacement for {dead_gateway_id} failed after the retry ladder "
+                f"({time.monotonic() - t0:.1f}s): {type(e).__name__}: {e}; fleet degrades to survivors-only"
+            )
+            with self._lock:
+                self._repairs[dead_gateway_id] = {"state": "failed", "error": str(e)[:300], "reason": reason}
+            logger.fs.error(f"[repair] {msg}")
+            get_recorder().record(
+                EV_REPLACEMENT_FAILED, dead_gateway=dead_gateway_id, error=str(e)[:300], reason=reason
+            )
+            if tracker is not None:
+                tracker.note_replacement_failed(dead_gateway_id, msg)
+            return
+        with self._lock:
+            closing = self._closing
+        if closing:
+            # the launch finished AFTER close() gave up waiting: the teardown
+            # sweep already ran, so nothing else will ever terminate this VM
+            logger.fs.warning(
+                f"[repair] replacement {bound.gateway_id} landed during teardown; terminating it"
+            )
+            server = getattr(bound, "server", None)
+            if server is not None and hasattr(server, "terminate_instance"):
+                try:
+                    server.terminate_instance()
+                except Exception as te:  # noqa: BLE001 — best effort; the leak is at least logged loudly
+                    logger.fs.error(f"[repair] could not terminate late replacement {bound.gateway_id}: {te}")
+            with self._lock:
+                self._repairs[dead_gateway_id] = {"state": "failed", "error": "landed during teardown", "reason": reason}
+            return
+        seconds = round(time.monotonic() - t0, 3)
+        with self._lock:
+            self._repairs[dead_gateway_id] = {
+                "state": "ready",
+                "replacement_id": bound.gateway_id,
+                "seconds": seconds,
+                "reason": reason,
+            }
+        get_recorder().record(
+            EV_REPLACEMENT_READY,
+            dead_gateway=dead_gateway_id,
+            replacement=bound.gateway_id,
+            seconds=seconds,
+            reason=reason,
+        )
+        logger.fs.warning(f"[repair] replacement {bound.gateway_id} READY for {dead_gateway_id} in {seconds}s")
+        if tracker is not None:
+            tracker.note_replacement_ready(dead_gateway_id, bound, seconds)
+
+    # ---- introspection / shutdown ----
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {gid: dict(rec) for gid, rec in self._repairs.items()}
+
+    def budget_remaining(self) -> int:
+        with self._lock:
+            return max(0, self.max_replacements - self._budget_used)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Join outstanding repair threads WITHOUT aborting them (tests and
+        soaks that want the repair outcome, not a teardown)."""
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=timeout)
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Stop accepting repairs and wait (bounded) for in-flight ones.
+        Repairs waiting in their backoff abort before the next SDK call; a
+        launch already inside the SDK that outlives the join terminates its
+        own VM on completion — either way no replacement leaks past the
+        teardown sweep."""
+        with self._lock:
+            self._closing = True
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=timeout)

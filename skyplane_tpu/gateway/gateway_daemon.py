@@ -68,6 +68,7 @@ class GatewayDaemon:
         e2ee_key: Optional[bytes] = None,
         use_tls: bool = True,
         cdc_params: Optional[CDCParams] = None,
+        preempt_watch: Optional[bool] = None,
     ):
         self.region = region
         self.gateway_id = gateway_id
@@ -75,6 +76,21 @@ class GatewayDaemon:
         self.cdc_params = cdc_params or CDCParams()
         self.chunk_store = ChunkStore(chunk_dir)
         self.error_event = threading.Event()
+        # graceful drain (docs/provisioning.md "Repair & drain"): set by an
+        # announced preemption (PreemptionWatcher) or POST /api/v1/drain —
+        # admission of new chunks stops, in-flight work flushes under
+        # SKYPLANE_TPU_DRAIN_DEADLINE_S, then the daemon stops cleanly
+        self.draining = threading.Event()
+        self._drain_lock = threading.Lock()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_started_monotonic: Optional[float] = None
+        self._drain_reason = ""
+        self._drain_flushed_chunks = 0
+        # preempt_watch: True forces the watcher on (tests/harness), False
+        # forces it off, None defers to SKYPLANE_TPU_PREEMPT_WATCH (a provider
+        # name selecting the metadata probe, or "1"/"on" for fault-point-only)
+        self.preempt_watch = preempt_watch
+        self._preempt_watcher = None
         # sklint: disable=unbounded-queue-in-gateway -- the first error sets error_event which stops every producer; depth is bounded by the operator/thread count
         self.error_queue: "queue.Queue[str]" = queue.Queue()
         self.e2ee_key = e2ee_key
@@ -264,6 +280,9 @@ class GatewayDaemon:
             tenant_registry=self.tenants,
             tenant_policy_fn=self.apply_tenant_policy,
             require_admission=self.require_admission,
+            draining_event=self.draining,
+            drain_fn=self.begin_drain,
+            retarget_fn=self.retarget_sender,
         )
         self.api.upload_id_map_update = self._update_upload_ids
 
@@ -559,6 +578,10 @@ class GatewayDaemon:
                 use_tls=self.use_tls,
                 batch_runner=self.batch_runner,
                 window=int(os.environ.get("SKYPLANE_TPU_SENDER_WINDOW", op.get("window", 16))),
+                # byte bound on each stream's in-flight window (docs/
+                # configuration.md): WAN tuning + the replan tests, which
+                # need frames to FLOW over time rather than burst at once
+                window_bytes=int(os.environ.get("SKYPLANE_TPU_SENDER_WINDOW_MB", "256")) << 20,
                 api_token=self.api_token,
                 control_tls=self.control_tls,
                 source_gateway_id=self.gateway_id,
@@ -568,12 +591,135 @@ class GatewayDaemon:
             )
         raise ValueError(f"unknown operator type {op_type!r}")
 
+    # ---- graceful drain + applied replans (docs/provisioning.md) ----
+
+    def retarget_sender(
+        self, new_target_gateway_id: str, host: str, control_port: int, old_target_gateway_id: Optional[str] = None
+    ) -> int:
+        """Applied replan: repoint sender operators at a new next hop. With
+        ``old_target_gateway_id`` only matching senders cut over; without it
+        every sender does (the single-send-op common case). Returns the
+        number of operators retargeted."""
+        n = 0
+        for op in self.operators:
+            if not isinstance(op, GatewaySenderOperator):
+                continue
+            if old_target_gateway_id is not None and op.target_gateway_id != old_target_gateway_id:
+                continue
+            new_index = self._dedup_index_for(new_target_gateway_id) if op.dedup_index is not None else None
+            n += op.retarget(new_target_gateway_id, host, control_port, dedup_index=new_index)
+        if n:
+            logger.fs.warning(
+                f"[daemon {self.gateway_id}] replan cutover applied: {n} sender operator(s) now target "
+                f"{new_target_gateway_id} at {host}:{control_port}"
+            )
+        return n
+
+    def begin_drain(self, reason: str = "operator request", deadline_s: Optional[float] = None) -> bool:
+        """Flip this gateway into DRAINING (idempotent; False when already
+        draining). Admission of new chunks stops immediately (the control API
+        503s POST /chunk_requests); a drain thread waits for every admitted
+        chunk to finish — bounded by the deadline — then stops the daemon,
+        whose shutdown path fsyncs the dedup journals and spills the segment
+        memory tier so a replacement can adopt warm state."""
+        with self._drain_lock:
+            if self.draining.is_set():
+                return False
+            self.draining.set()
+        from skyplane_tpu.utils.envcfg import env_float
+        from skyplane_tpu.obs.events import EV_DRAIN_START
+        from skyplane_tpu.obs import get_recorder
+
+        if deadline_s is None:
+            deadline_s = env_float("SKYPLANE_TPU_DRAIN_DEADLINE_S", 30.0)
+        self._drain_started_monotonic = time.monotonic()
+        self._drain_reason = reason
+        pending = self.api.incomplete_count()
+        get_recorder().record(
+            EV_DRAIN_START,
+            gateway=self.gateway_id,
+            region=self.region,
+            reason=str(reason)[:200],
+            deadline_s=float(deadline_s),
+            pending_chunks=pending,
+        )
+        logger.fs.warning(
+            f"[daemon {self.gateway_id}] DRAINING ({reason}): admission stopped, "
+            f"{pending} chunk(s) to flush within {deadline_s:.0f}s"
+        )
+        self._drain_thread = threading.Thread(
+            target=self._drain_run, args=(float(deadline_s),), name=f"drain-{self.gateway_id}", daemon=True
+        )
+        self._drain_thread.start()
+        return True
+
+    def _drain_run(self, deadline_s: float) -> None:
+        """Wait (bounded) for the admitted chunk backlog to flush, then stop
+        the daemon — run()'s shutdown path does the journal fsync + segment
+        spill and records drain.complete AFTER they land."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline and not self.error_event.is_set():
+            if self.api.incomplete_count() == 0:
+                break
+            time.sleep(0.05)
+        self._drain_flushed_chunks = self.api.complete_count()
+        remaining = self.api.incomplete_count()
+        if remaining:
+            logger.fs.warning(
+                f"[daemon {self.gateway_id}] drain deadline hit with {remaining} chunk(s) unflushed; "
+                "survivors pick them up through tracker failover"
+            )
+        self.stop()
+
+    def _record_drain_complete(self) -> None:
+        """Emitted from run()'s shutdown path, after journals/spill are
+        durable — drain.complete must never precede the fsync it reports."""
+        from skyplane_tpu.obs.events import EV_DRAIN_COMPLETE
+        from skyplane_tpu.obs import get_recorder
+
+        seconds = time.monotonic() - (self._drain_started_monotonic or time.monotonic())
+        get_recorder().record(
+            EV_DRAIN_COMPLETE,
+            gateway=self.gateway_id,
+            region=self.region,
+            reason=self._drain_reason[:200],
+            seconds=round(seconds, 3),
+            flushed_chunks=self._drain_flushed_chunks,
+            remaining_chunks=self.api.incomplete_count(),
+            journals_flushed=len(self._dedup_indexes),
+        )
+
+    def _maybe_start_preempt_watcher(self) -> None:
+        env_val = os.environ.get("SKYPLANE_TPU_PREEMPT_WATCH", "").strip().lower()
+        from skyplane_tpu.gateway.preempt import PreemptionWatcher, probe_for
+
+        if self.preempt_watch is not None:
+            if not self.preempt_watch:
+                return
+            # explicit kwarg (provisioned daemons / tests): probe by the
+            # daemon's own cloud; local/unknown providers watch faults only
+            provider = self.region.split(":")[0]
+        else:
+            if not env_val or env_val == "0":
+                return
+            # documented contract (docs/configuration.md): a provider NAME
+            # selects the metadata probe; a bare "1"/"on"/"true" watches ONLY
+            # the injected fault point — never the real metadata service
+            provider = "" if env_val in ("1", "on", "true") else env_val
+        self._preempt_watcher = PreemptionWatcher(
+            lambda reason: self.begin_drain(reason=reason),
+            probe=probe_for(provider),
+            name=f"preempt-watcher-{self.gateway_id}",
+        )
+        self._preempt_watcher.start()
+
     # ---- run loop ----
 
     def run(self) -> None:
         self.api.start()
         for op in self.operators:
             op.start_workers()
+        self._maybe_start_preempt_watcher()
         logger.fs.info(
             f"[daemon {self.gateway_id}] running: {len(self.operators)} operators, control port {self.api.port}"
         )
@@ -609,6 +755,16 @@ class GatewayDaemon:
                     self.receiver.segment_store.flush_to_spill()
                 except OSError as e:
                     logger.fs.warning(f"[daemon {self.gateway_id}] segment spill flush failed: {e}")
+            # announced-preemption drain: the completion event is recorded
+            # only HERE, after the journal close + spill flush above, so
+            # drain.complete truthfully means "durable state handed off"
+            if self.draining.is_set():
+                self._record_drain_complete()
+            if self._preempt_watcher is not None:
+                self._preempt_watcher.stop(timeout=2.0)
+            drain_thread = self._drain_thread
+            if drain_thread is not None and drain_thread is not threading.current_thread():
+                drain_thread.join(timeout=2.0)
             # keep the API up briefly so the client can collect errors/status
             time.sleep(0.2)
             # then actually release the control port: a subprocess daemon's

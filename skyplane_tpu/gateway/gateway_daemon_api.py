@@ -16,6 +16,11 @@ directly:
                                            (?include_log=1 adds the full
                                            transition log)
   POST /api/v1/upload_id_maps              dest_key -> multipart upload id
+  POST /api/v1/drain                       graceful drain {reason?, deadline_s?}
+                                           (admission stops; in-flight flushes)
+  POST /api/v1/retarget                    applied replan: repoint senders at
+                                           {new_target_gateway_id, host,
+                                           control_port, old_target_gateway_id?}
   POST /api/v1/jobs                        admit a job {job_id, tenant_id,
                                            weight?, quotas?} -> 200 | 429
   DELETE /api/v1/jobs/<job_id>             release a job's admission slot
@@ -80,6 +85,9 @@ class GatewayDaemonAPI:
         tenant_registry=None,
         tenant_policy_fn=None,
         require_admission: bool = False,
+        draining_event: Optional[threading.Event] = None,
+        drain_fn=None,
+        retarget_fn=None,
     ):
         self.chunk_store = chunk_store
         self.receiver = receiver
@@ -107,6 +115,14 @@ class GatewayDaemonAPI:
         self.tenant_registry = tenant_registry
         self.tenant_policy_fn = tenant_policy_fn
         self.require_admission = require_admission
+        # graceful drain + applied replans (docs/provisioning.md):
+        # draining_event set => POST /chunk_requests 503s (admission stopped);
+        # drain_fn starts a drain (POST /drain); retarget_fn repoints sender
+        # operators at a new next hop (POST /retarget). All optional — bare
+        # test constructions keep the old single-purpose surface.
+        self.draining_event = draining_event
+        self.drain_fn = drain_fn
+        self.retarget_fn = retarget_fn
 
         self._lock = threading.Lock()
         self._dedup_sources: set = set()  # distinct source gateway ids seen on /servers
@@ -297,6 +313,21 @@ class GatewayDaemonAPI:
         with self._lock:
             self._errors.append(tb)
 
+    # ---- drain accounting (docs/provisioning.md "Repair & drain") ----
+
+    def incomplete_count(self) -> int:
+        """Admitted chunks not yet complete/failed at this gateway — the
+        drain loop's flush condition (failed chunks cannot flush; waiting on
+        them would burn the whole drain deadline for nothing)."""
+        with self._lock:
+            return sum(
+                1 for cid in self.chunk_requests if self.chunk_status.get(cid) not in ("complete", "failed")
+            )
+
+    def complete_count(self) -> int:
+        with self._lock:
+            return sum(1 for cid in self.chunk_requests if self.chunk_status.get(cid) == "complete")
+
     # ---- routing ----
 
     @staticmethod
@@ -317,6 +348,10 @@ class GatewayDaemonAPI:
                     "region": self.region,
                     "gateway_id": self.gateway_id,
                     "error": self.error_event.is_set(),
+                    # a draining gateway is alive but closed to new chunks —
+                    # the tracker reads this to route requeues/reshards away
+                    # and to pre-warm a replacement (docs/provisioning.md)
+                    "draining": bool(self.draining_event is not None and self.draining_event.is_set()),
                 },
             )
         elif path == "/api/v1/chunk_requests":
@@ -576,7 +611,47 @@ class GatewayDaemonAPI:
                 EV_ADMISSION_GRANTED, gateway=self.gateway_id, job_id=job_id, tenant=tenant_id
             )
             req._send(200, {"status": "ok", "job_id": job_id, "tenant_id": tenant_id})
+        elif path == "/api/v1/drain":
+            # graceful drain entry point: operator-initiated (CLI / soak) or
+            # the tracker simulating a preemption. Idempotent: a second POST
+            # reports the drain already in progress.
+            if self.drain_fn is None:
+                req._send(501, {"error": "this gateway has no drain controller"})
+                return
+            try:
+                body = req._read_json()
+            except Exception:  # noqa: BLE001 — body is optional
+                body = {}
+            body = body if isinstance(body, dict) else {}
+            started = self.drain_fn(
+                reason=str(body.get("reason") or "control API request"),
+                deadline_s=float(body["deadline_s"]) if body.get("deadline_s") is not None else None,
+            )
+            req._send(200, {"status": "draining", "started": bool(started)})
+        elif path == "/api/v1/retarget":
+            # applied replan (docs/provisioning.md): repoint sender operators
+            # at a new next hop; streams cut over like a deliberate break
+            if self.retarget_fn is None:
+                req._send(501, {"error": "this gateway has no retarget controller"})
+                return
+            body = req._read_json()
+            new_id = body.get("new_target_gateway_id")
+            host = body.get("host")
+            port = body.get("control_port")
+            if not (new_id and host and port):
+                req._send(400, {"error": "new_target_gateway_id, host and control_port are required"})
+                return
+            n = self.retarget_fn(
+                str(new_id), str(host), int(port), old_target_gateway_id=body.get("old_target_gateway_id")
+            )
+            req._send(200, {"status": "ok", "retargeted": n})
         elif path == "/api/v1/chunk_requests":
+            if self.draining_event is not None and self.draining_event.is_set():
+                # DRAINING: admission stopped. 503 (not 4xx) so dispatch/
+                # requeue retry ladders route the batch to a surviving
+                # gateway instead of treating it as a client error.
+                req._send(503, {"error": "gateway draining (preemption notice): admission stopped", "draining": True})
+                return
             body = req._read_json()
             if not isinstance(body, list):
                 req._send(400, {"error": "expected a json list of chunk requests"})

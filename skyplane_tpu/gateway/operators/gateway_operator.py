@@ -662,6 +662,10 @@ class GatewaySenderOperator(GatewayOperator):
         self.reset_budget = env_int("SKYPLANE_TPU_STREAM_RESET_BUDGET", 5)
         self._engines: list = []  # every worker's live engine (wire_counters aggregation)
         self._engines_lock = threading.Lock()
+        # applied-replan cutover (docs/provisioning.md "Repair & drain"):
+        # bumped by retarget(); serial-path workers compare their cached
+        # socket's generation against it and re-dial the (new) target
+        self._target_gen = 0
         from skyplane_tpu.gateway.control_auth import control_session
 
         self._session = control_session(api_token)
@@ -717,6 +721,11 @@ class GatewaySenderOperator(GatewayOperator):
         self.dedup_index.set_max_bytes(max(1 << 20, capacity // (2 * n_sources)))
 
     def _sock(self) -> socket.socket:
+        if getattr(self._local, "sock_gen", None) != self._target_gen:
+            # the operator was retargeted since this worker last dialed: the
+            # cached socket points at the OLD next hop — drop and re-dial
+            self._reset_sock()
+            self._local.sock_gen = self._target_gen
         if getattr(self._local, "sock", None) is None:
             self._local.sock = self._make_socket()
         return self._local.sock
@@ -758,6 +767,34 @@ class GatewaySenderOperator(GatewayOperator):
             with self._engines_lock:
                 self._engines.append(engine)
         return engine
+
+    def retarget(self, new_target_gateway_id: str, host: str, control_port: int, dedup_index=None) -> int:
+        """Applied replan (docs/provisioning.md "Repair & drain"): point this
+        sender at a new next-hop gateway mid-job. Future connects dial the new
+        target (``_make_socket`` reads the fields per call); every live wire
+        stream is flagged for a pump-thread cutover reset, so un-acked frames
+        re-queue and re-frame onto the new route exactly like a stream break
+        while acked chunks stay truthfully complete. A dedup sender swaps to
+        the new target's index (``dedup_index``, or a fresh ephemeral one) —
+        REFs against the OLD sink's segments would NACK-storm the new one.
+        An ack from the old hop racing the swap can seed the new index with
+        an unproven fp; that heals through the NACK → literal-resend path,
+        never corruption. Returns 1 (operators retargeted)."""
+        logger.fs.warning(
+            f"[{self.handle}] retarget: {self.target_gateway_id} -> {new_target_gateway_id} "
+            f"({host}:{control_port})"
+        )
+        self.target_gateway_id = new_target_gateway_id
+        self.target_host = host
+        self.target_control_port = int(control_port)
+        if self.dedup_index is not None:
+            self.dedup_index = dedup_index if dedup_index is not None else SenderDedupIndex()
+        self._target_gen += 1  # serial-path workers re-dial on next use
+        with self._engines_lock:
+            engines = list(self._engines)
+        for engine in engines:
+            engine.retarget()
+        return 1
 
     def sched_acquire(self, req: ChunkRequest) -> bool:
         """Block until this chunk's fair-share tokens are granted (wire bytes
@@ -913,9 +950,12 @@ class GatewaySenderOperator(GatewayOperator):
         )
 
     def process_batch(self, batch: List[ChunkRequest], worker_id: int) -> Optional[List[bool]]:
+        gen0 = self._target_gen
         self._register_batch(batch)
         if not self.pipelined:
-            return self._process_batch_serial(batch, worker_id)
+            results = self._process_batch_serial(batch, worker_id)
+            self._reregister_if_retargeted(batch, gen0)
+            return results
         # pipelined path: hand the window to this worker's wire engine. The
         # submit loop below IS the framer stage — it runs the data path and
         # blocks only on the frame-ahead queue, so by the time the last chunk
@@ -941,7 +981,27 @@ class GatewaySenderOperator(GatewayOperator):
             # frame builder would double it)
             frame = engine.submit(lambda pending, _req=req: self._build_wire_frame(_req, pending, window))
             window.add_wire(frame.wire_len)
+        self._reregister_if_retargeted(batch, gen0)
         return None
+
+    def _reregister_if_retargeted(self, batch: List[ChunkRequest], gen0: int) -> None:
+        """Close the replan-cutover registration race: this batch was
+        pre-registered at the target read at batch START; a retarget landing
+        between that POST and the frames going out means some frames ship to
+        the NEW target carrying ids only the OLD target knows — staged bytes
+        the new receiver's completion accounting would never adopt. When the
+        target generation moved during the batch, re-register the whole batch
+        at the CURRENT target (idempotent at the gateway; a chunk whose data
+        ends up arriving via the old route still completes there — every
+        route converges on the same sinks)."""
+        if self._target_gen == gen0:
+            return
+        try:
+            self._register_batch(batch)
+        except requests.RequestException as e:
+            # frames that raced the cutover will requeue through their stream
+            # reset and re-register on the retry pass; log, don't fail
+            logger.fs.warning(f"[{self.handle}] post-cutover re-registration failed: {e}")
 
     def _build_wire_frame(self, req: ChunkRequest, pending_fps: set, window: "_WindowStats"):
         """Framer body: one chunk -> WireFrame, REF decisions against the

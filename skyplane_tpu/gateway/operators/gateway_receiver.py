@@ -700,6 +700,13 @@ class GatewayReceiver:
             # immediately polls counters must never observe the pre-response
             # state (budget resets are rate bookkeeping, not delivery proof)
             self._note_success()
+            inj = get_injector()
+            if inj.enabled and inj.fire("receiver.ack_delay"):
+                # docs/fault-injection.md: hold the ack without dropping it —
+                # a congested/struggling hop as the sender's ack_lag counters
+                # see it. This is what drives the replan monitor's
+                # ack-lag-dominant signal deterministically in chaos runs.
+                time.sleep(0.05)
             try:
                 # application-level ack: the sender commits dedup fingerprints
                 # and marks the chunk complete only after this lands — TCP

@@ -91,6 +91,7 @@ SENDER_WIRE_COUNTER_ZERO = {
     "stream_resets": 0,
     "streams_broken": 0,  # circuit breaker: streams declared dead past the reset budget
     "streams_revived": 0,  # fresh streams opened after every stream broke
+    "stream_retargets": 0,  # replan cutovers: streams reset onto a new next hop
     "windows": 0,  # submit batches (the _drain_batch granularity)
     "profile_events_dropped": 0,  # per-window profile events lost to the bounded queue
 }
@@ -177,6 +178,7 @@ class _Stream:
         "thread",
         "consec_resets",
         "broken",
+        "retarget",
     )
 
     def __init__(self, idx: int):
@@ -203,6 +205,11 @@ class _Stream:
         # consecutive socket/connect errors with no intervening ack
         self.consec_resets = 0
         self.broken = False  # declared dead past the reset budget
+        # replan cutover (docs/provisioning.md "Repair & drain"): set by
+        # engine.retarget() from a control thread, consumed by THIS stream's
+        # pump thread — which performs the actual reset, preserving the
+        # single-thread socket-ownership invariant
+        self.retarget = False
 
     def wake(self) -> None:
         try:
@@ -349,6 +356,26 @@ class SenderWireEngine:
         """Caller marker: one submit batch (= one `_drain_batch` window)."""
         self._bump("windows")
 
+    def retarget(self) -> int:
+        """Replan cutover: the operator's target changed (socket_factory now
+        dials the new next hop). Flag every live stream for a pump-thread
+        reset — un-acked frames re-queue and re-frame onto the new route
+        exactly like a stream break, pending fp views clear, and acked chunks
+        stay committed (their fps were reaped before the cutover). Returns
+        the number of streams flagged."""
+        with self._streams_lock:
+            streams = list(self._streams)
+        n = 0
+        for s in streams:
+            with s.lock:
+                if s.dead:
+                    continue
+                s.retarget = True
+                s.cond.notify_all()
+            s.wake()
+            n += 1
+        return n
+
     def counters(self) -> dict:
         with self._counters_lock:
             out = dict(self._counters)
@@ -437,10 +464,19 @@ class SenderWireEngine:
         try:
             while True:
                 with stream.lock:
-                    while not stream.frames and not stream.inflight and not stream.dead:
+                    while not stream.frames and not stream.inflight and not stream.dead and not stream.retarget:
                         stream.cond.wait(self.IDLE_TICK_S)
                     if stream.dead and not stream.frames and not stream.inflight:
                         break
+                    do_retarget, stream.retarget = stream.retarget, False
+                if do_retarget:
+                    # cutover = a deliberate stream break: close the old-hop
+                    # socket, requeue un-acked frames (NOT counted against the
+                    # chunk retry budget — nothing failed), clear the pending
+                    # view; the next _connect dials the new target
+                    self._reset_stream(stream, "replan cutover to new next hop", counted=False)
+                    self._bump("stream_retargets")
+                    continue
                 if stream.sock is None and not self._connect(stream):
                     continue
                 try:
@@ -677,10 +713,12 @@ class SenderWireEngine:
                 self._completion_cond.notify()
             block = False  # past the first ack, only drain what is already here
 
-    def _reset_stream(self, stream: _Stream, why: str) -> None:
+    def _reset_stream(self, stream: _Stream, why: str, counted: bool = True) -> None:
         """Socket death: close, re-queue every un-sent and un-acked frame,
         reset the pending view (nothing uncommitted leaked — acked frames'
-        fps were already committed by the reaper)."""
+        fps were already committed by the reaper). ``counted=False`` marks the
+        requeues as deliberate (replan cutover), exempt from the per-chunk
+        retry budget."""
         logger.fs.warning(f"[{self.name}:stream{stream.idx}] socket error mid-stream: {why}")
         self._bump("stream_resets")
         from skyplane_tpu.obs.events import EV_STREAM_RESET, get_recorder
@@ -710,6 +748,8 @@ class SenderWireEngine:
             except OSError:
                 pass
         for frame in doomed:
+            if not counted:
+                frame.counted_retry = False
             self.callbacks.on_requeue(frame)
 
     # ---- ack reaper (one per engine; never touches a socket) ----

@@ -476,6 +476,16 @@ class TelemetryCollector:
 
     # ---- lifecycle ----
 
+    def add_target(self, target: GatewayTarget) -> bool:
+        """Adopt a gateway mid-run (a replacement provisioned by the repair
+        loop, docs/provisioning.md): it joins the next scrape wave. Returns
+        False when the id is already tracked (idempotent)."""
+        with self._lock:
+            if target.gateway_id in self._states:
+                return False
+            self._states[target.gateway_id] = _TargetState(target)
+        return True
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name=f"telemetry-collector-{self.label}", daemon=True)
         self._thread.start()

@@ -46,6 +46,15 @@ EV_ADMISSION_REJECTED = "admission.rejected"
 EV_JOB_RELEASED = "job.released"
 EV_GATEWAY_DEAD = "failover.gateway_dead"
 EV_REPLAN = "replan.decision"
+EV_REPLAN_APPLIED = "replan.applied"
+# capacity-repair loop (docs/provisioning.md "Repair & drain"): graceful spot
+# drain on the gateway side, replacement provisioning on the tracker side
+EV_DRAIN_START = "drain.start"
+EV_DRAIN_COMPLETE = "drain.complete"
+EV_DRAIN_OBSERVED = "drain.observed"  # tracker noticed a gateway DRAINING
+EV_REPLACEMENT_REQUESTED = "replacement.requested"
+EV_REPLACEMENT_READY = "replacement.ready"
+EV_REPLACEMENT_FAILED = "replacement.failed"
 EV_FAULT_FIRED = "fault.fired"
 EV_STREAM_RESET = "stream.reset"
 EV_STREAM_BREAK = "stream.break"

@@ -227,12 +227,30 @@ class StubDataplane:
         self.src_region_tag = src_region_tag
         self.dst_region_tags = list(dst_region_tags)
         self._trackers: List = []
+        # capacity repair (compute/repair.py): tests/soaks attach a
+        # RepairController here and a factory that spawns a loopback daemon
+        # standing in for a provisioned replacement VM
+        self.repairer = None
+        self.replacement_factory = None  # callable(dead_gateway_id) -> BoundGateway
 
     def source_gateways(self):
         return list(self._sources)
 
     def sink_gateways(self):
         return list(self._sinks)
+
+    def provision_replacement(self, dead_gateway_id: str):
+        """Stubbed-SDK replacement provisioning: delegate to the test's
+        factory (which starts a fresh in-process daemon running the dead
+        gateway's program) and register the result exactly like the real
+        Dataplane does — source_gateways(), liveness polling and telemetry
+        all see it."""
+        if self.replacement_factory is None:
+            raise RuntimeError("StubDataplane has no replacement_factory")
+        bound = self.replacement_factory(dead_gateway_id)
+        self._sources.append(bound)
+        self.bound_gateways[bound.gateway_id] = bound
+        return bound
 
     def check_error_logs(self, exclude=None) -> Dict[str, List[str]]:
         from skyplane_tpu.utils import do_parallel
@@ -261,9 +279,10 @@ class HarnessCopyJob:
         self.uuid = uuid.uuid4().hex
         self.chunk_targets: Dict[str, str] = {}
         self._request_bodies: Dict[str, dict] = {}
-        # reuse the production requeue/release machinery verbatim
+        # reuse the production requeue/release/reshard machinery verbatim
         self.requeue_chunks = TransferJob.requeue_chunks.__get__(self)
         self.release_requeue_state = TransferJob.release_requeue_state.__get__(self)
+        self.reshard_chunks = TransferJob.reshard_chunks.__get__(self)
 
     def _requests(self) -> List[ChunkRequest]:
         size = self.src_file.stat().st_size

@@ -1,0 +1,143 @@
+"""Graceful spot-drain acceptance (docs/provisioning.md "Repair & drain").
+
+A source daemon with the preemption watcher armed gets a synthetic
+preemption notice (the ``gateway.preempt_notice`` fault point) mid-transfer:
+it must flip DRAINING (admission 503s), flush every admitted chunk under the
+drain deadline, fsync its persistent state, record ``drain.start`` /
+``drain.complete`` on the flight recorder, then stop — losing zero acked
+chunks and leaving a byte-identical destination."""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+import numpy as np
+import pytest
+import requests
+
+from integration.harness import dispatch_file, make_pair, wait_complete
+from skyplane_tpu.chunk import Chunk, ChunkRequest
+from skyplane_tpu.faults import FaultPlan, configure_injector
+from skyplane_tpu.obs.events import EV_DRAIN_COMPLETE, EV_DRAIN_START, get_recorder
+
+CHUNK = 64 << 10
+N_CHUNKS = 24
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_injector(None)
+
+
+def _drain_events(since_seq, kind):
+    return [e for e in get_recorder().events_since(since_seq) if e["kind"] == kind]
+
+
+def test_preempt_notice_drains_flushes_and_stops(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPLANE_TPU_PREEMPT_POLL_S", "0.05")
+    monkeypatch.setenv("SKYPLANE_TPU_DRAIN_DEADLINE_S", "20")
+    seq0 = get_recorder().seq()
+    payload = np.random.default_rng(21).integers(0, 256, CHUNK * N_CHUNKS, dtype=np.uint8).tobytes()
+    src_file = tmp_path / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp_path / "out" / "corpus.bin"
+    # the watcher needs a few polls' head start configured BEFORE the daemon
+    # boots; after=3 lands the notice ~0.2s in, with chunks in flight
+    configure_injector(
+        FaultPlan.from_dict({"seed": 5, "points": {"gateway.preempt_notice": {"p": 1.0, "after": 3, "max_fires": 1}}})
+    )
+    src, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+    # only the SOURCE watches for preemption: with two in-process daemons
+    # sharing one injector, arming both would race for the single firing
+    from skyplane_tpu.gateway.preempt import PreemptionWatcher
+
+    src.daemon._preempt_watcher = PreemptionWatcher(
+        lambda reason: src.daemon.begin_drain(reason=reason), name="preempt-watcher-test"
+    )
+    src.daemon._preempt_watcher.start()
+    try:
+        ids = dispatch_file(src, src_file, out_file, chunk_bytes=CHUNK)
+        # wait for the drain to START (watcher fires ~0.2s in)
+        deadline = time.time() + 10
+        while time.time() < deadline and not _drain_events(seq0, EV_DRAIN_START):
+            time.sleep(0.02)
+        starts = _drain_events(seq0, EV_DRAIN_START)
+        assert starts, "preempt notice never started a drain"
+        assert starts[0]["gateway"] == "gw_src"
+        assert "preempt_notice" in starts[0]["reason"]
+
+        # acked chunks at drain start must never be lost
+        status = src.get("status", timeout=5).json()
+        complete_at_drain = {
+            cid for cid, st in dst.get("chunk_status_log", timeout=10).json()["chunk_status"].items() if st == "complete"
+        }
+        assert status.get("draining") is True or _drain_events(seq0, EV_DRAIN_COMPLETE)
+
+        # admission is STOPPED while draining: a fresh chunk 503s (or the
+        # daemon already finished its drain and refuses the connection)
+        probe = ChunkRequest(
+            chunk=Chunk(
+                src_key=str(src_file),
+                dest_key=str(tmp_path / "out" / "probe.bin"),
+                chunk_id=uuid.uuid4().hex,
+                chunk_length_bytes=CHUNK,
+                file_offset_bytes=0,
+            )
+        )
+        try:
+            resp = src.session().post(src.url("chunk_requests"), json=[probe.as_dict()], timeout=10)
+            assert resp.status_code == 503, f"draining gateway admitted a new chunk: {resp.status_code}"
+            assert resp.json().get("draining") is True
+        except requests.exceptions.ConnectionError:
+            pass  # drain already completed and the daemon stopped: also correct
+
+        # every admitted chunk flushes: destination byte-identical
+        wait_complete(dst, ids, timeout=60)
+        assert out_file.read_bytes() == payload
+
+        # the daemon stops itself after the flush; drain.complete is recorded
+        # AFTER the journal/spill fsync, bounded by the deadline
+        src.thread.join(timeout=30)
+        assert not src.thread.is_alive(), "drained daemon failed to stop"
+        completes = _drain_events(seq0, EV_DRAIN_COMPLETE)
+        assert completes, "drain.complete never recorded"
+        done = completes[0]
+        assert done["gateway"] == "gw_src"
+        assert done["remaining_chunks"] == 0, "drain left admitted chunks unflushed"
+        assert done["seconds"] <= 20.0, f"drain blew its deadline: {done['seconds']}s"
+
+        # zero acked-chunk loss: everything complete at drain start is still
+        # complete at the end (and the whole corpus landed)
+        final = {
+            cid for cid, st in dst.get("chunk_status_log", timeout=10).json()["chunk_status"].items() if st == "complete"
+        }
+        assert complete_at_drain <= final
+        assert set(ids) <= final
+    finally:
+        for gw in (src, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — src already stopped itself
+                pass
+
+
+def test_drain_route_is_idempotent_and_operator_triggerable(tmp_path, monkeypatch):
+    """POST /api/v1/drain starts exactly one drain (second call reports the
+    drain already running) — the operator/CLI entry the chaos soak drives."""
+    monkeypatch.setenv("SKYPLANE_TPU_DRAIN_DEADLINE_S", "10")
+    src, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+    try:
+        r1 = src.post("drain", json={"reason": "test drain"}, timeout=10)
+        assert r1.status_code == 200 and r1.json()["started"] is True
+        r2 = src.post("drain", json={"reason": "again"}, timeout=10)
+        assert r2.status_code == 200 and r2.json()["started"] is False
+        src.thread.join(timeout=20)
+        assert not src.thread.is_alive()
+    finally:
+        for gw in (src, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001
+                pass

@@ -141,6 +141,133 @@ def test_kill_one_of_two_gateways_mid_transfer(tmp_path, monkeypatch):
                 pass
 
 
+def _unwedge(gw) -> None:
+    """Restart a wedged daemon's operator workers (test-only inverse of
+    _wedge): the exit flag clears and a fresh worker pool drains whatever
+    queued while the data plane was stopped."""
+    for op in gw.daemon.operators:
+        op.exit_flag.clear()
+        op.start_workers()
+
+
+def test_double_death_with_replacement_is_idempotent(tmp_path, monkeypatch):
+    """The double-death contract (ISSUE 10): the same gateway's chunks fail
+    over twice — death during repair brings a replacement, the replacement
+    itself dies — without double-requeueing chunk ids, without leaking
+    scheduler tokens, and with the repair budget bounding the cascade
+    (second repair declines loudly to survivors-only)."""
+    from skyplane_tpu.compute.repair import RepairController
+
+    monkeypatch.setenv("SKYPLANE_TPU_HEARTBEAT_DEADLINE_S", "1.5")
+    payload = np.random.default_rng(17).integers(0, 256, CHUNK * N_CHUNKS, dtype=np.uint8).tobytes()
+    src_file = tmp_path / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp_path / "out" / "corpus.bin"
+
+    src_a, src_b, dst = _start_two_source_topology(tmp_path)
+    replacements = []
+    try:
+        # BOTH sources wedged: every chunk stays deterministically pending, so
+        # the reshard onto the replacement always finds work to move
+        _wedge(src_a)
+        _wedge(src_b)
+        dp = StubDataplane([bind_gateway(src_a), bind_gateway(src_b)], [bind_gateway(dst)])
+
+        def factory(dead_gateway_id):
+            program = {
+                "plan": [
+                    {
+                        "partitions": ["default"],
+                        "value": [
+                            {
+                                "op_type": "read_local",
+                                "handle": "read",
+                                "num_connections": 2,
+                                "children": [
+                                    {
+                                        "op_type": "send",
+                                        "handle": "send",
+                                        "target_gateway_id": "gw_dst",
+                                        "region": "local:local",
+                                        "num_connections": 2,
+                                        "compress": "none",
+                                        "encrypt": False,
+                                        "dedup": False,
+                                        "children": [],
+                                    }
+                                ],
+                            }
+                        ],
+                    }
+                ]
+            }
+            info = {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}}
+            gw = start_gateway(program, info, "gw_src_r", str(tmp_path / "replacement_chunks"), use_tls=False)
+            _wedge(gw)  # the replacement holds its resharded chunks, so its death is observable
+            replacements.append(gw)
+            return bind_gateway(gw)
+
+        dp.replacement_factory = factory
+        dp.repairer = RepairController(dp, max_replacements=1, deadline_s=30.0, launch_attempts=2)
+        job = HarnessCopyJob(src_file, out_file, chunk_bytes=CHUNK, batch_size=BATCH)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig(compress="none", dedup=False, encrypt_e2e=False))
+        dp._trackers.append(tracker)
+        tracker.start()
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with tracker._lock:
+                if len(tracker.dispatched_chunk_ids) == N_CHUNKS and "gw_src" in set(job.chunk_targets.values()):
+                    break
+            time.sleep(0.05)
+        src_a.stop()  # first death: failover + repair
+
+        # wait until the replacement joined and load was re-sharded onto it
+        deadline = time.time() + 60
+        while time.time() < deadline and not tracker.replacement_events:
+            time.sleep(0.05)
+        assert tracker.replacement_events, "repair never produced a replacement"
+        ready = tracker.replacement_events[0]
+        assert ready["dead_gateway_id"] == "gw_src"
+        assert ready["replacement_id"] == "gw_src_r"
+        assert ready["resharded_chunks"] > 0, "replacement joined but no load was re-sharded onto it"
+
+        # idempotency: a repeated death report for the SAME gateway is a no-op
+        assert dp.repairer.request_replacement("gw_src", tracker=tracker) is False
+        assert len(replacements) == 1
+
+        # second death: the replacement itself dies mid-job. Its chunks fail
+        # over AGAIN; the budget (1) is spent, so repair declines loudly.
+        replacements[0].stop()
+        deadline = time.time() + 60
+        while time.time() < deadline and not tracker.replacement_failures:
+            time.sleep(0.05)
+        assert tracker.replacement_failures and "budget exhausted" in tracker.replacement_failures[0]["reason"]
+        assert dp.repairer.snapshot()["gw_src_r"]["state"] == "failed"
+
+        _unwedge(src_b)  # the lone survivor drains the whole corpus
+        tracker.join(timeout=120)
+        assert not tracker.is_alive(), "tracker wedged after double death"
+        assert tracker.error is None, f"double-death failover should still complete: {tracker.error!r}"
+        assert tracker.dead_gateway_ids == {"gw_src", "gw_src_r"}
+        assert len(tracker.failover_events) == 2
+
+        # no double-requeue: every chunk id is registered at the survivor
+        # exactly once across dispatch + two failovers (the registration map
+        # is id-keyed; a duplicate POST must not create a second entry)
+        assert len(src_b.daemon.api.chunk_requests) == N_CHUNKS
+        assert out_file.read_bytes() == payload
+        for gw in (src_b, dst):
+            held = sum(sum(usage.values()) for usage in gw.daemon.scheduler.usage_snapshot().values())
+            assert held == 0, f"{gw.daemon.gateway_id} leaked {held} scheduler tokens"
+    finally:
+        for gw in [src_a, src_b, dst] + replacements:
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 - some are already stopped
+                pass
+
+
 def test_dead_sink_still_fails_loudly(tmp_path, monkeypatch):
     """Failover is for SOURCE gateways only: a dead destination cannot be
     healed by requeueing, so the transfer must fail with GatewayException

@@ -1,0 +1,215 @@
+"""Applied-replan acceptance (docs/provisioning.md "Repair & drain"): a
+ReplanMonitor decision is EXECUTED, not just surfaced.
+
+Topology: src --send--> relay --forward--> dst, driven by the real
+TransferProgressTracker. The relay hop's acks are artificially lagged (the
+``receiver.ack_delay`` fault point), the monitor's real delta/threshold/
+ack-dominance detector flags the src->relay edge, and a stubbed re-solve
+routes src directly to dst. The tracker must POST /retarget to the source
+gateway; its sender streams cut over like a deliberate stream break
+(un-acked frames re-frame onto the new route, acked chunks stay truthful)
+and the remaining frames land at the destination byte-identically with no
+pending-fp contract violation."""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from integration.harness import HarnessCopyJob, StubDataplane, bind_gateway, make_pair, start_gateway
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.tracker import TransferProgressTracker
+from skyplane_tpu.faults import FaultPlan, configure_injector
+from skyplane_tpu.gateway.operators.gateway_operator import GatewaySenderOperator
+from skyplane_tpu.planner.replan import ReplanMonitor
+from skyplane_tpu.planner.solver import ThroughputSolution
+
+CHUNK = 64 << 10
+N_CHUNKS = 96
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    configure_injector(None)
+
+
+class StubResolveMonitor(ReplanMonitor):
+    """The real congestion detector (per-frame deltas, threshold, ack-lag
+    dominance) with the MILP re-solve stubbed: the re-solved overlay routes
+    src directly to dst, dodging the lagged relay."""
+
+    def resolve(self, congested_edge):
+        return ThroughputSolution(
+            problem=None,
+            is_feasible=True,
+            edge_flow_gbits={("local:srcA", "local:dstB"): 1.0},
+        )
+
+
+def _relay_topology(tmp_path):
+    """dst <- relay <- src: the relay forwards opaque frames (raw relay)."""
+    dst_program = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "receive",
+                        "handle": "recv",
+                        "decrypt": False,
+                        "dedup": False,
+                        "children": [{"op_type": "write_local", "handle": "write", "children": []}],
+                    }
+                ],
+            }
+        ]
+    }
+    dst = start_gateway(dst_program, {}, "gw_dst", str(tmp_path / "dst_chunks"), use_tls=False)
+    info_dst = {"gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port}}
+    relay_program = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "receive",
+                        "handle": "recv",
+                        "decrypt": False,
+                        "dedup": False,
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "fwd",
+                                "target_gateway_id": "gw_dst",
+                                "region": "local:local",
+                                "num_connections": 2,
+                                "compress": "none",
+                                "encrypt": False,
+                                "dedup": False,
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    relay = start_gateway(relay_program, info_dst, "gw_relay", str(tmp_path / "relay_chunks"), use_tls=False)
+    info_src = {
+        "gw_relay": {"public_ip": "127.0.0.1", "control_port": relay.control_port},
+        "gw_dst": {"public_ip": "127.0.0.1", "control_port": dst.control_port},
+    }
+    src_program = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "num_connections": 2,
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "send",
+                                "target_gateway_id": "gw_relay",
+                                "region": "local:local",
+                                "num_connections": 2,
+                                "compress": "none",
+                                "encrypt": False,
+                                "dedup": False,
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    src = start_gateway(src_program, info_src, "gw_src", str(tmp_path / "src_chunks"), use_tls=False)
+    return src, relay, dst
+
+
+def test_replan_decision_is_applied_and_streams_cut_over(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPLANE_TPU_REPLAN_POLL_S", "0.2")
+    # small in-flight byte window and no adaptive striping, so frames FLOW
+    # across poll waves instead of bursting before the monitor's first
+    # baseline snapshot
+    monkeypatch.setenv("SKYPLANE_TPU_SENDER_WINDOW_MB", "1")
+    monkeypatch.setenv("SKYPLANE_TPU_SENDER_STREAMS", "0")
+    # every relay/dst ack held 50ms: a genuinely ack-lag-dominant hop as the
+    # sender wire counters measure it (stall stays ~0: window never fills)
+    configure_injector(
+        FaultPlan.from_dict({"seed": 9, "points": {"receiver.ack_delay": {"p": 1.0, "after": 4, "max_fires": 400}}})
+    )
+    payload = np.random.default_rng(31).integers(0, 256, CHUNK * N_CHUNKS, dtype=np.uint8).tobytes()
+    src_file = tmp_path / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp_path / "out" / "corpus.bin"
+
+    src, relay, dst = _relay_topology(tmp_path)
+    try:
+        dp = StubDataplane(
+            [bind_gateway(src, "local:srcA")], [bind_gateway(dst, "local:dstB")], src_region_tag="local:srcA"
+        )
+        relay_bound = bind_gateway(relay, "local:relayR")
+        dp.bound_gateways[relay_bound.gateway_id] = relay_bound
+        # minimal topology surface: the tracker labels the flagged hop with
+        # the program's true send target (src -> relay), not the final dst
+        dp.topology = SimpleNamespace(
+            get_outgoing_paths=lambda gid: {"gw_relay": 2} if gid == "gw_src" else {},
+            gateways={"gw_relay": SimpleNamespace(region_tag="local:relayR")},
+        )
+        dp.replanner = StubResolveMonitor(
+            problem=None,
+            candidate_regions=[],
+            ack_lag_threshold_ms=5.0,
+            min_frames=4,
+        )
+        job = HarnessCopyJob(src_file, out_file, chunk_bytes=CHUNK, batch_size=8)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig(compress="none", dedup=False, encrypt_e2e=False))
+        dp._trackers.append(tracker)
+        tracker.start()
+        tracker.join(timeout=180)
+        assert not tracker.is_alive(), "tracker wedged"
+        assert tracker.error is None, f"transfer failed: {tracker.error!r}"
+
+        # the decision was surfaced AND applied, exactly once (cooldown)
+        assert tracker.replan_events, "ack-lag-dominant hop never produced a replan decision"
+        assert len(tracker.replan_applied_events) == 1, tracker.replan_applied_events
+        applied = tracker.replan_applied_events[0]
+        assert applied["gateway_id"] == "gw_src"
+        assert applied["congested_edge"] == ["local:srcA", "local:relayR"]
+        assert applied["new_next_hop_gateway"] == "gw_dst"
+        assert applied["retargeted_ops"] == 1
+        # post-cutover bookkeeping: future samples/retargets for gw_src must
+        # describe the NEW edge, not the abandoned src->relay one
+        assert tracker._applied_next_hop["gw_src"] == ("local:dstB", "gw_dst")
+        assert tracker._next_hop_region("gw_src") == "local:dstB"
+        assert tracker._next_hop_gateway_id("gw_src") == "gw_dst"
+
+        # the source's sender operator now targets dst directly, and its wire
+        # engine performed the cutover as a (counted) stream retarget
+        senders = [op for op in src.daemon.operators if isinstance(op, GatewaySenderOperator)]
+        assert senders and all(op.target_gateway_id == "gw_dst" for op in senders)
+        retargets = sum(op.wire_counters()["stream_retargets"] for op in senders)
+        assert retargets >= 1, "no stream performed the cutover reset"
+
+        # pending-fp / requeue contract: the corpus lands byte-identical with
+        # zero failed chunks — un-acked frames re-framed onto the new route,
+        # acked chunks were never re-framed as failures
+        assert out_file.read_bytes() == payload
+        status = dst.get("chunk_status_log", timeout=10).json()["chunk_status"]
+        assert all(status.get(cid) == "complete" for cid in job.chunk_targets or status)
+        errors = src.get("errors", timeout=10).json()["errors"]
+        assert not errors, f"source gateway errored through the cutover: {errors[:1]}"
+    finally:
+        for gw in (src, relay, dst):
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001
+                pass

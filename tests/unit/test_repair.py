@@ -1,0 +1,202 @@
+"""Capacity-repair loop unit coverage (compute/repair.py, gateway/preempt.py,
+docs/provisioning.md "Repair & drain"): replacement budget/deadline/
+idempotency against a fake dataplane, the provision.replace fault point's
+deterministic retry ladder and survivors-only degrade, and the preemption
+watcher firing its one-shot drain notice off the injected fault."""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from skyplane_tpu.compute.repair import RepairController
+from skyplane_tpu.faults import FaultPlan, configure_injector
+from skyplane_tpu.gateway.preempt import PreemptionWatcher, probe_for
+from skyplane_tpu.obs.events import (
+    EV_REPLACEMENT_FAILED,
+    EV_REPLACEMENT_READY,
+    EV_REPLACEMENT_REQUESTED,
+    configure_recorder,
+    get_recorder,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_recorder():
+    configure_injector(FaultPlan.from_dict({"seed": 0, "points": {}}))
+    configure_recorder(capacity=4096)
+    yield
+    configure_injector(None)
+    configure_recorder()
+
+
+class FakeDataplane:
+    """provision_replacement surface: succeeds after ``fail_n`` failures."""
+
+    def __init__(self, fail_n: int = 0):
+        self.fail_n = fail_n
+        self.calls = 0
+        self.lock = threading.Lock()
+        self.repairer = None
+
+    def provision_replacement(self, dead_gateway_id: str):
+        with self.lock:
+            self.calls += 1
+            if self.calls <= self.fail_n:
+                raise OSError(f"launch failed (attempt {self.calls})")
+        return SimpleNamespace(gateway_id=f"{dead_gateway_id}+r1")
+
+
+class RecordingTracker:
+    def __init__(self):
+        self.ready = []
+        self.failed = []
+
+    def note_replacement_ready(self, dead_gid, bound, seconds):
+        self.ready.append((dead_gid, bound.gateway_id, seconds))
+
+    def note_replacement_failed(self, dead_gid, reason):
+        self.failed.append((dead_gid, reason))
+
+
+def _events(kind):
+    return [e for e in get_recorder().events_since(0) if e["kind"] == kind]
+
+
+def test_repair_provisions_and_notifies_tracker():
+    dp = FakeDataplane()
+    ctl = RepairController(dp, max_replacements=2, deadline_s=10.0, launch_attempts=2)
+    tracker = RecordingTracker()
+    assert ctl.request_replacement("gw_a", tracker=tracker) is True
+    ctl.wait()
+    assert dp.calls == 1
+    assert len(tracker.ready) == 1 and tracker.ready[0][:2] == ("gw_a", "gw_a+r1")
+    assert ctl.snapshot()["gw_a"]["state"] == "ready"
+    assert len(_events(EV_REPLACEMENT_REQUESTED)) == 1
+    assert len(_events(EV_REPLACEMENT_READY)) == 1
+
+
+def test_repair_is_idempotent_per_dead_gateway():
+    """A second death report mid-repair (or post-repair) must not launch a
+    second replacement — the double-death contract's first half."""
+    dp = FakeDataplane()
+    ctl = RepairController(dp, max_replacements=4, deadline_s=10.0)
+    assert ctl.request_replacement("gw_a") is True
+    assert ctl.request_replacement("gw_a") is False
+    ctl.wait()
+    assert ctl.request_replacement("gw_a") is False  # resolved: still a no-op
+    assert dp.calls == 1
+
+
+def test_repair_budget_exhaustion_degrades_loudly():
+    dp = FakeDataplane()
+    ctl = RepairController(dp, max_replacements=1, deadline_s=10.0)
+    tracker = RecordingTracker()
+    assert ctl.request_replacement("gw_a", tracker=tracker) is True
+    ctl.wait()
+    # the replacement itself dying is a NEW dead id, but the budget is spent
+    assert ctl.request_replacement("gw_a+r1", tracker=tracker) is False
+    assert dp.calls == 1
+    assert tracker.failed and "budget exhausted" in tracker.failed[0][1]
+    assert ctl.snapshot()["gw_a+r1"]["state"] == "failed"
+    failed = _events(EV_REPLACEMENT_FAILED)
+    assert failed and "survivors-only" in failed[0]["error"]
+
+
+def test_repair_retries_transient_launch_failures_then_succeeds():
+    dp = FakeDataplane(fail_n=2)
+    ctl = RepairController(dp, max_replacements=1, deadline_s=10.0, launch_attempts=3)
+    tracker = RecordingTracker()
+    ctl.request_replacement("gw_a", tracker=tracker)
+    ctl.wait()
+    assert dp.calls == 3
+    assert tracker.ready and tracker.ready[0][1] == "gw_a+r1"
+
+
+def test_repair_exhausted_ladder_fails_to_survivors_only():
+    dp = FakeDataplane(fail_n=99)
+    ctl = RepairController(dp, max_replacements=1, deadline_s=5.0, launch_attempts=2)
+    tracker = RecordingTracker()
+    ctl.request_replacement("gw_a", tracker=tracker)
+    ctl.wait()
+    assert dp.calls == 2
+    assert not tracker.ready
+    assert tracker.failed and "survivors-only" in tracker.failed[0][1]
+    assert ctl.snapshot()["gw_a"]["state"] == "failed"
+
+
+def test_provision_replace_fault_point_drives_the_ladder():
+    """provision.replace fires deterministically from the plan seed: two
+    armed firings consume the first two launch attempts, the third
+    provisions — the chaos-soak replacement scenario's recovery contract."""
+    configure_injector(
+        FaultPlan.from_dict({"seed": 7, "points": {"provision.replace": {"p": 1.0, "max_fires": 2}}})
+    )
+    dp = FakeDataplane()
+    ctl = RepairController(dp, max_replacements=1, deadline_s=10.0, launch_attempts=3)
+    tracker = RecordingTracker()
+    ctl.request_replacement("gw_a", tracker=tracker)
+    ctl.wait()
+    assert dp.calls == 1  # first two attempts died AT the fault point, before the SDK call
+    assert tracker.ready and not tracker.failed
+
+
+def test_provision_replace_exhaustion_degrades():
+    configure_injector(
+        FaultPlan.from_dict({"seed": 7, "points": {"provision.replace": {"p": 1.0}}})
+    )
+    dp = FakeDataplane()
+    ctl = RepairController(dp, max_replacements=1, deadline_s=5.0, launch_attempts=2)
+    tracker = RecordingTracker()
+    ctl.request_replacement("gw_a", tracker=tracker)
+    ctl.wait()
+    assert dp.calls == 0
+    assert tracker.failed and "survivors-only" in tracker.failed[0][1]
+
+
+def test_closed_controller_declines_new_repairs():
+    """Teardown contract: after close() no repair may launch a VM the
+    deprovision sweep will never see."""
+    dp = FakeDataplane()
+    ctl = RepairController(dp, max_replacements=4, deadline_s=10.0)
+    ctl.close(timeout=1.0)
+    assert ctl.request_replacement("gw_a") is False
+    assert dp.calls == 0
+
+
+# ---------------------------------------------------------------- watcher
+
+
+def test_preempt_watcher_fires_once_off_injected_fault():
+    configure_injector(
+        FaultPlan.from_dict({"seed": 3, "points": {"gateway.preempt_notice": {"p": 1.0, "after": 1}}})
+    )
+    notices = []
+    watcher = PreemptionWatcher(notices.append, poll_s=0.01)
+    watcher.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not notices:
+        time.sleep(0.01)
+    watcher.stop()
+    assert len(notices) == 1 and "preempt_notice" in notices[0]
+    assert not watcher.is_alive(), "watcher must exit after its one-shot notice"
+
+
+def test_preempt_watcher_quiet_without_notice_and_joins_on_stop():
+    notices = []
+    watcher = PreemptionWatcher(notices.append, poll_s=0.01)
+    watcher.start()
+    time.sleep(0.05)
+    watcher.stop()
+    assert not notices
+    assert not watcher.is_alive()
+
+
+def test_probe_for_known_and_unknown_providers():
+    assert probe_for("aws") is not None
+    assert probe_for("gcp") is not None
+    assert probe_for("local") is None
+    assert probe_for("") is None

@@ -452,6 +452,102 @@ def wait_forever(check):
     assert findings and all(f.suppressed for f in findings)
 
 
+def test_unjoined_thread_in_gateway_fires_on_unmanaged_thread():
+    """The drain/repair bug class (ISSUE 10): a long-lived control thread
+    under gateway//compute/ with neither daemon= nor a joined stop path
+    outlives shutdown."""
+    bound_never_joined = """
+import threading
+
+class Watcher:
+    def start(self):
+        self._t = threading.Thread(target=self.loop)
+        self._t.start()
+    def loop(self): ...
+"""
+    unbound_fire_and_forget = """
+import threading
+
+def kick(fn):
+    threading.Thread(target=fn).start()
+"""
+    for fixture in (bound_never_joined, unbound_fire_and_forget):
+        findings = [
+            f for f in run_source(fixture, "skyplane_tpu/gateway/fixture.py") if f.rule == "unjoined-thread-in-gateway"
+        ]
+        assert len(findings) == 1, fixture
+    # compute/ paths are covered too (repair threads live there)
+    findings = [
+        f
+        for f in run_source(unbound_fire_and_forget, "skyplane_tpu/compute/fixture.py")
+        if f.rule == "unjoined-thread-in-gateway"
+    ]
+    assert len(findings) == 1
+
+
+def test_unjoined_thread_in_gateway_quiet_when_daemon_joined_or_elsewhere():
+    daemonized = """
+import threading
+
+def kick(fn):
+    threading.Thread(target=fn, daemon=True).start()
+"""
+    joined_in_stop = """
+import threading
+
+class Drainer:
+    def start(self):
+        self._t = threading.Thread(target=self.loop)
+        self._t.start()
+    def loop(self): ...
+    def stop(self):
+        self._t.join(timeout=2.0)
+"""
+    joined_loop_var = """
+import threading
+
+class Pool:
+    def start(self):
+        self.workers = []
+        for i in range(4):
+            t = threading.Thread(target=self.loop)
+            t.start()
+            self.workers.append(t)
+    def loop(self): ...
+    def stop(self):
+        for t in self.workers:
+            t.join()
+"""
+    for fixture in (daemonized, joined_in_stop, joined_loop_var):
+        assert not [
+            f for f in run_source(fixture, "skyplane_tpu/gateway/fixture.py") if f.rule == "unjoined-thread-in-gateway"
+        ], fixture
+    # outside gateway//compute/ this rule stays quiet (thread-no-daemon owns it)
+    unmanaged = """
+import threading
+
+def kick(fn):
+    threading.Thread(target=fn).start()
+"""
+    assert not [
+        f for f in run_source(unmanaged, "skyplane_tpu/obs/fixture.py") if f.rule == "unjoined-thread-in-gateway"
+    ]
+
+
+def test_unjoined_thread_in_gateway_suppressible():
+    src = """
+import threading
+
+def kick(fn):
+    # sklint: disable=unjoined-thread-in-gateway -- fixture: process-lifetime thread documented here
+    threading.Thread(target=fn).start()
+"""
+    findings = [
+        f for f in run_source(src, "skyplane_tpu/gateway/fixture.py") if f.rule == "unjoined-thread-in-gateway"
+    ]
+    assert findings and all(f.suppressed for f in findings)
+
+
 def test_unbounded_event_log_fires_on_untrimmed_event_append():
     """The flight-recorder bug class (docs/observability.md): an event record
     appended forever in gateway code is unbounded memory charged to every
