@@ -127,6 +127,14 @@ REQUIRED_CHAOS = (
     "chaos_torn_records_dropped",
     "baseline_seconds",
     "chaos_seconds",
+    # runtime lock-order witness (SKYPLANE_TPU_LOCKCHECK=1, obs/lockwitness.py):
+    # observed acquisition-order graph must stay acyclic, overhead gated <5%
+    "lockcheck_enabled",
+    "lockcheck_acyclic",
+    "lockcheck_locks",
+    "lockcheck_edges",
+    "lockcheck_acquisitions",
+    "lockcheck_overhead_pct",
     # gateway-death scenario (requeue-to-survivor, docs/provisioning.md)
     "gateway_death_ok",
     "gateway_death_detected",
@@ -157,6 +165,10 @@ MIN_REPLACEMENT_RECOVERY_RATIO = 0.8
 #: the acceptance floor: a chaos run proves nothing unless it injected faults
 #: across at least this many distinct points of the stack
 MIN_CHAOS_POINTS = 5
+#: acceptance bound for the runtime lock-order witness: with
+#: SKYPLANE_TPU_LOCKCHECK=1 the instrumented-lock tax on the chaos run
+#: (deterministic per-acquire cost x observed acquisitions) stays under this
+MAX_LOCKCHECK_OVERHEAD_PCT = 5.0
 
 # fleet-telemetry smoke result (scripts/monitor_smoke.py / docs/observability.md):
 # a loopback 2-hop relay transfer scraped by the TelemetryCollector — merged
@@ -359,6 +371,29 @@ def check_chaos(result: dict) -> int:
     if result["replan_stream_retargets"] < 1:
         print("chaos-smoke: replan applied but no wire stream performed a cutover reset", file=sys.stderr)
         return 1
+    overhead = result["lockcheck_overhead_pct"]
+    if not isinstance(overhead, (int, float)) or overhead < 0 or overhead >= MAX_LOCKCHECK_OVERHEAD_PCT:
+        print(
+            f"chaos-smoke: lock-witness overhead {overhead!r}% breaches the "
+            f"{MAX_LOCKCHECK_OVERHEAD_PCT}% budget (SKYPLANE_TPU_LOCKCHECK)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["lockcheck_enabled"]:
+        if result["lockcheck_acyclic"] is not True:
+            print(
+                "chaos-smoke: observed lock-acquisition-order graph has a CYCLE (or a swallowed "
+                "LockOrderViolation) — see /api/v1/profile/locks witness output",
+                file=sys.stderr,
+            )
+            return 1
+        if result["lockcheck_acquisitions"] <= 0:
+            print(
+                "chaos-smoke: SKYPLANE_TPU_LOCKCHECK=1 but the witness observed zero acquisitions "
+                "— the wrap() shims are not on the hot path (vacuous lockcheck run)",
+                file=sys.stderr,
+            )
+            return 1
     if result["chaos_seconds"] > result["chaos_bound_seconds"]:
         print(
             f"chaos-smoke: recovery took {result['chaos_seconds']}s, over the bound "
@@ -376,6 +411,13 @@ def check_chaos(result: dict) -> int:
         f"({result['replacement_resharded_chunks']} chunk(s) re-sharded, recovery {ratio}x pre-kill), "
         f"drain {result['drain_seconds']}s/{result['drain_deadline_s']}s with 0 acked chunks lost, "
         f"{result['replan_applied_events']} replan(s) applied over {result['replan_stream_retargets']} stream cutover(s)"
+        + (
+            f"; lockcheck: {result['lockcheck_acquisitions']} acquisitions over "
+            f"{result['lockcheck_locks']} locks, {result['lockcheck_edges']} order edge(s) acyclic, "
+            f"overhead {overhead}%"
+            if result["lockcheck_enabled"]
+            else "; lockcheck: disabled"
+        )
     )
     return 0
 

@@ -163,6 +163,38 @@ else
   echo "[devloop] chaos-smoke clean; result at $LOGDIR/chaos_smoke.out" >>"$LOGDIR/devloop.log"
 fi
 
+# Lockcheck gate (CPU-only, ~2-3 min): the runtime lock-order witness
+# (SKYPLANE_TPU_LOCKCHECK=1, obs/lockwitness.py, docs/debugging.md "deadlock
+# triage") armed over (a) the tier-1 integration suite and (b) a chaos-smoke
+# rerun. Every wrapped lock records into the observed acquisition-order
+# graph and RAISES with both witness stacks the moment an acquisition would
+# close a cycle — so any run that merely *permits* an ABBA deadlock fails
+# loudly here instead of hanging a fleet at 3am. The chaos rerun must stay
+# byte-identical with an acyclic observed graph and measured witness
+# overhead < 5% (lockcheck_* keys in the chaos branch of
+# check_bench_json.py). Like the other smokes: failures are logged LOUDLY
+# but do not block device profiling.
+JAX_PLATFORMS=cpu SKYPLANE_TPU_LOCKCHECK=1 python -m pytest -q -p no:cacheprovider \
+  tests/integration >"$LOGDIR/lockcheck_tests.out" 2>&1
+LOCKTEST_RC=$?
+if [ "$LOCKTEST_RC" -ne 0 ]; then
+  echo "[devloop] LOCKCHECK-TESTS FAILURE (rc=$LOCKTEST_RC) — a lock-order violation (or regression) under the witness; see $LOGDIR/lockcheck_tests.out" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] lockcheck integration tests clean; report at $LOGDIR/lockcheck_tests.out" >>"$LOGDIR/devloop.log"
+fi
+JAX_PLATFORMS=cpu SKYPLANE_TPU_LOCKCHECK=1 SKYPLANE_CHAOS_JOBS=4 SKYPLANE_CHAOS_MB_PER_JOB=2 \
+  python scripts/soak_chaos.py --seed 1337 >"$LOGDIR/lockcheck_smoke.out" 2>"$LOGDIR/lockcheck_smoke.err"
+LOCKCHECK_RC=$?
+if [ "$LOCKCHECK_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/lockcheck_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  LOCKCHECK_RC=$?
+fi
+if [ "$LOCKCHECK_RC" -ne 0 ]; then
+  echo "[devloop] LOCKCHECK-SMOKE FAILURE (rc=$LOCKCHECK_RC) — lock-order cycle, witness overhead, or chaos gates regressed under SKYPLANE_TPU_LOCKCHECK=1; see $LOGDIR/lockcheck_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] lockcheck-smoke clean; result at $LOGDIR/lockcheck_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only
   # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
   # acquired but crashed mid-profile must be retried, not recorded

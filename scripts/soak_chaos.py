@@ -61,6 +61,7 @@ from integration.harness import (  # noqa: E402
 from skyplane_tpu.chunk import Chunk, ChunkRequest  # noqa: E402
 from skyplane_tpu.faults import FAULTS_ENV, FaultInjector, FaultPlan, configure_injector  # noqa: E402
 from skyplane_tpu.gateway.operators.sender_wire import env_int  # noqa: E402
+from skyplane_tpu.obs import lockwitness  # noqa: E402
 from skyplane_tpu.obs.metrics import open_fd_count  # noqa: E402
 from skyplane_tpu.tenancy import mint_tenant_id  # noqa: E402
 from skyplane_tpu.utils.retry import retry_backoff  # noqa: E402
@@ -805,6 +806,76 @@ def run_replan_scenario(base: Path, seed: int) -> dict:
     return out
 
 
+_PER_ACQUIRE_NS: list = []
+
+
+def _probe_per_acquire_ns() -> float:
+    """Per-acquire cost delta of a witness-wrapped lock vs a plain lock.
+
+    Measured ONCE, lazily, and main() calls this BEFORE any transfer runs:
+    the probe must see a quiet single-threaded process, not the GIL
+    contention of leftover daemon threads after the chaos run — otherwise
+    the gate measures scheduler noise, not the witness (same determinism
+    rationale as bench.py's trace_overhead_pct). Interleaved best-of-5 with
+    GC paused; minima, because noise only ever adds time."""
+    if _PER_ACQUIRE_NS:
+        return _PER_ACQUIRE_NS[0]
+    import gc
+
+    n = 20000
+    plain = threading.Lock()
+    witness = lockwitness.WitnessLock(threading.Lock(), "overhead_probe")
+
+    def timed(lock) -> int:
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            with lock:
+                pass
+        return time.perf_counter_ns() - t0
+
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        t_plain = min(min(timed(plain), timed(plain)) for _ in range(5))
+        t_witness = min(min(timed(witness), timed(witness)) for _ in range(5))
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    _PER_ACQUIRE_NS.append(max(0.0, (t_witness - t_plain) / n))
+    return _PER_ACQUIRE_NS[0]
+
+
+def lockcheck_report(chaos_wall: float) -> dict:
+    """The runtime lock-order witness's verdict over the chaos transfer
+    (``SKYPLANE_TPU_LOCKCHECK=1``; docs/debugging.md "deadlock triage").
+
+    ``lockcheck_overhead_pct`` is deterministic, not wall-clock noise between
+    two runs (the same scheme as bench.py's ``trace_overhead_pct``): the
+    per-acquire cost delta of a witness-wrapped lock vs a plain lock is
+    micro-measured in-process, multiplied by the acquisitions the soak
+    actually performed, and expressed against the chaos wall time."""
+    if not lockwitness.enabled():
+        return {
+            "lockcheck_enabled": False,
+            "lockcheck_acyclic": True,
+            "lockcheck_locks": 0,
+            "lockcheck_edges": 0,
+            "lockcheck_acquisitions": 0,
+            "lockcheck_overhead_pct": 0.0,
+        }
+    prof = lockwitness.lock_profile()
+    acq_total = sum(st["acquisitions"] for st in prof["locks"].values())
+    overhead_pct = 100.0 * _probe_per_acquire_ns() * acq_total / max(chaos_wall * 1e9, 1.0)
+    return {
+        "lockcheck_enabled": True,
+        "lockcheck_acyclic": bool(prof["acyclic"] and prof["violations"] == 0),
+        "lockcheck_locks": len(prof["locks"]),
+        "lockcheck_edges": len(prof["order_edges"]),
+        "lockcheck_acquisitions": acq_total,
+        "lockcheck_overhead_pct": round(overhead_pct, 4),
+    }
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seed", type=int, default=1337, help="FaultPlan seed (same seed => same firing schedule)")
@@ -814,6 +885,11 @@ def main() -> int:
     # the fault-free baseline (and the clean recovery replay at the end) are
     # genuinely fault-free — configure_injector(None) re-reads the env
     os.environ.pop(FAULTS_ENV, None)
+
+    # witness-cost probe first, while the process is still single-threaded
+    # (see _probe_per_acquire_ns)
+    if lockwitness.enabled():
+        _probe_per_acquire_ns()
 
     n_jobs = env_int("SKYPLANE_CHAOS_JOBS", 4)
     mb_per_job = env_int("SKYPLANE_CHAOS_MB_PER_JOB", 3)
@@ -842,6 +918,10 @@ def main() -> int:
             return 1
 
     # ---- chaos: same corpus under the published plan ----
+    # the runtime lock-order witness (SKYPLANE_TPU_LOCKCHECK=1) observes this
+    # whole run; reset here so the acquisition counts attribute to the chaos
+    # transfer itself, not the baseline warm-up above
+    lockwitness.reset()
     plan = build_plan(args.seed)
     inj: FaultInjector = configure_injector(plan)
     (base / "chaos").mkdir()
@@ -856,6 +936,7 @@ def main() -> int:
     integrity_ok = all(
         (base / "chaos" / "out" / f"job{i}.bin").read_bytes() == files[i].read_bytes() for i in range(n_jobs)
     )
+    lockcheck = lockcheck_report(chaos_wall)
 
     # determinism proof: the live firing log must equal the plan's pure
     # decision schedule replayed over the observed evaluation counts
@@ -896,6 +977,15 @@ def main() -> int:
     drain = run_drain_scenario(base, args.seed)
     replan = run_replan_scenario(base, args.seed)
 
+    # the repair/drain/replan scenarios above also ran under the witness:
+    # fold their observed edges into the final acyclicity verdict
+    if lockcheck["lockcheck_enabled"]:
+        final_prof = lockwitness.lock_profile()
+        lockcheck["lockcheck_acyclic"] = bool(
+            lockcheck["lockcheck_acyclic"] and final_prof["acyclic"] and final_prof["violations"] == 0
+        )
+        lockcheck["lockcheck_edges"] = len(final_prof["order_edges"])
+
     fds_end = open_fd_count()
     slowdown = round(chaos_wall / max(baseline_wall, 1e-9), 3)
     # bounded-recovery gate: a multiple of the fault-free time PLUS a fixed
@@ -928,6 +1018,7 @@ def main() -> int:
         "chaos_torn_records_dropped": torn_dropped,
         "baseline_seconds": round(baseline_wall, 3),
         "chaos_seconds": round(chaos_wall, 3),
+        **lockcheck,
         **death,
         **replacement,
         **drain,

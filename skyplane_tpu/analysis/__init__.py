@@ -11,8 +11,12 @@ catching on this codebase (ADVICE round 5 shipped three of them):
 
 The framework (``core``) is a per-file AST walk with a checker registry,
 ``file:line`` findings, and ``# sklint: disable=<rule> -- <reason>``
-suppressions (the reason is mandatory; a bare disable is itself a finding).
-Checker families live in ``concurrency`` and ``tracer``.
+suppressions (the reason is mandatory; a bare disable is itself a finding;
+``--check-suppressions`` audits for stale ones). Checker families live in
+``concurrency``, ``tracer``, ``spans``, and ``lockgraph`` — the last is a
+whole-program pass (``ProjectChecker``): a lock-order graph over the
+project-wide call graph (``callgraph``) with deadlock-cycle detection and
+fork-safety rules, mirrored at runtime by ``obs/lockwitness.py``.
 
 Run it as ``python -m skyplane_tpu.analysis [paths...]`` or
 ``skyplane-tpu lint``; tier-1 ``tests/unit/test_static_analysis.py`` gates the
@@ -23,9 +27,13 @@ from skyplane_tpu.analysis.core import (  # noqa: F401
     AnalysisReport,
     Checker,
     Finding,
+    ProjectChecker,
     RuleSpec,
     all_checkers,
+    all_project_checkers,
+    audit_suppressions,
     iter_rules,
     run_paths,
     run_source,
+    run_sources,
 )

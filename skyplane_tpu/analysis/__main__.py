@@ -25,6 +25,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--json", metavar="FILE", help="also write the full findings report as JSON ('-' for stdout)")
     parser.add_argument("--rule", action="append", metavar="RULE", help="only run/report these rules (repeatable)")
     parser.add_argument("--show-suppressed", action="store_true", help="print suppressed findings too")
+    parser.add_argument(
+        "--check-suppressions",
+        action="store_true",
+        help="also report stale suppressions (disables whose rule no longer fires on that line)",
+    )
     parser.add_argument("--list-rules", action="store_true", help="list every rule with severity and exit")
     args = parser.parse_args(argv)
 
@@ -40,7 +45,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if bad:
             parser.error(f"unknown rule(s): {', '.join(sorted(bad))} (see --list-rules)")
     try:
-        report = run_paths(args.paths or ["skyplane_tpu"], rules=rules)
+        report = run_paths(args.paths or ["skyplane_tpu"], rules=rules, check_suppressions=args.check_suppressions)
     except FileNotFoundError as e:
         # exit 2 (usage error), distinct from exit 1 (findings): a typo'd
         # path or wrong cwd must fail loudly, never read as a clean gate

@@ -56,11 +56,16 @@ def _is_lockish(expr: ast.AST, lock_attrs: Set[str]) -> bool:
 
 
 def _lock_attr_names(cls: ast.ClassDef) -> Set[str]:
-    """self.X attributes bound from a threading lock factory anywhere in the class."""
+    """self.X attributes bound from a threading lock factory anywhere in the
+    class — seeing through the ``lockcheck.wrap(threading.Lock(), ...)``
+    runtime-witness shim (obs/lockwitness.py)."""
     attrs: Set[str] = set()
     for node in ast.walk(cls):
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            factory = dotted_name(node.value.func).split(".")[-1]
+            call = node.value
+            factory = dotted_name(call.func).split(".")[-1]
+            if factory == "wrap" and call.args and isinstance(call.args[0], ast.Call):
+                factory = dotted_name(call.args[0].func).split(".")[-1]
             if factory in _LOCK_FACTORIES:
                 for tgt in node.targets:
                     if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":

@@ -37,6 +37,7 @@ FRAMEWORK_RULES = (
     ("parse-error", "error", "file does not parse; nothing on it was checked"),
     ("suppression-missing-reason", "error", "sklint disable comment without a justification"),
     ("suppression-unknown-rule", "warning", "sklint disable names a rule that does not exist"),
+    ("stale-suppression", "warning", "sklint disable whose rule no longer fires on that line (--check-suppressions)"),
 )
 
 
@@ -114,19 +115,40 @@ class Checker:
         return Finding(rule=rule, severity=spec.severity, path=module.path, line=line, message=message)
 
 
+class ProjectChecker:
+    """Base for whole-program passes: gets EVERY parsed module at once (the
+    lock-order graph needs cross-module call edges). Findings still attach to
+    one ``path:line`` each, so the per-line suppression contract applies
+    unchanged."""
+
+    rules: Tuple[RuleSpec, ...] = ()
+
+    def check_project(self, modules: Sequence[ModuleInfo]) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
 def all_checkers() -> List[Checker]:
     # local import: concurrency/tracer/spans import this module for the base class
     from skyplane_tpu.analysis.concurrency import CONCURRENCY_CHECKERS
+    from skyplane_tpu.analysis.lockgraph import LOCKGRAPH_CHECKERS
     from skyplane_tpu.analysis.spans import SPAN_CHECKERS
     from skyplane_tpu.analysis.tracer import TRACER_CHECKERS
 
-    return [cls() for cls in (*CONCURRENCY_CHECKERS, *TRACER_CHECKERS, *SPAN_CHECKERS)]
+    return [cls() for cls in (*CONCURRENCY_CHECKERS, *TRACER_CHECKERS, *SPAN_CHECKERS, *LOCKGRAPH_CHECKERS)]
+
+
+def all_project_checkers() -> List[ProjectChecker]:
+    from skyplane_tpu.analysis.lockgraph import LOCKGRAPH_PROJECT_CHECKERS
+
+    return [cls() for cls in LOCKGRAPH_PROJECT_CHECKERS]
 
 
 def iter_rules() -> List[RuleSpec]:
     """Every rule the pass can emit, framework rules included (docs + CLI)."""
     rules = [RuleSpec(*r) for r in FRAMEWORK_RULES]
     for checker in all_checkers():
+        rules.extend(checker.rules)
+    for checker in all_project_checkers():
         rules.extend(checker.rules)
     return rules
 
@@ -257,28 +279,116 @@ def run_module(module: ModuleInfo, checkers: Optional[Iterable[Checker]] = None)
     return findings
 
 
+def run_project(modules: Sequence[ModuleInfo], checkers: Optional[Iterable[ProjectChecker]] = None) -> List[Finding]:
+    """Run the whole-program passes over a set of parsed modules, applying
+    each finding's suppression from the module it is attributed to."""
+    by_path = {m.path: m for m in modules}
+    findings: List[Finding] = []
+    for checker in checkers if checkers is not None else all_project_checkers():
+        findings.extend(checker.check_project(list(modules)))
+    for f in findings:
+        module = by_path.get(f.path)
+        if module is None:
+            continue
+        sup = module.suppression_for(f.rule, f.line)
+        if sup is not None:
+            f.suppressed = True
+            f.suppression_reason = sup.reason
+    return findings
+
+
+def audit_suppressions(modules: Sequence[ModuleInfo], findings: Sequence[Finding]) -> List[Finding]:
+    """stale-suppression: a ``# sklint: disable=<rule>`` whose rule no longer
+    fires on its line. Dead suppressions rot the justification discipline —
+    the comment reads as a vetted hazard when nothing is being vetted. Like
+    the other suppression meta-rules, this one cannot itself be suppressed.
+
+    Must run over the UNFILTERED findings (a ``--rule`` filter would make
+    every live suppression for other rules look dead). A disable naming only
+    nonexistent rules is NOT additionally stale — ``suppression-unknown-rule``
+    already reports it, and "the rule no longer fires" would be misleading
+    for a rule that never existed."""
+    known = known_rule_names()
+    out: List[Finding] = []
+    for module in modules:
+        for sup in module.suppressions:
+            if "all" not in sup.rules and not (set(sup.rules) & known):
+                continue
+            live = any(
+                f.path == module.path
+                and f.line == sup.line
+                and ("all" in sup.rules or f.rule in sup.rules)
+                for f in findings
+            )
+            if live:
+                continue
+            out.append(
+                Finding(
+                    "stale-suppression",
+                    "warning",
+                    module.path,
+                    sup.comment_line,
+                    f"suppression for {', '.join(sup.rules)} matches no finding on line {sup.line} — "
+                    "the rule no longer fires here; remove the disable (or re-anchor it)",
+                )
+            )
+    return out
+
+
 def run_source(source: str, display: str = "<string>", rules: Optional[Set[str]] = None) -> List[Finding]:
-    """Analyze one source string (the fixture-test entry point)."""
+    """Analyze one source string (the fixture-test entry point). Project-wide
+    passes run over the single module, so cycle fixtures work in one string."""
     module, findings = load_module_source(source, display)
     if module is not None:
         findings.extend(run_module(module))
+        findings.extend(run_project([module]))
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
     return findings
 
 
-def run_paths(paths: Sequence[str], rules: Optional[Set[str]] = None) -> AnalysisReport:
+def run_sources(named_sources: Sequence[Tuple[str, str]], rules: Optional[Set[str]] = None) -> AnalysisReport:
+    """Analyze several (display_path, source) pairs as ONE project — the
+    cross-module fixture entry point for the lock-order pass."""
+    report = AnalysisReport()
+    modules: List[ModuleInfo] = []
+    for display, source in named_sources:
+        module, load_findings = load_module_source(source, display)
+        report.files_checked += 1
+        report.findings.extend(load_findings)
+        if module is not None:
+            modules.append(module)
+            report.findings.extend(run_module(module))
+    report.findings.extend(run_project(modules))
+    if rules is not None:
+        report.findings = [f for f in report.findings if f.rule in rules]
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report
+
+
+def run_paths(
+    paths: Sequence[str],
+    rules: Optional[Set[str]] = None,
+    check_suppressions: bool = False,
+) -> AnalysisReport:
     report = AnalysisReport()
     checkers = all_checkers()
     known = known_rule_names()
+    modules: List[ModuleInfo] = []
+    findings: List[Finding] = []
     for fs_path, display in _iter_py_files(paths):
         module, load_findings = load_module(fs_path, display, known=known)
         report.files_checked += 1
-        found = load_findings  # framework findings obey --rule like any other
+        findings.extend(load_findings)  # framework findings obey --rule like any other
         if module is not None:
-            found = found + run_module(module, checkers)
-        if rules is not None:
-            found = [f for f in found if f.rule in rules]
-        report.findings.extend(found)
+            modules.append(module)
+            findings.extend(run_module(module, checkers))
+    findings.extend(run_project(modules))
+    if check_suppressions:
+        # over the UNFILTERED findings — see audit_suppressions
+        findings.extend(audit_suppressions(modules, findings))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    report.findings = findings
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return report

@@ -31,6 +31,7 @@ from skyplane_tpu.obs.events import (
 from skyplane_tpu.utils.envcfg import env_float
 from skyplane_tpu.utils.logger import logger
 from skyplane_tpu.utils.retry import retry_backoff
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 # ---- client-side fleet metrics (docs/observability.md) ----
 # Control-plane state used to live only in tracker attributes
@@ -44,7 +45,7 @@ from skyplane_tpu.utils.retry import retry_backoff
 
 _live_trackers: "weakref.WeakSet" = weakref.WeakSet()
 _fleet_metrics_registered = False
-_fleet_metrics_lock = threading.Lock()
+_fleet_metrics_lock = lockcheck.wrap(threading.Lock(), "tracker._fleet_metrics_lock")
 
 
 def _tracker_totals() -> dict:
@@ -186,7 +187,7 @@ class TransferProgressTracker(threading.Thread):
         self.drain_events: List[dict] = []
         self.replacement_events: List[dict] = []
         self.replacement_failures: List[dict] = []
-        self._lock = threading.Lock()
+        self._lock = lockcheck.wrap(threading.Lock(), "TransferProgressTracker._lock")
         # fleet telemetry plane (docs/observability.md): client-side registry
         # metrics are always on (cheap scrape-time callbacks); the collector
         # thread is opt-in via SKYPLANE_TPU_COLLECT=1 (it scrapes every

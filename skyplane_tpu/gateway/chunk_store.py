@@ -17,6 +17,7 @@ from typing import Dict, Optional
 from skyplane_tpu.chunk import ChunkRequest, ChunkState
 from skyplane_tpu.gateway.gateway_queue import GatewayQueue
 from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 
 class ChunkStore:
@@ -30,7 +31,7 @@ class ChunkStore:
         self.chunk_requests: Dict[str, GatewayQueue] = {}
         # sklint: disable=unbounded-queue-in-gateway -- sole consumer is the daemon main loop draining unconditionally at 20 Hz; a bound would DROP completion records and wedge terminal accounting
         self.chunk_status_queue: "queue.Queue[dict]" = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.wrap(threading.Lock(), "ChunkStore._lock")
 
     def add_partition(self, partition_id: str, inbound_queue: GatewayQueue) -> None:
         if partition_id in self.chunk_requests:

@@ -33,6 +33,9 @@ directly:
   GET  /api/v1/profile/decode              receiver decode-pool counters+events
   GET  /api/v1/profile/cpu                 per-thread CPU seconds (bottleneck
                                            attribution input)
+  GET  /api/v1/profile/locks               per-lock hold/contention ns + the
+                                           observed lock-order graph
+                                           (SKYPLANE_TPU_LOCKCHECK=1)
   GET  /api/v1/trace                       Chrome trace-event JSON (Perfetto)
   GET  /api/v1/metrics                     Prometheus text exposition
   GET  /api/v1/events?since=<seq>          flight-recorder tail (bounded,
@@ -468,6 +471,21 @@ class GatewayDaemonAPI:
                     "region": self.region,
                     "threads": thread_cpu_seconds(),
                     "process_cpu_s": round(_time.process_time(), 6),
+                },
+            )
+        elif path == "/api/v1/profile/locks":
+            # lock hold/contention profile + the observed acquisition-order
+            # graph from the runtime witness (SKYPLANE_TPU_LOCKCHECK=1;
+            # docs/debugging.md "deadlock triage"). Disabled -> enabled:false
+            # with empty tables, so the route is always scrape-safe.
+            from skyplane_tpu.obs.lockwitness import lock_profile
+
+            req._send(
+                200,
+                {
+                    "gateway_id": self.gateway_id,
+                    "region": self.region,
+                    **lock_profile(),
                 },
             )
         elif path == "/api/v1/telemetry":

@@ -53,6 +53,7 @@ from skyplane_tpu.obs import NOOP_SPAN, get_registry, get_tracer
 from skyplane_tpu.ops.dedup import PooledChunk, SegmentStore
 from skyplane_tpu.ops.pipeline import DataPathProcessor
 from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 RECV_BLOCK = 4 * 1024 * 1024
 ACK_BYTE = b"\x06"  # per-chunk delivery ack written back on the data socket
@@ -160,7 +161,7 @@ class _ConnState:
     def __init__(self, conn: socket.socket, port: int):
         self.conn = conn
         self.port = port
-        self.lock = threading.Lock()
+        self.lock = lockcheck.wrap(threading.Lock(), "_ConnState.lock")
         self.drained = threading.Condition(self.lock)
         # sklint: disable=unbounded-queue-in-gateway -- depth is capped by the sender's byte-bounded in-flight window plus the bounded decode work queue's backpressure on the framing loop
         self.pending: "deque[_DecodeTask]" = deque()
@@ -272,7 +273,7 @@ class GatewayReceiver:
         self.raw_forward = raw_forward
         self._servers: Dict[int, socket.socket] = {}
         self._threads: List[threading.Thread] = []
-        self._lock = threading.Lock()
+        self._lock = lockcheck.wrap(threading.Lock(), "GatewayReceiver._lock")
         # payload errors (bad codec/recipe/checksum from a peer) drop the
         # connection rather than killing the daemon — a hostile or corrupted
         # frame must not be a gateway DoS. Persistent corruption escalates.
@@ -316,7 +317,7 @@ class GatewayReceiver:
         # bounded work queue = backpressure: framing loops block (and TCP
         # flow-control pushes back on senders) instead of buffering payloads
         self._work_q: "queue.Queue[Optional[_DecodeTask]]" = queue.Queue(maxsize=max(2 * decode_workers, 8))
-        self._stats_lock = threading.Lock()
+        self._stats_lock = lockcheck.wrap(threading.Lock(), "GatewayReceiver._stats_lock")
         self._decode_stats = {
             "decode_chunks": 0,
             "decode_raw_bytes": 0,

@@ -58,6 +58,7 @@ from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRES
 from skyplane_tpu.obs import NOOP_SPAN, get_tracer
 from skyplane_tpu.utils.logger import logger
 from skyplane_tpu.utils.retry import RetryPolicy
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 #: reconnect pacing for a stream whose socket keeps dying: jittered
 #: exponential (docs/fault-injection.md) — every worker's streams re-dialing
@@ -183,7 +184,7 @@ class _Stream:
 
     def __init__(self, idx: int):
         self.idx = idx
-        self.lock = threading.Lock()
+        self.lock = lockcheck.wrap(threading.Lock(), "_Stream.lock")
         self.cond = threading.Condition(self.lock)
         # sklint: disable=unbounded-queue-in-gateway -- submit() blocks at frame_ahead entries; the count bound lives in the producer, not the deque
         self.frames: "deque[WireFrame]" = deque()  # framed, not yet sent
@@ -290,12 +291,12 @@ class SenderWireEngine:
         )
         self._revivals = 0  # guarded by _streams_lock
         self._streams: List[_Stream] = []
-        self._streams_lock = threading.Lock()
+        self._streams_lock = lockcheck.wrap(threading.Lock(), "SenderWireEngine._streams_lock")
         # sklint: disable=unbounded-queue-in-gateway -- every entry is an in-flight frame, already capped by the per-stream inflight_limit byte windows
         self._completion_q: "deque" = deque()  # (stream, frame, resp byte) in ack order
-        self._completion_cond = threading.Condition()
+        self._completion_cond = threading.Condition(lockcheck.wrap(threading.RLock(), "SenderWireEngine._completion_cond"))
         self._counters = dict(SENDER_WIRE_COUNTER_ZERO)
-        self._counters_lock = threading.Lock()
+        self._counters_lock = lockcheck.wrap(threading.Lock(), "SenderWireEngine._counters_lock")
         self._closed = False
         self._reaper = threading.Thread(target=self._reap, name=f"{name}-reaper", daemon=True)
         self._reaper.start()

@@ -55,6 +55,7 @@ from skyplane_tpu.obs import get_tracer
 from skyplane_tpu.ops.bufpool import BufferPool, bucket_size
 from skyplane_tpu.ops.cdc import CDCParams
 from skyplane_tpu.ops.fused_cdc import FusedCDCFP, finalize_row
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 
 @dataclass(eq=False)  # identity semantics: dataclass __eq__ on ndarray fields
@@ -151,7 +152,7 @@ class DeviceBatchRunner:
         # waiter. Past the ceiling the leader flushes anyway, so a wedged
         # device batch surfaces as the existing TimeoutError.
         self.defer_ceiling_s = max(100.0 * self.max_wait_s, 120.0)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.wrap(threading.Lock(), "DeviceBatchRunner._lock")
         # window-formation condition (same mutex): joiners notify on a full
         # flush, _run_batch notifies when a batch drains — the leader reacts
         # immediately instead of sleep-polling a 10 ms tick

@@ -41,6 +41,7 @@ from collections import OrderedDict
 from typing import Dict, List, Tuple
 
 import numpy as np
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 MIN_BUCKET = 1 << 16  # 64 KiB — smallest padded upload worth a device dispatch
 
@@ -77,7 +78,7 @@ class BufferPool:
         self._outstanding: "OrderedDict[int, np.ndarray]" = OrderedDict()
         self._max_outstanding = max(1, int(max_outstanding_tracked))
         self._scratch: Dict[Tuple[Tuple[int, ...], str], List[np.ndarray]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.wrap(threading.Lock(), "BufferPool._lock")
         self._hits = 0
         self._misses = 0
         self._recycled = 0

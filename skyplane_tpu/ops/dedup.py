@@ -41,6 +41,7 @@ from skyplane_tpu.faults import get_injector as _get_injector
 from skyplane_tpu.obs.tracer import get_tracer as _get_tracer
 from skyplane_tpu.ops.bufpool import BufferPool, bucket_size
 from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 MAGIC = b"\xde\xd1"
 VERSION = 1
@@ -60,7 +61,7 @@ class _IndexStripe:
     __slots__ = ("lock", "lru", "bytes")
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = lockcheck.wrap(threading.Lock(), "_IndexStripe.lock")
         self.lru: "OrderedDict[bytes, Tuple[int, int]]" = OrderedDict()  # fp -> (size, last-touch seq)
         self.bytes = 0
 
@@ -96,7 +97,7 @@ class SenderDedupIndex:
         self._stripes = [_IndexStripe() for _ in range(n)]
         self._mask = n - 1
         self._seq = itertools.count()  # itertools.count: GIL-atomic next()
-        self._budget_lock = threading.Lock()  # guards the global byte total
+        self._budget_lock = lockcheck.wrap(threading.Lock(), "SenderDedupIndex._budget_lock")  # guards the global byte total
         self._max_bytes = max_bytes
         self._bytes = 0
 
@@ -195,7 +196,7 @@ class _StoreStripe:
     __slots__ = ("lock", "mem", "waiters", "contended")
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = lockcheck.wrap(threading.Lock(), "_StoreStripe.lock")
         self.mem: "OrderedDict[bytes, list]" = OrderedDict()  # fp -> [data, last-touch seq]
         # fp -> [arrival Event, waiter refcount]: REFs that raced ahead of
         # their LITERAL park here and wake the moment put() lands the bytes
@@ -243,12 +244,12 @@ class SegmentStore:
         self._stripes = [_StoreStripe() for _ in range(n)]
         self._mask = n - 1
         self._seq = itertools.count()  # itertools.count: GIL-atomic next()
-        self._budget_lock = threading.Lock()  # guards the global mem byte total
+        self._budget_lock = lockcheck.wrap(threading.Lock(), "SegmentStore._budget_lock")  # guards the global mem byte total
         self._max_bytes = max_bytes
         self._mem_bytes = 0
         self._spill_dir = Path(spill_dir) if spill_dir else None
         self._spill_max_bytes = spill_max_bytes
-        self._spill_lock = threading.Lock()  # guards spill index + in-transit map
+        self._spill_lock = lockcheck.wrap(threading.Lock(), "SegmentStore._spill_lock")  # guards spill index + in-transit map
         self._spill_bytes = 0
         self._spill_order: "OrderedDict[bytes, int]" = OrderedDict()  # fp -> size, recency order
         # segments popped from memory whose spill write is still in flight:

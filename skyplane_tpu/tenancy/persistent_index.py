@@ -45,6 +45,7 @@ from skyplane_tpu.chunk import DEFAULT_TENANT_ID
 from skyplane_tpu.faults import get_injector
 from skyplane_tpu.ops.dedup import SenderDedupIndex
 from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 _REC = struct.Struct("<B16sQ8s")  # kind, fp, size, tenant8 (+ crc32 suffix)
 _REC_LEN = _REC.size + 4
@@ -89,7 +90,7 @@ class PersistentDedupIndex(SenderDedupIndex):
         self._journal_max_bytes = max(1 << 16, int(journal_max_bytes))
         # attribution state, all guarded by _attr_lock (never held across the
         # base class's stripe locks — add/discard touch them sequentially)
-        self._attr_lock = threading.Lock()
+        self._attr_lock = lockcheck.wrap(threading.Lock(), "PersistentDedupIndex._attr_lock")
         self._owner: Dict[bytes, Tuple[str, int]] = {}  # fp -> (tenant, size)
         self._tenant_order: Dict[str, "OrderedDict[bytes, int]"] = {}  # insertion (≈LRU) order
         self._tenant_bytes: Dict[str, int] = {}
@@ -104,7 +105,7 @@ class PersistentDedupIndex(SenderDedupIndex):
         self._c_recovered = 0
         self._c_quota_evictions = 0
         self._recovered_fps: set = set()
-        self._journal_lock = threading.Lock()
+        self._journal_lock = lockcheck.wrap(threading.Lock(), "PersistentDedupIndex._journal_lock")
         self._jf = None
         self._recover()
         self._jf = open(self._journal_path, "ab")

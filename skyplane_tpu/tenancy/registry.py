@@ -28,6 +28,7 @@ from typing import Dict, Optional
 from skyplane_tpu.chunk import validate_tenant_id
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.tenancy.scheduler import FairShareScheduler
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 
 def mint_tenant_id() -> str:
@@ -80,7 +81,7 @@ class TenantRegistry:
         self.max_jobs_total = int(max_jobs_total)
         self.max_jobs_per_tenant = int(max_jobs_per_tenant)
         self.job_ttl_s = float(job_ttl_s) if job_ttl_s is not None else self.JOB_TTL_S
-        self._lock = threading.Lock()
+        self._lock = lockcheck.wrap(threading.Lock(), "TenantRegistry._lock")
         self._tenants: Dict[str, _TenantState] = {}
         self._jobs: Dict[str, str] = {}  # job_id -> tenant_id
         self._job_started: Dict[str, float] = {}

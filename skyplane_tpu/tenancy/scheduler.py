@@ -35,6 +35,7 @@ from typing import Callable, Dict, Optional
 from skyplane_tpu.chunk import DEFAULT_TENANT_ID
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.faults import get_injector
+from skyplane_tpu.obs import lockwitness as lockcheck
 
 #: canonical resource names (docs/multitenancy.md). wire_bytes bounds the
 #: bytes a tenant may hold in sender frame-ahead queues + in-flight windows;
@@ -56,7 +57,7 @@ class _Resource:
     def __init__(self, name: str, capacity: int):
         self.name = name
         self.capacity = int(capacity)
-        self.cond = threading.Condition()
+        self.cond = threading.Condition(lockcheck.wrap(threading.RLock(), "_Resource.cond"))
         self.usage: Dict[str, int] = {}  # tenant -> held tokens
         self.waiting: Dict[str, int] = {}  # tenant -> waiter count
         self.used_total = 0
@@ -67,7 +68,7 @@ class FairShareScheduler:
         self._resources: Dict[str, _Resource] = {}
         self._weights: Dict[str, float] = {}
         self._caps: Dict[str, Dict[str, int]] = {}  # tenant -> resource -> hard cap
-        self._meta_lock = threading.Lock()
+        self._meta_lock = lockcheck.wrap(threading.Lock(), "FairShareScheduler._meta_lock")
         # accounting (read by the tenant metrics provider): shared across
         # resources, so read-modify-writes serialize on _meta_lock
         self._grants: Dict[str, int] = {}

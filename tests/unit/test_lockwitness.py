@@ -1,0 +1,385 @@
+"""The runtime lock-order witness (obs/lockwitness.py): the deliberate ABBA
+deadlock is caught with both witness stacks, reentrant RLocks stay legal, the
+disabled path is a zero-cost identity passthrough, and hold/contention
+counters reach the MetricsRegistry / /api/v1/profile/locks payload.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import tracemalloc
+
+import pytest
+
+from skyplane_tpu.obs import lockwitness
+
+
+@pytest.fixture()
+def lockcheck_on(monkeypatch):
+    monkeypatch.setenv(lockwitness.ENV, "1")
+    lockwitness.reset()
+    yield
+    lockwitness.reset()
+
+
+# ------------------------------------------------------------- disabled = free
+
+
+def test_disabled_wrap_is_identity(monkeypatch):
+    monkeypatch.delenv(lockwitness.ENV, raising=False)
+    lock = threading.Lock()
+    assert lockwitness.wrap(lock, "x") is lock
+    monkeypatch.setenv(lockwitness.ENV, "0")
+    rlock = threading.RLock()
+    assert lockwitness.wrap(rlock, "y") is rlock
+
+
+def test_disabled_path_zero_allocation(monkeypatch):
+    monkeypatch.delenv(lockwitness.ENV, raising=False)
+    lock = lockwitness.wrap(threading.Lock(), "free")
+    witness_file = sys.modules["skyplane_tpu.obs.lockwitness"].__file__
+    for _ in range(100):  # warm any lazy interpreter state
+        with lock:
+            pass
+    tracemalloc.start()
+    try:
+        for _ in range(1000):
+            with lock:
+                pass
+        snapshot = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    hits = [
+        s
+        for s in snapshot.statistics("filename")
+        if s.traceback[0].filename == witness_file and s.count >= 10
+    ]
+    assert not hits, f"disabled lockcheck allocates per acquire: {hits}"
+
+
+# --------------------------------------------------------------- ABBA deadlock
+
+
+def test_abba_cycle_raises_with_both_witness_stacks(lockcheck_on):
+    a = lockwitness.wrap(threading.Lock(), "WitA")
+    b = lockwitness.wrap(threading.Lock(), "WitB")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockwitness.LockOrderViolation) as exc:
+        with b:
+            with a:
+                pass
+    msg = str(exc.value)
+    # both halves of the deadlock are in the message: this thread's stacks...
+    assert "acquiring WitA while holding WitB" in msg
+    assert "this acquisition:" in msg and "WitB was acquired:" in msg
+    # ...and the prior witness for the reverse order, with its own site
+    assert "reverse order was already observed" in msg
+    assert "WitA -> WitB" in msg and __file__.split("/")[-1] in msg
+    assert lockwitness.lock_profile()["violations"] == 1
+
+
+def test_inner_lock_is_released_on_violation(lockcheck_on):
+    a = lockwitness.wrap(threading.Lock(), "RelA")
+    b = lockwitness.wrap(threading.Lock(), "RelB")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockwitness.LockOrderViolation):
+        with b:
+            with a:
+                pass
+    # the violating acquire must not leave A's inner lock wedged
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_cross_thread_abba_is_caught(lockcheck_on):
+    a = lockwitness.wrap(threading.Lock(), "XtA")
+    b = lockwitness.wrap(threading.Lock(), "XtB")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=forward)
+    t.start()
+    t.join()
+    # the edge recorded on the worker thread trips the main thread's reverse
+    with pytest.raises(lockwitness.LockOrderViolation):
+        with b:
+            with a:
+                pass
+
+
+# ------------------------------------------------------------------ reentrancy
+
+
+def test_reentrant_rlock_is_legal(lockcheck_on):
+    r = lockwitness.wrap(threading.RLock(), "Reent")
+    with r:
+        with r:
+            with r:
+                pass
+    prof = lockwitness.lock_profile()
+    assert prof["acyclic"] and prof["violations"] == 0
+    assert prof["locks"]["Reent"]["acquisitions"] == 3
+    # reentrancy records no self-edge
+    assert not any(e["from"] == "Reent" for e in prof["order_edges"])
+
+
+def test_same_name_instances_do_not_self_edge(lockcheck_on):
+    s1 = lockwitness.wrap(threading.Lock(), "Stripe.lock")
+    s2 = lockwitness.wrap(threading.Lock(), "Stripe.lock")
+    with s1:
+        with s2:
+            pass
+    assert not any(e["from"] == e["to"] for e in lockwitness.lock_profile()["order_edges"])
+
+
+# ------------------------------------------------------------------- Condition
+
+
+def test_condition_wait_notify_over_wrapped_rlock(lockcheck_on):
+    cond = threading.Condition(lockwitness.wrap(threading.RLock(), "CondLock"))
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=1.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        hits.append(1)
+        cond.notify_all()
+    t.join(timeout=2)
+    assert not t.is_alive()
+    prof = lockwitness.lock_profile()
+    assert prof["acyclic"] and prof["violations"] == 0
+    assert prof["locks"]["CondLock"]["acquisitions"] >= 2
+
+
+def test_condition_wait_reacquire_records_no_order_edge(lockcheck_on):
+    other = lockwitness.wrap(threading.Lock(), "Other")
+    cond = threading.Condition(lockwitness.wrap(threading.Lock(), "CondEdge"))
+    # establish Other -> CondEdge; a wait() re-acquire inside the cond block
+    # must not fabricate the reverse CondEdge -> Other edge
+    with other:
+        with cond:
+            pass
+    with cond:
+        cond.wait(timeout=0.01)
+    with other:  # still legal: no cycle recorded by the wait re-acquire
+        with cond:
+            pass
+    assert lockwitness.lock_profile()["acyclic"]
+
+
+# ------------------------------------------------------------------- counters
+
+
+def test_contention_and_hold_counters(lockcheck_on):
+    lock = lockwitness.wrap(threading.Lock(), "Contended")
+
+    def holder():
+        with lock:
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.01)
+    with lock:  # blocks until the holder releases -> real contention
+        pass
+    t.join()
+    st = lockwitness.lock_profile()["locks"]["Contended"]
+    assert st["acquisitions"] == 2
+    assert st["contention_ns"] > 10_000_000  # waited >=10ms of the 50ms hold
+    assert st["hold_ns"] >= st["max_hold_ns"] > 30_000_000
+
+
+def test_profile_shape_and_edge_witness(lockcheck_on):
+    a = lockwitness.wrap(threading.Lock(), "ShapeA")
+    b = lockwitness.wrap(threading.Lock(), "ShapeB")
+    with a:
+        with b:
+            pass
+    prof = lockwitness.lock_profile()
+    assert prof["enabled"] is True
+    assert set(prof) == {"enabled", "violations", "locks", "order_edges", "acyclic"}
+    edge = next(e for e in prof["order_edges"] if e["from"] == "ShapeA" and e["to"] == "ShapeB")
+    assert "ShapeA at [" in edge["witness"] and "then ShapeB at [" in edge["witness"]
+    assert set(prof["locks"]["ShapeA"]) == {"acquisitions", "contention_ns", "hold_ns", "max_hold_ns"}
+
+
+def test_metrics_registry_exposition(lockcheck_on):
+    from skyplane_tpu.obs.metrics import get_registry
+
+    lock = lockwitness.wrap(threading.Lock(), "Exposed")
+    with lock:
+        pass
+    text = get_registry().render_prometheus()
+    assert 'skyplane_lock_acquisitions{lock="Exposed"}' in text
+    assert 'skyplane_lock_hold_ns{lock="Exposed"}' in text
+    assert 'skyplane_lock_contention_ns{lock="Exposed"}' in text
+
+
+def test_reset_clears_edges_and_stats(lockcheck_on):
+    a = lockwitness.wrap(threading.Lock(), "RstA")
+    b = lockwitness.wrap(threading.Lock(), "RstB")
+    with a:
+        with b:
+            pass
+    assert lockwitness.lock_profile()["order_edges"]
+    lockwitness.reset()
+    prof = lockwitness.lock_profile()
+    assert not prof["order_edges"] and prof["violations"] == 0
+    assert prof["locks"].get("RstA", {}).get("acquisitions", 0) == 0
+    # and the reverse order is legal again after the reset
+    with b:
+        with a:
+            pass
+
+
+# ------------------------------------------------------------- the API route
+
+
+def test_profile_locks_route_over_http(tmp_path, lockcheck_on):
+    import json
+    import queue
+    import urllib.request
+
+    from skyplane_tpu.gateway.chunk_store import ChunkStore
+    from skyplane_tpu.gateway.gateway_daemon_api import GatewayDaemonAPI
+    from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+
+    probe = lockwitness.wrap(threading.Lock(), "RouteProbeA")
+    inner = lockwitness.wrap(threading.Lock(), "RouteProbeB")
+    with probe:
+        with inner:
+            pass
+
+    class _FakeReceiver:
+        socket_profile_events = queue.Queue()
+
+        def socket_events_dropped(self):
+            return 0
+
+    store = ChunkStore(str(tmp_path / "chunks"))
+    store.add_partition("default", GatewayQueue())
+    api = GatewayDaemonAPI(
+        chunk_store=store,
+        receiver=_FakeReceiver(),
+        error_event=threading.Event(),
+        error_queue=queue.Queue(),
+        terminal_operators={"default": []},
+        handle_to_group={"default": {}},
+        region="test:r",
+        gateway_id="gw_locks",
+        host="127.0.0.1",
+        port=0,
+    )
+    api.start()
+    try:
+        url = f"http://127.0.0.1:{api.port}/api/v1/profile/locks"
+        payload = json.loads(urllib.request.urlopen(url, timeout=5).read())
+    finally:
+        api.stop()
+    assert payload["gateway_id"] == "gw_locks" and payload["enabled"] is True
+    assert payload["locks"]["RouteProbeA"]["acquisitions"] >= 1
+    assert any(e["from"] == "RouteProbeA" and e["to"] == "RouteProbeB" for e in payload["order_edges"])
+    assert payload["acyclic"] is True and payload["violations"] == 0
+
+
+# ------------------------------------------------- review-hardening regressions
+
+
+def test_post_wait_orderings_are_still_recorded(lockcheck_on):
+    """The wait() re-acquire itself records no edge, but lock orderings
+    chosen INSIDE the post-wait body must still enter the graph — otherwise
+    the cond->B half of an ABBA pair escapes and the reverse passes."""
+    b = lockwitness.wrap(threading.Lock(), "PostWaitB")
+    cond = threading.Condition(lockwitness.wrap(threading.Lock(), "PostWaitC"))
+    with cond:
+        cond.wait(timeout=0.01)
+        with b:  # ordering chosen after the wait: C -> B
+            pass
+    assert any(
+        e["from"] == "PostWaitC" and e["to"] == "PostWaitB"
+        for e in lockwitness.lock_profile()["order_edges"]
+    )
+    with pytest.raises(lockwitness.LockOrderViolation):
+        with b:
+            with cond:
+                pass
+
+
+def test_stats_survive_instance_garbage_collection(lockcheck_on):
+    """Short-lived locks (per-connection state) fold their counters into
+    per-name totals at GC — exported counters never go backward."""
+    import gc
+
+    lock = lockwitness.wrap(threading.Lock(), "ShortLived")
+    with lock:
+        pass
+    before = lockwitness.lock_profile()["locks"]["ShortLived"]["acquisitions"]
+    del lock
+    gc.collect()
+    after = lockwitness.lock_profile()["locks"]["ShortLived"]["acquisitions"]
+    assert after == before == 1
+
+
+def test_cross_thread_release_does_not_fabricate_edges(lockcheck_on):
+    """threading.Lock may be released by a different thread; the acquirer's
+    stale held-stack entry must not mint false edges or a false violation."""
+    a = lockwitness.wrap(threading.Lock(), "HandoffA")
+    x = lockwitness.wrap(threading.Lock(), "HandoffX")
+    with x:  # establish the legitimate order X -> A
+        with a:
+            pass
+    a.acquire()
+    t = threading.Thread(target=a.release)  # cross-thread handoff release
+    t.start()
+    t.join()
+    # main's stack still lists A; acquiring X must NOT record A -> X (which
+    # would close a false cycle against the legitimate X -> A) nor raise
+    with x:
+        pass
+    prof = lockwitness.lock_profile()
+    assert prof["violations"] == 0
+    assert not any(e["from"] == "HandoffA" for e in prof["order_edges"])
+
+
+def test_gc_finalizer_cannot_deadlock_on_graph_lock(lockcheck_on):
+    """A WitnessLock finalized by an allocation-triggered GC pass may run on
+    a thread that already HOLDS _graph_lock (e.g. mid _record_edge) — the
+    finalizer must be lock-free or the witness deadlocks the daemon."""
+    import gc
+
+    class _Cycle:  # reference cycle owning a WitnessLock: dies only via gc
+        def __init__(self):
+            self.me = self
+            self.lock = lockwitness.wrap(threading.Lock(), "CycleOwned")
+
+    c = _Cycle()
+    with c.lock:
+        pass
+    del c
+    done = threading.Event()
+
+    def collect_under_lock():
+        with lockwitness._graph_lock:  # the state _record_edge holds
+            gc.collect()  # finalizes the cycle-held WitnessLock HERE
+        done.set()
+
+    t = threading.Thread(target=collect_under_lock, daemon=True)
+    t.start()
+    assert done.wait(timeout=5), "gc.collect() under _graph_lock deadlocked the finalizer"
+    # and the retired counters still surface after the lock-free publish
+    assert lockwitness.lock_profile()["locks"]["CycleOwned"]["acquisitions"] == 1

@@ -10,7 +10,7 @@ from pathlib import Path
 
 import pytest
 
-from skyplane_tpu.analysis import run_paths, run_source
+from skyplane_tpu.analysis import audit_suppressions, run_paths, run_source
 from skyplane_tpu.analysis.core import iter_rules
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
@@ -936,3 +936,82 @@ def test_cli_rule_filter_applies_to_framework_findings_too(tmp_path):
     assert scoped.ok() and not scoped.findings
     unscoped = run_paths([str(bad)])
     assert [f.rule for f in unscoped.findings] == ["parse-error"]
+
+
+# --------------------------------------------------- stale-suppression audit
+
+
+def test_stale_suppression_reported_under_check_suppressions(tmp_path):
+    """A disable whose rule no longer fires on its line rots the
+    justification discipline — the --check-suppressions pass names it."""
+    src = tmp_path / "stale.py"
+    src.write_text(
+        "def fine():\n"
+        "    x = 1  # sklint: disable=blocking-under-lock -- historical: the lock was refactored away\n"
+        "    return x\n"
+    )
+    plain = run_paths([str(src)])
+    assert plain.ok(), "a dead suppression is silent without the audit flag"
+    audited = run_paths([str(src)], check_suppressions=True)
+    assert not audited.ok()
+    assert [f.rule for f in audited.unsuppressed] == ["stale-suppression"]
+    assert "blocking-under-lock" in audited.unsuppressed[0].message
+
+
+def test_live_suppression_is_not_stale(tmp_path):
+    src = tmp_path / "live.py"
+    src.write_text(
+        "import threading\n"
+        "def go():\n"
+        "    threading.Thread(target=print).start()  # sklint: disable=thread-no-daemon -- fixture thread dies with the test\n"
+    )
+    audited = run_paths([str(src)], check_suppressions=True)
+    assert audited.ok(), "\n".join(f.render() for f in audited.unsuppressed)
+
+
+def test_stale_audit_ignores_rule_filter(tmp_path):
+    """The audit must judge liveness against the UNFILTERED findings — a
+    --rule filter must not make every other rule's suppression look dead."""
+    src = tmp_path / "filtered.py"
+    src.write_text(
+        "import threading\n"
+        "def go():\n"
+        "    threading.Thread(target=print).start()  # sklint: disable=thread-no-daemon -- fixture thread dies with the test\n"
+    )
+    audited = run_paths([str(src)], rules={"stale-suppression"}, check_suppressions=True)
+    assert audited.ok(), "\n".join(f.render() for f in audited.unsuppressed)
+
+
+def test_cli_check_suppressions_flag(tmp_path, capsys):
+    from skyplane_tpu.analysis.__main__ import main as lint_main
+
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # sklint: disable=bare-except-in-loop -- no loop here anymore\n")
+    assert lint_main([str(stale)]) == 0
+    assert lint_main([str(stale), "--check-suppressions"]) == 1
+    assert "stale-suppression" in capsys.readouterr().out
+
+
+def test_repo_has_no_stale_suppressions(repo_report):
+    """The in-repo discipline gate: every sklint disable in the package still
+    suppresses a live finding (satellite: dead suppressions fixed/removed)."""
+    from skyplane_tpu.analysis.core import _iter_py_files, known_rule_names, load_module
+
+    modules = []
+    known = known_rule_names()
+    for fs_path, display in _iter_py_files([str(REPO_ROOT / "skyplane_tpu")]):
+        module, _ = load_module(fs_path, display, known=known)
+        if module is not None:
+            modules.append(module)
+    stale = audit_suppressions(modules, repo_report.findings)
+    assert not stale, "\n".join(f.render() for f in stale)
+
+
+def test_unknown_rule_disable_is_not_also_stale(tmp_path):
+    """suppression-unknown-rule already covers a disable naming a
+    nonexistent rule; the stale audit must not double-report it with
+    misleading 'no longer fires' advice."""
+    src = tmp_path / "unknown.py"
+    src.write_text("x = 1  # sklint: disable=no-such-rule -- typo fixture\n")
+    audited = run_paths([str(src)], check_suppressions=True)
+    assert [f.rule for f in audited.unsuppressed] == ["suppression-unknown-rule"]
