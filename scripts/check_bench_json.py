@@ -43,6 +43,7 @@ REQUIRED_TOP = (
     "wire_counters",
     "stage_latency_us",
     "trace_overhead_pct",
+    "cpu_breakdown",
 )
 # trace-derived per-stage latency breakdown (bench.py TRACE_STAGES /
 # docs/observability.md): a future perf PR proves WHERE it moved time
@@ -50,6 +51,34 @@ REQUIRED_STAGES = ("frame", "send_stall", "ack_lag", "decode", "store")
 # acceptance bound: with tracing DISABLED the instrumentation may tax the
 # loopback wire bench by at most this much (ISSUE 5 acceptance criteria)
 MAX_TRACE_OVERHEAD_PCT = 2.0
+# core-time attribution (bench.py bench_cpu_profile / obs/profiler.py,
+# docs/observability.md "Core-time profiling"): per-stage CPU seconds over
+# the loopback wire stack + the GIL-probe wait fraction + cores effectively
+# used — the single-core-ceiling baseline ROADMAP item 1 is judged against
+REQUIRED_CPU_BREAKDOWN = (
+    "stage_cpu_s",
+    "gil_wait_fraction",
+    "cores_effective",
+    "profile_hz",
+    "profile_samples",
+    "profile_samples_dropped",
+    "profile_overhead_pct",
+)
+REQUIRED_CPU_STAGES = (
+    "frame",
+    "send_stall",
+    "ack_lag",
+    "decode",
+    "store",
+    "device_wait",
+    "codec",
+    "crypto",
+    "framing",
+    "other",
+)
+# acceptance bound (ISSUE 12): the sampler's measured steady-state cost at
+# the configured rate may consume at most this share of ONE core
+MAX_PROFILE_OVERHEAD_PCT = 2.0
 REQUIRED_COUNTERS = (
     "pool_hit_rate",
     "pool_hits",
@@ -189,6 +218,8 @@ REQUIRED_FLEET = (
     "fleet_log_path",
     "fleet_log_lines",
     "fleet_stage_latency_us",
+    "fleet_profile_gateways",
+    "fleet_gil_wait_fraction",
     "fleet_reconcile_pct",
     "fleet_stale_gateways",
     "collector_scrapes",
@@ -243,6 +274,15 @@ def check_fleet(result: dict) -> int:
             f"{result['fleet_events_tailed']} events were tailed",
             file=sys.stderr,
         )
+        return 1
+    # core-time scrape proof (ISSUE 12): the combined telemetry scrape must
+    # have carried at least one profiler summary, with a sane GIL fraction
+    if result["fleet_profile_gateways"] < 1:
+        print("monitor-smoke: no gateway's scrape carried a profiler summary (?profile=1 path broken)", file=sys.stderr)
+        return 1
+    gil = result["fleet_gil_wait_fraction"]
+    if not isinstance(gil, (int, float)) or gil < 0.0 or gil > 1.0:
+        print(f"monitor-smoke: implausible fleet_gil_wait_fraction {gil!r} (must be 0..1)", file=sys.stderr)
         return 1
     rec = result["fleet_reconcile_pct"]
     if not isinstance(rec, (int, float)) or rec < 0 or rec > MAX_FLEET_RECONCILE_PCT:
@@ -524,6 +564,16 @@ def main(argv) -> int:
         missing.append("stage_latency_us(dict)")
     else:
         missing += [f"stage_latency_us.{k}" for k in REQUIRED_STAGES if k not in stages]
+    cpu = result.get("cpu_breakdown")
+    if not isinstance(cpu, dict):
+        missing.append("cpu_breakdown(dict)")
+    else:
+        missing += [f"cpu_breakdown.{k}" for k in REQUIRED_CPU_BREAKDOWN if k not in cpu]
+        cpu_stages = cpu.get("stage_cpu_s")
+        if not isinstance(cpu_stages, dict):
+            missing.append("cpu_breakdown.stage_cpu_s(dict)")
+        else:
+            missing += [f"cpu_breakdown.stage_cpu_s.{k}" for k in REQUIRED_CPU_STAGES if k not in cpu_stages]
     if missing:
         print(f"bench-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
         return 1
@@ -558,12 +608,36 @@ def main(argv) -> int:
             file=sys.stderr,
         )
         return 1
+    # core-time attribution gates (ISSUE 12): the profile must hold real
+    # samples, a sane GIL fraction, a positive core count, and a measured
+    # sampler cost under the always-on budget
+    if cpu["profile_samples"] <= 0:
+        print("bench-smoke: cpu_breakdown holds zero profile samples (sampler never ran)", file=sys.stderr)
+        return 1
+    gil = cpu["gil_wait_fraction"]
+    if not isinstance(gil, (int, float)) or gil < 0.0 or gil > 1.0:
+        print(f"bench-smoke: implausible gil_wait_fraction {gil!r} (must be 0..1)", file=sys.stderr)
+        return 1
+    cores = cpu["cores_effective"]
+    if not isinstance(cores, (int, float)) or cores <= 0.0:
+        print(f"bench-smoke: implausible cores_effective {cores!r}", file=sys.stderr)
+        return 1
+    p_overhead = cpu["profile_overhead_pct"]
+    if not isinstance(p_overhead, (int, float)) or p_overhead < 0 or p_overhead >= MAX_PROFILE_OVERHEAD_PCT:
+        print(
+            f"bench-smoke: sampling-profiler overhead {p_overhead!r}% breaches the "
+            f"{MAX_PROFILE_OVERHEAD_PCT}% always-on budget (one-core share at "
+            f"{cpu.get('profile_hz')!r} Hz)",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"bench-smoke OK: {result['value']} {result['unit']} encode, "
         f"{result['decode_gbps']} {result['unit']} decode on {result['platform']} "
         f"(device {result['device']}); wire: {wire['frames_pipelined']} frames pipelined, "
         f"stall {wire['wire_stall_ns_per_window']}ns/window vs serial drain {wire['serial_drain_ns_per_window']}ns/window; "
-        f"trace overhead {overhead}%"
+        f"trace overhead {overhead}%; cpu profile: {cpu['profile_samples']} samples, "
+        f"{cores} cores effective, GIL wait {round(100.0 * gil, 1)}%, sampler overhead {p_overhead}%"
     )
     return 0
 

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Validate a speedscope JSON export from the sampling profiler.
+
+The devloop profile-smoke step (scripts/devloop.sh) runs bench.py with
+``SKYPLANE_BENCH_PROFILE_OUT`` set and feeds the file here, so a profiler
+export regression — empty stacks, out-of-range frame indices, samples/weights
+mismatch, a schema drift the speedscope app would reject — is caught in
+seconds on CPU instead of when an operator drops the file on
+https://www.speedscope.app mid-incident.
+
+Checks (the subset of the speedscope file-format schema our "sampled"
+profiles exercise):
+
+  * top level: ``$schema`` is the speedscope schema URL, ``shared.frames``
+    is a non-empty list of ``{"name": ...}`` entries, ``profiles`` is a
+    non-empty list;
+  * every profile: ``type == "sampled"``, non-empty ``samples``/``weights``
+    of equal length, every weight positive, every sample a list of in-range
+    frame indices;
+  * at least ``--min-samples`` total sample weight across profiles (the
+    profile proves the sampler actually ran over the transfer).
+
+Exit 0 iff the file passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+SCHEMA_URL = "https://www.speedscope.app/file-format-schema.json"
+
+
+def validate(doc: dict, min_samples: int = 1) -> int:
+    if doc.get("$schema") != SCHEMA_URL:
+        print(f"profile-smoke: $schema is {doc.get('$schema')!r}, expected {SCHEMA_URL!r}", file=sys.stderr)
+        return 1
+    frames = (doc.get("shared") or {}).get("frames")
+    if not isinstance(frames, list) or not frames:
+        print("profile-smoke: shared.frames missing or empty", file=sys.stderr)
+        return 1
+    bad_frames = [i for i, fr in enumerate(frames) if not isinstance(fr, dict) or not fr.get("name")]
+    if bad_frames:
+        print(f"profile-smoke: {len(bad_frames)} frame(s) without a name (first at index {bad_frames[0]})", file=sys.stderr)
+        return 1
+    profiles = doc.get("profiles")
+    if not isinstance(profiles, list) or not profiles:
+        print("profile-smoke: profiles missing or empty (sampler recorded no thread tracks)", file=sys.stderr)
+        return 1
+    total_weight = 0
+    for p, prof in enumerate(profiles):
+        name = prof.get("name") or f"#{p}"
+        if prof.get("type") != "sampled":
+            print(f"profile-smoke: profile {name} has type {prof.get('type')!r}, expected 'sampled'", file=sys.stderr)
+            return 1
+        samples, weights = prof.get("samples"), prof.get("weights")
+        if not isinstance(samples, list) or not isinstance(weights, list) or len(samples) != len(weights):
+            print(
+                f"profile-smoke: profile {name} samples/weights malformed "
+                f"({type(samples).__name__}[{len(samples) if isinstance(samples, list) else '?'}] vs "
+                f"{type(weights).__name__}[{len(weights) if isinstance(weights, list) else '?'}])",
+                file=sys.stderr,
+            )
+            return 1
+        for s, stack in enumerate(samples):
+            if not isinstance(stack, list) or any(
+                not isinstance(i, int) or i < 0 or i >= len(frames) for i in stack
+            ):
+                print(f"profile-smoke: profile {name} sample {s} holds out-of-range frame indices", file=sys.stderr)
+                return 1
+        if any(not isinstance(w, (int, float)) or w <= 0 for w in weights):
+            print(f"profile-smoke: profile {name} holds non-positive weights", file=sys.stderr)
+            return 1
+        total_weight += sum(weights)
+    if total_weight < min_samples:
+        print(
+            f"profile-smoke: only {total_weight} total sample weight across {len(profiles)} profile(s); "
+            f"need >= {min_samples} (did the sampler run during the transfer?)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"profile-smoke OK: {len(profiles)} thread track(s), {len(frames)} unique frame(s), "
+        f"{total_weight} samples"
+    )
+    return 0
+
+
+def main(argv) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="speedscope JSON file (SKYPLANE_BENCH_PROFILE_OUT)")
+    parser.add_argument("--min-samples", type=int, default=1, help="minimum total sample weight (default 1)")
+    args = parser.parse_args(argv[1:])
+    try:
+        with open(args.path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"profile-smoke: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(doc, dict):
+        print(f"profile-smoke: top level is {type(doc).__name__}, expected an object", file=sys.stderr)
+        return 1
+    return validate(doc, min_samples=args.min_samples)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

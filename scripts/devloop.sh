@@ -63,6 +63,7 @@ SKYPLANE_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu \
   SKYPLANE_BENCH_CHUNK_MB=1 SKYPLANE_BENCH_SNAPSHOTS=2 SKYPLANE_BENCH_SNAP_CHUNKS=2 SKYPLANE_BENCH_REPS=1 \
   SKYPLANE_BENCH_DECODE_WORKERS=4 \
   SKYPLANE_BENCH_TRACE_OUT="$LOGDIR/trace_smoke.json" \
+  SKYPLANE_BENCH_PROFILE_OUT="$LOGDIR/profile_smoke.speedscope.json" \
   python bench.py >"$LOGDIR/bench_smoke.out" 2>"$LOGDIR/bench_smoke.err"
 BENCH_RC=$?
 if [ "$BENCH_RC" -eq 0 ]; then
@@ -87,6 +88,25 @@ if [ "$TRACE_RC" -ne 0 ]; then
   echo "[devloop] TRACE-SMOKE FAILURE (rc=$TRACE_RC) — exported trace invalid; see $LOGDIR/trace_smoke.json" >>"$LOGDIR/devloop.log"
 else
   echo "[devloop] trace-smoke clean; trace at $LOGDIR/trace_smoke.json" >>"$LOGDIR/devloop.log"
+fi
+
+# Profile-smoke gate (CPU-only, part of the same bench run): bench.py's
+# cpu-profile pass runs the sampling profiler (obs/profiler.py) over a
+# fully-sampled loopback transfer and exports speedscope JSON
+# (SKYPLANE_BENCH_PROFILE_OUT above); validate the export schema here
+# (scripts/check_speedscope_json.py: frames table, sampled profiles,
+# in-range indices, nonzero sample weight). The cpu_breakdown keys and the
+# <2% sampler-overhead gate already ride the bench-smoke check above
+# (scripts/check_bench_json.py REQUIRED_CPU_BREAKDOWN /
+# MAX_PROFILE_OVERHEAD_PCT). Catches a profiler-export regression before an
+# operator drops an empty flame graph on speedscope mid-incident.
+python scripts/check_speedscope_json.py "$LOGDIR/profile_smoke.speedscope.json" \
+  --min-samples 16 >>"$LOGDIR/devloop.log" 2>&1
+PROFILE_RC=$?
+if [ "$PROFILE_RC" -ne 0 ]; then
+  echo "[devloop] PROFILE-SMOKE FAILURE (rc=$PROFILE_RC) — speedscope export invalid or sampler never ran; see $LOGDIR/profile_smoke.speedscope.json" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] profile-smoke clean; speedscope at $LOGDIR/profile_smoke.speedscope.json" >>"$LOGDIR/devloop.log"
 fi
 
 # Monitor-smoke gate (CPU-only, seconds): the fleet telemetry plane end to

@@ -46,7 +46,13 @@ from integration.harness import HarnessCopyJob, LocalGateway, StubDataplane, bin
 from skyplane_tpu.api.config import TransferConfig  # noqa: E402
 from skyplane_tpu.api.tracker import TransferProgressTracker  # noqa: E402
 from skyplane_tpu.faults import FaultPlan, FaultSpec, configure_injector  # noqa: E402
-from skyplane_tpu.obs import configure_recorder, configure_tracer, get_recorder, get_tracer  # noqa: E402
+from skyplane_tpu.obs import (  # noqa: E402
+    configure_profiler,
+    configure_recorder,
+    configure_tracer,
+    get_recorder,
+    get_tracer,
+)
 from skyplane_tpu.obs.collector import (  # noqa: E402
     BOTTLENECK_STAGES,
     GatewayTarget,
@@ -179,6 +185,10 @@ def main() -> int:
     configure_injector(
         FaultPlan(seed=1234, points={"sender.send": FaultSpec(p=1.0, after=3, max_fires=1)})
     )
+    # the sampling profiler rides the same combined telemetry scrape
+    # (?profile=1): arming it here proves the collector's core-budget path
+    # end to end over real HTTP (docs/observability.md "Core-time profiling")
+    configure_profiler(hz=47.0).ensure_started()
 
     tmp = Path(tempfile.mkdtemp(prefix="skyplane_monitor_smoke_"))
     rng = np.random.default_rng(7)
@@ -260,7 +270,12 @@ def main() -> int:
         log_lines = sum(1 for ln in open(fleet_log) if ln.strip()) if os.path.exists(fleet_log) else 0
 
         # ---- bottleneck attribution + reconciliation ----
-        report = bottleneck_report(merged, collector.cpu_profiles())
+        # profile summaries scraped over HTTP (the in-process harness
+        # gateways share one profiler, so every scrape sees the same
+        # process-wide summary — the dedupe-by-payload concern the
+        # collector's per-gateway keying already handles)
+        profile_summaries = collector.profile_summaries()
+        report = bottleneck_report(merged, collector.cpu_profiles(), profile_summaries)
         local = stage_breakdown(get_tracer().export()["traceEvents"])
         reconcile_pct = 0.0
         for stage in BOTTLENECK_STAGES:
@@ -294,6 +309,12 @@ def main() -> int:
             "fleet_log_path": fleet_log,
             "fleet_log_lines": log_lines,
             "fleet_stage_latency_us": {s: report["stages"][s]["mean_us"] for s in BOTTLENECK_STAGES},
+            # core-time scrape proof: every gateway's combined scrape carried
+            # the profiler summary, and the probe fraction is sane
+            "fleet_profile_gateways": len(profile_summaries),
+            "fleet_gil_wait_fraction": max(
+                [float(s.get("gil_wait_fraction") or 0.0) for s in profile_summaries.values()] or [0.0]
+            ),
             "fleet_reconcile_pct": round(reconcile_pct, 3),
             "fleet_stale_gateways": counters["collector_stale_gateways"],
             "collector_scrapes": counters["collector_scrapes"],
@@ -316,6 +337,7 @@ def main() -> int:
         configure_injector(None)
         configure_tracer()
         configure_recorder()
+        configure_profiler()
     return rc
 
 

@@ -53,6 +53,13 @@ def main() -> None:
     os.environ.setdefault("SKYPLANE_TPU_SEGSTORE_MB", "512")
     os.environ.setdefault("SKYPLANE_TPU_SEGSTORE_SPILL_MB", "1024")
     tmp = Path(tempfile.mkdtemp(prefix="soak_"))
+    # core-time attribution over the whole soak (docs/observability.md
+    # "Core-time profiling"): honor SKYPLANE_TPU_PROFILE_HZ like a gateway
+    # would — off by default, a core-budget line in the summary when armed
+    from skyplane_tpu.obs.profiler import get_profiler
+
+    profiler = get_profiler()
+    profiler.ensure_started()
     src, dst = make_pair(tmp, compress="zstd", dedup=True, encrypt=True, use_tls=True, num_connections=4)
     rng = np.random.default_rng(3)
     base_block = rng.integers(0, 256, args.wave_mb << 20, dtype=np.uint8)
@@ -99,6 +106,15 @@ def main() -> None:
             f"fds {first['fds']} -> {last['fds']} (growth {fd_growth}), "
             f"peak RSS {last['rss_mb']} MB (late-wave growth {late_growth_mb:.0f} MB)"
         )
+        if profiler.enabled:
+            prof = profiler.cpu_breakdown()
+            top = sorted(prof["stage_cpu_s"].items(), key=lambda kv: -kv[1])[:4]
+            summary += (
+                f"; core budget: {prof['cores_effective']} cores effective, "
+                f"GIL wait {100.0 * prof['gil_wait_fraction']:.1f}%, "
+                f"top CPU stages {', '.join(f'{s} {v:.1f}s' for s, v in top if v > 0)} "
+                f"({prof['profile_samples']} samples, {prof['profile_samples_dropped']} dropped)"
+            )
         failures = []
         if fd_growth > 32:
             failures.append(f"fd growth {fd_growth} > 32")

@@ -196,6 +196,7 @@ def bottleneck(trace_path, urls, cpu_path, token, as_json):
     from skyplane_tpu.obs.collector import bottleneck_report, format_bottleneck, scrape_trace_once
 
     cpu_profiles = None
+    profile_summaries = None
     if trace_path:
         with open(trace_path) as f:
             trace = json_mod.load(f)
@@ -205,24 +206,124 @@ def bottleneck(trace_path, urls, cpu_path, token, as_json):
 
         trace = scrape_trace_once(list(urls), token=token)
         cpu_profiles = {}
+        profile_summaries = {}
         for u in urls:
             base = api_base_of(u)
+            # the two fetches are independent and each additive: a failed
+            # CPU scrape must not shadow a working stacks scrape (or vice
+            # versa) — either block alone still improves the report
             try:
                 payload = control_session(token).get(f"{base}/profile/cpu", timeout=10).json()
                 cpu_profiles[payload.get("gateway_id") or base] = payload
             except Exception:  # noqa: BLE001 — CPU attribution is additive
-                continue
+                pass
+            try:
+                # core budget (docs/observability.md "Core-time profiling"):
+                # old gateways 404, unarmed profilers report zero samples —
+                # either way the report simply omits the core-budget block
+                stacks = control_session(token).get(f"{base}/profile/stacks", params={"summary": "1"}, timeout=10)
+                if stacks.ok:
+                    payload = stacks.json()
+                    profile_summaries[payload.get("gateway_id") or base] = payload.get("summary")
+            except Exception:  # noqa: BLE001 — profiler summary is additive
+                pass
     else:
         raise click.ClickException("pass --trace <file> or at least one --url")
     if cpu_path:
         with open(cpu_path) as f:
             cpu_profiles = json_mod.load(f)
-    report = bottleneck_report(trace, cpu_profiles)
+    report = bottleneck_report(trace, cpu_profiles, profile_summaries)
     if report["n_spans"] == 0:
         raise click.ClickException(
             "trace holds no spans — was SKYPLANE_TPU_TRACE_SAMPLE set on the gateways? (docs/observability.md)"
         )
     click.echo(json_mod.dumps(report, indent=2) if as_json else format_bottleneck(report))
+
+
+@main.command()
+@click.option("--url", "urls", multiple=True, help="gateway control URL(s) to scrape live; repeatable")
+@click.option("--trace", "trace_path", default=None, help="a saved /api/v1/profile/stacks payload JSON instead of --url")
+@click.option("--token", default=None, help="gateway API bearer token (defaults to none)")
+@click.option("-o", "--output", default=None, help="write speedscope JSON here (open at https://www.speedscope.app)")
+@click.option("--top", default=12, type=int, help="hottest folded stacks to print per gateway")
+def flame(urls, trace_path, token, output, top):
+    """Core-time flame view: pull each gateway's sampling-profiler stacks
+    (GET /api/v1/profile/stacks, SKYPLANE_TPU_PROFILE_HZ > 0), print the
+    core-budget verdict plus the hottest folded stacks, and optionally write
+    a speedscope JSON (docs/observability.md "Core-time profiling")."""
+    import json as json_mod
+
+    from skyplane_tpu.obs.collector import core_budget
+
+    payloads = []
+    if trace_path:
+        with open(trace_path) as f:
+            payload = json_mod.load(f)
+        payloads.append((payload.get("gateway_id") or trace_path, payload))
+    elif urls:
+        from skyplane_tpu.gateway.control_auth import control_session
+        from skyplane_tpu.obs.collector import api_base_of
+
+        for u in urls:
+            base = api_base_of(u)
+            resp = control_session(token).get(f"{base}/profile/stacks", timeout=30)
+            if resp.status_code == 404:
+                click.echo(f"{base}: no /profile/stacks route (older gateway) — skipping")
+                continue
+            resp.raise_for_status()
+            payload = resp.json()
+            payloads.append((payload.get("gateway_id") or base, payload))
+    else:
+        raise click.ClickException("pass --trace <file> or at least one --url")
+    if not payloads:
+        raise click.ClickException("no profile payloads collected")
+    merged_profiles: list = []
+    merged_frames: list = []
+    for gw, payload in payloads:
+        summary = payload.get("summary") or {}
+        if not summary.get("enabled"):
+            click.echo(f"gateway {gw}: profiler OFF (set SKYPLANE_TPU_PROFILE_HZ on the gateway)")
+            continue
+        budget = core_budget(summary)
+        if budget is None:
+            click.echo(f"gateway {gw}: profiler armed but no samples yet")
+            continue
+        verdict = "YES" if budget["single_core_bound"] else "no"
+        click.echo(
+            f"gateway {gw}: {budget['cores_effective']:.2f} cores used, "
+            f"GIL wait {100.0 * budget['gil_wait_fraction']:.1f}% "
+            f"(cross-check {100.0 * budget['gil_wait_expected']:.1f}%), "
+            f"{budget['samples']} samples — single-core-bound: {verdict}"
+        )
+        for row in budget["top_stages"]:
+            click.echo(f"  {row['stage']:<12} {row['cpu_s']:>9.3f}s cpu")
+        for line in (payload.get("folded") or [])[: max(0, top)]:
+            click.echo(f"  {line}")
+        ss = payload.get("speedscope")
+        if ss and output:
+            # merge gateways into one speedscope file: per-gateway frame
+            # tables re-index into one shared table, profile names prefix
+            # the gateway id so threads stay attributable
+            base_idx = len(merged_frames)
+            merged_frames.extend(ss.get("shared", {}).get("frames", []))
+            for prof in ss.get("profiles", []):
+                shifted = dict(prof)
+                shifted["name"] = f"{gw}:{prof.get('name', '?')}"
+                shifted["samples"] = [[i + base_idx for i in s] for s in prof.get("samples", [])]
+                merged_profiles.append(shifted)
+    if output:
+        if not merged_profiles:
+            raise click.ClickException("nothing to write: no gateway returned profiler stacks")
+        doc = {
+            "$schema": "https://www.speedscope.app/file-format-schema.json",
+            "name": "skyplane-tpu flame",
+            "exporter": "skyplane-tpu-profiler",
+            "shared": {"frames": merged_frames},
+            "profiles": merged_profiles,
+        }
+        with open(output, "w") as f:
+            json_mod.dump(doc, f)
+        click.echo(f"wrote {output} ({len(merged_profiles)} thread track(s)); open it at https://www.speedscope.app")
 
 
 @main.command()
@@ -239,7 +340,13 @@ def monitor(urls, token, interval, once, count):
     import time as time_mod
 
     from skyplane_tpu.gateway.control_auth import control_session
-    from skyplane_tpu.obs.collector import GatewayTarget, TelemetryCollector, api_base_of, parse_prometheus
+    from skyplane_tpu.obs.collector import (
+        GatewayTarget,
+        TelemetryCollector,
+        api_base_of,
+        cpu_gil_cells,
+        parse_prometheus,
+    )
 
     targets = []
     for u in urls:
@@ -251,13 +358,16 @@ def monitor(urls, token, interval, once, count):
         except Exception:  # noqa: BLE001 — identity probe best-effort; collector marks it stale
             pass
         targets.append(GatewayTarget(gid, base, region=region, session_fn=lambda: control_session(token)))
-    collector = TelemetryCollector(targets, poll_interval_s=interval, label="monitor")
+    # cpu_every=1: the dashboard's CPU%/GIL% columns are scrape deltas — a
+    # thinned CPU cadence would smear them across poll intervals
+    collector = TelemetryCollector(targets, poll_interval_s=interval, label="monitor", cpu_every=1)
 
     def sample(name_sub: str, metrics: dict) -> float:
         return sum(v for k, v in metrics.items() if k.endswith(name_sub))
 
     prev: dict = {}
     prev_t: dict = {}
+    prev_cpu: dict = {}
     rounds = 0
     while True:
         collector.poll_once()
@@ -281,9 +391,16 @@ def monitor(urls, token, interval, once, count):
             refs = sample("datapath_ref_segments", metrics)
             hit = f"{100.0 * refs / segs:.1f}%" if segs else "-"
             tenants_n = len({lbl for name, lbl, _ in samples if name == "skyplane_tenant_bytes_delivered"})
+            # core-time columns (docs/observability.md "Core-time profiling"):
+            # CPU% from /telemetry cpu deltas, GIL% from the profiler summary
+            # — old gateways (404) and unarmed profilers render "—"
+            cpu_cell, gil_cell, cpu_now = cpu_gil_cells(st.cpu, prev_cpu.get(gid), dt, st.profile)
+            if cpu_now is not None:
+                prev_cpu[gid] = cpu_now
             lines.append(
                 f"  {gid:<24} {gbps:7.3f} Gbps   in-flight {inflight / 1e6:8.1f} MB   "
-                f"dedup hit {hit:>6}   nacks {int(sample('decode_decode_nacks', metrics))}"
+                f"dedup hit {hit:>6}   cpu {cpu_cell:>5}   gil {gil_cell:>4}   "
+                f"nacks {int(sample('decode_decode_nacks', metrics))}"
                 + (f"   tenants {tenants_n}" if tenants_n else "")
             )
         events = collector.fleet_events()[-8:]

@@ -236,6 +236,14 @@ class GatewayDaemon:
         self.metrics.register_provider("decode", self.receiver.decode_counters)
         self.metrics.register_provider("sender_wire", self._sender_wire_counters)
         self.metrics.register_provider("trace", lambda: get_tracer().counters())
+        # sampling profiler (docs/observability.md "Core-time profiling"):
+        # off by default (SKYPLANE_TPU_PROFILE_HZ=0 -> NOOP, ensure_started
+        # is a no-op); when armed, its sample/drop counters — including the
+        # profile.sample_stall degradation signal — ride the same scrape
+        from skyplane_tpu.obs import get_profiler
+
+        get_profiler().ensure_started()
+        self.metrics.register_provider("profile", lambda: get_profiler().counters())
         # flight-recorder health (docs/observability.md): recorded/dropped/
         # buffered event counts ride the same scrape as everything else
         from skyplane_tpu.obs import get_recorder

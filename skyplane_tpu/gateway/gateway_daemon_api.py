@@ -33,6 +33,11 @@ directly:
   GET  /api/v1/profile/decode              receiver decode-pool counters+events
   GET  /api/v1/profile/cpu                 per-thread CPU seconds (bottleneck
                                            attribution input)
+  GET  /api/v1/profile/stacks              sampling-profiler export: folded
+                                           stacks + speedscope JSON + the
+                                           core-budget summary
+                                           (SKYPLANE_TPU_PROFILE_HZ > 0;
+                                           ?summary=1 for the summary alone)
   GET  /api/v1/profile/locks               per-lock hold/contention ns + the
                                            observed lock-order graph
                                            (SKYPLANE_TPU_LOCKCHECK=1)
@@ -40,9 +45,10 @@ directly:
   GET  /api/v1/metrics                     Prometheus text exposition
   GET  /api/v1/events?since=<seq>          flight-recorder tail (bounded,
                                            seq-ordered fleet events)
-  GET  /api/v1/telemetry?since=<seq>&cpu=1 combined collector scrape: metrics
-                                           + trace + events (+ cpu) in ONE
-                                           round trip
+  GET  /api/v1/telemetry?since=<seq>&cpu=1&profile=1
+                                           combined collector scrape: metrics
+                                           + trace + events (+ cpu + profile
+                                           summary) in ONE round trip
 
 Completion accounting (the reference's most bug-prone logic, SURVEY §7 #6):
 an explicit per-chunk refcount of terminal-operator completions — a chunk is
@@ -473,6 +479,24 @@ class GatewayDaemonAPI:
                     "process_cpu_s": round(_time.process_time(), 6),
                 },
             )
+        elif path == "/api/v1/profile/stacks":
+            # sampling-profiler export (docs/observability.md "Core-time
+            # profiling"): folded stacks + speedscope JSON + the core-budget
+            # summary. Disabled -> enabled:false with empty tables, so the
+            # route is always scrape-safe; ?summary=1 skips the stack tables
+            # (the cheap form the collector's fallback path uses).
+            from skyplane_tpu.obs import get_profiler
+
+            prof = get_profiler()
+            payload = {
+                "gateway_id": self.gateway_id,
+                "region": self.region,
+                "summary": prof.summary(),
+            }
+            if query.get("summary") != ["1"]:
+                payload["folded"] = prof.folded()
+                payload["speedscope"] = prof.speedscope()
+            req._send(200, payload)
         elif path == "/api/v1/profile/locks":
             # lock hold/contention profile + the observed acquisition-order
             # graph from the runtime witness (SKYPLANE_TPU_LOCKCHECK=1;
@@ -523,6 +547,13 @@ class GatewayDaemonAPI:
                     "threads": thread_cpu_seconds(),
                     "process_cpu_s": round(_time.process_time(), 6),
                 }
+            if query.get("profile") == ["1"]:
+                # core-budget summary only (stage CPU seconds, GIL wait,
+                # cores_effective) — the full stack tables stay behind
+                # /profile/stacks so the per-interval scrape stays small
+                from skyplane_tpu.obs import get_profiler
+
+                payload["profile"] = get_profiler().summary()
             req._send(200, payload)
         elif path == "/api/v1/trace":
             # Chrome trace-event JSON from the process tracer: loads directly

@@ -34,6 +34,7 @@ one timeline keyed by the chunk id (docs/observability.md).
 
 from skyplane_tpu.obs.events import FlightRecorder, configure_recorder, get_recorder
 from skyplane_tpu.obs.metrics import MetricsRegistry, get_registry
+from skyplane_tpu.obs.profiler import NOOP_PROFILER, StackProfiler, configure_profiler, get_profiler
 from skyplane_tpu.obs.tracer import NOOP_SPAN, Tracer, configure_tracer, get_tracer
 
 # NOTE: skyplane_tpu.obs.collector (the fleet TelemetryCollector) is imported
@@ -43,10 +44,14 @@ from skyplane_tpu.obs.tracer import NOOP_SPAN, Tracer, configure_tracer, get_tra
 __all__ = [
     "FlightRecorder",
     "MetricsRegistry",
+    "NOOP_PROFILER",
     "NOOP_SPAN",
+    "StackProfiler",
     "Tracer",
+    "configure_profiler",
     "configure_recorder",
     "configure_tracer",
+    "get_profiler",
     "get_recorder",
     "get_registry",
     "get_tracer",

@@ -62,6 +62,15 @@ _SPAN_TO_STAGE = {v: k for k, v in STAGE_SPANS.items()}
 # '1.5e-05' (negative exponent) or 'NaN'
 _PROM_SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
 
+#: a gateway is "single-core-bound" when its process effectively uses no more
+#: than this many cores while demand is visible (GIL wait, or the one core
+#: near saturation) — the verdict ROADMAP item 1's multi-core pump is judged
+#: against (docs/benchmark.md "single-core ceiling")
+SINGLE_CORE_CEILING = 1.25
+#: GIL wait above this fraction marks contention as the reason threads fail
+#: to scale (vs genuinely idle)
+GIL_BOUND_FRACTION = 0.2
+
 
 # --------------------------------------------------------------- attribution
 
@@ -101,11 +110,46 @@ def stage_breakdown(events: Sequence[dict]) -> Dict[str, dict]:
     return out
 
 
-def bottleneck_report(merged_trace: dict, cpu_profiles: Optional[Dict[str, dict]] = None) -> dict:
+def core_budget(summary: Optional[dict]) -> Optional[dict]:
+    """One gateway's core-time budget from a profiler ``summary()`` payload
+    (obs/profiler.py): cores effectively used, GIL-wait fraction, the top-5
+    stages by CPU seconds, and the single-core-bound verdict. ``None`` when
+    the profiler is off or has no samples yet (graceful on old gateways)."""
+    if not isinstance(summary, dict) or not summary.get("samples"):
+        return None
+    stage_cpu = summary.get("stage_cpu_s") or {}
+    top = sorted(((s, v) for s, v in stage_cpu.items() if v > 0), key=lambda kv: -kv[1])[:5]
+    cores = float(summary.get("cores_effective") or 0.0)
+    gil = float(summary.get("gil_wait_fraction") or 0.0)
+    # single-core-bound = the process cannot use a second core: it burns at
+    # most ~one core AND either threads visibly serialize on the GIL or that
+    # one core is near saturation (a mostly-idle process is I/O-bound, not
+    # core-bound — adding cores would not help either, but for a different,
+    # non-actionable reason, so the verdict stays False)
+    single = cores <= SINGLE_CORE_CEILING and (gil >= GIL_BOUND_FRACTION or cores >= 0.75)
+    return {
+        "cores_effective": cores,
+        "gil_wait_fraction": gil,
+        "gil_wait_expected": float(summary.get("gil_wait_expected") or 0.0),
+        "runnable_threads": float(summary.get("runnable_threads") or 0.0),
+        "top_stages": [{"stage": s, "cpu_s": round(v, 4)} for s, v in top],
+        "single_core_bound": bool(single),
+        "samples": int(summary.get("samples") or 0),
+        "samples_dropped": int(summary.get("samples_dropped") or 0),
+        "cpu_clock": summary.get("cpu_clock") or "task",
+    }
+
+
+def bottleneck_report(
+    merged_trace: dict,
+    cpu_profiles: Optional[Dict[str, dict]] = None,
+    profile_summaries: Optional[Dict[str, dict]] = None,
+) -> dict:
     """The per-transfer "where did the time go" attribution: fleet-wide and
     per-gateway stage breakdowns from a (merged) trace, plus per-gateway
     per-thread CPU seconds when ``/profile/cpu`` scrapes are supplied
-    (``{gateway_id: cpu_payload}``)."""
+    (``{gateway_id: cpu_payload}``) and the core-budget table when sampling
+    profiles are (``{gateway_id: profiler summary}``)."""
     events = merged_trace.get("traceEvents", [])
     spans = [e for e in events if e.get("ph") in ("X", "b")]
     # a merged timeline already assigned every event a per-gateway pid; use
@@ -121,13 +165,21 @@ def bottleneck_report(merged_trace: dict, cpu_profiles: Optional[Dict[str, dict]
     chunk_ids = {(e.get("args") or {}).get("chunk_id") for e in spans}
     chunk_ids.discard(None)
     per_gateway = {}
-    for gw, evs in sorted(by_gateway.items()):
+    # profile summaries may cover gateways whose spans never reached this
+    # trace (sampling off / trace ring overwritten): the core-budget table
+    # must still show them, so union the two key sets
+    all_gateways = set(by_gateway) | set(profile_summaries or {})
+    for gw in sorted(all_gateways):
+        evs = by_gateway.get(gw, [])
         entry = {"stages": stage_breakdown(evs), "spans": len(evs)}
         cpu = (cpu_profiles or {}).get(gw)
         if cpu:
             threads = cpu.get("threads") or {}
             entry["cpu_s"] = {name: info.get("cpu_s", 0.0) for name, info in sorted(threads.items())}
             entry["cpu_total_s"] = round(sum(entry["cpu_s"].values()), 6)
+        budget = core_budget((profile_summaries or {}).get(gw))
+        if budget is not None:
+            entry["core_budget"] = budget
         per_gateway[gw] = entry
     return {
         "stages": stage_breakdown(spans),
@@ -164,7 +216,41 @@ def format_bottleneck(report: dict) -> str:
             lines.append(f"  thread cpu ({entry.get('cpu_total_s', 0.0):.3f}s total):")
             for name, s in sorted(cpu.items(), key=lambda kv: -kv[1])[:12]:
                 lines.append(f"    {name:<28} {s:>9.3f}s")
+        budget = entry.get("core_budget")
+        if budget:
+            verdict = "YES" if budget["single_core_bound"] else "no"
+            lines.append(
+                f"  core budget: {budget['cores_effective']:.2f} cores used, "
+                f"GIL wait {100.0 * budget['gil_wait_fraction']:.1f}%, "
+                f"single-core-bound: {verdict}"
+                + (f" ({budget['samples_dropped']} samples dropped)" if budget["samples_dropped"] else "")
+            )
+            if budget["top_stages"]:
+                tops = ", ".join(f"{r['stage']} {r['cpu_s']:.3f}s" for r in budget["top_stages"])
+                lines.append(f"    top CPU stages: {tops}")
     return "\n".join(lines)
+
+
+def cpu_gil_cells(
+    cpu_payload: Optional[dict],
+    prev_cpu_s: Optional[float],
+    dt_s: float,
+    profile_summary: Optional[dict],
+) -> Tuple[str, str, Optional[float]]:
+    """The ``skyplane-tpu monitor`` CPU%/GIL-wait% cells for one gateway row:
+    ``(cpu_cell, gil_cell, process_cpu_s_now)``. CPU% is the process-CPU
+    delta between scrapes over the scrape interval (may exceed 100% — that's
+    cores); GIL% comes from the profiler summary. Either source missing (old
+    gateway 404, profiler off, first scrape) renders a graceful ``—``."""
+    cpu_cell, cpu_now = "—", None
+    if isinstance(cpu_payload, dict) and isinstance(cpu_payload.get("process_cpu_s"), (int, float)):
+        cpu_now = float(cpu_payload["process_cpu_s"])
+        if prev_cpu_s is not None and dt_s > 0:
+            cpu_cell = f"{100.0 * max(0.0, cpu_now - prev_cpu_s) / dt_s:.0f}%"
+    gil_cell = "—"
+    if isinstance(profile_summary, dict) and profile_summary.get("samples"):
+        gil_cell = f"{100.0 * float(profile_summary.get('gil_wait_fraction') or 0.0):.0f}%"
+    return cpu_cell, gil_cell, cpu_now
 
 
 # ------------------------------------------------------------- trace merging
@@ -396,6 +482,7 @@ class _TargetState:
         "metrics_text",
         "trace",
         "cpu",
+        "profile",
         "recoveries",
         "combined",
     )
@@ -408,6 +495,7 @@ class _TargetState:
         self.metrics_text: Optional[str] = None
         self.trace: Optional[dict] = None
         self.cpu: Optional[dict] = None
+        self.profile: Optional[dict] = None  # sampling-profiler summary (core budget)
         self.recoveries = 0
         self.combined = True  # /api/v1/telemetry supported (cleared on 404)
 
@@ -542,14 +630,20 @@ class TelemetryCollector:
         try:
             session = t.session()
             timeout = self.scrape_timeout_s
-            metrics_text = trace_payload = events_payload = cpu_payload = None
+            metrics_text = trace_payload = events_payload = cpu_payload = profile_payload = None
             if state.combined:
                 # ONE round trip per gateway per wave (GET /api/v1/telemetry):
                 # per-request HTTP machinery costs more CPU than the payloads,
                 # and the <2% collector budget is spent on round trips
                 resp = session.get(
                     f"{t.api_base}/telemetry",
-                    params={"since": str(state.events_since), "cpu": "1" if want_cpu else "0"},
+                    params={
+                        "since": str(state.events_since),
+                        "cpu": "1" if want_cpu else "0",
+                        # the profiler summary rides the CPU cadence: both
+                        # answer "where do the cores go" and move slowly
+                        "profile": "1" if want_cpu else "0",
+                    },
                     timeout=timeout,
                 )
                 if resp.status_code == 404:
@@ -561,6 +655,7 @@ class TelemetryCollector:
                     trace_payload = payload.get("trace")
                     events_payload = payload.get("events") or {}
                     cpu_payload = payload.get("cpu")
+                    profile_payload = payload.get("profile")
             if metrics_text is None:
                 metrics = session.get(f"{t.api_base}/metrics", timeout=timeout)
                 metrics.raise_for_status()
@@ -579,6 +674,16 @@ class TelemetryCollector:
                         if cpu.ok:
                             cpu_payload = cpu.json()
                     except Exception:  # noqa: BLE001 - cpu profile is additive, never gating
+                        pass
+                    try:
+                        # summary-only form: old gateways 404 here and the
+                        # scrape stays whole — core-budget columns render "—"
+                        stacks = session.get(
+                            f"{t.api_base}/profile/stacks", params={"summary": "1"}, timeout=timeout
+                        )
+                        if stacks.ok:
+                            profile_payload = (stacks.json() or {}).get("summary")
+                    except Exception:  # noqa: BLE001 - profiler summary is additive, never gating
                         pass
         except Exception as e:  # noqa: BLE001 - any scrape failure is a liveness signal, not a crash
             with self._lock:
@@ -601,6 +706,8 @@ class TelemetryCollector:
             state.trace = trace_payload
             if cpu_payload is not None:
                 state.cpu = cpu_payload
+            if profile_payload is not None:
+                state.profile = profile_payload
             self._counters["collector_scrapes"] += 1
         self._ingest_events(
             events_payload.get("recorder") or t.gateway_id,
@@ -689,8 +796,14 @@ class TelemetryCollector:
         with self._lock:
             return {gid: s.cpu for gid, s in self._states.items() if s.cpu is not None}
 
+    def profile_summaries(self) -> Dict[str, dict]:
+        """Per-gateway sampling-profiler summaries (core-budget input); only
+        gateways with the profiler armed AND the new route appear."""
+        with self._lock:
+            return {gid: s.profile for gid, s in self._states.items() if s.profile is not None}
+
     def bottleneck(self) -> dict:
-        return bottleneck_report(self.merged_trace(), self.cpu_profiles())
+        return bottleneck_report(self.merged_trace(), self.cpu_profiles(), self.profile_summaries())
 
     def stale_gateways(self) -> List[str]:
         with self._lock:

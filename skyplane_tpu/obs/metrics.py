@@ -311,50 +311,70 @@ def open_fd_count() -> int:
         return -1
 
 
-def thread_cpu_seconds() -> Dict[str, dict]:
+def thread_cpu_by_tid(task_dir: str = "/proc/self/task") -> Dict[int, float]:
+    """Per-kernel-thread CPU seconds ``{tid: utime+stime}`` from
+    ``/proc/self/task/<tid>/stat``. Empty when /proc is unavailable (macOS,
+    sandboxes) — callers treat an empty map as a degraded CPU clock. This is
+    the sampling profiler's on-CPU/off-CPU input (obs/profiler.py), kept
+    separate from :func:`thread_cpu_seconds` so the sampler never pays the
+    Python-thread name mapping per tick."""
+    import os
+
+    out: Dict[int, float] = {}
+    try:
+        tick = float(os.sysconf("SC_CLK_TCK"))
+        tids = os.listdir(task_dir)
+    except (OSError, ValueError, AttributeError):
+        return out
+    for tid_s in tids:
+        try:
+            with open(f"{task_dir}/{tid_s}/stat", "rb") as f:
+                raw = f.read().decode(errors="replace")
+            tid = int(tid_s)
+        except (OSError, ValueError):
+            continue  # thread exited between listdir and read / non-tid entry
+        # comm may contain spaces/parens: fields 14/15 (utime/stime) are
+        # counted from AFTER the last ')'
+        rest = raw.rpartition(")")[2].split()
+        if len(rest) < 13:
+            continue
+        out[tid] = (int(rest[11]) + int(rest[12])) / tick
+    return out
+
+
+def thread_cpu_seconds(task_dir: str = "/proc/self/task") -> Dict[str, dict]:
     """Per-thread CPU seconds of this process, keyed by Python thread name
     (``GET /api/v1/profile/cpu``; the bottleneck report's CPU-attribution
     input, docs/observability.md).
 
-    Linux: reads utime+stime from ``/proc/self/task/<tid>/stat`` and maps the
-    kernel tid back to a Python thread via ``Thread.native_id`` — the only
-    way to observe EVERY thread's CPU clock, since ``time.thread_time()``
-    measures only its caller. Non-Python threads (and any tid that raced
-    thread exit) appear as ``tid-<n>``. Elsewhere: degrades to the calling
-    thread's ``time.thread_time()`` so the schema never vanishes.
+    Fallback ladder (each rung keeps the schema alive, tested in
+    tests/unit/test_profiler.py):
+
+      1. Linux: utime+stime per ``/proc/self/task/<tid>/stat`` via
+         :func:`thread_cpu_by_tid`, tids mapped back to Python threads via
+         ``Thread.native_id`` — the only way to observe EVERY thread's CPU
+         clock, since ``time.thread_time()`` measures only its caller.
+      2. ``native_id`` missing on a thread (exotic platforms / stub threads):
+         its tid row survives as ``tid-<n>`` instead of vanishing.
+      3. ``task_dir`` unreadable (no /proc at all): degrades to the calling
+         thread's ``time.thread_time()`` so the schema never vanishes.
     """
-    import os
     import threading
     import time
 
+    by_tid = thread_cpu_by_tid(task_dir)
+    if not by_tid:
+        return {threading.current_thread().name: {"tid": -1, "cpu_s": round(time.thread_time(), 6)}}
     names: Dict[int, str] = {}
     for t in threading.enumerate():
         nid = getattr(t, "native_id", None)
         if nid is not None:
             names[nid] = t.name
     out: Dict[str, dict] = {}
-    try:
-        tick = float(os.sysconf("SC_CLK_TCK"))
-        tids = os.listdir("/proc/self/task")
-    except (OSError, ValueError, AttributeError):
-        out[threading.current_thread().name] = {"tid": -1, "cpu_s": round(time.thread_time(), 6)}
-        return out
-    for tid_s in tids:
-        try:
-            with open(f"/proc/self/task/{tid_s}/stat", "rb") as f:
-                raw = f.read().decode(errors="replace")
-        except OSError:
-            continue  # thread exited between listdir and read
-        # comm may contain spaces/parens: fields 14/15 (utime/stime) are
-        # counted from AFTER the last ')'
-        rest = raw.rpartition(")")[2].split()
-        if len(rest) < 13:
-            continue
-        cpu_s = (int(rest[11]) + int(rest[12])) / tick
-        tid = int(tid_s)
+    for tid in sorted(by_tid):
         name = names.get(tid, f"tid-{tid}")
         key = name if name not in out else f"{name}#{tid}"  # duplicate names stay distinct
-        out[key] = {"tid": tid, "cpu_s": round(cpu_s, 6)}
+        out[key] = {"tid": tid, "cpu_s": round(by_tid[tid], 6)}
     return out
 
 

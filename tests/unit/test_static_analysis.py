@@ -681,6 +681,94 @@ class DebugSpan:
     assert all(f.suppressed for f in run_source(src) if f.rule == "blocking-io-in-span")
 
 
+# ------------------------------------------------- frame-walk safety rule
+
+
+def test_frame_walk_under_lock_fires_when_snapshot_taken_under_lock():
+    """The sampler-deadlock bug class (obs/profiler.py): snapshotting
+    sys._current_frames() while holding a lock — a walked thread blocked on
+    that same lock wedges the process the profiler observes. Import aliases
+    must not dodge the match."""
+    src = """
+import sys, threading
+class Sampler:
+    def snap(self):
+        with self._lock:
+            return dict(sys._current_frames())
+"""
+    assert "frame-walk-under-lock" in rules_of(src)
+    aliased = """
+from sys import _current_frames as cf
+class Sampler:
+    def snap(self):
+        with self._lock:
+            return cf()
+"""
+    assert "frame-walk-under-lock" in rules_of(aliased)
+
+
+def test_frame_walk_under_lock_fires_on_lock_and_callback_inside_walk():
+    """Inside the walk loop: taking a lock per walked thread, or invoking a
+    non-local callback (caller-supplied parameter / on_* attribute), runs
+    blocking or arbitrary code inside the most delicate loop in the process."""
+    lock_in_walk = """
+import sys
+class Sampler:
+    def walk(self):
+        for tid, frame in sys._current_frames().items():
+            with self._lock:
+                self.table[tid] = frame
+"""
+    assert "frame-walk-under-lock" in rules_of(lock_in_walk)
+    cb_param = """
+import sys
+def walk(callback):
+    for tid, frame in sys._current_frames().items():
+        callback(tid, frame)
+"""
+    assert "frame-walk-under-lock" in rules_of(cb_param)
+    cb_attr = """
+import threading
+class Sampler:
+    def walk(self):
+        for t in threading.enumerate():
+            self.on_sample(t)
+"""
+    assert "frame-walk-under-lock" in rules_of(cb_attr)
+
+
+def test_frame_walk_under_lock_quiet_on_snapshot_then_merge():
+    """The safe pattern the profiler uses: snapshot first, fold into LOCAL
+    aggregates with pure operations, merge under the lock AFTER the walk.
+    Reading thread attributes in the walk (thread_cpu_seconds) is clean too."""
+    src = """
+import sys, threading
+class Sampler:
+    def sample_once(self):
+        frames = sys._current_frames()
+        rows = []
+        for tid, frame in frames.items():
+            rows.append((tid, frame.f_code.co_name))
+        names = {}
+        for t in threading.enumerate():
+            names[t.ident] = t.name
+        with self._lock:
+            self._merge(rows, names)
+"""
+    assert "frame-walk-under-lock" not in rules_of(src)
+
+
+def test_frame_walk_under_lock_suppressible():
+    src = """
+import sys
+class Sampler:
+    def snap(self):
+        with self._lock:
+            return dict(sys._current_frames())  # sklint: disable=frame-walk-under-lock -- shutdown-only path, all threads parked
+"""
+    assert all(f.suppressed for f in run_source(src) if f.rule == "frame-walk-under-lock")
+
+
 # ------------------------------------------------------------ tracer rules
 
 
