@@ -9,6 +9,12 @@ multi-core gateway VM; if Gbps stops scaling with workers while cores idle,
 the GIL is the ceiling and the pump should move to processes (reference uses
 one process per sender connection / receiver socket).
 
+That process-model pump now exists: ``SKYPLANE_TPU_PUMP_PROCS=N``
+(gateway/pump.py, docs/datapath-performance.md "Multi-process pump") shards
+the same stack across worker processes — export it before running this
+sweep to measure the sharded plane, and see ``bench.py``'s
+``wire_gbps_by_procs`` for the gated 1/2/4-proc scaling curve.
+
 Usage:
     python scripts/bench_pump.py [--sizes-mb 256] [--chunk-mb 4] \
         [--workers 1,2,4,8] [--tls] [--json]

@@ -44,6 +44,9 @@ REQUIRED_TOP = (
     "stage_latency_us",
     "trace_overhead_pct",
     "cpu_breakdown",
+    "wire_gbps_by_procs",
+    "pump_cores_available",
+    "pump_cores_effective",
 )
 # trace-derived per-stage latency breakdown (bench.py TRACE_STAGES /
 # docs/observability.md): a future perf PR proves WHERE it moved time
@@ -79,6 +82,15 @@ REQUIRED_CPU_STAGES = (
 # acceptance bound (ISSUE 12): the sampler's measured steady-state cost at
 # the configured rate may consume at most this share of ONE core
 MAX_PROFILE_OVERHEAD_PCT = 2.0
+# multi-process pump scaling (gateway/pump.py, docs/benchmark.md "Gbps vs
+# pump processes"): the proc counts bench.py sweeps, the measurement-noise
+# tolerance on the monotonicity requirement, the throughput floor at 4 procs
+# on runners with >= 4 cores, and the cores-effective floor that proves the
+# single-core ceiling actually broke (ISSUE 13 acceptance)
+PUMP_PROC_KEYS = ("1", "2", "4")
+PUMP_MONOTONIC_TOLERANCE = 0.85
+MIN_PUMP_GBPS_AT_4 = 2.0
+MIN_PUMP_CORES_EFFECTIVE = 1.5
 REQUIRED_COUNTERS = (
     "pool_hit_rate",
     "pool_hits",
@@ -187,6 +199,19 @@ REQUIRED_CHAOS = (
     "replan_applied_events",
     "replan_retargeted_ops",
     "replan_stream_retargets",
+    # multi-process pump scenario (gateway/pump.py, docs/fault-injection.md
+    # pump.worker_crash): worker killed mid-transfer -> respawn + uncounted
+    # requeue, byte-identical corpus, zero acked-chunk loss, zero duplicate
+    # registrations at the sink
+    "pump_ok",
+    "pump_procs",
+    "pump_worker_deaths",
+    "pump_respawns",
+    "pump_requeued_chunks",
+    "pump_byte_identical",
+    "pump_acked_chunks_lost",
+    "pump_duplicate_registrations",
+    "pump_seconds",
 )
 #: post-recovery completion rate must reach this fraction of the pre-kill
 #: rate once the replacement joins ("within 20%" of pre-kill throughput)
@@ -411,6 +436,31 @@ def check_chaos(result: dict) -> int:
     if result["replan_stream_retargets"] < 1:
         print("chaos-smoke: replan applied but no wire stream performed a cutover reset", file=sys.stderr)
         return 1
+    if result["pump_ok"] is not True:
+        print(
+            "chaos-smoke: pump worker-crash scenario failed — "
+            f"deaths={result.get('pump_worker_deaths')} respawns={result.get('pump_respawns')} "
+            f"byte_identical={result.get('pump_byte_identical')} "
+            f"acked_lost={result.get('pump_acked_chunks_lost')} "
+            f"dup_registrations={result.get('pump_duplicate_registrations')} "
+            f"error={result.get('pump_error')}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["pump_worker_deaths"] < 1 or result["pump_respawns"] < 1:
+        print(
+            f"chaos-smoke: pump scenario was vacuous — {result['pump_worker_deaths']} death(s), "
+            f"{result['pump_respawns']} respawn(s); the crash fault never fired",
+            file=sys.stderr,
+        )
+        return 1
+    if result["pump_acked_chunks_lost"] != 0 or result["pump_duplicate_registrations"] != 0:
+        print(
+            f"chaos-smoke: pump accounting broke — {result['pump_acked_chunks_lost']} acked chunk(s) lost, "
+            f"{result['pump_duplicate_registrations']} duplicate sink registration(s)",
+            file=sys.stderr,
+        )
+        return 1
     overhead = result["lockcheck_overhead_pct"]
     if not isinstance(overhead, (int, float)) or overhead < 0 or overhead >= MAX_LOCKCHECK_OVERHEAD_PCT:
         print(
@@ -450,7 +500,9 @@ def check_chaos(result: dict) -> int:
         f"repair loop: replacement ready {result['replacement_detect_to_ready_seconds']}s after detection "
         f"({result['replacement_resharded_chunks']} chunk(s) re-sharded, recovery {ratio}x pre-kill), "
         f"drain {result['drain_seconds']}s/{result['drain_deadline_s']}s with 0 acked chunks lost, "
-        f"{result['replan_applied_events']} replan(s) applied over {result['replan_stream_retargets']} stream cutover(s)"
+        f"{result['replan_applied_events']} replan(s) applied over {result['replan_stream_retargets']} stream cutover(s); "
+        f"pump: {result['pump_worker_deaths']} worker crash(es) absorbed in {result['pump_seconds']}s "
+        f"({result['pump_respawns']} respawn(s), {result['pump_requeued_chunks']} chunk(s) requeued, byte-identical)"
         + (
             f"; lockcheck: {result['lockcheck_acquisitions']} acquisitions over "
             f"{result['lockcheck_locks']} locks, {result['lockcheck_edges']} order edge(s) acyclic, "
@@ -631,13 +683,68 @@ def main(argv) -> int:
             file=sys.stderr,
         )
         return 1
+    # multi-process pump scaling gates (ISSUE 13, docs/benchmark.md): every
+    # swept proc count must report a positive Gbps; on runners with the
+    # cores to show it, scaling must be monotonic (within measurement
+    # tolerance), clear the 2 Gbps floor at 4 procs, and the merged
+    # parent+worker profile must prove > 1.5 cores effectively used.
+    # Small runners (pump_cores_available < 4) downgrade gracefully to the
+    # schema + sanity checks — a 1-core container cannot demonstrate scaling.
+    pump_g = result["wire_gbps_by_procs"]
+    if not isinstance(pump_g, dict):
+        print(f"bench-smoke: wire_gbps_by_procs must be a dict, got {pump_g!r}", file=sys.stderr)
+        return 1
+    missing_pump = [k for k in PUMP_PROC_KEYS if k not in pump_g]
+    if missing_pump:
+        print(f"bench-smoke: wire_gbps_by_procs missing proc counts: {missing_pump}", file=sys.stderr)
+        return 1
+    bad_pump = {k: pump_g[k] for k in PUMP_PROC_KEYS if not isinstance(pump_g[k], (int, float)) or pump_g[k] <= 0}
+    if bad_pump:
+        print(f"bench-smoke: implausible pump throughput(s): {bad_pump}", file=sys.stderr)
+        return 1
+    pump_cores = result["pump_cores_available"]
+    pump_note = f"(cores_available={pump_cores}: scaling gates downgraded)"
+    if isinstance(pump_cores, (int, float)) and pump_cores >= 2:
+        if pump_g["2"] < PUMP_MONOTONIC_TOLERANCE * pump_g["1"]:
+            print(
+                f"bench-smoke: pump throughput regressed 1->2 procs ({pump_g['1']} -> {pump_g['2']} Gbps) "
+                f"on a {pump_cores}-core runner",
+                file=sys.stderr,
+            )
+            return 1
+        pump_note = f"(cores_available={pump_cores}: 4-proc gates downgraded)"
+    if isinstance(pump_cores, (int, float)) and pump_cores >= 4:
+        if pump_g["4"] < PUMP_MONOTONIC_TOLERANCE * pump_g["2"]:
+            print(
+                f"bench-smoke: pump throughput regressed 2->4 procs ({pump_g['2']} -> {pump_g['4']} Gbps) "
+                f"on a {pump_cores}-core runner",
+                file=sys.stderr,
+            )
+            return 1
+        if pump_g["4"] < MIN_PUMP_GBPS_AT_4:
+            print(
+                f"bench-smoke: pump throughput at 4 procs is {pump_g['4']} Gbps, below the "
+                f"{MIN_PUMP_GBPS_AT_4} Gbps acceptance floor (cores_available={pump_cores})",
+                file=sys.stderr,
+            )
+            return 1
+        eff = result["pump_cores_effective"]
+        if not isinstance(eff, (int, float)) or eff <= MIN_PUMP_CORES_EFFECTIVE:
+            print(
+                f"bench-smoke: merged pump cores_effective {eff!r} does not clear the "
+                f"{MIN_PUMP_CORES_EFFECTIVE} floor — the single-core ceiling did not break",
+                file=sys.stderr,
+            )
+            return 1
+        pump_note = f"(cores_available={pump_cores}, cores_effective={result['pump_cores_effective']})"
     print(
         f"bench-smoke OK: {result['value']} {result['unit']} encode, "
         f"{result['decode_gbps']} {result['unit']} decode on {result['platform']} "
         f"(device {result['device']}); wire: {wire['frames_pipelined']} frames pipelined, "
         f"stall {wire['wire_stall_ns_per_window']}ns/window vs serial drain {wire['serial_drain_ns_per_window']}ns/window; "
         f"trace overhead {overhead}%; cpu profile: {cpu['profile_samples']} samples, "
-        f"{cores} cores effective, GIL wait {round(100.0 * gil, 1)}%, sampler overhead {p_overhead}%"
+        f"{cores} cores effective, GIL wait {round(100.0 * gil, 1)}%, sampler overhead {p_overhead}%; "
+        f"pump: {pump_g} Gbps by procs {pump_note}"
     )
     return 0
 

@@ -61,7 +61,7 @@ fi
 # not block device profiling.
 SKYPLANE_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu \
   SKYPLANE_BENCH_CHUNK_MB=1 SKYPLANE_BENCH_SNAPSHOTS=2 SKYPLANE_BENCH_SNAP_CHUNKS=2 SKYPLANE_BENCH_REPS=1 \
-  SKYPLANE_BENCH_DECODE_WORKERS=4 \
+  SKYPLANE_BENCH_DECODE_WORKERS=4 SKYPLANE_BENCH_PUMP_MB=4 \
   SKYPLANE_BENCH_TRACE_OUT="$LOGDIR/trace_smoke.json" \
   SKYPLANE_BENCH_PROFILE_OUT="$LOGDIR/profile_smoke.speedscope.json" \
   python bench.py >"$LOGDIR/bench_smoke.out" 2>"$LOGDIR/bench_smoke.err"
@@ -213,6 +213,24 @@ if [ "$LOCKCHECK_RC" -ne 0 ]; then
   echo "[devloop] LOCKCHECK-SMOKE FAILURE (rc=$LOCKCHECK_RC) — lock-order cycle, witness overhead, or chaos gates regressed under SKYPLANE_TPU_LOCKCHECK=1; see $LOGDIR/lockcheck_smoke.err" >>"$LOGDIR/devloop.log"
 else
   echo "[devloop] lockcheck-smoke clean; result at $LOGDIR/lockcheck_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
+# Pump-smoke gate (CPU-only, minutes): the tier-1 integration suite rerun
+# with the multi-process byte pump armed (SKYPLANE_TPU_PUMP_PROCS=2,
+# gateway/pump.py, docs/datapath-performance.md "Multi-process pump") — the
+# full data plane must behave identically when receiver decode and sender
+# framing/wire work shard across spawn-context worker processes: fd-passed
+# sockets, control-channel chunk accounting, worker telemetry muxing. A
+# regression here (stranded chunk, double accounting, worker wedge) is the
+# class of bug only the end-to-end suite catches. Like the other smokes:
+# failures are logged LOUDLY but do not block device profiling.
+JAX_PLATFORMS=cpu SKYPLANE_TPU_PUMP_PROCS=2 python -m pytest -q -m 'not slow' -p no:cacheprovider \
+  tests/integration >"$LOGDIR/pump_tests.out" 2>&1
+PUMP_RC=$?
+if [ "$PUMP_RC" -ne 0 ]; then
+  echo "[devloop] PUMP-SMOKE FAILURE (rc=$PUMP_RC) — integration suite regressed under SKYPLANE_TPU_PUMP_PROCS=2; see $LOGDIR/pump_tests.out" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] pump-smoke clean; report at $LOGDIR/pump_tests.out" >>"$LOGDIR/devloop.log"
 fi
 
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only

@@ -809,6 +809,84 @@ def run_replan_scenario(base: Path, seed: int) -> dict:
 _PER_ACQUIRE_NS: list = []
 
 
+def run_pump_scenario(base: Path, seed: int) -> dict:
+    """Multi-process pump chaos (docs/datapath-performance.md "Multi-process
+    pump"): run a loopback transfer with SKYPLANE_TPU_PUMP_PROCS=2 and the
+    ``pump.worker_crash`` fault armed (p=1, after=2, max_fires=1 — each
+    FIRST-generation worker exits hard at its third fault evaluation, mid-
+    transfer). The parent daemons must respawn replacements and requeue the
+    dead workers' outstanding chunks UNCOUNTED; the run passes only when the
+    destination corpus is byte-identical, every chunk completes exactly once
+    (zero duplicate registrations at the sink), zero acked chunks are lost,
+    and at least one worker actually died and was respawned."""
+    plan = {"seed": seed, "points": {"pump.worker_crash": {"p": 1.0, "after": 2, "max_fires": 1}}}
+    saved = {k: os.environ.get(k) for k in (FAULTS_ENV, "SKYPLANE_TPU_PUMP_PROCS")}
+    os.environ[FAULTS_ENV] = json.dumps(plan)  # inherited by the spawn workers
+    os.environ["SKYPLANE_TPU_PUMP_PROCS"] = "2"
+    chunk_bytes = 256 << 10
+    n_chunks = 24
+    payload = np.random.default_rng(seed + 5).integers(0, 256, chunk_bytes * n_chunks, dtype=np.uint8).tobytes()
+    tmp = base / "pump"
+    tmp.mkdir()
+    src_file = tmp / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp / "out" / "corpus.bin"
+    out = {
+        "pump_ok": False,
+        "pump_procs": 2,
+        "pump_worker_deaths": 0,
+        "pump_respawns": 0,
+        "pump_requeued_chunks": 0,
+        "pump_byte_identical": False,
+        "pump_acked_chunks_lost": -1,
+        "pump_duplicate_registrations": -1,
+        "pump_seconds": None,
+    }
+    src = dst = None
+    try:
+        src, dst = make_pair(tmp, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+        t0 = time.monotonic()
+        ids = dispatch_with_retry(src, src_file, out_file, chunk_bytes, tenant_id=None)
+        wait_complete(src, ids, timeout=180)
+        wait_complete(dst, ids, timeout=180)
+        out["pump_seconds"] = round(time.monotonic() - t0, 3)
+        time.sleep(0.5)  # let the final pump counter pushes land
+        c_src, c_dst = src.daemon._pump_counters(), dst.daemon._pump_counters()
+        out["pump_worker_deaths"] = c_src["worker_deaths"] + c_dst["worker_deaths"]
+        out["pump_respawns"] = c_src["worker_respawns"] + c_dst["worker_respawns"]
+        out["pump_requeued_chunks"] = c_src["chunks_requeued_on_death"] + c_dst["chunks_requeued_on_death"]
+        out["pump_byte_identical"] = out_file.read_bytes() == payload
+        # acked-chunk truth: every dispatched chunk must read complete at
+        # BOTH gateways (a crash may never regress a completed chunk), and
+        # the sink must hold exactly one registration per chunk id even
+        # though death-requeued chunks re-registered on their retry pass
+        status = dst.get("chunk_status_log", timeout=30).json()["chunk_status"]
+        out["pump_acked_chunks_lost"] = sum(1 for cid in ids if status.get(cid) != "complete")
+        sink_regs = dst.get("chunk_requests", timeout=30).json()["chunk_requests"]
+        reg_ids = [r["chunk"]["chunk_id"] for r in sink_regs]
+        out["pump_duplicate_registrations"] = len(reg_ids) - len(set(reg_ids))
+        out["pump_ok"] = bool(
+            out["pump_byte_identical"]
+            and out["pump_worker_deaths"] >= 1
+            and out["pump_respawns"] >= 1
+            and out["pump_acked_chunks_lost"] == 0
+            and out["pump_duplicate_registrations"] == 0
+        )
+    except (RuntimeError, TimeoutError, requests.RequestException) as e:
+        out["pump_error"] = str(e)[:500]
+    finally:
+        for gw in (src, dst):
+            if gw is not None:
+                gw.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        configure_injector(None)  # parent injector back to the (clean) env
+    return out
+
+
 def _probe_per_acquire_ns() -> float:
     """Per-acquire cost delta of a witness-wrapped lock vs a plain lock.
 
@@ -976,6 +1054,9 @@ def main() -> int:
     replacement = run_replacement_scenario(base, args.seed)
     drain = run_drain_scenario(base, args.seed)
     replan = run_replan_scenario(base, args.seed)
+    # multi-process pump: worker crash -> respawn + uncounted requeue with a
+    # byte-identical corpus (docs/datapath-performance.md "Multi-process pump")
+    pump = run_pump_scenario(base, args.seed)
 
     # the repair/drain/replan scenarios above also ran under the witness:
     # fold their observed edges into the final acyclicity verdict
@@ -1023,6 +1104,7 @@ def main() -> int:
         **replacement,
         **drain,
         **replan,
+        **pump,
     }
     print(json.dumps(result))
     return 0

@@ -131,13 +131,21 @@ def all_checkers() -> List[Checker]:
     # local import: concurrency/tracer/spans import this module for the base class
     from skyplane_tpu.analysis.concurrency import CONCURRENCY_CHECKERS
     from skyplane_tpu.analysis.framewalk import FRAMEWALK_CHECKERS
+    from skyplane_tpu.analysis.ipc import IPC_CHECKERS
     from skyplane_tpu.analysis.lockgraph import LOCKGRAPH_CHECKERS
     from skyplane_tpu.analysis.spans import SPAN_CHECKERS
     from skyplane_tpu.analysis.tracer import TRACER_CHECKERS
 
     return [
         cls()
-        for cls in (*CONCURRENCY_CHECKERS, *TRACER_CHECKERS, *SPAN_CHECKERS, *FRAMEWALK_CHECKERS, *LOCKGRAPH_CHECKERS)
+        for cls in (
+            *CONCURRENCY_CHECKERS,
+            *TRACER_CHECKERS,
+            *SPAN_CHECKERS,
+            *FRAMEWALK_CHECKERS,
+            *LOCKGRAPH_CHECKERS,
+            *IPC_CHECKERS,
+        )
     ]
 
 

@@ -21,12 +21,16 @@ from skyplane_tpu.obs import lockwitness as lockcheck
 
 
 class ChunkStore:
-    def __init__(self, chunk_dir: str):
+    def __init__(self, chunk_dir: str, clean_stale: bool = True):
         self.chunk_dir = Path(chunk_dir)
         self.chunk_dir.mkdir(parents=True, exist_ok=True)
-        for stale in self.chunk_dir.glob("*.chunk"):
-            logger.fs.warning(f"removing stale chunk file {stale}")
-            stale.unlink()
+        if clean_stale:
+            # daemon-owned stores sweep leftovers from a prior run; pump
+            # worker processes (gateway/pump.py) open the SAME directory
+            # mid-transfer and must never delete live chunks
+            for stale in self.chunk_dir.glob("*.chunk"):
+                logger.fs.warning(f"removing stale chunk file {stale}")
+                stale.unlink()
         # per-partition inbound queues (reference: chunk_store.py:44-49)
         self.chunk_requests: Dict[str, GatewayQueue] = {}
         # sklint: disable=unbounded-queue-in-gateway -- sole consumer is the daemon main loop draining unconditionally at 20 Hz; a bound would DROP completion records and wedge terminal accounting

@@ -166,6 +166,12 @@ class GatewayDaemon:
         # accelerator gateways (micro-batches CDC+fingerprint device calls).
         # Built BEFORE the receiver so paranoid recipe verification in the
         # decode pool batches through the same runner.
+        # multi-process byte pump (gateway/pump.py, docs/datapath-performance
+        # "Multi-process pump"): 0 (default) = the in-process thread data
+        # plane exactly as before; N>0 shards receiver decode and sender
+        # framing/wire work across N spawn-context worker processes each
+        self.pump_procs = _env_int("SKYPLANE_TPU_PUMP_PROCS", 0, minimum=0)
+
         self.batch_runner = None
         from skyplane_tpu.ops.backend import on_accelerator
 
@@ -203,6 +209,10 @@ class GatewayDaemon:
             tenant_registry=self.tenants,
             gateway_id=gateway_id,
         )
+        if self.pump_procs and any(op.get("op_type") == "receive" for op in _iter_program_ops(gateway_program)):
+            # receiver shard pool only where the program actually receives —
+            # a pure source/relay-origin gateway must not pay idle workers
+            self.receiver.enable_pump(self.pump_procs, persist_dedup=self.persist_dedup)
 
         self.upload_id_map: Dict[str, str] = {}
         self.operators: List[GatewayOperator] = []
@@ -268,6 +278,9 @@ class GatewayDaemon:
         from skyplane_tpu.obs.metrics import open_fd_count
 
         self.metrics.gauge("process_open_fds", help_="open file descriptors of the daemon process", fn=open_fd_count)
+        # multi-process pump health (docs/datapath-performance.md): always
+        # present (zeros when the pump is off) as skyplane_pump_*
+        self.metrics.register_provider("pump", self._pump_counters)
         self.api = GatewayDaemonAPI(
             chunk_store=self.chunk_store,
             receiver=self.receiver,
@@ -282,7 +295,7 @@ class GatewayDaemon:
             compression_stats_fn=self._compression_stats,
             sender_profile_fn=self._sender_socket_events,
             metrics_fn=self.metrics.render_prometheus,
-            trace_fn=lambda: get_tracer().export(),
+            trace_fn=self._merged_trace_export,
             api_token=self.api_token,
             ssl_ctx=ssl_ctx,
             tenant_registry=self.tenants,
@@ -291,6 +304,11 @@ class GatewayDaemon:
             draining_event=self.draining,
             drain_fn=self.begin_drain,
             retarget_fn=self.retarget_sender,
+            # pump telemetry mux: /profile/stacks + /telemetry report the
+            # gateway as parent + workers (cores-effective SUMS, so
+            # `skyplane-tpu flame`/`monitor` see the whole gateway row)
+            profile_summary_fn=self._merged_profile_summary,
+            pump_cpu_fn=self._pump_worker_cpu if self.pump_procs else None,
         )
         self.api.upload_id_map_update = self._update_upload_ids
 
@@ -388,6 +406,67 @@ class GatewayDaemon:
     def _update_upload_ids(self, body: Dict[str, str]) -> None:
         self.upload_id_map.update(body)
 
+    # ---- multi-process pump telemetry mux (gateway/pump.py) ----
+
+    def _pump_pools(self):
+        """Every pump pool owner this daemon runs: the receiver pump plus
+        any pump sender operators. Empty when SKYPLANE_TPU_PUMP_PROCS=0."""
+        owners = []
+        if self.receiver.pump is not None:
+            owners.append(self.receiver.pump)
+        from skyplane_tpu.gateway.pump import is_pump_sender
+
+        for op in self.operators:
+            if is_pump_sender(op):
+                owners.append(op)
+        return owners
+
+    def _pump_counters(self) -> dict:
+        from skyplane_tpu.gateway.pump import PUMP_COUNTER_ZERO
+
+        out = dict(PUMP_COUNTER_ZERO)
+        for owner in self._pump_pools():
+            snap = owner.pump_counters() if hasattr(owner, "pump_counters") else owner.counters()
+            for k in out:
+                out[k] += snap.get(k, 0)
+        return out
+
+    def _merged_profile_summary(self) -> dict:
+        """Parent profiler summary with every pump worker's pushed summary
+        folded in — the gateway's TRUE core budget (cores-effective sums
+        across processes; docs/observability.md)."""
+        from skyplane_tpu.obs import get_profiler
+        from skyplane_tpu.obs.profiler import merge_profile_summaries
+
+        summaries = []
+        for owner in self._pump_pools():
+            summaries.extend(owner.profile_summaries())
+        return merge_profile_summaries(get_profiler().summary(), summaries)
+
+    def _merged_trace_export(self) -> dict:
+        """Parent tracer export plus every pump worker's pushed span-ring
+        snapshot: /api/v1/trace covers the whole gateway, and the collector's
+        args.gateway regrouping (workers stamp the parent id) keeps one
+        Perfetto row per gateway regardless of process count."""
+        from skyplane_tpu.obs import get_tracer
+
+        export = get_tracer().export()
+        for owner in self._pump_pools():
+            extra = owner.trace_events()
+            if extra:
+                export["traceEvents"] = list(export.get("traceEvents", [])) + extra
+        return export
+
+    def _pump_worker_cpu(self) -> Dict[str, float]:
+        """Per-worker process CPU seconds for /profile/cpu and the combined
+        telemetry scrape — monitor's cpu column must reflect the sum of
+        workers, not just the parent."""
+        out: Dict[str, float] = {}
+        for owner in self._pump_pools():
+            for name, s in owner.worker_cpu_s().items():
+                out[name] = out.get(name, 0.0) + s
+        return out
+
     def _sender_socket_events(self) -> dict:
         """Per-window send profile events + the stable wire-counter schema
         from every sender operator (sender-side analog of the receiver
@@ -429,7 +508,7 @@ class GatewayDaemon:
         hot_path = dict(DataPathStats.EXTERNAL_ZERO)  # pool / batch / donation counters
         for op in self.operators:
             if isinstance(op, GatewaySenderOperator):
-                d = op.processor.stats.as_dict()
+                d = op.datapath_counters()  # pump operators merge worker-process stats
                 for k in agg:
                     agg[k] += d.get(k, 0)
                 if self.batch_runner is None:
@@ -573,8 +652,20 @@ class GatewayDaemon:
             if not host:
                 raise ValueError(f"no address for target gateway {target_id}")
             dedup = op.get("dedup", False)
-            return GatewaySenderOperator(
+            sender_cls = GatewaySenderOperator
+            sender_extra = {}
+            if self.pump_procs:
+                # multi-process pump: framing + codec + wire work runs in
+                # worker processes; each worker keeps a PRIVATE dedup-index
+                # partition (the daemon-shared persistent index is not
+                # multi-process safe), so no shared index is injected here
+                from skyplane_tpu.gateway.pump import make_sender_pump_operator
+
+                sender_cls = make_sender_pump_operator
+                sender_extra = {"pump_procs": self.pump_procs}
+            return sender_cls(
                 **common,
+                **sender_extra,
                 n_workers=op.get("num_connections", 16),
                 target_gateway_id=target_id,
                 target_host=host,
@@ -593,7 +684,7 @@ class GatewayDaemon:
                 api_token=self.api_token,
                 control_tls=self.control_tls,
                 source_gateway_id=self.gateway_id,
-                dedup_index=self._dedup_index_for(target_id) if dedup else None,
+                dedup_index=self._dedup_index_for(target_id) if dedup and not self.pump_procs else None,
                 scheduler=self.scheduler,
                 tenant_registry=self.tenants,
             )

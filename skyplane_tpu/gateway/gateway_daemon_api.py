@@ -97,6 +97,8 @@ class GatewayDaemonAPI:
         draining_event: Optional[threading.Event] = None,
         drain_fn=None,
         retarget_fn=None,
+        profile_summary_fn=None,
+        pump_cpu_fn=None,
     ):
         self.chunk_store = chunk_store
         self.receiver = receiver
@@ -132,6 +134,15 @@ class GatewayDaemonAPI:
         self.draining_event = draining_event
         self.drain_fn = drain_fn
         self.retarget_fn = retarget_fn
+        # multi-process pump telemetry mux (gateway/pump.py): the daemon
+        # injects a summary fn that folds pump-worker profiles into the
+        # parent's (so flame/monitor see one gateway row whose cores SUM),
+        # and a per-worker CPU fn merged into the /profile/cpu payloads.
+        # None keeps the bare single-process surface.
+        from skyplane_tpu.obs import get_profiler
+
+        self.profile_summary_fn = profile_summary_fn or (lambda: get_profiler().summary())
+        self.pump_cpu_fn = pump_cpu_fn
 
         self._lock = threading.Lock()
         self._dedup_sources: set = set()  # distinct source gateway ids seen on /servers
@@ -466,19 +477,7 @@ class GatewayDaemonAPI:
         elif path == "/api/v1/profile/cpu":
             # per-thread CPU seconds: the bottleneck report's "which thread
             # burned the core" input (ROADMAP item 1's multi-core question)
-            import time as _time
-
-            from skyplane_tpu.obs.metrics import thread_cpu_seconds
-
-            req._send(
-                200,
-                {
-                    "gateway_id": self.gateway_id,
-                    "region": self.region,
-                    "threads": thread_cpu_seconds(),
-                    "process_cpu_s": round(_time.process_time(), 6),
-                },
-            )
+            req._send(200, self._cpu_payload())
         elif path == "/api/v1/profile/stacks":
             # sampling-profiler export (docs/observability.md "Core-time
             # profiling"): folded stacks + speedscope JSON + the core-budget
@@ -491,7 +490,9 @@ class GatewayDaemonAPI:
             payload = {
                 "gateway_id": self.gateway_id,
                 "region": self.region,
-                "summary": prof.summary(),
+                # pump-aware: the daemon's summary fn folds worker-process
+                # profiles in, so cores_effective reflects the whole gateway
+                "summary": self.profile_summary_fn(),
             }
             if query.get("summary") != ["1"]:
                 payload["folded"] = prof.folded()
@@ -518,10 +519,7 @@ class GatewayDaemonAPI:
             # polls this each interval — four separate requests per gateway
             # per wave would spend more CPU on HTTP machinery than on the
             # payloads (the <2% collector-overhead budget).
-            import time as _time
-
             from skyplane_tpu.obs import get_recorder
-            from skyplane_tpu.obs.metrics import thread_cpu_seconds
 
             try:
                 since = int(query.get("since", ["0"])[0] or 0)
@@ -541,19 +539,13 @@ class GatewayDaemonAPI:
                 },
             }
             if query.get("cpu") == ["1"]:
-                payload["cpu"] = {
-                    "gateway_id": self.gateway_id,
-                    "region": self.region,
-                    "threads": thread_cpu_seconds(),
-                    "process_cpu_s": round(_time.process_time(), 6),
-                }
+                payload["cpu"] = self._cpu_payload()
             if query.get("profile") == ["1"]:
                 # core-budget summary only (stage CPU seconds, GIL wait,
                 # cores_effective) — the full stack tables stay behind
-                # /profile/stacks so the per-interval scrape stays small
-                from skyplane_tpu.obs import get_profiler
-
-                payload["profile"] = get_profiler().summary()
+                # /profile/stacks so the per-interval scrape stays small.
+                # Pump-aware: worker-process profiles fold in.
+                payload["profile"] = self.profile_summary_fn()
             req._send(200, payload)
         elif path == "/api/v1/trace":
             # Chrome trace-event JSON from the process tracer: loads directly
@@ -586,6 +578,32 @@ class GatewayDaemonAPI:
                 req._send(200, {"log": tail, "path": str(log_file), "size": size})
         else:
             req._send(404, {"error": f"no route {req.path}"})
+
+    def _cpu_payload(self) -> dict:
+        """Per-thread CPU seconds of the daemon process, plus — when the
+        multi-process pump runs — per-worker-process CPU rows and a
+        process_cpu_s that SUMS parent and workers, so monitor's cpu column
+        and the bottleneck report's attribution cover the whole gateway."""
+        import time as _time
+
+        from skyplane_tpu.obs.metrics import thread_cpu_seconds
+
+        threads = thread_cpu_seconds()
+        total = _time.process_time()
+        if self.pump_cpu_fn is not None:
+            try:
+                workers = self.pump_cpu_fn() or {}
+            except Exception:  # noqa: BLE001 — telemetry must not break the route
+                workers = {}
+            for name, s in sorted(workers.items()):
+                threads[f"pump:{name}"] = {"tid": -1, "cpu_s": round(float(s), 6)}
+                total += float(s)
+        return {
+            "gateway_id": self.gateway_id,
+            "region": self.region,
+            "threads": threads,
+            "process_cpu_s": round(total, 6),
+        }
 
     def _handle_post(self, req) -> None:
         path, _ = self._split_route(req)

@@ -603,6 +603,11 @@ class GatewaySenderOperator(GatewayOperator):
         self.target_host = target_host
         self.target_control_port = target_control_port
         self.use_tls = use_tls
+        # raw config retained for the multi-process pump (gateway/pump.py):
+        # worker processes rebuild the framing stack from these fields
+        self._codec_name = codec_name
+        self._e2ee_key = e2ee_key
+        self.cdc_params = cdc_params
         from skyplane_tpu.ops.pipeline import effective_codec_name
 
         self.processor = DataPathProcessor(
@@ -621,6 +626,7 @@ class GatewaySenderOperator(GatewayOperator):
         self.window = max(1, int(window))
         self.window_bytes = int(window_bytes)
         self.control_tls = control_tls
+        self.api_token = api_token
         # per-window send profile events (drained by /profile/socket/sender,
         # the sender-side analog of the receiver's socket profiler). Bounded:
         # with nothing polling the endpoint, a long-lived daemon must not
@@ -835,6 +841,12 @@ class GatewaySenderOperator(GatewayOperator):
             with self._events_dropped_lock:
                 self._events_dropped += 1
         self._window_hist.observe(seconds)
+
+    def datapath_counters(self) -> dict:
+        """This operator's DataPathProcessor counters — the daemon's
+        /profile/compression aggregation point. The multi-process pump
+        operator overrides this to merge its worker processes' stats."""
+        return self.processor.stats.as_dict()
 
     def wire_counters(self) -> dict:
         """Stable-schema sender wire counters summed across worker engines

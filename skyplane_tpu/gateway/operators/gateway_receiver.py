@@ -225,6 +225,7 @@ class GatewayReceiver:
         decode_workers: Optional[int] = None,
         tenant_registry=None,
         gateway_id: Optional[str] = None,
+        ssl_cert_files=None,
     ):
         self.region = region
         # span identity on a merged fleet timeline: every receiver span
@@ -243,7 +244,12 @@ class GatewayReceiver:
         # the receiver single-tenant (bare test constructions)
         self.tenant_registry = tenant_registry
         self.use_tls = use_tls
+        self._e2ee_key = e2ee_key  # raw key retained for pump worker configs
         self.cipher = ChunkCipher(e2ee_key) if e2ee_key else None
+        # multi-process pump (gateway/pump.py): when attached via
+        # enable_pump(), accepted connections are fd-passed to worker
+        # processes instead of framed/decoded in this process
+        self.pump = None
         self.segment_store = segment_store if segment_store is not None else (SegmentStore() if dedup else None)
         from skyplane_tpu.ops.cdc import CDCParams
 
@@ -252,10 +258,11 @@ class GatewayReceiver:
         # batch_runner (accelerator gateways): paranoid verification of
         # concurrent decode workers micro-batches through the shared runner
         # instead of one blocking device call per chunk.
+        self._cdc_params = cdc_params if cdc_params is not None else CDCParams()
         self.processor = DataPathProcessor(
             codec_name="none",
             dedup=dedup,
-            cdc_params=cdc_params if cdc_params is not None else CDCParams(),
+            cdc_params=self._cdc_params,
             paranoid_verify=os.environ.get("SKYPLANE_TPU_PARANOID_VERIFY") == "1",
             batch_runner=batch_runner,
         )
@@ -331,11 +338,69 @@ class GatewayReceiver:
             t.start()
             self._decode_threads.append(t)
         self._ssl_ctx: Optional[ssl.SSLContext] = None
+        self._ssl_cert_files: Optional[tuple] = None
         if use_tls:
-            cert_dir = Path(chunk_store.chunk_dir) / "certs"
-            cert, key = generate_self_signed_certificate("skyplane-tpu-gateway", cert_dir / "cert.pem", cert_dir / "key.pem")
+            if ssl_cert_files is not None:
+                # pump worker processes load the parent's on-disk cert pair:
+                # regenerating here would race sibling workers over the files
+                cert, key = ssl_cert_files
+            else:
+                cert_dir = Path(chunk_store.chunk_dir) / "certs"
+                cert, key = generate_self_signed_certificate(
+                    "skyplane-tpu-gateway", cert_dir / "cert.pem", cert_dir / "key.pem"
+                )
+            self._ssl_cert_files = (str(cert), str(key))
             self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             self._ssl_ctx.load_cert_chain(certfile=str(cert), keyfile=str(key))
+
+    def enable_pump(self, procs: int, persist_dedup: bool = False) -> None:
+        """Shard this receiver's decode path across ``procs`` worker
+        processes (gateway/pump.py): accepts stay here, every accepted
+        socket is fd-passed to a worker that owns it end to end. Call before
+        the first start_server()."""
+        from skyplane_tpu.gateway.pump import PUMP_PUSH_S_ENV, ReceiverPump
+
+        cfg = {
+            "role": "receiver",
+            "gateway_id": self.gateway_id or "gateway",
+            "region": self.region,
+            "chunk_dir": str(self.chunk_store.chunk_dir),
+            "use_tls": self.use_tls,
+            "ssl_cert_files": list(self._ssl_cert_files) if self._ssl_cert_files else None,
+            "e2ee_key": list(self._e2ee_key) if self._e2ee_key else None,
+            "dedup": self.segment_store is not None,
+            "persist_dedup": persist_dedup,
+            "raw_forward": self.raw_forward,
+            "cdc": (self._cdc_params.min_bytes, self._cdc_params.avg_bytes, self._cdc_params.max_bytes),
+            "ref_wait_timeout": self.ref_wait_timeout,
+            "decode_workers": max(2, len(self._decode_threads) // max(1, procs)),
+            "procs": int(procs),
+            "push_s": float(os.environ.get(PUMP_PUSH_S_ENV, "0.25") or 0.25),
+        }
+        self.pump = ReceiverPump(
+            cfg,
+            procs,
+            gateway_id=self.gateway_id or "gateway",
+            error_event=self.error_event,
+            error_queue=self.error_queue,
+            # workers tally per-tenant decode/nack attribution; the pump
+            # replays the deltas into the daemon's real registry
+            tenant_registry=self.tenant_registry,
+        )
+        # the parent decode pool can never receive work once every accepted
+        # socket is fd-passed to a worker: retire it (idle threads would also
+        # skew the muxed decode_workers gauge to parent+workers summed). The
+        # parent SegmentStore stays — /servers still advertises its capacity
+        # and the daemon's shutdown spill/adoption contract reads it — but it
+        # holds no resident segments in pump mode (nothing decodes here).
+        for _ in self._decode_threads:
+            try:
+                self._work_q.put_nowait(None)
+            except queue.Full:
+                break
+        for t in self._decode_threads:
+            t.join(timeout=2.0)
+        self._decode_threads = []
 
     def start_server(self) -> int:
         """Bind a new ephemeral data port; returns the port (reference :69-114)."""
@@ -368,6 +433,8 @@ class GatewayReceiver:
             ports = list(self._servers)
         for p in ports:
             self.stop_server(p)
+        if self.pump is not None:
+            self.pump.stop()
         # sentinels queue BEHIND any in-flight tasks, so workers finish real
         # work first; the receiver is single-use after stop_all. Best-effort:
         # a full queue means workers are still draining real tasks — they are
@@ -385,16 +452,33 @@ class GatewayReceiver:
             except OSError:
                 return  # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            if self._ssl_ctx is not None:
+            if self.pump is not None:
+                # multi-process pump: the raw accepted socket crosses to a
+                # worker process (socket.send_fds); TLS handshake, framing
+                # and decode all run there (docs/datapath-performance.md
+                # "Multi-process pump")
+                self.pump.dispatch_connection(conn, port)
+                continue
+            self.adopt_connection(conn, port, addr=addr)
+
+    def adopt_connection(self, conn: socket.socket, port: int, addr=None) -> bool:
+        """Serve one already-accepted TCP connection: TLS handshake (when
+        configured) + a dedicated framing thread. Shared by the in-process
+        accept loop and pump worker processes adopting fd-passed sockets."""
+        if self._ssl_ctx is not None:
+            try:
+                conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
+            except (ssl.SSLError, OSError) as e:
+                logger.fs.warning(f"[receiver:{port}] TLS handshake failed from {addr}: {e}")
                 try:
-                    conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
-                except ssl.SSLError as e:
-                    logger.fs.warning(f"[receiver:{port}] TLS handshake failed from {addr}: {e}")
                     conn.close()
-                    continue
-            t = threading.Thread(target=self._conn_loop, args=(conn, port), name=f"receiver-conn-{port}", daemon=True)
-            t.start()
-            self._threads.append(t)
+                except OSError:
+                    pass
+                return False
+        t = threading.Thread(target=self._conn_loop, args=(conn, port), name=f"receiver-conn-{port}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return True
 
     # ---- framing loop (one per connection) ----
 
@@ -830,6 +914,13 @@ class GatewayReceiver:
         for k in ("pool_hits", "pool_misses", "pool_hit_rate"):
             out[k] = pool[k]
         out.update(self.processor.verify_counters())
+        if self.pump is not None:
+            # multi-process pump: the decode work happened in the worker
+            # processes — merge their pushed snapshots so one scrape shows
+            # the whole gateway (the mux-on-the-parent telemetry contract)
+            from skyplane_tpu.gateway.pump import merge_numeric_counters
+
+            out = merge_numeric_counters(out, self.pump.decode_snapshots())
         return out
 
     def socket_events_dropped(self) -> int:

@@ -56,6 +56,7 @@ EV_REPLACEMENT_REQUESTED = "replacement.requested"
 EV_REPLACEMENT_READY = "replacement.ready"
 EV_REPLACEMENT_FAILED = "replacement.failed"
 EV_FAULT_FIRED = "fault.fired"
+EV_PUMP_WORKER_DEATH = "pump.worker_death"  # multi-process pump worker died (respawn follows)
 EV_STREAM_RESET = "stream.reset"
 EV_STREAM_BREAK = "stream.break"
 EV_STREAM_REVIVE = "stream.revive"

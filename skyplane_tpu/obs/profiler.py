@@ -754,6 +754,48 @@ class _NoopProfiler:
         return None
 
 
+def merge_profile_summaries(parent: dict, workers) -> dict:
+    """Fold pump-worker profiler summaries into the parent daemon's summary
+    so one gateway scrape reflects the WHOLE gateway (docs/observability.md;
+    `skyplane-tpu flame`/`monitor` and the collector's core-budget block all
+    consume this shape). CPU seconds, sample counts and cores-effective ADD
+    across processes; the GIL-wait fraction is CPU-weighted (each process
+    has its own GIL); per-thread rollups are namespaced by worker."""
+    workers = [w for w in (workers or []) if isinstance(w, dict) and w.get("samples")]
+    if not workers:
+        return parent
+    out = dict(parent)
+    parts = [parent] + workers
+    out["enabled"] = any(bool(p.get("enabled")) for p in parts)
+    for key in ("samples", "samples_dropped", "retired_threads", "stacks_truncated"):
+        out[key] = sum(int(p.get(key) or 0) for p in parts)
+    out["cpu_s"] = round(sum(float(p.get("cpu_s") or 0.0) for p in parts), 4)
+    out["cores_effective"] = round(sum(float(p.get("cores_effective") or 0.0) for p in parts), 3)
+    out["runnable_threads"] = round(sum(float(p.get("runnable_threads") or 0.0) for p in parts), 2)
+    out["wall_s"] = round(max(float(p.get("wall_s") or 0.0) for p in parts), 3)
+    weights = [max(1e-9, float(p.get("cpu_s") or 0.0)) for p in parts]
+    for key in ("gil_wait_fraction", "gil_wait_expected"):
+        total = sum(w * float(p.get(key) or 0.0) for w, p in zip(weights, parts))
+        out[key] = round(total / sum(weights), 4)
+    stage_cpu: dict = {}
+    stage_samples: dict = {}
+    for p in parts:
+        for s, v in (p.get("stage_cpu_s") or {}).items():
+            stage_cpu[s] = round(stage_cpu.get(s, 0.0) + float(v or 0.0), 4)
+        for s, v in (p.get("stage_samples") or {}).items():
+            stage_samples[s] = round(stage_samples.get(s, 0.0) + float(v or 0.0), 1)
+    out["stage_cpu_s"] = stage_cpu
+    out["stage_samples"] = stage_samples
+    threads = list(parent.get("threads") or [])
+    for w in workers:
+        tag = w.get("worker") or f"pid{w.get('pid')}"
+        for t in w.get("threads") or []:
+            threads.append({**t, "name": f"[{tag}] {t.get('name')}"})
+    out["threads"] = sorted(threads, key=lambda t: -(t.get("samples") or 0))[:24]
+    out["pump_workers"] = len(workers)
+    return out
+
+
 NOOP_PROFILER = _NoopProfiler()
 
 # ---- process-wide singleton (the tracer/injector idiom) ----

@@ -47,6 +47,12 @@ def _recv_program(children):
 
 @pytest.fixture(autouse=True)
 def _restore_obs(monkeypatch):
+    # this suite arms tracer/recorder/injector IN-PROCESS (configure_*),
+    # which by design cannot reach spawn-context pump workers — their arming
+    # channel is the environment (docs/observability.md "Pump workers").
+    # Pin the in-process plane so a pump-smoke run measures the same thing;
+    # env-armed pump tracing is covered by tests/integration/test_pump.py.
+    monkeypatch.setenv("SKYPLANE_TPU_PUMP_PROCS", "0")
     yield
     configure_injector(None)
     configure_tracer()

@@ -114,7 +114,12 @@ class DelayProxy:
 def delayed_connections(monkeypatch):
     """Route every outbound TCP connection in this process through a fresh
     DelayProxy, injecting ONE_WAY_DELAY each direction (so a full RTT per
-    round trip) — data plane and control plane alike, as on a real WAN."""
+    round trip) — data plane and control plane alike, as on a real WAN.
+
+    Pins the multi-process pump off: the socket.create_connection patch can
+    only reach THIS process, so pump worker processes would dial straight
+    past the delay proxy and the latency comparison would measure nothing."""
+    monkeypatch.setenv("SKYPLANE_TPU_PUMP_PROCS", "0")
     ONE_WAY = 0.03
     proxies = []
     real_create = socket.create_connection

@@ -1,0 +1,188 @@
+"""Integration tests for the multi-process byte pump (gateway/pump.py):
+the full two-daemon loopback data plane with SKYPLANE_TPU_PUMP_PROCS=2 —
+fd-passed receiver connections, process-sharded sender framing, the
+control-channel accounting stream, telemetry muxing, and the worker-kill
+truth table across a REAL process boundary (the process-level mirror of
+test_sender_pipeline's mid-stream kill test)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from integration.harness import dispatch_file, make_pair, wait_complete
+
+
+@pytest.fixture
+def pump_env(monkeypatch):
+    monkeypatch.setenv("SKYPLANE_TPU_PUMP_PROCS", "2")
+    monkeypatch.setenv("SKYPLANE_TPU_PERSIST_DEDUP", "0")
+
+
+@pytest.fixture
+def traced_pump_env(pump_env, monkeypatch):
+    # the ENVIRONMENT is the pump workers' arming channel: spawn children
+    # re-read it, so fleet-wide tracing under the pump is env-armed
+    monkeypatch.setenv("SKYPLANE_TPU_TRACE_SAMPLE", "1.0")
+    from skyplane_tpu.obs import configure_tracer
+
+    configure_tracer()  # parent re-reads the env too
+    yield
+    monkeypatch.delenv("SKYPLANE_TPU_TRACE_SAMPLE")
+    configure_tracer()
+
+
+def _corpus(tmp_path: Path, mb: int, seed: int = 7) -> Path:
+    src_file = tmp_path / "src.bin"
+    src_file.write_bytes(np.random.default_rng(seed).integers(0, 256, mb << 20, dtype=np.uint8).tobytes())
+    return src_file
+
+
+def _unique_sink_registrations(dst) -> int:
+    regs = dst.get("chunk_requests", timeout=30).json()["chunk_requests"]
+    ids = [r["chunk"]["chunk_id"] for r in regs]
+    return len(ids) - len(set(ids))
+
+
+def test_pump_transfer_byte_identical(tmp_path, traced_pump_env):
+    """2-proc pump end to end: byte-identical output, decode work actually
+    done in the worker processes (merged counters), sender windows shipped,
+    and the parent's telemetry mux reporting worker profiles/CPU/spans."""
+    src_file = _corpus(tmp_path, 4)
+    dst_file = tmp_path / "out" / "dst.bin"
+    src, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+    try:
+        assert dst.daemon.receiver.pump is not None  # receive op => shard pool
+        assert src.daemon.receiver.pump is None  # pure source: no idle workers
+        ids = dispatch_file(src, src_file, dst_file, chunk_bytes=256 << 10)
+        wait_complete(src, ids, timeout=120)
+        wait_complete(dst, ids, timeout=120)
+        deadline = time.time() + 10
+        while time.time() < deadline and dst_file.read_bytes() != src_file.read_bytes():
+            time.sleep(0.2)
+        assert dst_file.read_bytes() == src_file.read_bytes()
+        # the decode work happened in worker PROCESSES, and the parent's
+        # merged counters prove it (its own decode pool saw zero chunks)
+        time.sleep(0.6)  # let the final worker counter pushes land
+        merged = dst.daemon.receiver.decode_counters()
+        assert merged["decode_chunks"] >= len(ids)
+        pump_src = src.daemon._pump_counters()
+        assert pump_src["batches_shipped"] >= 1
+        assert pump_src["workers_alive"] == 2
+        assert pump_src["worker_deaths"] == 0
+        assert _unique_sink_registrations(dst) == 0
+        # the pump health surface rides /api/v1/metrics
+        metrics = src.get("metrics", timeout=30).text
+        assert "skyplane_pump_workers_alive" in metrics
+        # per-worker CPU rows merge into /profile/cpu (the monitor cpu cell)
+        cpu = src.get("profile/cpu", timeout=30).json()
+        assert any(name.startswith("pump:") for name in cpu["threads"])
+        # env-armed tracing reaches the workers; their span rings union into
+        # the parent's /api/v1/trace, stamped with the PARENT gateway id so
+        # the collector keeps one Perfetto row per gateway
+        deadline = time.time() + 5
+        sender_spans = receiver_spans = []
+        while time.time() < deadline:
+            src_events = src.get("trace", timeout=30).json().get("traceEvents", [])
+            dst_events = dst.get("trace", timeout=30).json().get("traceEvents", [])
+            sender_spans = [e for e in src_events if e.get("name") == "wire.send"]
+            receiver_spans = [e for e in dst_events if e.get("name") == "decode"]
+            if sender_spans and receiver_spans:
+                break
+            time.sleep(0.3)
+        assert sender_spans, "no worker wire.send spans reached the parent trace export"
+        assert receiver_spans, "no worker decode spans reached the parent trace export"
+        assert all((e.get("args") or {}).get("gateway") == "gw_src" for e in sender_spans)
+        assert all((e.get("args") or {}).get("gateway") == "gw_dst" for e in receiver_spans)
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_pump_worker_kill_truth_table(tmp_path, pump_env):
+    """Kill one sender worker AND one receiver worker mid-transfer
+    (SIGKILL, a real process death): the parents must respawn replacements,
+    requeue the dead workers' un-acked chunks UNCOUNTED, keep every
+    already-acked chunk complete, land a byte-identical corpus, and the
+    sink must hold exactly one registration per chunk id."""
+    src_file = _corpus(tmp_path, 12, seed=13)
+    dst_file = tmp_path / "out" / "dst.bin"
+    src, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+    try:
+        ids = dispatch_file(src, src_file, dst_file, chunk_bytes=256 << 10)
+        # let the transfer get going so some chunks are acked pre-kill and
+        # some are in flight on the doomed workers
+        sender_ops = [op for op in src.daemon.operators if hasattr(op, "pool") and op.pool is not None]
+        assert sender_ops, "pump sender operator missing"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status = src.get("chunk_status_log", timeout=30).json()["chunk_status"]
+            if sum(1 for cid in ids if status.get(cid) == "complete") >= 4:
+                break
+            time.sleep(0.05)
+        acked_pre_kill = {
+            cid
+            for cid, state in src.get("chunk_status_log", timeout=30).json()["chunk_status"].items()
+            if state == "complete" and cid in set(ids)
+        }
+        os.kill(sender_ops[0].pool.live_workers()[0].proc.pid, signal.SIGKILL)
+        os.kill(dst.daemon.receiver.pump.pool.live_workers()[0].proc.pid, signal.SIGKILL)
+        wait_complete(src, ids, timeout=240)
+        wait_complete(dst, ids, timeout=240)
+        deadline = time.time() + 10
+        while time.time() < deadline and dst_file.read_bytes() != src_file.read_bytes():
+            time.sleep(0.2)
+        assert dst_file.read_bytes() == src_file.read_bytes()
+        # truth table: every chunk acked before the kill is still complete
+        final = src.get("chunk_status_log", timeout=30).json()["chunk_status"]
+        assert all(final.get(cid) == "complete" for cid in acked_pre_kill)
+        # ... and nothing was double-registered at the sink despite the
+        # death-requeued chunks re-registering on their retry pass
+        assert _unique_sink_registrations(dst) == 0
+        pump_src = src.daemon._pump_counters()
+        pump_dst = dst.daemon._pump_counters()
+        assert pump_src["worker_deaths"] + pump_dst["worker_deaths"] >= 2
+        assert pump_src["worker_respawns"] >= 1 and pump_dst["worker_respawns"] >= 1
+        # the sender-side kill happened with chunks in flight -> they were
+        # requeued through the uncounted path (never failed, never counted
+        # against the per-chunk retry budget — no chunk reads 'failed')
+        assert not any(state == "failed" for state in final.values())
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_pump_matches_inprocess_output(tmp_path, pump_env, monkeypatch):
+    """The same corpus through the pump (2 procs) and through the default
+    in-process plane (SKYPLANE_TPU_PUMP_PROCS=0) lands byte-identical files
+    — the pump changes WHERE the wire work runs, never what arrives."""
+    src_file = _corpus(tmp_path, 2, seed=23)
+    out_pump = tmp_path / "out_pump" / "dst.bin"
+    src, dst = make_pair(tmp_path / "pump", compress="none", dedup=False, encrypt=False, use_tls=False)
+    try:
+        ids = dispatch_file(src, src_file, out_pump, chunk_bytes=256 << 10)
+        wait_complete(src, ids, timeout=120)
+        wait_complete(dst, ids, timeout=120)
+    finally:
+        src.stop()
+        dst.stop()
+    monkeypatch.setenv("SKYPLANE_TPU_PUMP_PROCS", "0")
+    out_plain = tmp_path / "out_plain" / "dst.bin"
+    src2, dst2 = make_pair(tmp_path / "plain", compress="none", dedup=False, encrypt=False, use_tls=False)
+    try:
+        assert dst2.daemon.receiver.pump is None  # knob at 0 => pre-pump plane
+        ids2 = dispatch_file(src2, src_file, out_plain, chunk_bytes=256 << 10)
+        wait_complete(src2, ids2, timeout=120)
+        wait_complete(dst2, ids2, timeout=120)
+    finally:
+        src2.stop()
+        dst2.stop()
+    deadline = time.time() + 10
+    while time.time() < deadline and out_pump.read_bytes() != src_file.read_bytes():
+        time.sleep(0.2)
+    assert out_pump.read_bytes() == src_file.read_bytes() == out_plain.read_bytes()

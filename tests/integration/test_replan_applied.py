@@ -32,7 +32,13 @@ N_CHUNKS = 96
 
 
 @pytest.fixture(autouse=True)
-def _disarm():
+def _disarm(monkeypatch):
+    # this suite asserts IN-PROCESS sender internals (engine stream_retargets
+    # counters read synchronously after the cutover): pin the multi-process
+    # pump off so a pump-smoke run (SKYPLANE_TPU_PUMP_PROCS=2) measures the
+    # same machinery — the pump's own retarget broadcast is covered by
+    # GatewaySenderPumpOperator.retarget + the chaos pump scenario
+    monkeypatch.setenv("SKYPLANE_TPU_PUMP_PROCS", "0")
     yield
     configure_injector(None)
 

@@ -1,0 +1,470 @@
+"""Unit tests for the multi-process byte pump (skyplane_tpu/gateway/pump.py):
+control-channel framing + fd alignment, counter/profile merging, the
+shard-accounting truth table at the parent operator (terminal-vs-death
+idempotency, uncounted requeues), env knob parsing, ChunkStore stale-sweep
+gating, and the ``unsafe-object-over-ipc`` lint rule (fixtures + the pump
+module itself staying clean under the fork-safety family)."""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+
+import pytest
+
+from skyplane_tpu.gateway.pump import (
+    PUMP_COUNTER_ZERO,
+    PUMP_PROCS_ENV,
+    CtrlChannel,
+    _WorkerHandle,
+    merge_numeric_counters,
+    pump_procs,
+)
+
+
+# ---------------------------------------------------------------- channel
+
+
+def _channel_pair():
+    a, b = socket.socketpair()
+    return CtrlChannel(a), CtrlChannel(b)
+
+
+def test_ctrl_channel_roundtrip_and_eof():
+    tx, rx = _channel_pair()
+    assert tx.send({"type": "x", "n": 1})
+    assert tx.send({"type": "y", "payload": "z" * 100_000})  # multi-recv message
+    msg, fds = rx.recv()
+    assert msg == {"type": "x", "n": 1} and fds == []
+    msg, fds = rx.recv()
+    assert msg["type"] == "y" and len(msg["payload"]) == 100_000
+    tx.close()
+    assert rx.recv() is None  # clean EOF
+    assert tx.send({"type": "late"}) is False  # closed channel reports, not raises
+    rx.close()
+
+
+def test_ctrl_channel_fd_passing_alignment():
+    tx, rx = _channel_pair()
+    r1, w1 = socket.socketpair()
+    try:
+        # an fd-carrying message between two plain ones: fds must attach to
+        # the message that declared them, not bleed into neighbors
+        tx.send({"type": "plain1"})
+        tx.send({"type": "conn", "n_fds": 1}, fds=(w1.fileno(),))
+        tx.send({"type": "plain2"})
+        m1, f1 = rx.recv()
+        m2, f2 = rx.recv()
+        m3, f3 = rx.recv()
+        assert (m1["type"], f1) == ("plain1", [])
+        assert m2["type"] == "conn" and len(f2) == 1
+        assert (m3["type"], f3) == ("plain2", [])
+        # the passed fd is live: write through the dup, read on the peer
+        passed = socket.socket(fileno=f2[0])
+        passed.sendall(b"ping")
+        assert r1.recv(4) == b"ping"
+        passed.close()
+    finally:
+        for s in (r1, w1):
+            try:
+                s.close()
+            except OSError:
+                pass
+        tx.close()
+        rx.close()
+
+
+def test_ctrl_channel_corrupt_length_is_death_not_oom():
+    a, b = socket.socketpair()
+    rx = CtrlChannel(b)
+    a.sendall(b"\xff\xff\xff\xff")  # 4 GiB declared length
+    assert rx.recv() is None
+    a.close()
+    rx.close()
+
+
+# ----------------------------------------------------------------- merging
+
+
+def test_merge_numeric_counters_sums_and_recomputes_rate():
+    base = {"decode_chunks": 1, "pool_hits": 1, "pool_misses": 1, "pool_hit_rate": 0.5, "label": "x"}
+    merged = merge_numeric_counters(base, [{"decode_chunks": 4, "pool_hits": 7, "pool_misses": 1}])
+    assert merged["decode_chunks"] == 5
+    assert merged["pool_hits"] == 8 and merged["pool_misses"] == 2
+    assert merged["pool_hit_rate"] == 0.8
+    assert merged["label"] == "x"  # non-numeric passthrough
+    # bools must not be summed as ints
+    merged2 = merge_numeric_counters({"enabled": True}, [{"enabled": True}])
+    assert merged2["enabled"] is True
+
+
+def test_merge_profile_summaries_sums_cores_and_weights_gil():
+    from skyplane_tpu.obs.profiler import merge_profile_summaries
+
+    parent = {
+        "enabled": True,
+        "samples": 100,
+        "samples_dropped": 0,
+        "cpu_s": 2.0,
+        "cores_effective": 0.8,
+        "runnable_threads": 3.0,
+        "wall_s": 5.0,
+        "gil_wait_fraction": 0.4,
+        "gil_wait_expected": 0.3,
+        "stage_cpu_s": {"decode": 1.0, "framing": 0.5},
+        "stage_samples": {"decode": 50.0},
+        "threads": [{"name": "main", "samples": 60, "cpu_s": 1.5, "on_cpu_frac": 0.9}],
+        "retired_threads": 0,
+        "stacks_truncated": 0,
+    }
+    worker = {
+        "enabled": True,
+        "worker": "pump-sender0.g0",
+        "samples": 200,
+        "samples_dropped": 1,
+        "cpu_s": 6.0,
+        "cores_effective": 0.9,
+        "runnable_threads": 2.0,
+        "wall_s": 4.0,
+        "gil_wait_fraction": 0.1,
+        "gil_wait_expected": 0.1,
+        "stage_cpu_s": {"decode": 3.0, "codec": 2.0},
+        "stage_samples": {"decode": 120.0},
+        "threads": [{"name": "receiver-decode-0", "samples": 150, "cpu_s": 4.0, "on_cpu_frac": 1.0}],
+        "retired_threads": 1,
+        "stacks_truncated": 0,
+    }
+    out = merge_profile_summaries(parent, [worker])
+    assert out["samples"] == 300
+    assert out["cores_effective"] == pytest.approx(1.7)  # ADDS across processes
+    assert out["cpu_s"] == pytest.approx(8.0)
+    assert out["stage_cpu_s"]["decode"] == pytest.approx(4.0)
+    assert out["stage_cpu_s"]["codec"] == pytest.approx(2.0)
+    # gil weighted by cpu_s: (2*0.4 + 6*0.1) / 8 = 0.175
+    assert out["gil_wait_fraction"] == pytest.approx(0.175, abs=1e-4)
+    assert out["pump_workers"] == 1
+    names = [t["name"] for t in out["threads"]]
+    assert "[pump-sender0.g0] receiver-decode-0" in names and "main" in names
+    # no workers -> identity (the pump-off path must stay bit-for-bit)
+    assert merge_profile_summaries(parent, []) is parent
+    assert merge_profile_summaries(parent, [{"samples": 0}]) is parent
+
+
+# --------------------------------------------------------------- env knobs
+
+
+def test_pump_procs_env_parsing(monkeypatch):
+    monkeypatch.delenv(PUMP_PROCS_ENV, raising=False)
+    assert pump_procs() == 0
+    monkeypatch.setenv(PUMP_PROCS_ENV, "4")
+    assert pump_procs() == 4
+    monkeypatch.setenv(PUMP_PROCS_ENV, "-2")
+    assert pump_procs() == 0
+    monkeypatch.setenv(PUMP_PROCS_ENV, "garbage")
+    assert pump_procs() == 0
+
+
+def test_pump_counter_zero_schema():
+    # the stable schema the daemon's skyplane_pump_* provider renders: every
+    # key numeric, no surprises for dashboards when the pump is off
+    assert all(isinstance(v, (int, float)) for v in PUMP_COUNTER_ZERO.values())
+    for key in ("procs", "workers_alive", "worker_deaths", "worker_respawns", "chunks_requeued_on_death"):
+        assert key in PUMP_COUNTER_ZERO
+
+
+def test_chunk_store_clean_stale_gating(tmp_path):
+    from skyplane_tpu.gateway.chunk_store import ChunkStore
+
+    live = tmp_path / "ab.chunk"
+    live.write_bytes(b"payload")
+    ChunkStore(str(tmp_path), clean_stale=False)  # pump worker: must NOT sweep
+    assert live.exists()
+    ChunkStore(str(tmp_path))  # daemon default: sweeps leftovers
+    assert not live.exists()
+
+
+# -------------------------------------------- shard-accounting truth table
+
+
+class _DummyProc:
+    exitcode = -9
+
+    @staticmethod
+    def is_alive():
+        return False
+
+
+class _DummyChan:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg, fds=()):
+        self.sent.append(msg)
+        return True
+
+    def close(self):
+        pass
+
+
+class _FakePool:
+    def __init__(self):
+        self.slot_event = threading.Event()
+
+    def live_workers(self):
+        return []
+
+    def counters(self):
+        return {}
+
+
+def _make_pump_op(tmp_path):
+    """A pump sender operator with NO pool spawned: the parent-side
+    accounting brain in isolation."""
+    from skyplane_tpu.gateway.chunk_store import ChunkStore
+    from skyplane_tpu.gateway.gateway_queue import GatewayQueue
+    from skyplane_tpu.gateway.pump import make_sender_pump_operator
+
+    out_q = GatewayQueue()
+    out_q.register_handle("downstream")
+    op = make_sender_pump_operator(
+        handle="send",
+        region="local:local",
+        input_queue=GatewayQueue(),
+        output_queue=out_q,
+        error_event=threading.Event(),
+        error_queue=queue.Queue(),
+        chunk_store=ChunkStore(str(tmp_path)),
+        n_workers=2,
+        gateway_id="gw_test",
+        pump_procs=2,
+        target_gateway_id="gw_dst",
+        target_host="127.0.0.1",
+        target_control_port=1,
+        use_tls=False,
+    )
+    op.pool = _FakePool()
+    return op, out_q
+
+
+def _req(i: int):
+    from skyplane_tpu.chunk import Chunk, ChunkRequest
+
+    return ChunkRequest(
+        chunk=Chunk(src_key="s", dest_key="d", chunk_id=f"{i:032x}", chunk_length_bytes=64, file_offset_bytes=0)
+    )
+
+
+def test_terminal_outcome_accounting(tmp_path):
+    """complete -> logged complete + forwarded downstream; failed -> logged
+    failed; a second terminal for the same chunk id is a no-op (idempotent
+    against the death-requeue race)."""
+    op, out_q = _make_pump_op(tmp_path)
+    w = _WorkerHandle(0, 0, "w0", _DummyProc(), _DummyChan())
+    r_ok, r_bad = _req(1), _req(2)
+    with op._acct_lock:
+        op._outstanding[r_ok.chunk.chunk_id] = r_ok
+        op._outstanding[r_bad.chunk.chunk_id] = r_bad
+        w.outstanding.update({r_ok.chunk.chunk_id, r_bad.chunk.chunk_id})
+    op._on_terminal(w, {"chunk_id": r_ok.chunk.chunk_id, "state": "complete"})
+    op._on_terminal(w, {"chunk_id": r_bad.chunk.chunk_id, "state": "failed"})
+    # duplicate terminal: already popped, must not double-forward
+    op._on_terminal(w, {"chunk_id": r_ok.chunk.chunk_id, "state": "complete"})
+    assert out_q.pop("downstream", timeout=1).chunk.chunk_id == r_ok.chunk.chunk_id
+    with pytest.raises(queue.Empty):
+        out_q.get_nowait("downstream")
+    states = {}
+    while True:
+        try:
+            rec = op.chunk_store.chunk_status_queue.get_nowait()
+        except queue.Empty:
+            break
+        states[rec["chunk_id"]] = rec["state"]
+    assert states[r_ok.chunk.chunk_id] == "complete"
+    assert states[r_bad.chunk.chunk_id] == "failed"
+    assert not op._outstanding
+
+
+def test_worker_death_requeues_uncounted(tmp_path):
+    """Mid-transfer worker kill: acked chunks (terminal already received)
+    stay complete and are NOT requeued; everything else outstanding on the
+    dead worker returns to the input queue with its retry budget untouched
+    (wire_retries never set — a crash is not the chunk's fault)."""
+    op, _ = _make_pump_op(tmp_path)
+    w = _WorkerHandle(0, 0, "w0", _DummyProc(), _DummyChan())
+    acked, pending1, pending2 = _req(3), _req(4), _req(5)
+    for r in (acked, pending1, pending2):
+        with op._acct_lock:
+            op._outstanding[r.chunk.chunk_id] = r
+            w.outstanding.add(r.chunk.chunk_id)
+    op._on_terminal(w, {"chunk_id": acked.chunk.chunk_id, "state": "complete"})
+    op._on_worker_death(w)
+    requeued = set()
+    while True:
+        try:
+            requeued.add(op.input_queue.get_nowait(op.handle).chunk.chunk_id)
+        except queue.Empty:
+            break
+    assert requeued == {pending1.chunk.chunk_id, pending2.chunk.chunk_id}
+    assert not hasattr(pending1, "wire_retries") and not getattr(pending1.chunk, "wire_retries", None)
+    assert op.pump_counters()["chunks_requeued_on_death"] == 2
+    # a late terminal from the (already-dead) worker for a requeued chunk is
+    # ignored — the chunk's truth now lives with whoever dequeues it
+    op._on_terminal(w, {"chunk_id": pending1.chunk.chunk_id, "state": "complete"})
+    assert not op._outstanding
+
+
+def test_failed_ship_requeues_once_without_redispatch(tmp_path):
+    """A send that races the worker's death must requeue the window exactly
+    once and STOP — not fall through and re-ship the same payload to another
+    worker (double-dispatch: two workers carrying the same chunk ids with
+    the fair-share tokens already released)."""
+    op, _ = _make_pump_op(tmp_path)
+
+    class _DeadChan(_DummyChan):
+        def send(self, msg, fds=()):
+            return False  # worker died between selection and send
+
+    w = _WorkerHandle(0, 0, "w0", _DummyProc(), _DeadChan())
+    healthy = _WorkerHandle(1, 0, "w1", _DummyProc(), _DummyChan())
+    picks = [w, healthy]  # a buggy retry loop would reach the healthy worker
+
+    class _Pool(_FakePool):
+        def least_loaded(self, cap):
+            return picks.pop(0) if picks else None
+
+    op.pool = _Pool()
+    r = _req(9)
+    assert op._ship([r]) is True
+    # the chunk is back on the input queue exactly once...
+    assert op.input_queue.get_nowait(op.handle).chunk.chunk_id == r.chunk.chunk_id
+    with pytest.raises(queue.Empty):
+        op.input_queue.get_nowait(op.handle)
+    # ...nothing was dispatched to the healthy worker, nothing is outstanding
+    assert healthy.chan.sent == []
+    assert not op._outstanding and not healthy.outstanding
+
+
+# --------------------------------------------------- unsafe-object-over-ipc
+
+
+def _findings(source: str):
+    from skyplane_tpu.analysis.core import run_source
+
+    return [f for f in run_source(source, "fixture.py") if f.rule == "unsafe-object-over-ipc"]
+
+
+def test_ipc_rule_flags_lock_on_mp_queue():
+    src = (
+        "import multiprocessing as mp\n"
+        "import threading\n"
+        "q = mp.Queue()\n"
+        "lock = threading.Lock()\n"
+        "q.put(lock)\n"
+    )
+    found = _findings(src)
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_ipc_rule_flags_inline_and_container_payloads():
+    src = (
+        "import multiprocessing as mp\n"
+        "import threading, socket\n"
+        "q = mp.Queue()\n"
+        "q.put_nowait(('tag', threading.Condition()))\n"
+        "a, b = mp.Pipe()\n"
+        "s = socket.socket()\n"
+        "a.send(s)\n"
+        "from skyplane_tpu.obs import get_tracer\n"
+        "q.put({'t': get_tracer()})\n"
+    )
+    lines = sorted(f.line for f in _findings(src))
+    assert lines == [4, 7, 9]
+
+
+def test_ipc_rule_clean_on_data_and_thread_queues():
+    src = (
+        "import multiprocessing as mp\n"
+        "import queue, threading\n"
+        "q = mp.Queue()\n"
+        "q.put({'chunk_id': 'ab', 'n': 3})\n"
+        "tq = queue.Queue()\n"
+        "tq.put(threading.Lock())\n"  # same-process thread queue: fine
+        "a, b = mp.Pipe()\n"
+        "a.send([1, 2, 3])\n"
+    )
+    assert _findings(src) == []
+
+
+def test_pump_module_clean_under_fork_and_ipc_rules():
+    """The satellite contract: gateway/pump.py passes ``fork-with-threads``
+    (the spawn guard is the module-level get_context('spawn')) and its own
+    ``unsafe-object-over-ipc`` rule — plus every other repo rule (tier-1's
+    repo-wide lint test covers that globally; this pins the two that exist
+    because of this module)."""
+    import skyplane_tpu.gateway.pump as pump_mod
+    from skyplane_tpu.analysis.core import load_module, run_module
+
+    module, errors = load_module(pump_mod.__file__, display_path="skyplane_tpu/gateway/pump.py")
+    assert module is not None and not errors
+    findings = [f for f in run_module(module) if not f.suppressed]
+    bad = [f for f in findings if f.rule in ("fork-with-threads", "unsafe-object-over-ipc", "lock-held-across-fork")]
+    assert bad == [], [f.render() for f in bad]
+
+
+def test_receiver_pump_gated_off_by_default(tmp_path, monkeypatch):
+    """SKYPLANE_TPU_PUMP_PROCS unset => structurally the pre-pump daemon:
+    no pump attached to the receiver, plain sender operator class, zeroed
+    pump counters — the bit-for-bit reproduction guarantee."""
+    monkeypatch.delenv(PUMP_PROCS_ENV, raising=False)
+    monkeypatch.setenv("SKYPLANE_TPU_PERSIST_DEDUP", "0")
+    from skyplane_tpu.gateway.gateway_daemon import GatewayDaemon
+    from skyplane_tpu.gateway.pump import is_pump_sender
+
+    program = {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "send",
+                                "target_gateway_id": "gw_b",
+                                "region": "local:local",
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    daemon = GatewayDaemon(
+        region="local:local",
+        chunk_dir=str(tmp_path / "chunks"),
+        gateway_program=program,
+        gateway_info={"gw_b": {"public_ip": "127.0.0.1", "control_port": 18081}},
+        gateway_id="gw_a",
+        control_port=0,
+        bind_host="127.0.0.1",
+        use_tls=False,
+    )
+    try:
+        assert daemon.pump_procs == 0
+        assert daemon.receiver.pump is None
+        assert not any(is_pump_sender(op) for op in daemon.operators)
+        assert daemon._pump_counters() == dict(PUMP_COUNTER_ZERO)
+    finally:
+        daemon.api.stop()
+        daemon.receiver.stop_all()
+
+
+def test_env_int_used_for_pump_knob(monkeypatch):
+    monkeypatch.setenv(PUMP_PROCS_ENV, "0")
+    assert pump_procs() == 0
+    assert os.environ[PUMP_PROCS_ENV] == "0"
