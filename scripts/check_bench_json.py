@@ -18,7 +18,13 @@ Three result shapes are recognized, dispatched on the ``metric`` field:
     telemetry smoke — a 2-hop relay transfer collector-merged into one
     timeline, the flight-recorder fleet log complete and ordered, bottleneck
     attribution reconciling within 10%, and collector overhead < 2% per poll
-    cycle (docs/observability.md).
+    cycle (docs/observability.md);
+  * scripts/soak_service.py results (``metric: service_jobs``): the
+    always-on service soak — one standing fleet, >=50 sequential + >=8
+    concurrent warm jobs (p50 start < 1 s, warm dedup > cold), continuous
+    sync delta rounds, and a SIGKILLed controller recovered from the WAL
+    with byte-identical output, zero acked-chunk loss, zero duplicate sink
+    registrations, and idempotent resubmission (docs/service-mode.md).
 
 Exit 0 iff the result parses and every required key is present; used by the
 bench-smoke, multijob-smoke, and chaos-smoke steps in scripts/devloop.sh so a
@@ -258,6 +264,174 @@ REQUIRED_FLEET_STAGES = ("frame", "send_stall", "ack_lag", "decode", "store", "d
 MAX_FLEET_RECONCILE_PCT = 10.0
 #: the collector's CPU cost per poll cycle, as % of the poll interval
 MAX_COLLECTOR_OVERHEAD_PCT = 2.0
+
+
+# always-on service soak result (scripts/soak_service.py /
+# docs/service-mode.md): one standing fleet, >=50 sequential + >=8
+# concurrent warm jobs, a SIGKILLed controller recovered from the WAL
+REQUIRED_SERVICE = (
+    "metric",
+    "value",
+    "unit",
+    "service_seq_jobs",
+    "service_concurrent_jobs",
+    "service_job_start_p50_s",
+    "service_job_start_p95_s",
+    "service_start_bound_s",
+    "service_dedup_hit_cold",
+    "service_dedup_hit_warm",
+    "service_heartbeats",
+    "service_watch_rounds",
+    "service_watch_delta_only",
+    "service_watch_byte_identical",
+    "service_controller_killed",
+    "service_recovery_seconds",
+    "service_recovery_bound_s",
+    "service_recovered",
+    "service_byte_identical",
+    "service_acked_chunks_lost",
+    "service_duplicate_registrations",
+    "service_requeued_chunks",
+    "service_torn_records_dropped",
+    "service_crash_fault_fired",
+    "service_resubmit_noop",
+    "service_dispatch_gap_ok",
+    "process_open_fds_start",
+    "process_open_fds_end",
+    "service_rss_start_bytes",
+    "service_rss_end_bytes",
+)
+#: acceptance floors (ISSUE 14): the soak proves nothing below these
+MIN_SERVICE_SEQ_JOBS = 50
+MIN_SERVICE_CONC_JOBS = 8
+#: fd/RSS must stay flat across the >=50-job soak (leak gates)
+MAX_SERVICE_FD_GROWTH = 64
+MAX_SERVICE_RSS_GROWTH_BYTES = 256 << 20
+
+
+def check_service(result: dict) -> int:
+    missing = [k for k in REQUIRED_SERVICE if k not in result]
+    if missing:
+        print(f"service-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    if result["service_seq_jobs"] < MIN_SERVICE_SEQ_JOBS:
+        print(
+            f"service-smoke: only {result['service_seq_jobs']} sequential jobs "
+            f"(acceptance floor {MIN_SERVICE_SEQ_JOBS})",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_concurrent_jobs"] < MIN_SERVICE_CONC_JOBS:
+        print(
+            f"service-smoke: only {result['service_concurrent_jobs']} concurrent jobs "
+            f"(acceptance floor {MIN_SERVICE_CONC_JOBS})",
+            file=sys.stderr,
+        )
+        return 1
+    p50 = result["service_job_start_p50_s"]
+    if not isinstance(p50, (int, float)) or p50 <= 0 or p50 >= result["service_start_bound_s"]:
+        print(
+            f"service-smoke: warm-job start p50 {p50!r}s breaches the "
+            f"{result['service_start_bound_s']}s bound — the standing fleet is not warm",
+            file=sys.stderr,
+        )
+        return 1
+    cold, warm = result["service_dedup_hit_cold"], result["service_dedup_hit_warm"]
+    if not isinstance(warm, (int, float)) or warm <= cold:
+        print(
+            f"service-smoke: warm dedup hit rate {warm!r} does not beat cold {cold!r} — "
+            "the persistent index is not staying warm across jobs",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_heartbeats"] < 1:
+        print("service-smoke: no TTL heartbeats observed (reap-vs-heartbeat untested)", file=sys.stderr)
+        return 1
+    if result["service_watch_rounds"] < 2 or result["service_watch_delta_only"] is not True:
+        print(
+            f"service-smoke: continuous sync failed — rounds={result['service_watch_rounds']} "
+            f"delta_only={result['service_watch_delta_only']}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_watch_byte_identical"] is not True:
+        print("service-smoke: sync-watch mirror NOT byte-identical", file=sys.stderr)
+        return 1
+    if result["service_controller_killed"] is not True:
+        print("service-smoke: the controller was never SIGKILLed mid-job (vacuous run)", file=sys.stderr)
+        return 1
+    if result["service_recovered"] is not True or result["service_byte_identical"] is not True:
+        print(
+            f"service-smoke: recovery failed — recovered={result['service_recovered']} "
+            f"byte_identical={result['service_byte_identical']}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_recovery_seconds"] > result["service_recovery_bound_s"]:
+        print(
+            f"service-smoke: recovery took {result['service_recovery_seconds']}s, over the "
+            f"{result['service_recovery_bound_s']}s bound",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_acked_chunks_lost"] != 0:
+        print(
+            f"service-smoke: {result['service_acked_chunks_lost']} acked chunk(s) LOST across the kill",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_duplicate_registrations"] != 0:
+        print(
+            f"service-smoke: {result['service_duplicate_registrations']} duplicate sink "
+            "registration(s) — recovery re-dispatched under fresh chunk ids",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_torn_records_dropped"] < 1:
+        print("service-smoke: the torn WAL tail was never exercised (vacuous)", file=sys.stderr)
+        return 1
+    if result["service_crash_fault_fired"] is not True:
+        print("service-smoke: service.crash never fired during recovery (vacuous)", file=sys.stderr)
+        return 1
+    if result["service_resubmit_noop"] is not True:
+        print("service-smoke: post-recovery resubmission was NOT idempotent", file=sys.stderr)
+        return 1
+    if result["service_dispatch_gap_ok"] is not True:
+        print(
+            "service-smoke: the WAL->POST crash-window scenario failed (requeue from the "
+            "dispatch record broke)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["service_requeued_chunks"] < 1:
+        print("service-smoke: recovery requeued zero chunks (vacuous crash window)", file=sys.stderr)
+        return 1
+    fd_growth = result["process_open_fds_end"] - result["process_open_fds_start"]
+    if fd_growth > MAX_SERVICE_FD_GROWTH:
+        print(f"service-smoke: fd count grew by {fd_growth} across the soak (descriptor leak)", file=sys.stderr)
+        return 1
+    rss_growth = result["service_rss_end_bytes"] - result["service_rss_start_bytes"]
+    if rss_growth > MAX_SERVICE_RSS_GROWTH_BYTES:
+        print(
+            f"service-smoke: RSS grew by {rss_growth / (1 << 20):.0f} MiB across the soak "
+            f"(bound {MAX_SERVICE_RSS_GROWTH_BYTES >> 20} MiB)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"service-smoke OK: {result['service_seq_jobs']} sequential + "
+        f"{result['service_concurrent_jobs']} concurrent jobs on one standing fleet, "
+        f"warm start p50 {p50}s/p95 {result['service_job_start_p95_s']}s (bound "
+        f"{result['service_start_bound_s']}s), dedup cold {cold} -> warm {warm}; "
+        f"controller SIGKILLed mid-job and recovered in {result['service_recovery_seconds']}s "
+        f"(byte-identical, 0 acked lost, 0 duplicate registrations, "
+        f"{result['service_requeued_chunks']} chunk(s) requeued from the WAL, "
+        f"{result['service_torn_records_dropped']} torn record(s) dropped, crash-in-recovery + "
+        f"idempotent resubmission proven); continuous sync: {result['service_watch_rounds']} "
+        f"round(s), delta-only, byte-identical; fd growth {fd_growth}, "
+        f"RSS growth {rss_growth / (1 << 20):.0f} MiB"
+    )
+    return 0
 
 
 def check_fleet(result: dict) -> int:
@@ -595,6 +769,8 @@ def main(argv) -> int:
         return check_chaos(result)
     if result.get("metric") == "fleet_telemetry":
         return check_fleet(result)
+    if result.get("metric") == "service_jobs":
+        return check_service(result)
     missing = [k for k in REQUIRED_TOP if k not in result]
     counters = result.get("datapath_counters")
     if not isinstance(counters, dict):

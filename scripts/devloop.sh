@@ -155,6 +155,30 @@ else
   echo "[devloop] multijob-smoke clean; result at $LOGDIR/multijob_smoke.out" >>"$LOGDIR/devloop.log"
 fi
 
+# Service-smoke gate (CPU-only, ~1-2 min): the always-on replication service
+# (skyplane_tpu/service/, docs/service-mode.md) — one standing loopback
+# fleet, >= 50 sequential + >= 8 concurrent warm jobs (p50 start gated < 1 s,
+# warm dedup hit rate gated > cold), continuous-sync delta rounds, then the
+# crash lab: a worker controller SIGKILLed mid-job, its WAL tail torn, a
+# service.crash fault fired inside recovery itself — and the restarted
+# controller must finish byte-identical with zero acked-chunk loss, zero
+# duplicate sink registrations, a deterministic WAL->POST-window requeue,
+# and an idempotent resubmission (service branch of check_bench_json.py).
+# Like the other smokes: failures are logged LOUDLY but do not block
+# device profiling.
+JAX_PLATFORMS=cpu SKYPLANE_SERVICE_SEQ_JOBS=50 SKYPLANE_SERVICE_CONC_JOBS=8 \
+  python scripts/soak_service.py >"$LOGDIR/service_smoke.out" 2>"$LOGDIR/service_smoke.err"
+SERVICE_RC=$?
+if [ "$SERVICE_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/service_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  SERVICE_RC=$?
+fi
+if [ "$SERVICE_RC" -ne 0 ]; then
+  echo "[devloop] SERVICE-SMOKE FAILURE (rc=$SERVICE_RC) — warm-start, dedup-warmth, or WAL-recovery gates regressed; see $LOGDIR/service_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] service-smoke clean; result at $LOGDIR/service_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 # Chaos-smoke gate (CPU-only, ~1-2 min): the deterministic fault-injection soak
 # plus the capacity-repair scenarios (docs/provisioning.md "Repair & drain"):
 # gateway death -> requeue-to-survivor, kill-one-of-two -> replacement

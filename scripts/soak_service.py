@@ -1,0 +1,415 @@
+#!/usr/bin/env python
+"""Always-on service soak: one standing fleet, many jobs, one murdered
+controller (ISSUE 14 / ROADMAP item 4 acceptance; docs/service-mode.md).
+
+The fleet (a loopback dedup pair) is provisioned ONCE. Then:
+
+  phase 1  — ≥ 50 SEQUENTIAL jobs of a repeated snapshot-like corpus through
+             one ServiceController: per-job start latency (p50 gated < 1 s —
+             nothing provisions, nothing cold-starts) and per-job dedup hit
+             rate from the gateway's cumulative compression counters (warm
+             jobs must beat the cold first job — the resident
+             PersistentDedupIndex is the whole point of standing warm).
+  phase 2  — ≥ 8 CONCURRENT jobs through the same controller, byte-verified.
+  phase 3  — continuous sync: a sync_watch spec runs delta rounds; a touched
+             file ships ONLY its own chunks.
+  phase 4  — crash lab, subprocess edition: a worker controller
+             (`python -m skyplane_tpu.service.worker`) is SIGKILLed mid-job;
+             the parent then TEARS the WAL tail (half a record, exactly what
+             a killed append leaves); restart #1 runs with `service.crash`
+             armed so recovery ITSELF dies once at the reconcile boundary
+             (exit 86); restart #2 recovers cleanly. Gates: byte-identical
+             output, zero acked-chunk loss, zero duplicate sink
+             registrations, > 0 chunks requeued (non-vacuous), ≥ 1 torn
+             record dropped, the crash fault actually fired, and an
+             idempotent resubmission after recovery dispatches nothing new.
+
+Emits ONE JSON result line (metric: service_jobs) validated + gated by the
+service branch of scripts/check_bench_json.py; scripts/devloop.sh runs this
+as the service-smoke step.
+
+Env knobs: SKYPLANE_SERVICE_SEQ_JOBS (50), SKYPLANE_SERVICE_CONC_JOBS (8),
+SKYPLANE_SERVICE_KB_PER_JOB (512), SKYPLANE_SERVICE_KILL_MB (16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import numpy as np  # noqa: E402
+
+from integration.harness import make_pair  # noqa: E402
+from skyplane_tpu.obs.metrics import open_fd_count  # noqa: E402
+from skyplane_tpu.service import ServiceController  # noqa: E402
+
+RECOVERY_BOUND_S = 120.0  # wall bound on kill -> recovered (generous for 1-core CI)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+def rss_bytes() -> int:
+    for line in Path("/proc/self/status").read_text().splitlines():
+        if line.startswith("VmRSS:"):
+            return int(line.split()[1]) << 10
+    return 0
+
+
+def drive(controller: ServiceController, job_ids, timeout: float = 300.0) -> None:
+    deadline = time.time() + timeout
+    pending = set(job_ids)
+    while pending and time.time() < deadline:
+        controller.poll_once()
+        pending = {j for j in pending if controller.job(j).state not in ("done", "failed")}
+        if pending:
+            time.sleep(0.02)
+    if pending:
+        raise TimeoutError(f"{len(pending)} service jobs incomplete")
+    bad = [j for j in job_ids if controller.job(j).state != "done"]
+    if bad:
+        raise RuntimeError(f"jobs failed: {[(j, controller.job(j).error) for j in bad[:3]]}")
+
+
+def dedup_counters(src) -> dict:
+    return src.get("profile/compression", timeout=30).json()
+
+
+def main() -> int:
+    n_seq = _env_int("SKYPLANE_SERVICE_SEQ_JOBS", 50)
+    n_conc = _env_int("SKYPLANE_SERVICE_CONC_JOBS", 8)
+    kb_per_job = _env_int("SKYPLANE_SERVICE_KB_PER_JOB", 512)
+    kill_mb = _env_int("SKYPLANE_SERVICE_KILL_MB", 16)
+
+    fds_start = open_fd_count()
+    rss_start = rss_bytes()
+    tmp = Path(tempfile.mkdtemp(prefix="skyplane_service_"))
+    # the standing fleet: provisioned once, outlives every job and every
+    # controller below
+    src, dst = make_pair(tmp, compress="none", dedup=True, encrypt=False, use_tls=False, num_connections=2)
+    rng = np.random.default_rng(14)
+
+    # ---- phase 1: sequential warm jobs ------------------------------------
+    corpus = tmp / "corpus.bin"
+    corpus.write_bytes(rng.integers(0, 256, kb_per_job << 10, dtype=np.uint8).tobytes())
+    c1 = ServiceController(
+        tmp / "wal_seq",
+        source_url=src.url("").rstrip("/"),
+        sink_url=dst.url("").rstrip("/"),
+        chunk_bytes=128 << 10,
+    )
+    c1.attach()
+    job_rates = []
+    for i in range(n_seq):
+        before = dedup_counters(src)
+        jid = c1.submit(
+            {"type": "copy", "src": str(corpus), "dst": str(tmp / "seq_out" / f"job{i}.bin")},
+            idem_key=f"seq-{i}",
+        )
+        drive(c1, [jid])
+        after = dedup_counters(src)
+        segs = after["segments"] - before["segments"]
+        refs = after["ref_segments"] - before["ref_segments"]
+        job_rates.append(refs / segs if segs else 0.0)
+    for i in range(n_seq):
+        if (tmp / "seq_out" / f"job{i}.bin").read_bytes() != corpus.read_bytes():
+            print(json.dumps({"error": f"sequential job {i} output mismatch"}), file=sys.stderr)
+            return 1
+    lat = sorted(c1.start_latencies()[1:])  # [0] is the cold first job
+    p50 = lat[len(lat) // 2]
+    p95 = lat[min(len(lat) - 1, int(0.95 * len(lat)))]
+    dedup_cold = round(job_rates[0], 4)
+    dedup_warm = round(sum(job_rates[1:]) / max(1, len(job_rates) - 1), 4)
+
+    # ---- phase 2: concurrent jobs -----------------------------------------
+    conc_files = []
+    for i in range(n_conc):
+        f = tmp / "conc_src" / f"c{i}.bin"
+        f.parent.mkdir(exist_ok=True)
+        f.write_bytes(rng.integers(0, 256, kb_per_job << 10, dtype=np.uint8).tobytes())
+        conc_files.append(f)
+    conc_ids: list = [None] * n_conc
+    errors: list = []
+
+    def submit_one(i: int) -> None:
+        try:
+            conc_ids[i] = c1.submit(
+                {"type": "copy", "src": str(conc_files[i]), "dst": str(tmp / "conc_out" / f"c{i}.bin")},
+                idem_key=f"conc-{i}",
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced as a soak failure below
+            errors.append(f"concurrent submit {i}: {e}")
+
+    threads = [threading.Thread(target=submit_one, args=(i,), daemon=True) for i in range(n_conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors or any(j is None for j in conc_ids):
+        print(json.dumps({"error": "concurrent submits failed", "detail": errors[:4]}), file=sys.stderr)
+        return 1
+    drive(c1, conc_ids)
+    for i in range(n_conc):
+        if (tmp / "conc_out" / f"c{i}.bin").read_bytes() != conc_files[i].read_bytes():
+            print(json.dumps({"error": f"concurrent job {i} output mismatch"}), file=sys.stderr)
+            return 1
+
+    # ---- phase 3: continuous sync (delta rounds) --------------------------
+    treedir = tmp / "tree"
+    treedir.mkdir()
+    (treedir / "stable.bin").write_bytes(rng.integers(0, 256, 128 << 10, dtype=np.uint8).tobytes())
+    (treedir / "hot.bin").write_bytes(rng.integers(0, 256, 128 << 10, dtype=np.uint8).tobytes())
+    watch_id = c1.submit(
+        {"type": "sync_watch", "src": str(treedir), "dst": str(tmp / "mirror"), "interval_s": 0.0},
+        idem_key="watch-0",
+    )
+    # TTL heartbeat: with a standing watch job live, the idempotent re-admit
+    # must reach the gateway (the reap-vs-heartbeat fix, docs/service-mode.md)
+    heartbeats = c1.heartbeat_once()
+    c1.run_watch_rounds()
+    r0 = c1.job(c1._idem[f"{watch_id}:r0"])
+    drive(c1, [r0.job_id])
+    time.sleep(0.05)
+    (treedir / "hot.bin").write_bytes(rng.integers(0, 256, 128 << 10, dtype=np.uint8).tobytes())
+    c1.run_watch_rounds()
+    r1 = c1.job(c1._idem[f"{watch_id}:r1"])
+    watch_delta_only = {d["src_key"] for d in r1.chunks.values()} == {str(treedir / "hot.bin")}
+    drive(c1, [r1.job_id])
+    watch_rounds = c1.c_watch_rounds
+    watch_identical = (tmp / "mirror" / "hot.bin").read_bytes() == (treedir / "hot.bin").read_bytes()
+    c1.close()
+
+    # ---- phase 4: kill the controller mid-job -----------------------------
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    worker_err = open(tmp / "worker.err", "ab")
+    chunk_kb = 64
+
+    def worker_cmd(wal_dir: Path, spool: Path) -> list:
+        return [
+            sys.executable,
+            "-m",
+            "skyplane_tpu.service.worker",
+            "--wal-dir", str(wal_dir),
+            "--spool", str(spool),
+            "--source-url", src.url("").rstrip("/"),
+            "--sink-url", dst.url("").rstrip("/"),
+            "--chunk-mb", str(chunk_kb / 1024),
+            "--poll-s", "0.02",
+        ]
+
+    def spawn(wal_dir: Path, spool: Path, extra_env: dict = None):  # noqa: ANN001
+        e = dict(env, **(extra_env or {}))
+        return subprocess.Popen(
+            worker_cmd(wal_dir, spool), env=e, cwd=str(REPO), stdout=subprocess.DEVNULL, stderr=worker_err
+        )
+
+    def sink_progress(dest: Path) -> tuple:
+        """(registered chunk ids, complete chunk ids) for one dest at the sink."""
+        snap = dst.get("chunk_requests", timeout=30).json()
+        ours = [
+            cr["chunk"]["chunk_id"]
+            for cr in snap["chunk_requests"]
+            if cr["chunk"]["dest_key"] == str(dest)
+        ]
+        done = {cid for cid in ours if snap["status"].get(cid) == "complete"}
+        return ours, done
+
+    def await_done(status_path: Path, bound_s: float) -> dict:
+        deadline = time.time() + bound_s
+        while time.time() < deadline:
+            if status_path.exists():
+                try:
+                    st = json.loads(status_path.read_text())
+                except ValueError:
+                    st = {}
+                if st.get("jobs_by_state", {}).get("done"):
+                    return st
+            time.sleep(0.05)
+        return {}
+
+    # -- scenario A: SIGKILL mid-flight + torn WAL tail + crash-in-recovery --
+    wal_a = tmp / "wal_kill_a"
+    spool_a = tmp / "spool_a"
+    spool_a.mkdir()
+    kill_src = tmp / "kill.bin"
+    kill_src.write_bytes(rng.integers(0, 256, kill_mb << 20, dtype=np.uint8).tobytes())
+    kill_out = tmp / "kill_out.bin"
+    expected_chunks = (kill_mb << 20) // (chunk_kb << 10)
+    (spool_a / "killjob.json").write_text(
+        json.dumps({"type": "copy", "src": str(kill_src), "dst": str(kill_out)})
+    )
+    proc = spawn(wal_a, spool_a)
+    killed_mid_job = False
+    deadline = time.time() + 120
+    acked_before_kill: set = set()
+    while time.time() < deadline:
+        registered, done = sink_progress(kill_out)
+        if registered and 0 < len(done) < expected_chunks:
+            proc.kill()  # SIGKILL: no handlers, no flush, no goodbye
+            acked_before_kill = done
+            killed_mid_job = True
+            break
+        if len(done) >= expected_chunks:
+            break  # landed before we could aim — should not happen at 16 MB
+        time.sleep(0.005)
+    proc.wait(timeout=30)
+    if not killed_mid_job:
+        print(json.dumps({"error": "kill window missed: job finished before SIGKILL"}), file=sys.stderr)
+        return 1
+    t_kill = time.monotonic()
+
+    # tear the WAL tail: a killed append leaves a PARTIAL frame AFTER the
+    # last good record (appends fsync before their action runs, so a real
+    # crash can only tear the record being written at death — never a record
+    # whose action already happened). Recovery must drop exactly the tear.
+    wal_file = wal_a / "jobs.wal"
+    wal_size_good = wal_file.stat().st_size
+    with open(wal_file, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xef half-a-rec")
+
+    # restart #1: recovery ITSELF crashes once at the reconcile boundary
+    # (service.crash, docs/fault-injection.md) — recovery must be idempotent,
+    # so dying inside it and re-running reaches the same state
+    crash_reconcile = {
+        "SKYPLANE_TPU_FAULTS": json.dumps(
+            {"seed": 14, "points": {"service.crash": {"p": 1.0, "max_fires": 1}}}
+        )
+    }
+    proc2 = spawn(wal_a, spool_a, crash_reconcile)
+    proc2.wait(timeout=120)
+    crash_fault_fired = proc2.returncode == 86
+    # restart #1 truncated the torn tail during WAL replay before it died:
+    # the file is back to its last good record boundary
+    torn_dropped = 1 if wal_file.stat().st_size == wal_size_good else 0
+
+    # restart #2: clean recovery to completion
+    proc3 = spawn(wal_a, spool_a)
+    status = await_done(wal_a / "status.json", RECOVERY_BOUND_S)
+    recovered = bool(status)
+    recovery_seconds = round(time.monotonic() - t_kill, 3)
+    # idempotent resubmission: the surviving spool file is rescanned every
+    # tick — the idempotency key must keep the job table at exactly ONE job
+    # and the sink's registration set frozen
+    registered_after, _ = sink_progress(kill_out)
+    time.sleep(0.5)
+    status2 = json.loads((wal_a / "status.json").read_text()) if (wal_a / "status.json").exists() else {}
+    registered_final, done_final = sink_progress(kill_out)
+    resubmit_noop = (
+        status2.get("jobs_total") == status.get("jobs_total") == 1
+        and len(registered_final) == len(registered_after)
+    )
+    proc3.send_signal(signal.SIGTERM)
+    try:
+        proc3.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc3.kill()
+        proc3.wait(timeout=10)
+
+    byte_identical = kill_out.exists() and kill_out.read_bytes() == kill_src.read_bytes()
+    acked_lost = len(acked_before_kill - done_final)
+    duplicate_registrations = max(0, len(registered_final) - expected_chunks)
+
+    # -- scenario B: death in the WAL->POST window (deterministic requeue) --
+    # service.crash with after=1 skips the reconcile evaluation and fires at
+    # the DISPATCH boundary: the dispatch record is durable, the chunk POST
+    # never happened, the sink holds nothing. Recovery must requeue every
+    # chunk under its original id and finish byte-identical.
+    wal_b = tmp / "wal_kill_b"
+    spool_b = tmp / "spool_b"
+    spool_b.mkdir()
+    gap_src = tmp / "gap.bin"
+    gap_src.write_bytes(rng.integers(0, 256, 4 << 20, dtype=np.uint8).tobytes())
+    gap_out = tmp / "gap_out.bin"
+    gap_expected = (4 << 20) // (chunk_kb << 10)
+    (spool_b / "gapjob.json").write_text(
+        json.dumps({"type": "copy", "src": str(gap_src), "dst": str(gap_out)})
+    )
+    crash_dispatch = {
+        "SKYPLANE_TPU_FAULTS": json.dumps(
+            {"seed": 14, "points": {"service.crash": {"p": 1.0, "after": 1, "max_fires": 1}}}
+        )
+    }
+    proc_b1 = spawn(wal_b, spool_b, crash_dispatch)
+    proc_b1.wait(timeout=120)
+    gap_crash_at_dispatch = proc_b1.returncode == 86
+    gap_registered_at_crash, _ = sink_progress(gap_out)
+    proc_b2 = spawn(wal_b, spool_b)
+    status_b = await_done(wal_b / "status.json", RECOVERY_BOUND_S)
+    proc_b2.send_signal(signal.SIGTERM)
+    try:
+        proc_b2.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc_b2.kill()
+        proc_b2.wait(timeout=10)
+    worker_err.close()
+    requeued = int(status_b.get("chunks_requeued") or 0)
+    gap_registered_final, _ = sink_progress(gap_out)
+    gap_byte_identical = gap_out.exists() and gap_out.read_bytes() == gap_src.read_bytes()
+    gap_ok = (
+        gap_crash_at_dispatch
+        and len(gap_registered_at_crash) == 0  # the POST really never happened
+        and requeued == gap_expected
+        and len(gap_registered_final) == gap_expected  # originals, no fresh ids
+        and gap_byte_identical
+    )
+
+    src.stop()
+    dst.stop()
+    fds_end = open_fd_count()
+    rss_end = rss_bytes()
+
+    result = {
+        "metric": "service_jobs",
+        "value": n_seq + n_conc + 2,  # sequential + concurrent + watch rounds
+        "unit": "jobs",
+        "service_seq_jobs": n_seq,
+        "service_concurrent_jobs": n_conc,
+        "service_job_start_p50_s": round(p50, 4),
+        "service_job_start_p95_s": round(p95, 4),
+        "service_start_bound_s": 1.0,
+        "service_dedup_hit_cold": dedup_cold,
+        "service_dedup_hit_warm": dedup_warm,
+        "service_heartbeats": heartbeats,
+        "service_watch_rounds": watch_rounds,
+        "service_watch_delta_only": bool(watch_delta_only),
+        "service_watch_byte_identical": bool(watch_identical),
+        "service_controller_killed": bool(killed_mid_job),
+        "service_recovery_seconds": recovery_seconds,
+        "service_recovery_bound_s": RECOVERY_BOUND_S,
+        "service_recovered": bool(recovered),
+        "service_byte_identical": bool(byte_identical),
+        "service_acked_chunks_lost": acked_lost,
+        "service_duplicate_registrations": duplicate_registrations,
+        "service_requeued_chunks": requeued,
+        "service_torn_records_dropped": torn_dropped,
+        "service_crash_fault_fired": bool(crash_fault_fired),
+        "service_resubmit_noop": bool(resubmit_noop),
+        "service_dispatch_gap_ok": bool(gap_ok),
+        "service_kill_expected_chunks": expected_chunks,
+        "service_kill_acked_before_kill": len(acked_before_kill),
+        "process_open_fds_start": fds_start,
+        "process_open_fds_end": fds_end,
+        "service_rss_start_bytes": rss_start,
+        "service_rss_end_bytes": rss_end,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,6 +130,7 @@ class ProjectChecker:
 def all_checkers() -> List[Checker]:
     # local import: concurrency/tracer/spans import this module for the base class
     from skyplane_tpu.analysis.concurrency import CONCURRENCY_CHECKERS
+    from skyplane_tpu.analysis.durability import DURABILITY_CHECKERS
     from skyplane_tpu.analysis.framewalk import FRAMEWALK_CHECKERS
     from skyplane_tpu.analysis.ipc import IPC_CHECKERS
     from skyplane_tpu.analysis.lockgraph import LOCKGRAPH_CHECKERS
@@ -140,6 +141,7 @@ def all_checkers() -> List[Checker]:
         cls()
         for cls in (
             *CONCURRENCY_CHECKERS,
+            *DURABILITY_CHECKERS,
             *TRACER_CHECKERS,
             *SPAN_CHECKERS,
             *FRAMEWALK_CHECKERS,

@@ -68,3 +68,26 @@ class SkyplaneClient:
         from skyplane_tpu.api.obj_store import ObjectStore
 
         return ObjectStore()
+
+    def attach_gateway(self, control_url: str, token: Optional[str] = None):
+        """Adopt an already-RUNNING gateway (service mode) as a BoundGateway
+        via its /status probe — no provisioning. See docs/service-mode.md."""
+        from skyplane_tpu.api.dataplane import attach_gateway
+
+        return attach_gateway(control_url, token=token)
+
+    def service(self, wal_dir, source_url: str, sink_url: str, token: Optional[str] = None, **kw):
+        """A crash-safe ServiceController over a standing fleet, submitting
+        jobs under THIS client's tenant identity (admission, fair-share,
+        per-tenant metrics all attribute to it). The embedding-app entry
+        point for the always-on service (docs/service-mode.md)."""
+        from skyplane_tpu.service import ServiceController
+
+        return ServiceController(
+            wal_dir,
+            source_url=source_url,
+            sink_url=sink_url,
+            token=token,
+            tenant_id=self.tenant_id,
+            **kw,
+        )

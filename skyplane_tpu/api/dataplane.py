@@ -74,6 +74,55 @@ class BoundGateway:
             return [f"(error endpoint unreachable: {e})"]
 
 
+class _AttachedServer:
+    """The Server surface BoundGateway needs, for a gateway that is already
+    RUNNING (service mode, docs/service-mode.md): no provisioning handle,
+    just the control endpoint + bearer token."""
+
+    def __init__(self, base_url: str, token: Optional[str] = None):
+        self._base = base_url.rstrip("/")
+        if not self._base.endswith("/api/v1"):
+            self._base += "/api/v1"
+        self._token = token
+
+    def control_url(self) -> str:
+        return self._base
+
+    def control_session(self) -> requests.Session:
+        from skyplane_tpu.gateway.control_auth import control_session
+
+        return control_session(self._token)
+
+
+def attach_gateway(control_url: str, token: Optional[str] = None, timeout: float = 10.0) -> BoundGateway:
+    """Adopt a RUNNING gateway into a BoundGateway by probing its open
+    ``GET /api/v1/status`` route — the service controller's fleet re-binding
+    primitive (and the API-layer attach-to-running-fleet surface: the
+    returned object drives the same tracker/liveness machinery a provisioned
+    gateway does). Raises :class:`SkyplaneTpuException` when the gateway is
+    unreachable or reports an error state, so adoption failures are loud at
+    attach time instead of ten minutes into the first job."""
+    from types import SimpleNamespace
+
+    server = _AttachedServer(control_url, token)
+    try:
+        resp = server.control_session().get(f"{server.control_url()}/status", timeout=timeout)
+        resp.raise_for_status()
+        status = resp.json()
+    except (requests.RequestException, ValueError) as e:
+        raise SkyplaneTpuException(f"cannot attach gateway at {control_url}: {e}") from e
+    if status.get("error"):
+        raise SkyplaneTpuException(
+            f"gateway {status.get('gateway_id')} at {control_url} reports an error state; "
+            "drain or restart it before adoption"
+        )
+    plan_gw = SimpleNamespace(
+        gateway_id=status.get("gateway_id") or control_url,
+        region_tag=status.get("region") or "local:local",
+    )
+    return BoundGateway(plan_gw, server)
+
+
 def _program_touches_key_material(plan_gateway) -> bool:
     """Relays forward opaque ciphertext and must never hold key material
     (reference relay semantics): only gateways whose program actually

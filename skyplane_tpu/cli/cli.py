@@ -52,13 +52,85 @@ def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, resume,
 @click.option("--dedup/--no-dedup", default=None)
 @click.option("--debug", is_flag=True)
 @click.option("--tenant", default=None, help="tenant id (16 hex chars) for multi-tenant gateways; minted when omitted")
-def sync(src, dst, yes, max_instances, solver, compress, dedup, debug, tenant):
-    """Delta-copy only new or changed objects (always recursive)."""
+@click.option("--watch", is_flag=True, help="continuous sync: re-run the delta on an interval through a running service (docs/service-mode.md)")
+@click.option("--interval", default=30.0, type=float, help="with --watch: seconds between delta rounds")
+@click.option("--spool", default=None, help="with --watch: spool directory of the running `skyplane-tpu serve` instance")
+def sync(src, dst, yes, max_instances, solver, compress, dedup, debug, tenant, watch, interval, spool):
+    """Delta-copy only new or changed objects (always recursive).
+
+    With --watch, the delta filter re-runs continuously on a standing fleet:
+    the job spec is dropped into a running service's spool directory and the
+    service keeps fingerprints warm across rounds (docs/service-mode.md)."""
     from skyplane_tpu.cli.cli_transfer import run_transfer
 
+    if watch:
+        import hashlib
+        import json
+        from pathlib import Path
+
+        if not spool:
+            raise click.ClickException(
+                "--watch needs --spool DIR pointing at a running `skyplane-tpu serve` "
+                "spool (start one first; see docs/service-mode.md)"
+            )
+        if len(dst) != 1:
+            raise click.ClickException("--watch supports exactly one destination")
+        spool_dir = Path(spool)
+        if not spool_dir.is_dir():
+            raise click.ClickException(f"spool directory does not exist: {spool_dir}")
+        import os
+
+        key = hashlib.blake2b(f"{src}\x00{dst[0]}".encode(), digest_size=8).hexdigest()
+        spec = {"type": "sync_watch", "src": src, "dst": dst[0], "interval_s": interval}
+        if tenant:
+            spec["tenant_id"] = tenant
+        spec_path = spool_dir / f"watch_{key}.json"
+        # atomic landing: the serve worker scans the spool every poll tick
+        # and quarantines unparseable files — a torn half-written spec would
+        # be .rejected'd instead of ever running
+        tmp_path = spec_path.with_suffix(".tmp")
+        tmp_path.write_text(json.dumps(spec, indent=2))
+        os.replace(tmp_path, spec_path)
+        click.echo(
+            f"queued continuous sync {src} -> {dst[0]} as {spec_path.name} "
+            f"(idempotent: re-running this command updates the same watch)"
+        )
+        return
     sys.exit(run_transfer(src, list(dst), recursive=True, sync=True, yes=yes,
                           max_instances=max_instances, solver=solver, compress=compress, dedup=dedup, debug=debug,
                           tenant=tenant))
+
+
+@main.command()
+@click.option("--wal-dir", required=True, help="WAL/snapshot state directory (survives restarts)")
+@click.option("--spool", required=True, help="job-spec spool directory (one JSON file per job)")
+@click.option("--source-url", required=True, help="source gateway control URL, e.g. https://10.0.0.5:8081")
+@click.option("--sink-url", required=True, help="sink gateway control URL")
+@click.option("--token", default=None, help="gateway API bearer token")
+@click.option("--tenant", default=None, help="default tenant id for submitted jobs")
+@click.option("--chunk-mb", default=4.0, type=float, help="default chunk size (MiB)")
+@click.option("--heartbeat-s", default=5.0, type=float, help="admission-TTL heartbeat interval")
+@click.option("--poll-s", default=0.1, type=float, help="progress poll interval")
+def serve(wal_dir, spool, source_url, sink_url, token, tenant, chunk_mb, heartbeat_s, poll_s):
+    """Run the always-on replication service over a standing fleet.
+
+    Adopts the (already running) gateways via /status, recovers in-flight
+    jobs from the crash-safe WAL, then serves jobs dropped into the spool
+    directory with sub-second warm dispatch. SIGKILL-safe by design: restart
+    with the same --wal-dir and nothing is lost (docs/service-mode.md)."""
+    from skyplane_tpu.service.worker import run_service
+
+    run_service(
+        wal_dir,
+        spool,
+        source_url=source_url,
+        sink_url=sink_url,
+        token=token,
+        tenant_id=tenant,
+        chunk_bytes=int(chunk_mb * (1 << 20)),
+        heartbeat_interval_s=heartbeat_s,
+        poll_interval_s=poll_s,
+    )
 
 
 @main.command()

@@ -23,6 +23,8 @@ directly:
                                            control_port, old_target_gateway_id?}
   POST /api/v1/jobs                        admit a job {job_id, tenant_id,
                                            weight?, quotas?} -> 200 | 429
+  POST /api/v1/jobs/<job_id>/heartbeat     refresh a live job's TTL clock
+                                           (service mode) -> 200 | 404
   DELETE /api/v1/jobs/<job_id>             release a job's admission slot
   GET  /api/v1/tenants                     tenant/job registry snapshot +
                                            scheduler usage (multitenancy)
@@ -607,6 +609,16 @@ class GatewayDaemonAPI:
 
     def _handle_post(self, req) -> None:
         path, _ = self._split_route(req)
+        parts = path.split("/")
+        if len(parts) == 6 and parts[:4] == ["", "api", "v1", "jobs"] and parts[5] == "heartbeat":
+            # light TTL refresh for a LIVE job (service-mode controllers,
+            # docs/service-mode.md): no tenant upsert, no scheduler push.
+            # 404 = unknown (reaped or never admitted) — the caller must
+            # re-admit through the full POST /jobs path, never assume
+            # liveness; an already-swept slot stays swept.
+            ok = self.tenant_registry is not None and self.tenant_registry.heartbeat_job(parts[4])
+            req._send(200 if ok else 404, {"status": "ok" if ok else "unknown job"})
+            return
         inj = get_injector()
         if inj.enabled and path in ("/api/v1/chunk_requests", "/api/v1/servers") and inj.fire("control.api"):
             # control-plane fault (docs/fault-injection.md): a transient 503

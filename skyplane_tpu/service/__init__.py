@@ -1,0 +1,24 @@
+"""Always-on replication service: a crash-safe job control plane over a
+standing warm fleet (docs/service-mode.md).
+
+  * :mod:`~skyplane_tpu.service.wal` — append-only CRC-per-record job WAL
+    with torn-tail truncation and snapshot compaction;
+  * :mod:`~skyplane_tpu.service.controller` — the ServiceController: warm
+    dispatch through the admission API, sink-reconciled recovery, client
+    idempotency keys, TTL heartbeats, continuous-sync rounds;
+  * :mod:`~skyplane_tpu.service.watch` — the sync delta filter;
+  * :mod:`~skyplane_tpu.service.worker` — the ``skyplane-tpu serve`` loop:
+    spool-directory job intake over a long-lived controller.
+"""
+
+from skyplane_tpu.service.controller import (  # noqa: F401
+    ServiceController,
+    ServiceJob,
+    ST_DISPATCHED,
+    ST_DONE,
+    ST_FAILED,
+    ST_SUBMITTED,
+    ST_WATCHING,
+)
+from skyplane_tpu.service.wal import ServiceWAL, fsync_dir, fsync_replace  # noqa: F401
+from skyplane_tpu.service.watch import compute_sync_delta  # noqa: F401

@@ -1,0 +1,264 @@
+"""Crash-safe job WAL for the always-on replication service.
+
+The service controller's one source of truth about job state is this
+append-only write-ahead log: a controller that is SIGKILLed at ANY byte
+boundary must come back knowing which jobs were submitted, which chunks were
+dispatched where, which landed at the sink, and which jobs finalized — so
+recovery requeues only what never landed and resubmission after an ambiguous
+crash is safe (client idempotency keys replay to the same job).
+
+Record framing (binary, CRC-per-record — the PersistentDedupIndex journal
+discipline applied to variable-length payloads)::
+
+    <u32 payload_len> <u32 crc32(payload)> <payload: UTF-8 JSON>
+
+  * **append = write + flush + fsync.** Unlike the dedup journal (warmth:
+    losing the write-back window is harmless), job records are CORRECTNESS —
+    a ``dispatch`` record that never hit disk means recovery cannot know a
+    chunk is in flight, and a lost ``finalize`` re-runs side effects. Every
+    append fsyncs before the caller proceeds to the action it logs
+    (write-ahead, not write-behind).
+  * **torn-tail truncation at recovery.** Replay walks records until the
+    first length/CRC mismatch, counts the tear, and truncates the file back
+    to the last good record boundary (fsync file + directory) so the next
+    append continues from a clean frame.
+  * **snapshot compaction.** When the log outgrows its bound, the live job
+    table is serialized to ``jobs.snap.tmp``, fsynced, ``os.replace``d over
+    ``jobs.snap`` with a directory fsync, and the WAL is truncated — the
+    atomic-landing idiom with the full fsync discipline the
+    ``unsynced-durable-write`` lint rule enforces. A crash between the
+    replace and the truncate replays a WAL whose records are idempotent
+    against the snapshot state.
+
+Record types (``job_id`` on every record)::
+
+    {"type": "submit",   "job_id", "idem", "spec": {...}}
+    {"type": "dispatch", "job_id", "gateway_id", "chunks": [[cid, off, len], ...]}
+    {"type": "progress", "job_id", "landed": [cid, ...]}
+    {"type": "finalize", "job_id", "status": "done" | "failed", "error"?}
+
+Fault points (docs/fault-injection.md): ``service.journal_torn`` persists
+half a record and stops journaling (the exact on-disk state a crash
+mid-append leaves); ``service.crash`` is evaluated by the CONTROLLER at its
+dispatch/reconcile/compact boundaries, not here.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import struct
+import threading
+import zlib
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skyplane_tpu.faults import get_injector
+from skyplane_tpu.utils.fsio import fsync_dir, fsync_replace  # noqa: F401 — re-exported via service/__init__
+from skyplane_tpu.utils.logger import logger
+from skyplane_tpu.obs import lockwitness as lockcheck
+
+_HDR = struct.Struct("<II")  # payload_len, crc32(payload)
+#: guard against a corrupt length field walking replay off a cliff — no job
+#: record (even a dispatch batch) approaches this
+MAX_RECORD_BYTES = 8 << 20
+
+REC_SUBMIT = "submit"
+REC_DISPATCH = "dispatch"
+REC_PROGRESS = "progress"
+REC_FINALIZE = "finalize"
+
+_SNAP_MAGIC = "skyplane-service-snap-v1"
+
+
+def _pack(rec: dict) -> bytes:
+    payload = json.dumps(rec, separators=(",", ":")).encode()
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+class ServiceWAL:
+    """Append-only, CRC-per-record job log with snapshot compaction.
+
+    Thread-safe: the controller's dispatcher, progress poller, and heartbeat
+    all append concurrently. Replay/compaction state (the job table) is owned
+    by the caller — the WAL only persists and replays records.
+    """
+
+    def __init__(self, state_dir, journal_max_bytes: int = 4 << 20):
+        self.dir = Path(state_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.wal_path = self.dir / "jobs.wal"
+        self.snap_path = self.dir / "jobs.snap"
+        self.journal_max_bytes = max(1 << 14, int(journal_max_bytes))
+        # one controller per WAL (the TransferJournal flock discipline): two
+        # live controllers would interleave appends and double-dispatch jobs.
+        # The flock dies with the process, so a SIGKILLed controller never
+        # blocks its successor.
+        self._flock_fh = (self.dir / "controller.lock").open("w")
+        try:
+            fcntl.flock(self._flock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            self._flock_fh.close()
+            self._flock_fh = None
+            from skyplane_tpu.exceptions import SkyplaneTpuException
+
+            raise SkyplaneTpuException(
+                f"another service controller already owns this WAL ({self.dir})"
+            ) from e
+        self._lock = lockcheck.wrap(threading.Lock(), "ServiceWAL._lock")
+        self._fh = None
+        self._bytes = 0
+        # counters (GIL-bumped; surfaced on the service status snapshot)
+        self.c_appends = 0
+        self.c_torn_dropped = 0
+        self.c_compactions = 0
+        self.c_recovered_records = 0
+
+    # ---- recovery ----
+
+    def _iter_records(self, buf: bytes, source: str) -> Iterator[Tuple[int, dict]]:
+        """Yield (end_offset, record) until the end or the first torn entry
+        (short header, short payload, implausible length, CRC mismatch, or
+        undecodable JSON — every one is what a mid-append crash leaves)."""
+        off = 0
+        while off < len(buf):
+            if off + _HDR.size > len(buf):
+                break
+            length, crc = _HDR.unpack_from(buf, off)
+            if length > MAX_RECORD_BYTES or off + _HDR.size + length > len(buf):
+                break
+            payload = buf[off + _HDR.size : off + _HDR.size + length]
+            if zlib.crc32(payload) != crc:
+                break
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                break
+            if not isinstance(rec, dict):
+                break
+            off += _HDR.size + length
+            yield off, rec
+        if off < len(buf):
+            self.c_torn_dropped += 1
+            logger.fs.warning(
+                f"[service-wal] dropping torn tail of {source} at offset {off} "
+                f"({len(buf) - off} trailing bytes)"
+            )
+
+    def recover(self) -> Tuple[Optional[dict], List[dict]]:
+        """Load (snapshot, wal_records), truncating the WAL past a torn tail
+        so the next append starts at a clean record boundary. Returns the
+        snapshot payload (or None) and the good WAL records in append order.
+        Must be called before the first append()."""
+        snapshot: Optional[dict] = None
+        if self.snap_path.exists():
+            buf = self.snap_path.read_bytes()
+            recs = [rec for _, rec in self._iter_records(buf, "snapshot")]
+            if recs and recs[0].get("type") == _SNAP_MAGIC:
+                snapshot = recs[0]
+            else:
+                logger.fs.warning("[service-wal] snapshot has bad magic; ignoring it")
+        records: List[dict] = []
+        good = 0
+        if self.wal_path.exists():
+            buf = self.wal_path.read_bytes()
+            for end, rec in self._iter_records(buf, "journal"):
+                good = end
+                records.append(rec)
+            if good < len(buf):
+                with open(self.wal_path, "r+b") as f:
+                    f.truncate(good)
+                    f.flush()
+                    os.fsync(f.fileno())
+                fsync_dir(self.dir)
+        self.c_recovered_records = len(records)
+        with self._lock:
+            self._fh = open(self.wal_path, "ab")
+            self._bytes = good
+        return snapshot, records
+
+    # ---- appends ----
+
+    def append(self, rec: dict) -> bool:
+        """Durably append one record (write + flush + fsync) BEFORE the
+        caller performs the action the record describes. Returns False when
+        the WAL is closed (shutdown or a fired torn-write fault) — the
+        caller keeps running on in-memory state; the next recovery simply
+        re-reconciles the unlogged window against the sink."""
+        body = _pack(rec)
+        inj = get_injector()
+        if inj.enabled and inj.fire("service.journal_torn"):
+            # torn-write fault (docs/fault-injection.md): persist HALF the
+            # record and stop journaling — the tear must stay at the tail
+            # (full records appended after a mid-file tear would be silently
+            # destroyed by recovery's truncation, an impossible crash state)
+            with self._lock:
+                if self._fh is not None:
+                    self._fh.write(body[: max(1, len(body) // 2)])
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self._fh.close()
+                    self._fh = None
+            return False
+        with self._lock:
+            if self._fh is None:
+                return False
+            self._fh.write(body)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.c_appends += 1
+            self._bytes += len(body)
+        return True
+
+    def needs_compaction(self) -> bool:
+        with self._lock:
+            return self._fh is not None and self._bytes >= self.journal_max_bytes
+
+    # ---- compaction ----
+
+    def compact(self, state: dict) -> None:
+        """Snapshot the caller's live job table and truncate the WAL.
+
+        The whole pass holds the append lock: a record appended between the
+        snapshot serialization and the truncate would be destroyed (a lost
+        ``finalize`` re-runs a completed job's side effects at the next
+        recovery). Appends block for the (small) snapshot write instead."""
+        with self._lock:
+            if self._fh is None:
+                return
+            blob = _pack({"type": _SNAP_MAGIC, "state": state})
+            tmp = self.snap_path.with_name(self.snap_path.name + ".tmp")
+            tmp.write_bytes(blob)
+            fsync_replace(tmp, self.snap_path)
+            self._fh.close()
+            self._fh = open(self.wal_path, "wb")  # truncate
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._bytes = 0
+            self.c_compactions += 1
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None
+            if self._flock_fh is not None:
+                try:
+                    fcntl.flock(self._flock_fh, fcntl.LOCK_UN)
+                except OSError:
+                    pass
+                self._flock_fh.close()
+                self._flock_fh = None
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "service_wal_appends": self.c_appends,
+            "service_wal_torn_records_dropped": self.c_torn_dropped,
+            "service_wal_compactions": self.c_compactions,
+            "service_wal_recovered_records": self.c_recovered_records,
+        }

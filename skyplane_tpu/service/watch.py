@@ -1,0 +1,50 @@
+"""Continuous-sync delta filter for service mode.
+
+``sync --watch`` re-runs SyncJob's delta discipline (copy only new or
+changed objects — size differs, destination missing, or source mtime newer)
+against LOCAL paths on an interval, through a standing fleet whose
+fingerprints stay warm across rounds: an unchanged file ships zero chunks,
+and a changed file's unchanged segments dedup to REFs at the wire
+(docs/service-mode.md "Continuous sync").
+
+The filter is a pure function of the two trees — the watcher keeps no state
+of its own, so a controller crash between rounds loses nothing: the next
+round recomputes the delta from the filesystem, and the WAL's idempotency
+keys make a crash *mid*-round resume that round's job.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Tuple
+
+
+def _changed(src_file: Path, dst_file: Path) -> bool:
+    """SyncJob._post_filter_fn's rule for local files: copy when the
+    destination is missing, sizes differ, or the source is newer."""
+    try:
+        dst_stat = dst_file.stat()
+    except OSError:
+        return True
+    src_stat = src_file.stat()
+    if src_stat.st_size != dst_stat.st_size:
+        return True
+    return src_stat.st_mtime > dst_stat.st_mtime
+
+
+def walk_pairs(src: Path, dst: Path) -> List[Tuple[Path, Path]]:
+    """(src_file, dst_file) pairs for a transfer: a file maps to dst
+    directly; a directory walks recursively with relative-path mapping.
+    The ONE traversal rule for service jobs — copy dispatch and the sync
+    delta filter both build on it, so they can never diverge."""
+    src, dst = Path(src), Path(dst)
+    if src.is_dir():
+        return [(f, dst / f.relative_to(src)) for f in sorted(src.rglob("*")) if f.is_file()]
+    return [(src, dst)]
+
+
+def compute_sync_delta(src: Path, dst: Path) -> List[Tuple[Path, Path]]:
+    """The pairs that need to ship this round (deletions are NOT
+    propagated — sync adds and updates, mirroring the reference's sync
+    semantics)."""
+    return [(s, d) for s, d in walk_pairs(src, dst) if _changed(s, d)]

@@ -1,0 +1,159 @@
+"""The ``skyplane-tpu serve`` loop: a long-lived controller over a spool.
+
+Job intake is a SPOOL DIRECTORY: clients (the CLI, cron, another process)
+drop one JSON job spec per file —
+
+    {"type": "copy" | "sync" | "sync_watch", "src": ..., "dst": ...,
+     "chunk_bytes"?, "tenant_id"?, "interval_s"?}
+
+— and the worker submits each with its filename stem as the idempotency key.
+That makes the intake itself crash-safe with zero extra machinery: a
+restarted worker re-scans the spool and resubmits every file, and the WAL's
+idempotency replay turns the resubmissions into no-ops for jobs it already
+knows (docs/service-mode.md "Job intake").
+
+The loop is: scan spool -> controller.tick() (progress, TTL heartbeats,
+watch rounds) -> write an advisory status.json -> sleep. SIGTERM exits
+cleanly (WAL fsynced on close); SIGKILL is the whole point — the next start
+recovers from the WAL.
+
+Run it via the CLI (``skyplane-tpu serve``) or directly:
+
+    python -m skyplane_tpu.service.worker --wal-dir D --spool S \
+        --source-url http://... --sink-url http://...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from pathlib import Path
+from typing import Optional
+
+from skyplane_tpu.service.controller import ServiceController
+from skyplane_tpu.utils.logger import logger
+
+
+def scan_spool(controller: ServiceController, spool_dir: Path) -> int:
+    """Submit every readable spec file (idempotency key = filename stem);
+    malformed files are renamed ``<name>.rejected`` so they are reported
+    once, not re-parsed forever. Returns specs submitted this scan (idempotent
+    resubmissions included — they cost one dict lookup)."""
+    n = 0
+    for spec_path in sorted(spool_dir.glob("*.json")):
+        try:
+            spec = json.loads(spec_path.read_text())
+            if not isinstance(spec, dict) or "src" not in spec or "dst" not in spec:
+                raise ValueError("job spec must be an object with src and dst")
+        except (OSError, ValueError) as e:
+            logger.fs.warning(f"[service] rejecting malformed spool file {spec_path.name}: {e}")
+            try:
+                spec_path.rename(spec_path.with_suffix(".rejected"))
+            except OSError:
+                pass
+            continue
+        try:
+            controller.submit(spec, idem_key=f"spool:{spec_path.stem}")
+            n += 1
+        except Exception as e:  # noqa: BLE001 — a failing job must not kill the intake loop
+            # the submit record (if it landed) makes the retry idempotent;
+            # the file stays in the spool and the next scan / the
+            # controller's dispatch_pending retries it
+            logger.fs.warning(f"[service] submit of spool file {spec_path.name} failed: {e}")
+    return n
+
+
+def write_status(controller: ServiceController, path: Path) -> None:
+    """Advisory status snapshot (atomic rename; NOT fsynced — it is derived
+    state the WAL re-creates, not durable truth)."""
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(controller.status(), indent=2, sort_keys=True))
+    os.replace(tmp, path)
+
+
+def run_service(
+    wal_dir,
+    spool_dir,
+    source_url: str,
+    sink_url: str,
+    token: Optional[str] = None,
+    tenant_id: Optional[str] = None,
+    chunk_bytes: int = 4 << 20,
+    heartbeat_interval_s: float = 5.0,
+    poll_interval_s: float = 0.1,
+    stop_event: Optional[threading.Event] = None,
+    max_ticks: Optional[int] = None,
+) -> ServiceController:
+    """Attach, recover, loop. Returns the controller after the loop exits
+    (stop_event set, SIGTERM, or max_ticks — the last is for tests)."""
+    spool = Path(spool_dir)
+    spool.mkdir(parents=True, exist_ok=True)
+    controller = ServiceController(
+        wal_dir,
+        source_url=source_url,
+        sink_url=sink_url,
+        token=token,
+        tenant_id=tenant_id,
+        chunk_bytes=chunk_bytes,
+        heartbeat_interval_s=heartbeat_interval_s,
+    )
+    stop = stop_event or threading.Event()
+
+    def _sigterm(signum, frame):  # noqa: ARG001 — signal signature
+        stop.set()
+
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, _sigterm)
+    adopted = controller.attach()
+    recovery = controller.recover()
+    logger.fs.info(f"[service] serving: adopted {adopted}, recovery {recovery}")
+    status_path = Path(wal_dir) / "status.json"
+    ticks = 0
+    while not stop.is_set():
+        try:
+            scan_spool(controller, spool)
+            controller.tick()
+            write_status(controller, status_path)
+        except Exception as e:  # noqa: BLE001 — the service must outlive transient gateway outages
+            logger.fs.warning(f"[service] tick failed (retrying): {e}")
+        ticks += 1
+        if max_ticks is not None and ticks >= max_ticks:
+            break
+        stop.wait(poll_interval_s)
+    controller.close()
+    write_status(controller, status_path)
+    return controller
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="skyplane-tpu service worker (see docs/service-mode.md)")
+    ap.add_argument("--wal-dir", required=True, help="WAL/snapshot state directory (survives restarts)")
+    ap.add_argument("--spool", required=True, help="job-spec spool directory (one JSON file per job)")
+    ap.add_argument("--source-url", required=True, help="source gateway control URL")
+    ap.add_argument("--sink-url", required=True, help="sink gateway control URL")
+    ap.add_argument("--token", default=None, help="gateway API bearer token")
+    ap.add_argument("--tenant", default=None, help="default tenant id for submitted jobs")
+    ap.add_argument("--chunk-mb", type=float, default=4.0, help="default chunk size (MiB)")
+    ap.add_argument("--heartbeat-s", type=float, default=5.0, help="TTL heartbeat interval")
+    ap.add_argument("--poll-s", type=float, default=0.1, help="progress poll interval")
+    args = ap.parse_args(argv)
+    run_service(
+        args.wal_dir,
+        args.spool,
+        source_url=args.source_url,
+        sink_url=args.sink_url,
+        token=args.token,
+        tenant_id=args.tenant,
+        chunk_bytes=int(args.chunk_mb * (1 << 20)),
+        heartbeat_interval_s=args.heartbeat_s,
+        poll_interval_s=args.poll_s,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

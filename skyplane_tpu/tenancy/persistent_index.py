@@ -44,6 +44,7 @@ from typing import Dict, Optional, Tuple
 from skyplane_tpu.chunk import DEFAULT_TENANT_ID
 from skyplane_tpu.faults import get_injector
 from skyplane_tpu.ops.dedup import SenderDedupIndex
+from skyplane_tpu.utils.fsio import fsync_replace
 from skyplane_tpu.utils.logger import logger
 from skyplane_tpu.obs import lockwitness as lockcheck
 
@@ -247,7 +248,12 @@ class PersistentDedupIndex(SenderDedupIndex):
                 blob += _pack_record(_KIND_ADD, fp, size, tenant)
             tmp = self._snap_path.with_name(f"{self._snap_path.name}.tmp{threading.get_ident()}")
             tmp.write_bytes(bytes(blob))
-            os.replace(tmp, self._snap_path)
+            # durable landing (utils/fsio.py, the unsynced-durable-write bug
+            # class): a bare os.replace can truncate the journal below while
+            # the new snapshot's bytes are still write-back cache — a badly
+            # timed power cut would then lose BOTH (cold restart, not
+            # corruption, but the warmth this index exists to keep)
+            fsync_replace(tmp, self._snap_path)
             self._jf.close()
             self._jf = open(self._journal_path, "wb")  # truncate
             self._c_journal_bytes = 0

@@ -143,7 +143,14 @@ class TenantRegistry:
         with self._lock:
             self._expire_stale_jobs_locked(time.time())
             if self._jobs.get(job_id) == tenant_id:
-                return tenant_id  # idempotent re-admit (client retry)
+                # idempotent re-admit (client retry / service-mode heartbeat):
+                # the re-admit IS the liveness signal, so it must refresh the
+                # TTL clock — without this, a continuous-sync job that
+                # heartbeats every few seconds still got reaped at the 24 h
+                # mark because only the ORIGINAL admission time was kept
+                # (the reap-vs-heartbeat race, docs/service-mode.md)
+                self._job_started[job_id] = time.time()
+                return tenant_id
             state = self._tenant_locked(tenant_id)
             if len(self._jobs) >= self.max_jobs_total:
                 state.jobs_rejected += 1
@@ -165,6 +172,17 @@ class TenantRegistry:
             # carried no explicit policy (default weight 1.0)
             self.scheduler.set_tenant(tenant_id, weight=job_weight, caps=job_caps)
         return tenant_id
+
+    def heartbeat_job(self, job_id: str) -> bool:
+        """Refresh a live job's TTL clock without the admission side effects
+        (no scheduler push, no tenant upsert). Returns False for an unknown
+        job — the caller should re-admit, not assume liveness: a sweep that
+        already reaped the slot must not be silently un-reaped."""
+        with self._lock:
+            if job_id not in self._jobs:
+                return False
+            self._job_started[job_id] = time.time()
+            return True
 
     def finish_job(self, job_id: str) -> bool:
         """Release a job's admission slot (idempotent)."""

@@ -68,6 +68,80 @@ def test_invalidate_record_clears_key_across_replays(tmp_path):
     j2.close()
 
 
+def test_torn_tail_replay_never_raises_never_resurrects(tmp_path):
+    """Crash-mid-append property (mirrors the PersistentDedupIndex torn-
+    journal tests): truncate the journal at EVERY byte of its last record —
+    every prefix a killed ``_append`` can leave on disk. Replay must (a)
+    never raise, (b) never resurrect the invalidated key's skip state, and
+    (c) keep every record before the tear intact."""
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    # key x: fully landed, finalized... then invalidated by a failed verify
+    j.record_object("x", 100, "t", part_size=10)
+    j.record_upload_id("r1", "x", "dst/x", "upload-X")
+    j.record_chunk("c1", "x", 0)
+    j.record_chunk_done("c1")
+    j.record_finalized("x")
+    j.record_invalidate("x")
+    # key y: landed in full — the record a torn tail may NOT corrupt
+    j.record_object("y", 50, "t", part_size=0)
+    j.record_chunk("c2", "y", 0)
+    j.record_chunk_done("c2")
+    # the record that tears: a fresh dispatch for key z
+    j.record_object("z", 75, "t", part_size=0)
+    j.close()
+
+    full = p.read_bytes()
+    lines = full.splitlines(keepends=True)
+    last = lines[-1]
+    body = b"".join(lines[:-1])
+    for cut in range(len(last) + 1):
+        p.write_bytes(body + last[:cut])
+        j2 = TransferJournal(p)  # replay must never raise
+        assert not j2.object_complete("x", 100, "t", 10, was_multipart=True), (
+            f"cut={cut}: invalidated key x resurrected as complete"
+        )
+        assert j2.reusable_upload_id("r1", "x") is None, f"cut={cut}: stale upload id resurrected"
+        assert not j2.part_done("x", 0), f"cut={cut}: invalidated key's parts resurrected"
+        # records BEFORE the torn tail survive untouched
+        assert j2.object_complete("y", 50, "t", 0, was_multipart=False), (
+            f"cut={cut}: torn tail corrupted an earlier, complete record"
+        )
+        j2.close()
+
+
+def test_torn_tail_mid_invalidate_loses_only_that_record(tmp_path):
+    """When the INVALIDATE record itself tears, the journal honestly reverts
+    to the pre-invalidate state (the invalidation never became durable) —
+    earlier records still replay, and a re-run's verify re-invalidates."""
+    p = tmp_path / "j.jsonl"
+    j = TransferJournal(p)
+    j.record_object("x", 100, "t", part_size=0)
+    j.record_chunk("c1", "x", 0)
+    j.record_chunk_done("c1")
+    j.record_invalidate("x")
+    j.close()
+    full = p.read_bytes()
+    lines = full.splitlines(keepends=True)
+    body, last = b"".join(lines[:-1]), lines[-1]
+    for cut in range(len(last)):
+        p.write_bytes(body + last[:cut])
+        # did this cut leave a COMPLETE record (e.g. all but the trailing
+        # newline)? Then the invalidation became durable and must apply.
+        try:
+            json.loads(last[:cut].decode())
+            invalidate_durable = True
+        except ValueError:
+            invalidate_durable = False
+        j2 = TransferJournal(p)  # replay must never raise
+        # binary outcome, never a mixed state: either the full pre-invalidate
+        # truth (x landed) or the full invalidation (x re-transfers)
+        assert j2.object_complete("x", 100, "t", 0, was_multipart=False) == (not invalidate_durable), (
+            f"cut={cut}"
+        )
+        j2.close()
+
+
 def test_layout_change_is_not_resumable(tmp_path):
     p = tmp_path / "j.jsonl"
     j = TransferJournal(p)

@@ -1,0 +1,175 @@
+"""ServiceWAL durability properties (service-mode recovery hinges on these,
+docs/service-mode.md "WAL record schema")."""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.faults import FaultPlan, configure_injector
+from skyplane_tpu.service.wal import _HDR, ServiceWAL, _pack
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    yield
+    configure_injector(None)  # never leak an armed plan into another test
+
+
+RECS = [
+    {"type": "submit", "job_id": "j1", "idem": "k1", "spec": {"src": "a", "dst": "b"}},
+    {"type": "dispatch", "job_id": "j1", "chunks": [{"chunk_id": "c1", "offset": 0, "length": 10}]},
+    {"type": "progress", "job_id": "j1", "landed": ["c1"]},
+    {"type": "finalize", "job_id": "j1", "status": "done"},
+]
+
+
+def test_roundtrip(tmp_path):
+    w = ServiceWAL(tmp_path)
+    w.recover()
+    for r in RECS:
+        assert w.append(r)
+    w.close()
+    w2 = ServiceWAL(tmp_path)
+    snap, records = w2.recover()
+    assert snap is None
+    assert records == RECS
+    assert w2.c_torn_dropped == 0
+    w2.close()
+
+
+def test_torn_tail_truncated_at_every_byte(tmp_path):
+    """Crash-mid-append property: for EVERY strict prefix of the last record
+    the on-disk file can hold, recovery never raises, keeps every earlier
+    record, drops the tear, and truncates so the next append frames cleanly."""
+    w = ServiceWAL(tmp_path)
+    w.recover()
+    for r in RECS[:-1]:
+        w.append(r)
+    w.close()
+    body = (tmp_path / "jobs.wal").read_bytes()
+    last = _pack(RECS[-1])
+    for cut in range(len(last)):  # strict prefixes: the record never lands
+        (tmp_path / "jobs.wal").write_bytes(body + last[:cut])
+        w2 = ServiceWAL(tmp_path)
+        snap, records = w2.recover()
+        assert records == RECS[:-1], f"cut={cut}: earlier records corrupted"
+        if cut:
+            assert w2.c_torn_dropped == 1, f"cut={cut}: tear not counted"
+        # the truncation left a clean boundary: appending works and replays
+        assert w2.append({"type": "finalize", "job_id": "j1", "status": "done"})
+        w2.close()
+        w3 = ServiceWAL(tmp_path)
+        _, records3 = w3.recover()
+        assert records3[-1] == {"type": "finalize", "job_id": "j1", "status": "done"}, f"cut={cut}"
+        w3.close()
+
+
+def test_corrupt_length_field_is_a_tear_not_a_crash(tmp_path):
+    """A flipped length field must not walk replay off a cliff (or allocate
+    gigabytes): anything implausible is a tear at that boundary."""
+    w = ServiceWAL(tmp_path)
+    w.recover()
+    w.append(RECS[0])
+    w.close()
+    good = (tmp_path / "jobs.wal").read_bytes()
+    evil = good + _HDR.pack(1 << 30, 0) + b"x" * 16
+    (tmp_path / "jobs.wal").write_bytes(evil)
+    w2 = ServiceWAL(tmp_path)
+    _, records = w2.recover()
+    assert records == [RECS[0]]
+    assert w2.c_torn_dropped == 1
+    assert (tmp_path / "jobs.wal").stat().st_size == len(good)
+    w2.close()
+
+
+def test_crc_mismatch_is_a_tear(tmp_path):
+    w = ServiceWAL(tmp_path)
+    w.recover()
+    w.append(RECS[0])
+    w.append(RECS[1])
+    w.close()
+    buf = bytearray((tmp_path / "jobs.wal").read_bytes())
+    buf[-3] ^= 0xFF  # flip a byte inside the LAST record's payload
+    (tmp_path / "jobs.wal").write_bytes(bytes(buf))
+    w2 = ServiceWAL(tmp_path)
+    _, records = w2.recover()
+    assert records == [RECS[0]]
+    assert w2.c_torn_dropped == 1
+    w2.close()
+
+
+def test_snapshot_compaction_and_replay(tmp_path):
+    w = ServiceWAL(tmp_path, journal_max_bytes=1 << 14)
+    w.recover()
+    for i in range(300):
+        w.append({"type": "progress", "job_id": "j1", "landed": [f"c{i}" * 8]})
+    assert w.needs_compaction()
+    state = {"jobs": [{"job_id": "j1", "state": "dispatched"}]}
+    w.compact(state)
+    assert not w.needs_compaction()
+    assert w.c_compactions == 1
+    # records appended AFTER the snapshot replay on top of it
+    w.append({"type": "finalize", "job_id": "j1", "status": "done"})
+    w.close()
+    w2 = ServiceWAL(tmp_path)
+    snap, records = w2.recover()
+    assert snap is not None and snap["state"] == state
+    assert records == [{"type": "finalize", "job_id": "j1", "status": "done"}]
+    w2.close()
+
+
+def test_torn_snapshot_is_ignored_not_fatal(tmp_path):
+    """A crash mid-snapshot-write cannot happen past fsync_replace, but a
+    corrupted snapshot file on disk must degrade to WAL-only replay."""
+    w = ServiceWAL(tmp_path)
+    w.recover()
+    w.append(RECS[0])
+    w.close()
+    (tmp_path / "jobs.snap").write_bytes(b"garbage that is not a framed record")
+    w2 = ServiceWAL(tmp_path)
+    snap, records = w2.recover()
+    assert snap is None
+    assert records == [RECS[0]]
+    w2.close()
+
+
+def test_journal_torn_fault_point(tmp_path):
+    """service.journal_torn (docs/fault-injection.md): the append persists
+    half a record and STOPS journaling — recovery truncates the tear and
+    replays everything before it."""
+    from skyplane_tpu.faults import FaultSpec
+
+    configure_injector(
+        FaultPlan(seed=7, points={"service.journal_torn": FaultSpec(p=1.0, after=2, max_fires=1)})
+    )
+    w = ServiceWAL(tmp_path)
+    w.recover()
+    assert w.append(RECS[0])
+    assert w.append(RECS[1])
+    assert not w.append(RECS[2]), "the torn append must report failure"
+    assert not w.append(RECS[3]), "journaling must STAY stopped after a tear"
+    configure_injector(None)
+    w.close()
+    w2 = ServiceWAL(tmp_path)
+    _, records = w2.recover()
+    assert records == RECS[:2]
+    assert w2.c_torn_dropped == 1
+    w2.close()
+
+
+def test_single_controller_flock(tmp_path):
+    w = ServiceWAL(tmp_path)
+    with pytest.raises(SkyplaneTpuException):
+        ServiceWAL(tmp_path)
+    w.close()
+    w2 = ServiceWAL(tmp_path)  # released on close
+    w2.close()
+
+
+def test_empty_payload_struct_sanity():
+    buf = _pack({"type": "x"})
+    length, crc = struct.unpack_from("<II", buf, 0)
+    assert length == len(buf) - 8

@@ -906,6 +906,86 @@ def gear_step(state, byte):
     assert "u32-cast-missing" not in rules_of(bad_src, "skyplane_tpu/planner/whatever.py")
 
 
+# ----------------------------------------------------- durability rules
+
+
+def test_unsynced_durable_write_fires_on_bare_snapshot_replace():
+    """The torn-state bug class the service PR must never ship: an
+    os.replace landing a snapshot/journal with no fsync of the staged file
+    and parent directory in the enclosing function."""
+    src = """
+import os
+def compact(self):
+    tmp = self.snap_path.with_name("jobs.snap.tmp")
+    tmp.write_bytes(b"x")
+    os.replace(tmp, self.snap_path)
+"""
+    assert "unsynced-durable-write" in rules_of(src)
+
+
+def test_unsynced_durable_write_fires_on_rename_with_one_fsync():
+    """One fsync (the file) is not enough — the parent directory must also
+    be synced or the rename itself can be forgotten."""
+    src = """
+import os
+def land(self):
+    tmp = self.dir / "state.tmp"
+    with open(tmp, "wb") as f:
+        f.write(b"x")
+        os.fsync(f.fileno())
+    os.rename(tmp, self.dir / "journal.state")
+"""
+    assert "unsynced-durable-write" in rules_of(src)
+
+
+def test_unsynced_durable_write_quiet_on_fsync_replace_helper():
+    src = """
+from skyplane_tpu.utils.fsio import fsync_replace
+def compact(self):
+    tmp = self.snap_path.with_name("jobs.snap.tmp")
+    tmp.write_bytes(b"x")
+    fsync_replace(tmp, self.snap_path)
+"""
+    assert "unsynced-durable-write" not in rules_of(src)
+
+
+def test_unsynced_durable_write_quiet_on_inline_fsync_pair():
+    src = """
+import os
+def compact(self):
+    tmp = self.journal_path.with_suffix(".tmp")
+    with open(tmp, "wb") as f:
+        f.write(b"x")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, self.journal_path)
+    fd = os.open(str(self.journal_path.parent), os.O_RDONLY)
+    os.fsync(fd)
+    os.close(fd)
+"""
+    assert "unsynced-durable-write" not in rules_of(src)
+
+
+def test_unsynced_durable_write_quiet_on_non_durable_paths():
+    """Scratch/log/output renames are not durable state — loss is
+    inconvenience, not incorrectness — and must not need suppressions."""
+    src = """
+import os
+def rotate(self):
+    os.replace(self.out_path, self.backup_path)
+"""
+    assert "unsynced-durable-write" not in rules_of(src)
+
+
+def test_unsynced_durable_write_suppressible():
+    src = """
+import os
+def compact(self):
+    os.replace(self.tmp, self.snap_path)  # sklint: disable=unsynced-durable-write -- snapshot is a rebuildable cache
+"""
+    assert "unsynced-durable-write" not in rules_of(src)
+
+
 # ---------------------------------------------------- suppression contract
 
 

@@ -320,6 +320,41 @@ def test_registry_stale_job_ttl_sweep():
     assert reg.snapshot()["tenants"][T_A]["active_jobs"] == 1
 
 
+def test_registry_heartbeat_refreshes_ttl_against_reap():
+    """Regression (service mode, docs/service-mode.md): a long-lived job that
+    heartbeats via idempotent re-admission must NEVER be reaped by the TTL
+    sweep — before the fix only the ORIGINAL admission time was kept, so a
+    live continuous-sync job aged past the TTL while dutifully re-admitting."""
+    reg = TenantRegistry(max_jobs_per_tenant=2, job_ttl_s=0.3)
+    reg.admit_job(T_A, "watch-1")
+    for _ in range(4):  # total elapsed ~0.6 s >> TTL, heartbeats every 0.15 s
+        time.sleep(0.15)
+        assert reg.admit_job(T_A, "watch-1") == T_A  # re-admit = heartbeat
+        # the sweep runs inside admit_job: the heartbeated job must survive it
+        assert reg.job_tenant("watch-1") == T_A, "TTL sweep reaped a heartbeating job"
+    assert reg.snapshot()["tenants"][T_A]["active_jobs"] == 1  # never double-counted
+    # once the heartbeats STOP, the sweep must still reclaim the slot
+    time.sleep(0.35)
+    reg.admit_job(T_A, "other")  # triggers the sweep
+    assert reg.job_tenant("watch-1") is None, "sweep no longer reclaims silent jobs"
+
+
+def test_registry_heartbeat_job_refreshes_without_side_effects():
+    """heartbeat_job refreshes a live job's clock and reports unknown jobs
+    honestly (False), so a reaped slot is re-admitted, not resurrected."""
+    reg = TenantRegistry(job_ttl_s=0.3)
+    reg.admit_job(T_A, "j1")
+    for _ in range(3):
+        time.sleep(0.15)
+        assert reg.heartbeat_job("j1")
+        reg.admit_job(T_A, "probe")  # run the sweep
+        reg.finish_job("probe")
+    assert reg.job_tenant("j1") == T_A
+    assert not reg.heartbeat_job("never-admitted")
+    reg.finish_job("j1")
+    assert not reg.heartbeat_job("j1"), "heartbeat resurrected a finished job"
+
+
 def test_registry_tenant_cardinality_is_bounded():
     """Regression: arbitrary wire-header tenant tags must not grow per-tenant
     state without bound (metric-label explosion / daemon memory)."""
