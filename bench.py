@@ -1010,6 +1010,56 @@ def bench_pump_scaling() -> dict:
     }
 
 
+def bench_blast() -> dict:
+    """Small loopback checkpoint blast (docs/blast.md): 1 source ->
+    ``SKYPLANE_BENCH_BLAST_SINKS`` peered sink daemons over a planner-placed
+    relay tree (source degree 1, fanout 2), kill-free. Reports
+    ``blast_egress_ratio`` — counter-measured source egress over corpus
+    size, the number that must sit at ~1x regardless of sink count (a tree
+    degraded to direct multicast reads ~= n_sinks and fails the
+    check_bench_json gate); banked per bench round so the fan-out-vs-egress
+    curve in docs/benchmark.md comes from the perf trajectory."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from tests.integration.harness import build_chunk_requests, start_blast_fleet
+
+    from skyplane_tpu.blast import BlastController, solve_blast_tree
+
+    n_sinks = int(os.environ.get("SKYPLANE_BENCH_BLAST_SINKS", "4"))
+    corpus_mb = int(os.environ.get("SKYPLANE_BENCH_BLAST_MB", "8"))
+    chunk_bytes = 256 << 10
+    payload = np.random.default_rng(13).integers(0, 256, corpus_mb << 20, dtype=np.uint8).tobytes()
+    tmp = Path(tempfile.mkdtemp(prefix="skyplane_blast_bench_"))
+    src_file = tmp / "ckpt.bin"
+    src_file.write_bytes(payload)
+    sinks = {f"sink_{i}": "local:local" for i in range(n_sinks)}
+    tree = solve_blast_tree(
+        "blast_src", sinks, "local:local", cost_fn=lambda a, b: 0.0, fanout=2, source_degree=1, solver="greedy"
+    )
+    source, sink_gws, _roots = start_blast_fleet(tmp, tree, compress="none", dedup=False, encrypt=False)
+    try:
+        reqs = build_chunk_requests(src_file, "/blast/ckpt.bin", chunk_bytes)
+        ctl = BlastController(source, sink_gws, tree, poll_s=0.05)
+        t0 = time.perf_counter()
+        ctl.dispatch(reqs)
+        ctl.wait(timeout=300)
+        dt = time.perf_counter() - t0
+        egress = ctl.source_egress_bytes()
+        return {
+            "blast_sinks": n_sinks,
+            "blast_egress_ratio": round(egress / len(payload), 4),
+            "blast_gbps": round(len(payload) * 8 / 1e9 / dt, 3),
+            "blast_corpus_mb": corpus_mb,
+        }
+    finally:
+        source.stop()
+        for gw in sink_gws.values():
+            gw.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_codec(chunks, one) -> dict:
     """Time a per-chunk codec with full core-level worker parallelism.
 
@@ -1228,6 +1278,15 @@ def main() -> None:
         f"merged cores effective {pump['pump_cores_effective']}"
     )
 
+    # checkpoint blast: source egress vs fan-out over a peered relay tree
+    # (docs/blast.md) — the ratio must sit at ~1x regardless of sink count;
+    # banked per round so the fan-out-vs-egress curve rides the trajectory
+    blast = bench_blast()
+    log(
+        f"blast bench done: {blast['blast_sinks']} sinks at {blast['blast_gbps']} Gbps, "
+        f"source egress {blast['blast_egress_ratio']}x corpus"
+    )
+
     ours_gbps = gbits / ours["seconds"]
     base_gbps = base["raw_bytes"] * 8 / 1e9 / base["seconds"]
     from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
@@ -1312,6 +1371,10 @@ def main() -> None:
         # procs when pump_cores_available allows (graceful small-runner
         # downgrade).
         **pump,
+        # checkpoint-blast fan-out (docs/blast.md): counter-measured source
+        # egress over corpus size on a kill-free loopback blast — gated
+        # <= 1.5x by check_bench_json.py (a degraded tree reads ~n_sinks)
+        **blast,
     }
     if base_lz4:
         # the honest reference-codec bar (BASELINE.json names LZ4, not zstd)

@@ -24,7 +24,13 @@ Three result shapes are recognized, dispatched on the ``metric`` field:
     concurrent warm jobs (p50 start < 1 s, warm dedup > cold), continuous
     sync delta rounds, and a SIGKILLed controller recovered from the WAL
     with byte-identical output, zero acked-chunk loss, zero duplicate sink
-    registrations, and idempotent resubmission (docs/service-mode.md).
+    registrations, and idempotent resubmission (docs/service-mode.md);
+  * scripts/soak_blast.py results (``metric: blast_soak``): the checkpoint-
+    blast fan-out soak — 1 source -> >=8 peered sinks over a planner-placed
+    relay tree, one relay hard-killed mid-blast and healed (replacement +
+    retarget + re-drive), every sink byte-identical, source egress
+    counter-measured at <= 1.5x the corpus, zero acked-chunk loss, zero
+    duplicate sink registrations (docs/blast.md).
 
 Exit 0 iff the result parses and every required key is present; used by the
 bench-smoke, multijob-smoke, and chaos-smoke steps in scripts/devloop.sh so a
@@ -53,7 +59,16 @@ REQUIRED_TOP = (
     "wire_gbps_by_procs",
     "pump_cores_available",
     "pump_cores_effective",
+    # checkpoint-blast fan-out (docs/blast.md): counter-measured source
+    # egress over corpus size on a small loopback blast, banked per round
+    "blast_egress_ratio",
+    "blast_sinks",
 )
+#: bench/soak acceptance bound: source egress may exceed 1x the corpus only
+#: by healing re-sends and in-flight re-frames (docs/blast.md)
+MAX_BLAST_EGRESS_RATIO = 1.5
+#: the acceptance floor for the blast soak's fan-out scale
+MIN_BLAST_SINKS = 8
 # trace-derived per-stage latency breakdown (bench.py TRACE_STAGES /
 # docs/observability.md): a future perf PR proves WHERE it moved time
 REQUIRED_STAGES = ("frame", "send_stall", "ack_lag", "decode", "store")
@@ -688,6 +703,90 @@ def check_chaos(result: dict) -> int:
     return 0
 
 
+# blast fan-out soak result (scripts/soak_blast.py / docs/blast.md)
+REQUIRED_BLAST = (
+    "metric",
+    "value",
+    "unit",
+    "blast_sinks",
+    "blast_fanout",
+    "blast_chunks",
+    "blast_corpus_bytes",
+    "blast_relay_killed",
+    "blast_healed",
+    "blast_byte_identical",
+    "blast_source_egress_bytes",
+    "blast_egress_ratio",
+    "blast_requeued_chunks",
+    "blast_acked_chunks_lost",
+    "blast_duplicate_registrations",
+    "blast_peer_serve_faults",
+    "blast_events_ok",
+    "blast_seconds",
+    "blast_ok",
+)
+
+
+def check_blast(result: dict) -> int:
+    missing = [k for k in REQUIRED_BLAST if k not in result]
+    if missing:
+        print(f"blast-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    if result["blast_sinks"] < MIN_BLAST_SINKS:
+        print(
+            f"blast-smoke: only {result['blast_sinks']} sinks; acceptance needs >= {MIN_BLAST_SINKS}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["blast_byte_identical"] is not True:
+        print("blast-smoke: sinks NOT byte-identical (CORRUPTION)", file=sys.stderr)
+        return 1
+    if result["blast_relay_killed"] is not True or result["blast_healed"] is not True:
+        print(
+            "blast-smoke: relay-death drill was vacuous — "
+            f"killed={result.get('blast_relay_killed')} healed={result.get('blast_healed')} "
+            f"error={result.get('blast_error')}",
+            file=sys.stderr,
+        )
+        return 1
+    ratio = result["blast_egress_ratio"]
+    if not isinstance(ratio, (int, float)) or ratio <= 0 or ratio > MAX_BLAST_EGRESS_RATIO:
+        print(
+            f"blast-smoke: source egress ratio {ratio!r} breaches the {MAX_BLAST_EGRESS_RATIO}x bound "
+            "(counter-measured skyplane_egress_bytes_total / corpus bytes)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["blast_acked_chunks_lost"] != 0 or result["blast_duplicate_registrations"] != 0:
+        print(
+            f"blast-smoke: accounting broke — {result['blast_acked_chunks_lost']} acked chunk(s) lost, "
+            f"{result['blast_duplicate_registrations']} duplicate sink registration(s)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["blast_peer_serve_faults"] < 1:
+        print(
+            "blast-smoke: the armed relay.peer_serve plan never fired — the injected-drop "
+            "absorption drill was vacuous (scale the corpus back up)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["blast_events_ok"] is not True:
+        print("blast-smoke: blast.* flight-recorder lifecycle events missing", file=sys.stderr)
+        return 1
+    if result["blast_ok"] is not True:
+        print(f"blast-smoke: soak self-check failed — error={result.get('blast_error')}", file=sys.stderr)
+        return 1
+    print(
+        f"blast-smoke OK: 1 source -> {result['blast_sinks']} sinks (fanout {result['blast_fanout']}, "
+        f"{result['blast_chunks']} chunks, {result['blast_corpus_bytes'] >> 20} MiB), relay killed mid-blast and "
+        f"healed ({result['blast_requeued_chunks']} chunk(s) re-driven), byte-identical everywhere, "
+        f"source egress {ratio}x corpus (bound {MAX_BLAST_EGRESS_RATIO}), "
+        f"{result['blast_peer_serve_faults']} peer-serve fault(s) absorbed, {result['blast_seconds']}s"
+    )
+    return 0
+
+
 def check_multijob(result: dict) -> int:
     missing = [k for k in REQUIRED_MULTIJOB if k not in result]
     if missing:
@@ -771,6 +870,8 @@ def main(argv) -> int:
         return check_fleet(result)
     if result.get("metric") == "service_jobs":
         return check_service(result)
+    if result.get("metric") == "blast_soak":
+        return check_blast(result)
     missing = [k for k in REQUIRED_TOP if k not in result]
     counters = result.get("datapath_counters")
     if not isinstance(counters, dict):
@@ -913,6 +1014,18 @@ def main(argv) -> int:
             )
             return 1
         pump_note = f"(cores_available={pump_cores}, cores_effective={result['pump_cores_effective']})"
+    # checkpoint-blast fan-out gate (docs/blast.md): the bench's small
+    # loopback blast is kill-free, so source egress must sit at ~1x the
+    # corpus — the 1.5x bound here catches a tree that degraded to direct
+    # multicast (ratio ~= n_sinks) long before the full soak runs
+    blast_ratio = result["blast_egress_ratio"]
+    if not isinstance(blast_ratio, (int, float)) or blast_ratio <= 0 or blast_ratio > MAX_BLAST_EGRESS_RATIO:
+        print(
+            f"bench-smoke: blast egress ratio {blast_ratio!r} over {result['blast_sinks']} sinks breaches "
+            f"the {MAX_BLAST_EGRESS_RATIO}x bound (counter-measured; docs/blast.md)",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"bench-smoke OK: {result['value']} {result['unit']} encode, "
         f"{result['decode_gbps']} {result['unit']} decode on {result['platform']} "
@@ -920,7 +1033,8 @@ def main(argv) -> int:
         f"stall {wire['wire_stall_ns_per_window']}ns/window vs serial drain {wire['serial_drain_ns_per_window']}ns/window; "
         f"trace overhead {overhead}%; cpu profile: {cpu['profile_samples']} samples, "
         f"{cores} cores effective, GIL wait {round(100.0 * gil, 1)}%, sampler overhead {p_overhead}%; "
-        f"pump: {pump_g} Gbps by procs {pump_note}"
+        f"pump: {pump_g} Gbps by procs {pump_note}; "
+        f"blast: {blast_ratio}x source egress over {result['blast_sinks']} sinks"
     )
     return 0
 

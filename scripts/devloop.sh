@@ -61,7 +61,7 @@ fi
 # not block device profiling.
 SKYPLANE_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu \
   SKYPLANE_BENCH_CHUNK_MB=1 SKYPLANE_BENCH_SNAPSHOTS=2 SKYPLANE_BENCH_SNAP_CHUNKS=2 SKYPLANE_BENCH_REPS=1 \
-  SKYPLANE_BENCH_DECODE_WORKERS=4 SKYPLANE_BENCH_PUMP_MB=4 \
+  SKYPLANE_BENCH_DECODE_WORKERS=4 SKYPLANE_BENCH_PUMP_MB=4 SKYPLANE_BENCH_BLAST_MB=2 \
   SKYPLANE_BENCH_TRACE_OUT="$LOGDIR/trace_smoke.json" \
   SKYPLANE_BENCH_PROFILE_OUT="$LOGDIR/profile_smoke.speedscope.json" \
   python bench.py >"$LOGDIR/bench_smoke.out" 2>"$LOGDIR/bench_smoke.err"
@@ -177,6 +177,28 @@ if [ "$SERVICE_RC" -ne 0 ]; then
   echo "[devloop] SERVICE-SMOKE FAILURE (rc=$SERVICE_RC) — warm-start, dedup-warmth, or WAL-recovery gates regressed; see $LOGDIR/service_smoke.err" >>"$LOGDIR/devloop.log"
 else
   echo "[devloop] service-smoke clean; result at $LOGDIR/service_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
+# Blast-smoke gate (CPU-only, ~1 min): the checkpoint-blast fan-out soak
+# (scripts/soak_blast.py, docs/blast.md) at smoke scale — 1 source -> 8
+# peered sink daemons over a planner-placed relay tree, the first relay
+# hard-killed mid-blast with the relay.peer_serve fault armed. Gates
+# (blast branch of check_bench_json.py): every sink byte-identical, the
+# tree healed (replacement + retarget + re-drive), source egress
+# counter-measured <= 1.5x the corpus, zero acked-chunk loss, zero
+# duplicate sink registrations, blast.* lifecycle events recorded. Like
+# the other smokes: failures are logged LOUDLY but do not block profiling.
+JAX_PLATFORMS=cpu SKYPLANE_BLAST_SINKS=8 SKYPLANE_BLAST_MB=16 \
+  python scripts/soak_blast.py >"$LOGDIR/blast_smoke.out" 2>"$LOGDIR/blast_smoke.err"
+BLAST_RC=$?
+if [ "$BLAST_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/blast_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  BLAST_RC=$?
+fi
+if [ "$BLAST_RC" -ne 0 ]; then
+  echo "[devloop] BLAST-SMOKE FAILURE (rc=$BLAST_RC) — fan-out integrity, egress ratio, or healing gates regressed; see $LOGDIR/blast_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] blast-smoke clean; result at $LOGDIR/blast_smoke.out" >>"$LOGDIR/devloop.log"
 fi
 
 # Chaos-smoke gate (CPU-only, ~1-2 min): the deterministic fault-injection soak

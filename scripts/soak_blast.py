@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Checkpoint-blast chaos soak: 1 source -> N peered sinks, relay killed
+mid-blast (docs/blast.md).
+
+The fan-out acceptance drill (ROADMAP item 5): a corpus blasts from one
+source daemon through a planner-placed relay tree to ``SKYPLANE_BLAST_SINKS``
+(>= 8) sink daemons on loopback. Mid-blast, the first relay — the node ALL
+traffic flows through — is hard-killed; the BlastController must provision a
+like-for-like replacement, retarget the source's streams, and re-drive the
+missing tail, converging with:
+
+  * every sink byte-identical to the corpus (the replacement included);
+  * ``source_egress_bytes / corpus_bytes <= 1.5`` — COUNTER-measured from
+    ``skyplane_egress_bytes_total{src,dst}``, never derived (healing
+    re-sends are why the bound is 1.5, not 1.0; an un-killed blast sits at
+    ~1.0 with source degree 1);
+  * zero acked-chunk loss (chunks complete at a live sink before the kill
+    stay complete) and zero duplicate sink registrations;
+  * the armed ``relay.peer_serve`` fault (injected drops of peer-served
+    chunks) absorbed through the silent-requeue path.
+
+Emits one JSON line (``metric: blast_soak``) REQUIRED + gated by the blast
+branch of scripts/check_bench_json.py; scripts/devloop.sh runs it at smoke
+scale as the blast-smoke step.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from skyplane_tpu.blast import BlastController, build_local_blast_programs, solve_blast_tree  # noqa: E402
+from skyplane_tpu.faults import FaultPlan, configure_injector  # noqa: E402
+from skyplane_tpu.obs import get_recorder  # noqa: E402
+from skyplane_tpu.obs.events import (  # noqa: E402
+    EV_BLAST_RELAY_DEAD,
+    EV_BLAST_REQUEUED,
+    EV_BLAST_RETARGETED,
+    EV_BLAST_SINK_COMPLETE,
+)
+from tests.integration.harness import build_chunk_requests, hard_kill, start_blast_fleet, start_gateway  # noqa: E402
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+CHUNK_BYTES = 128 << 10
+#: kill once the victim relay has landed this fraction (and not all) of the
+#: corpus: late enough that the healing re-drive keeps source egress under
+#: the 1.5x gate (requeue <= ~(1-fraction) x corpus + in-flight re-frames),
+#: early enough that real forwarding is interrupted
+KILL_AFTER_FRACTION = 0.65
+
+
+def run_blast(base: Path, seed: int, n_sinks: int, corpus_mb: int, fanout: int) -> dict:
+    rng = np.random.default_rng(seed)
+    n_bytes = corpus_mb << 20
+    payload = rng.integers(0, 256, n_bytes // 2, dtype=np.uint8).tobytes() + bytes(n_bytes - n_bytes // 2)
+    tmp = base / f"blast_{n_sinks}"
+    tmp.mkdir(parents=True)
+    src_file = tmp / "ckpt.bin"
+    src_file.write_bytes(payload)
+
+    sinks = {f"sink_{i}": "local:local" for i in range(n_sinks)}
+    tree = solve_blast_tree(
+        "blast_src", sinks, "local:local", cost_fn=lambda a, b: 0.0, fanout=fanout, source_degree=1, solver="greedy"
+    )
+    victim = tree.children(tree.root)[0]
+    out: dict = {
+        "blast_sinks": n_sinks,
+        "blast_fanout": fanout,
+        "blast_tree_depth": max(tree.depth(s) for s in tree.sinks()),
+        "blast_corpus_bytes": len(payload),
+        "blast_relay_killed": False,
+        "blast_healed": False,
+        "blast_byte_identical": False,
+        "blast_egress_ratio": None,
+        "blast_acked_chunks_lost": -1,
+        "blast_duplicate_registrations": -1,
+        "blast_requeued_chunks": 0,
+        "blast_peer_serve_faults": 0,
+        "blast_events_ok": False,
+        "blast_ok": False,
+    }
+    # deterministic drops of peer-served chunks (docs/fault-injection.md
+    # relay.peer_serve): absorbed by the silent-requeue path mid-soak
+    inj = configure_injector(
+        FaultPlan.from_dict({"seed": seed, "points": {"relay.peer_serve": {"p": 0.02, "max_fires": 4}}})
+    )
+    rec = get_recorder()
+    rec_seq0 = rec.seq()
+    source, sink_gws, out_roots = start_blast_fleet(tmp, tree, compress="none", dedup=False, encrypt=False)
+    replacements: list = []
+
+    def factory(dead):
+        new_id = f"{dead}+r1"
+        roots = dict(out_roots)
+        roots[new_id] = roots[dead]  # like-for-like: adopt the dead sink's output file
+        t2 = copy.deepcopy(ctl.tree)
+        t2.replace_node(dead, new_id)
+        progs = build_local_blast_programs(t2, roots, num_connections=2)
+        info = {
+            c: {"public_ip": "127.0.0.1", "control_port": ctl.sinks[c].control_port} for c in t2.children(new_id)
+        }
+        gw = start_gateway(progs[new_id], info, new_id, str(tmp / f"{new_id}_chunks"), use_tls=False)
+        replacements.append(gw)
+        return new_id, gw
+
+    reqs = build_chunk_requests(src_file, "/blast/ckpt.bin", CHUNK_BYTES)
+    n_chunks = len(reqs)
+    out["blast_chunks"] = n_chunks
+
+    killed = {"done": False}
+    acked_at_kill: dict = {}
+
+    def kill_check():
+        if killed["done"]:
+            return
+        done_counts = {node: len(v) for node, v in ctl._complete.items()}
+        # the victim (hop 1) leads the fleet: once it is past the threshold
+        # the healing re-drive stays under the 1.5x egress gate, and any
+        # still-incomplete sink proves the kill interrupts a live blast
+        if done_counts.get(victim, 0) >= int(KILL_AFTER_FRACTION * n_chunks) and not ctl.is_complete():
+            killed["done"] = True
+            # acked-chunk truth snapshot: everything complete at a LIVE sink
+            # at kill time must still be complete at the end
+            for node, done in ctl._complete.items():
+                if node != victim:
+                    acked_at_kill[node] = set(done)
+            hard_kill(sink_gws[victim])
+            out["blast_kill_progress"] = done_counts
+
+    try:
+        ctl = BlastController(source, sink_gws, tree, poll_s=0.05, replacement_factory=factory)
+        t0 = time.monotonic()
+        ctl.dispatch(reqs)
+        ctl.wait(timeout=float(env_int("SKYPLANE_BLAST_TIMEOUT_S", 300)), kill_check=kill_check)
+        out["blast_seconds"] = round(time.monotonic() - t0, 3)
+        out["blast_gbps"] = round(len(payload) * 8 / 1e9 / max(out["blast_seconds"], 1e-9), 4)
+        out["blast_relay_killed"] = killed["done"]
+        out["blast_healed"] = bool(ctl.replacements) and ctl.retargeted_ops >= 1
+        out["blast_requeued_chunks"] = ctl.requeued_chunks
+        out["blast_replacements"] = list(ctl.replacements)
+
+        # byte identity at EVERY sink (replacement adopted the victim's root)
+        roots = {node: out_roots.get(node, out_roots[victim]) for node in ctl.sinks}
+        identical = all((Path(root) / "blast/ckpt.bin").read_bytes() == payload for root in roots.values())
+        out["blast_byte_identical"] = identical
+
+        # counter-measured source egress (skyplane_egress_bytes_total{src,dst})
+        egress = ctl.source_egress_bytes()
+        out["blast_source_egress_bytes"] = egress
+        out["blast_egress_ratio"] = round(egress / len(payload), 4)
+
+        # zero acked-chunk loss: kill-time completions survived at live sinks
+        lost = 0
+        for node, done in acked_at_kill.items():
+            final = ctl._complete.get(node, set())
+            lost += len(done - final)
+        out["blast_acked_chunks_lost"] = lost
+        out["blast_duplicate_registrations"] = ctl.sink_registration_duplicates()
+        out["blast_peer_serve_faults"] = inj.counters().get("relay.peer_serve", 0)
+
+        kinds = {e["kind"] for e in rec.events_since(rec_seq0)}
+        out["blast_events_ok"] = {
+            EV_BLAST_RELAY_DEAD,
+            EV_BLAST_RETARGETED,
+            EV_BLAST_REQUEUED,
+            EV_BLAST_SINK_COMPLETE,
+        } <= kinds
+        out["blast_ok"] = bool(
+            identical
+            and out["blast_relay_killed"]
+            and out["blast_healed"]
+            and out["blast_egress_ratio"] is not None
+            and out["blast_egress_ratio"] <= 1.5
+            and lost == 0
+            and out["blast_duplicate_registrations"] == 0
+            # the armed drop plan must actually FIRE — a scale tweak that
+            # silently stops exercising the absorption path fails loudly
+            and out["blast_peer_serve_faults"] >= 1
+            and out["blast_events_ok"]
+        )
+    except (RuntimeError, TimeoutError, OSError) as e:
+        out["blast_error"] = str(e)[:500]
+    finally:
+        source.stop()
+        for gw in list(sink_gws.values()) + replacements:
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — victim already hard-killed
+                pass
+        configure_injector(None)
+    return out
+
+
+def main() -> int:
+    seed = env_int("SKYPLANE_BLAST_SEED", 1337)
+    n_sinks = env_int("SKYPLANE_BLAST_SINKS", 8)
+    corpus_mb = env_int("SKYPLANE_BLAST_MB", 32)
+    fanout = env_int("SKYPLANE_BLAST_FANOUT", 2)
+    base = Path(os.environ.get("SKYPLANE_BLAST_DIR", f"/tmp/skyplane_blast_{os.getpid()}"))
+    base.mkdir(parents=True, exist_ok=True)
+
+    out = run_blast(base, seed, n_sinks, corpus_mb, fanout)
+    if not out.get("blast_relay_killed") and "blast_error" not in out:
+        # the blast outran the kill window (fast machine / tiny corpus):
+        # rerun once at double scale so the drill is never vacuous
+        print("blast finished before the kill window; retrying at 2x corpus", file=sys.stderr)
+        out = run_blast(base / "retry", seed + 1, n_sinks, corpus_mb * 2, fanout)
+
+    result = {
+        "metric": "blast_soak",
+        "value": out.get("blast_gbps", 0.0),
+        "unit": "Gbps",
+        **out,
+    }
+    print(json.dumps(result), flush=True)
+    return 0 if out.get("blast_ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

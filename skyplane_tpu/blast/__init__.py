@@ -1,0 +1,41 @@
+"""Checkpoint blast: planner-placed multicast trees with dedup-driven peer
+relay (ROADMAP item 5, docs/blast.md).
+
+One source pushes a corpus to K destination sinks; the destinations *peer*:
+a degree-bounded min-cost arborescence over the egress grid (blast/tree.py)
+decides who serves whom, interior sinks re-serve landed chunks to siblings
+over the ordinary wire protocol (blast/planner.py), and a thin control loop
+(blast/controller.py) tracks per-sink completion and heals relay death via
+replacement + retarget + source re-drive.
+"""
+
+from skyplane_tpu.blast.controller import BlastController, parse_egress_edges
+from skyplane_tpu.blast.planner import (
+    BlastPlanner,
+    build_local_blast_programs,
+    gateway_info_for,
+    start_order,
+)
+from skyplane_tpu.blast.tree import (
+    BlastTree,
+    solve_blast_tree,
+    solve_blast_tree_greedy,
+    solve_blast_tree_milp,
+    tree_cost_per_gb,
+    validate_tree,
+)
+
+__all__ = [
+    "BlastController",
+    "BlastPlanner",
+    "BlastTree",
+    "build_local_blast_programs",
+    "gateway_info_for",
+    "parse_egress_edges",
+    "solve_blast_tree",
+    "solve_blast_tree_greedy",
+    "solve_blast_tree_milp",
+    "start_order",
+    "tree_cost_per_gb",
+    "validate_tree",
+]

@@ -1,0 +1,290 @@
+"""Blast job control: dispatch, per-sink completion, and tree healing.
+
+The transfer tracker (api/tracker.py) models one job over source gateways
+with sink-measured completion at THE destination. A blast job has K
+destinations that must EACH land every chunk, and the gateways between them
+are peers in a planner-placed tree — so blast gets its own (thin) control
+loop with fan-out-shaped accounting:
+
+  * **Per-sink completion, sink-measured.** The controller polls every
+    sink's ``chunk_status_log`` (pending-only queries, the tracker's
+    O(pending) discipline) and a blast is complete only when every sink
+    reports every chunk complete. Each sink's completion lands a
+    ``blast.sink_complete`` flight-recorder event.
+  * **Tree healing over PR-10's machinery.** A relay (interior sink) that
+    stops answering its control API is declared dead after a consecutive-
+    failure streak; the controller (1) provisions a like-for-like
+    replacement via the injected ``replacement_factory`` (same contract as
+    ``Dataplane.provision_replacement``: the replacement runs the dead
+    node's program, i.e. serves the same children), (2) POSTs
+    ``/api/v1/retarget`` to the dead node's parent so its sender streams cut
+    over exactly like a deliberate break (un-acked frames requeue uncounted
+    and re-register at the replacement), and (3) reconciles: chunks missing
+    at any sink of the orphaned subtree are re-driven from the source down
+    the tree via ``POST /api/v1/requeue_chunks`` at every interior node —
+    registration maps untouched (zero duplicate registrations), re-landed
+    bytes idempotent, acked chunks never regress.
+  * **Counter-measured egress.** ``source_egress_bytes()`` reads
+    ``skyplane_egress_bytes_total{src,dst}`` off the source's /metrics — the
+    1x-egress claim is measured from wire counters, never derived.
+
+Gateway handles are duck-typed to the loopback harness's ``LocalGateway``
+(``get``/``post``/``control_port``); the cloud path wraps BoundGateways the
+same way (docs/blast.md).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import requests
+
+from skyplane_tpu.blast.tree import BlastTree
+from skyplane_tpu.obs import get_recorder
+from skyplane_tpu.obs.events import (
+    EV_BLAST_RELAY_DEAD,
+    EV_BLAST_REQUEUED,
+    EV_BLAST_RETARGETED,
+    EV_BLAST_SINK_COMPLETE,
+)
+from skyplane_tpu.utils.logger import logger
+
+_EGRESS_RE = re.compile(r'^skyplane_egress_bytes_total\{src="([^"]*)",dst="([^"]*)"\}\s+(\d+(?:\.\d+)?)', re.M)
+
+#: consecutive failed control polls before a sink is declared dead
+DEAD_POLL_STREAK = 3
+
+
+def parse_egress_edges(metrics_text: str) -> Dict[Tuple[str, str], int]:
+    """{(src, dst): bytes} from a Prometheus scrape (the counter-measured
+    egress surface; docs/blast.md)."""
+    return {(m.group(1), m.group(2)): int(float(m.group(3))) for m in _EGRESS_RE.finditer(metrics_text)}
+
+
+class BlastController:
+    """Drives one blast over live gateways (see module doc)."""
+
+    def __init__(
+        self,
+        source,
+        sinks: Dict[str, object],
+        tree: BlastTree,
+        poll_s: float = 0.25,
+        replacement_factory: Optional[Callable[[str], Tuple[str, object]]] = None,
+        batch_size: int = 64,
+    ):
+        self.source = source
+        self.sinks: Dict[str, object] = dict(sinks)
+        self.tree = tree
+        self.poll_s = poll_s
+        # replacement_factory(dead_node_id) -> (replacement_node_id, handle):
+        # starts a daemon running the dead node's program (serving the same
+        # tree children, writing the same sink output root)
+        self.replacement_factory = replacement_factory
+        self.batch_size = max(1, int(batch_size))
+        self.chunk_ids: List[str] = []
+        self._fail_streak: Dict[str, int] = {}
+        self._complete: Dict[str, Set[str]] = {node: set() for node in self.sinks}
+        self._sink_complete_recorded: Set[str] = set()
+        # healing outcome counters (the soak's blast_* keys read these)
+        self.relays_died: List[str] = []
+        self.replacements: List[str] = []
+        self.retargeted_ops = 0
+        self.requeued_chunks = 0
+
+    # ---- dispatch ----
+
+    def dispatch(self, requests_batch: List) -> List[str]:
+        """POST chunk requests to the source gateway in batches; remembers
+        the id set the per-sink completion accounting runs against."""
+        ids = []
+        for start in range(0, len(requests_batch), self.batch_size):
+            batch = requests_batch[start : start + self.batch_size]
+            resp = self.source.post("chunk_requests", json=[r.as_dict() for r in batch], timeout=30)
+            resp.raise_for_status()
+            ids.extend(r.chunk.chunk_id for r in batch)
+        self.chunk_ids.extend(ids)
+        return ids
+
+    # ---- per-sink completion (sink-measured truth) ----
+
+    def _poll_sink(self, node: str) -> Optional[Set[str]]:
+        """This sink's newly-complete chunk ids; None on an unreachable
+        control API (feeds the liveness streak)."""
+        handle = self.sinks[node]
+        pending = [cid for cid in self.chunk_ids if cid not in self._complete[node]]
+        if not pending:
+            # nothing to ask about, but a COMPLETE interior sink may still be
+            # serving siblings: a cheap /status probe keeps the liveness
+            # streak honest (a dead-but-done relay must still heal so its
+            # children regain an upstream)
+            try:
+                handle.get("status", timeout=10)
+            except (requests.RequestException, OSError):
+                return None
+            return set()
+        params = {"chunk_ids": ",".join(sorted(pending))} if len(pending) <= 1500 else None
+        try:
+            status = handle.get("chunk_status_log", params=params, timeout=10).json()["chunk_status"]
+        except (requests.RequestException, OSError, ValueError):
+            return None
+        return {cid for cid in pending if status.get(cid) == "complete"}
+
+    def sink_progress(self) -> Dict[str, int]:
+        return {node: len(done) for node, done in sorted(self._complete.items())}
+
+    def is_complete(self) -> bool:
+        want = len(self.chunk_ids)
+        return all(len(done) >= want for done in self._complete.values())
+
+    def wait(self, timeout: float = 300.0, kill_check: Optional[Callable[[], None]] = None) -> Dict[str, int]:
+        """Poll every sink until all chunks are complete at all of them,
+        healing dead relays along the way. ``kill_check`` (tests/soaks) runs
+        once per poll wave — e.g. to SIGKILL a relay mid-blast."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if kill_check is not None:
+                kill_check()
+            for node in list(self.sinks):
+                newly = self._poll_sink(node)
+                if newly is None:
+                    streak = self._fail_streak.get(node, 0) + 1
+                    self._fail_streak[node] = streak
+                    if streak >= DEAD_POLL_STREAK:
+                        self.heal(node)
+                    continue
+                self._fail_streak[node] = 0
+                if newly:
+                    self._complete[node].update(newly)
+                if (
+                    node not in self._sink_complete_recorded
+                    and len(self._complete[node]) >= len(self.chunk_ids) > 0
+                ):
+                    self._sink_complete_recorded.add(node)
+                    get_recorder().record(
+                        EV_BLAST_SINK_COMPLETE, sink=node, chunks=len(self._complete[node])
+                    )
+            if self.is_complete():
+                return self.sink_progress()
+            time.sleep(self.poll_s)
+        missing = {
+            node: len(self.chunk_ids) - len(done)
+            for node, done in sorted(self._complete.items())
+            if len(done) < len(self.chunk_ids)
+        }
+        raise TimeoutError(f"blast incomplete after {timeout:.0f}s: missing per sink {missing}")
+
+    # ---- healing (replacement + retarget + requeue) ----
+
+    def _subtree(self, node: str) -> List[str]:
+        out = [node]
+        for child in self.tree.children(node):
+            out.extend(self._subtree(child))
+        return out
+
+    def heal(self, dead: str) -> None:
+        """Replace a dead sink, cut its parent's streams over, and re-drive
+        the chunks its subtree is missing (see module doc)."""
+        if dead not in self.sinks:
+            return  # already healed (double-detection is idempotent)
+        if self.replacement_factory is None:
+            raise RuntimeError(f"blast sink {dead} died and no replacement_factory is attached")
+        subtree = self._subtree(dead)
+        logger.fs.warning(f"[blast] relay {dead} unreachable; healing subtree {subtree}")
+        get_recorder().record(EV_BLAST_RELAY_DEAD, sink=dead, subtree=len(subtree))
+        self.relays_died.append(dead)
+
+        # (1) like-for-like replacement running the dead node's program
+        new_id, handle = self.replacement_factory(dead)
+        known_complete = self._complete.pop(dead)
+        del self.sinks[dead]
+        self._fail_streak.pop(dead, None)
+        self.sinks[new_id] = handle
+        # the replacement shares the dead sink's output root, so chunks known
+        # complete there survive on disk; everything else re-drives below
+        self._complete[new_id] = set(known_complete)
+        self.tree.replace_node(dead, new_id)
+        self.replacements.append(new_id)
+
+        # (2) parent stream cutover (PR-10 retarget: un-acked frames requeue
+        # uncounted and re-register at the replacement)
+        parent = self.tree.parent[new_id]
+        parent_handle = self.source if parent == self.tree.root else self.sinks[parent]
+        try:
+            resp = parent_handle.post(
+                "retarget",
+                json={
+                    "new_target_gateway_id": new_id,
+                    "host": "127.0.0.1",
+                    "control_port": handle.control_port,
+                    "old_target_gateway_id": dead,
+                },
+                timeout=30,
+            )
+            resp.raise_for_status()
+            self.retargeted_ops += int(resp.json().get("retargeted", 0))
+        except (requests.RequestException, OSError) as e:
+            # correlated deaths: the parent may be dead too — it heals on its
+            # own poll streak, and ITS replacement (built from the healed
+            # tree) dials this replacement directly; the re-drive below still
+            # runs so nothing waits on the broken edge
+            logger.fs.warning(f"[blast] retarget at parent {parent} failed (it will heal separately): {e}")
+        get_recorder().record(EV_BLAST_RETARGETED, dead=dead, replacement=new_id, parent=parent)
+
+        # (3) reconcile against sink-measured truth: chunks any subtree sink
+        # is missing re-drive from the source down the (healed) tree. The
+        # requeue touches no registration map; interior nodes re-forward and
+        # WaitReceiver operators absorb the re-landed bytes idempotently.
+        missing: Set[str] = set()
+        for node in self._subtree(new_id):
+            missing.update(cid for cid in self.chunk_ids if cid not in self._complete.get(node, set()))
+        if missing:
+            self.requeue(sorted(missing))
+
+    def requeue(self, chunk_ids: List[str]) -> int:
+        """Re-drive chunks through the tree: requeue at the source (whose
+        read operator regenerates the bytes) and at every live interior node
+        (which re-forwards to ALL its children — over-delivery is idempotent
+        and bounded by |chunk_ids| per edge)."""
+        requeued = 0
+        targets = [("source", self.source)] + [
+            (node, self.sinks[node]) for node in self.tree.interior_nodes() if node in self.sinks
+        ]
+        for name, handle in targets:
+            try:
+                resp = handle.post("requeue_chunks", json=chunk_ids, timeout=30)
+                resp.raise_for_status()
+                if name == "source":
+                    requeued = int(resp.json().get("requeued", 0))
+            except (requests.RequestException, OSError) as e:
+                # a relay that died between detection waves heals on its own
+                # streak; the source requeue is the one that must not fail
+                if name == "source":
+                    raise
+                logger.fs.warning(f"[blast] requeue at {name} failed (will heal separately): {e}")
+        self.requeued_chunks += requeued
+        get_recorder().record(EV_BLAST_REQUEUED, chunks=len(chunk_ids), requeued=requeued)
+        return requeued
+
+    # ---- counter-measured accounting ----
+
+    def source_egress_bytes(self) -> int:
+        """Total wire bytes the SOURCE sent, summed over its (src,dst) edges
+        from skyplane_egress_bytes_total — the numerator of the 1x-egress
+        gate, measured, not derived."""
+        text = self.source.get("metrics", timeout=10).text
+        src_id = getattr(getattr(self.source, "daemon", None), "gateway_id", None)
+        edges = parse_egress_edges(text)
+        return sum(n for (src, _dst), n in edges.items() if src_id is None or src == src_id)
+
+    def sink_registration_duplicates(self) -> int:
+        """Duplicate chunk registrations across all live sinks (must be 0 —
+        the idempotent-registration invariant under healing)."""
+        dups = 0
+        for node, handle in self.sinks.items():
+            regs = handle.get("chunk_requests", timeout=30).json()["chunk_requests"]
+            ids = [r["chunk"]["chunk_id"] for r in regs]
+            dups += len(ids) - len(set(ids))
+        return dups

@@ -1,0 +1,327 @@
+"""Multicast relay-tree placement over the region-pair egress grid.
+
+The checkpoint-blast workload (ROADMAP item 5, docs/blast.md) pushes one
+corpus from a single source to K destination sinks. A direct multicast pays
+source egress K times; a relay tree where the *destinations themselves*
+forward to siblings pays each edge once, so source egress approaches 1x the
+corpus regardless of K. This module places that tree:
+
+  * :func:`solve_blast_tree_milp` — the exact solver: a degree-constrained
+    minimum-cost spanning arborescence rooted at the source, posed as a MILP
+    (scipy.optimize.milp, the same dependency ladder as the overlay ILP in
+    planner/solver.py). Binary edge indicators + a single-commodity flow
+    (source emits K units, every sink absorbs one) enforce connectivity
+    without subtour constraints; a tiny flow-weighted term breaks cost ties
+    toward SHALLOW trees (total flow equals the sum of sink depths).
+  * :func:`solve_blast_tree_greedy` — the fallback ladder rung: Prim-style
+    cheapest-attachment under the same degree bounds, deterministic, always
+    feasible. Used when scipy's milp is unavailable or infeasible/timed out.
+  * :func:`solve_blast_tree` — the ladder itself ("auto": MILP then greedy).
+
+Edge costs come from an injectable ``cost_fn(src_region, dst_region) -> $/GB``
+— by default the PR-8 egress grid (planner/pricing.py), so tree placement
+prices real cloud egress, and the pin tests can swap in the flat model to
+show what the mispricing costs (tests/unit/test_blast_tree.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+
+#: default out-degree of interior (destination) nodes in the relay tree
+DEFAULT_FANOUT = 3
+#: default out-degree of the SOURCE: 1 keeps source egress at ~1x the corpus
+#: (the whole point of the blast tree); raise it to trade egress for depth
+DEFAULT_SOURCE_DEGREE = 1
+
+
+@dataclass
+class BlastTree:
+    """A rooted out-arborescence over {source} ∪ sinks.
+
+    ``parent`` maps every sink node to its parent node (the root has none);
+    ``regions`` maps every node (root included) to its region tag. Node ids
+    are caller-chosen strings (sink gateway ids in a TopologyPlan, harness
+    daemon ids on loopback).
+    """
+
+    root: str
+    parent: Dict[str, str]
+    regions: Dict[str, str]
+    cost_per_gb: float = 0.0
+    solver: str = "greedy"
+    fanout: int = DEFAULT_FANOUT
+    source_degree: int = DEFAULT_SOURCE_DEGREE
+    _children: Optional[Dict[str, List[str]]] = field(default=None, repr=False)
+
+    def children(self, node: str) -> List[str]:
+        if self._children is None:
+            ch: Dict[str, List[str]] = {n: [] for n in self.regions}
+            for c, p in self.parent.items():
+                ch.setdefault(p, []).append(c)
+            for v in ch.values():
+                v.sort()
+            self._children = ch
+        return list(self._children.get(node, []))
+
+    def edges(self) -> List[Tuple[str, str]]:
+        """(parent, child) pairs, child-sorted for determinism."""
+        return [(p, c) for c, p in sorted(self.parent.items())]
+
+    def sinks(self) -> List[str]:
+        return sorted(self.parent)
+
+    def interior_nodes(self) -> List[str]:
+        """Sinks that relay to at least one sibling (peer-serve nodes)."""
+        return sorted(n for n in self.parent if self.children(n))
+
+    def depth(self, node: str) -> int:
+        d, cur = 0, node
+        while cur != self.root:
+            cur = self.parent[cur]
+            d += 1
+            if d > len(self.parent) + 1:
+                raise ValueError(f"cycle reached from node {node!r}")
+        return d
+
+    def path_from_root(self, node: str) -> List[str]:
+        """Nodes from the root down to (and including) ``node``."""
+        path = [node]
+        while path[-1] != self.root:
+            path.append(self.parent[path[-1]])
+        return list(reversed(path))
+
+    def replace_node(self, old: str, new: str, region: Optional[str] = None) -> None:
+        """Swap a (dead) node id for its replacement in place: the new node
+        inherits the old one's parent and children (blast healing)."""
+        if old == self.root:
+            raise ValueError("cannot replace the source node")
+        self.regions[new] = region or self.regions[old]
+        del self.regions[old]
+        self.parent[new] = self.parent.pop(old)
+        for child, p in list(self.parent.items()):
+            if p == old:
+                self.parent[child] = new
+        self._children = None
+
+    def as_dict(self) -> dict:
+        return {
+            "root": self.root,
+            "edges": [[p, c] for p, c in self.edges()],
+            "regions": dict(sorted(self.regions.items())),
+            "cost_per_gb": round(self.cost_per_gb, 6),
+            "solver": self.solver,
+            "fanout": self.fanout,
+            "source_degree": self.source_degree,
+        }
+
+
+def validate_tree(tree: BlastTree) -> None:
+    """Structural invariants of a blast tree (the fan-out-shape contract the
+    unit tests pin): exactly one inbound edge per sink, none at the root, no
+    cycles, every sink reachable from the root, degree bounds respected."""
+    if tree.root in tree.parent:
+        raise ValueError("root has an inbound edge")
+    for node in tree.parent:
+        if node not in tree.regions:
+            raise ValueError(f"sink {node!r} has no region")
+    for node, ps in tree.parent.items():
+        if ps != tree.root and ps not in tree.parent:
+            raise ValueError(f"sink {node!r} hangs off unknown node {ps!r}")
+    # parent-pointer walk doubles as the cycle check
+    for node in tree.parent:
+        tree.depth(node)
+    if len(tree.children(tree.root)) > tree.source_degree:
+        raise ValueError(
+            f"source out-degree {len(tree.children(tree.root))} exceeds bound {tree.source_degree}"
+        )
+    for node in tree.parent:
+        if len(tree.children(node)) > tree.fanout:
+            raise ValueError(f"sink {node!r} out-degree {len(tree.children(node))} exceeds fanout {tree.fanout}")
+
+
+def tree_cost_per_gb(
+    edges: List[Tuple[str, str]], regions: Dict[str, str], cost_fn: Callable[[str, str], float]
+) -> float:
+    """$/GB of logical data for one tree: each edge is crossed exactly once
+    per corpus GB (the multicast-tree egress model; a GB relayed through d
+    hops pays d edges, but every sink's GB shares those edges)."""
+    return sum(cost_fn(regions[a], regions[b]) for a, b in edges)
+
+
+def solve_blast_tree_greedy(
+    root: str,
+    sink_regions: Dict[str, str],
+    root_region: str,
+    cost_fn: Optional[Callable[[str, str], float]] = None,
+    fanout: int = DEFAULT_FANOUT,
+    source_degree: int = DEFAULT_SOURCE_DEGREE,
+) -> BlastTree:
+    """Prim-style cheapest attachment: grow the tree from the root, always
+    attaching the cheapest (in-tree node with spare degree, detached sink)
+    pair; ties break toward SHALLOW attach points then lexical order, so
+    equal-cost grids (loopback) yield balanced, deterministic trees."""
+    cost_fn = cost_fn or get_egress_cost_per_gb
+    regions = {root: root_region, **sink_regions}
+    parent: Dict[str, str] = {}
+    depth = {root: 0}
+    degree_left = {root: max(1, int(source_degree))}
+    detached = sorted(sink_regions)
+    total = 0.0
+    while detached:
+        best: Optional[Tuple[float, int, str, str]] = None  # (cost, depth, in-node, out-node)
+        for u in sorted(degree_left):
+            if degree_left[u] <= 0:
+                continue
+            for v in detached:
+                c = cost_fn(regions[u], regions[v])
+                key = (c, depth[u], u, v)
+                if best is None or key < best:
+                    best = key
+        if best is None:  # every in-tree node saturated: should be impossible with fanout >= 1
+            raise ValueError("greedy tree ran out of attachment degree (fanout < 1?)")
+        c, _, u, v = best
+        parent[v] = u
+        depth[v] = depth[u] + 1
+        degree_left[u] -= 1
+        degree_left[v] = max(1, int(fanout))
+        detached.remove(v)
+        total += c
+    return BlastTree(
+        root=root,
+        parent=parent,
+        regions=regions,
+        cost_per_gb=total,
+        solver="greedy",
+        fanout=max(1, int(fanout)),
+        source_degree=max(1, int(source_degree)),
+    )
+
+
+def solve_blast_tree_milp(
+    root: str,
+    sink_regions: Dict[str, str],
+    root_region: str,
+    cost_fn: Optional[Callable[[str, str], float]] = None,
+    fanout: int = DEFAULT_FANOUT,
+    source_degree: int = DEFAULT_SOURCE_DEGREE,
+) -> Optional[BlastTree]:
+    """Exact degree-constrained min-cost arborescence (see module doc).
+
+    Returns None when scipy's milp is unavailable or reports infeasibility —
+    the caller falls down the ladder to the greedy solver.
+    """
+    try:
+        import numpy as np
+        from scipy.optimize import Bounds, LinearConstraint, milp
+    except ImportError:
+        return None
+    cost_fn = cost_fn or get_egress_cost_per_gb
+    regions = {root: root_region, **sink_regions}
+    sinks = sorted(sink_regions)
+    if not sinks:
+        return BlastTree(root=root, parent={}, regions=regions, solver="milp")
+    nodes = [root] + sinks
+    K = len(sinks)
+    edges = [(a, b) for a in nodes for b in sinks if a != b]
+    e_idx = {e: i for i, e in enumerate(edges)}
+    nE = len(edges)
+    costs = np.array([cost_fn(regions[a], regions[b]) for a, b in edges])
+    # tie-break toward shallow trees: sum of flows == sum of sink depths.
+    # With real prices, epsilon sits well below any price step so it never
+    # changes the cost-optimal edge SET, only the shape among equal-cost
+    # trees. On an all-zero-cost grid (loopback) depth IS the objective —
+    # full weight, or the solver's gap tolerance accepts any feasible tree.
+    if (costs > 0).any():
+        eps = max(1e-9, min(c for c in costs if c > 0) * 1e-6 / max(K, 1))
+    else:
+        eps = 1.0
+
+    # variables: x_e (binary, nE) then f_e (continuous, nE)
+    c = np.concatenate([costs, np.full(nE, eps)])
+    constraints = []
+
+    def row(pairs_x=(), pairs_f=()):
+        r = np.zeros(2 * nE)
+        for e, v in pairs_x:
+            r[e_idx[e]] = v
+        for e, v in pairs_f:
+            r[nE + e_idx[e]] = v
+        return r
+
+    # one inbound edge per sink
+    for b in sinks:
+        constraints.append(
+            LinearConstraint(row(pairs_x=[((a, b), 1.0) for a in nodes if a != b]), 1.0, 1.0)
+        )
+    # flow conservation: each sink absorbs exactly one unit
+    for b in sinks:
+        r = row(
+            pairs_f=[((a, b), 1.0) for a in nodes if a != b]
+            + [((b, d), -1.0) for d in sinks if d != b]
+        )
+        constraints.append(LinearConstraint(r, 1.0, 1.0))
+    # linking: flow only on selected edges (<= K units each)
+    for e in edges:
+        constraints.append(LinearConstraint(row(pairs_x=[(e, -float(K))], pairs_f=[(e, 1.0)]), -np.inf, 0.0))
+    # degree bounds
+    constraints.append(
+        LinearConstraint(
+            row(pairs_x=[((root, b), 1.0) for b in sinks]), 0.0, float(max(1, int(source_degree)))
+        )
+    )
+    for a in sinks:
+        outs = [((a, b), 1.0) for b in sinks if b != a]
+        if outs:
+            constraints.append(LinearConstraint(row(pairs_x=outs), 0.0, float(max(1, int(fanout)))))
+
+    integrality = np.concatenate([np.ones(nE), np.zeros(nE)])
+    bounds = Bounds(np.zeros(2 * nE), np.concatenate([np.ones(nE), np.full(nE, float(K))]))
+    res = milp(c=c, constraints=constraints, integrality=integrality, bounds=bounds)
+    if not getattr(res, "success", False):
+        return None
+    parent: Dict[str, str] = {}
+    for (a, b), i in e_idx.items():
+        if res.x[i] > 0.5:
+            parent[b] = a
+    tree = BlastTree(
+        root=root,
+        parent=parent,
+        regions=regions,
+        cost_per_gb=tree_cost_per_gb([(p, ch) for ch, p in parent.items()], regions, cost_fn),
+        solver="milp",
+        fanout=max(1, int(fanout)),
+        source_degree=max(1, int(source_degree)),
+    )
+    try:
+        validate_tree(tree)
+    except ValueError:
+        return None  # numerically degenerate solution: fall down the ladder
+    return tree
+
+
+def solve_blast_tree(
+    root: str,
+    sink_regions: Dict[str, str],
+    root_region: str,
+    cost_fn: Optional[Callable[[str, str], float]] = None,
+    fanout: int = DEFAULT_FANOUT,
+    source_degree: int = DEFAULT_SOURCE_DEGREE,
+    solver: str = "auto",
+) -> BlastTree:
+    """The placement ladder: ``"milp"`` (exact, may return greedy on missing
+    scipy support), ``"greedy"``, or ``"auto"`` (milp -> greedy)."""
+    if solver not in ("auto", "milp", "greedy"):
+        raise ValueError(f"unknown blast tree solver {solver!r}")
+    if solver in ("auto", "milp"):
+        tree = solve_blast_tree_milp(
+            root, sink_regions, root_region, cost_fn, fanout=fanout, source_degree=source_degree
+        )
+        if tree is not None:
+            return tree
+    return solve_blast_tree_greedy(
+        root, sink_regions, root_region, cost_fn, fanout=fanout, source_degree=source_degree
+    )

@@ -27,7 +27,10 @@ def main():
 @click.option("-r", "--recursive", is_flag=True, help="copy a prefix tree")
 @click.option("-y", "--yes", is_flag=True, help="skip confirmation")
 @click.option("--max-instances", default=None, type=int, help="gateway VMs per region")
-@click.option("--solver", default="direct", type=click.Choice(["direct", "src_one_sided", "dst_one_sided", "ron", "ilp"]))
+@click.option(
+    "--solver", default="direct",
+    type=click.Choice(["direct", "src_one_sided", "dst_one_sided", "ron", "ilp", "blast"]),
+)
 @click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz", "lz4"]))
 @click.option("--dedup/--no-dedup", default=None, help="content-defined dedup on the TPU path")
 @click.option("--resume", is_flag=True, help="journal chunk progress; re-run continues where a killed transfer stopped")
@@ -40,6 +43,45 @@ def cp(src, dst, recursive, yes, max_instances, solver, compress, dedup, resume,
     sys.exit(run_transfer(src, list(dst), recursive=recursive, sync=False, yes=yes,
                           max_instances=max_instances, solver=solver, compress=compress, dedup=dedup,
                           resume=resume, debug=debug, tenant=tenant))
+
+
+@main.command()
+@click.argument("src")
+@click.argument("dst", nargs=-1, required=True)
+@click.option("-y", "--yes", is_flag=True, help="skip confirmation")
+@click.option("--max-instances", default=None, type=int)
+@click.option("--fanout", default=None, type=int, help="max peer-serve out-degree per sink (SKYPLANE_TPU_BLAST_FANOUT)")
+@click.option(
+    "--source-degree", default=None, type=int,
+    help="max tree children of the SOURCE; 1 keeps source egress at ~1x the corpus (SKYPLANE_TPU_BLAST_SOURCE_DEGREE)",
+)
+@click.option("--compress", default=None, type=click.Choice(["none", "zstd", "tpu", "tpu_zstd", "native_lz", "lz4"]))
+@click.option("--dedup/--no-dedup", default=None, help="content-defined dedup per tree edge (warm repeat blasts)")
+@click.option("--debug", is_flag=True)
+@click.option("--tenant", default=None, help="tenant id (16 hex chars) for multi-tenant gateways; minted when omitted")
+def blast(src, dst, yes, max_instances, fanout, source_degree, compress, dedup, debug, tenant):
+    """Blast one corpus to MANY destinations over a peered relay tree.
+
+    The planner places a degree-bounded min-cost relay tree over the egress
+    grid; destination gateways peer-serve landed chunks to siblings, so
+    source egress approaches 1x the corpus regardless of destination count
+    (docs/blast.md). Example:
+
+        skyplane-tpu blast s3://ckpts/step900/ gs://eu/ gs://asia/ s3://west/ -y
+    """
+    import os
+
+    from skyplane_tpu.cli.cli_transfer import run_transfer
+
+    if len(dst) < 2:
+        raise click.ClickException("blast needs >= 2 destinations (one destination is a plain `cp`)")
+    if fanout is not None:
+        os.environ["SKYPLANE_TPU_BLAST_FANOUT"] = str(fanout)
+    if source_degree is not None:
+        os.environ["SKYPLANE_TPU_BLAST_SOURCE_DEGREE"] = str(source_degree)
+    sys.exit(run_transfer(src, list(dst), recursive=True, sync=False, yes=yes,
+                          max_instances=max_instances, solver="blast", compress=compress, dedup=dedup,
+                          debug=debug, tenant=tenant))
 
 
 @main.command()

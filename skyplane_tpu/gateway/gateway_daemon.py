@@ -281,6 +281,10 @@ class GatewayDaemon:
         # multi-process pump health (docs/datapath-performance.md): always
         # present (zeros when the pump is off) as skyplane_pump_*
         self.metrics.register_provider("pump", self._pump_counters)
+        # per-edge source-egress attribution (docs/blast.md): wire bytes
+        # keyed by (src, dst) gateway so fan-out-vs-egress curves come from
+        # counters, not arithmetic — skyplane_egress_bytes_total{src,dst}
+        self.metrics.register_labeled_provider("egress", self._egress_edges, label=("src", "dst"))
         self.api = GatewayDaemonAPI(
             chunk_store=self.chunk_store,
             receiver=self.receiver,
@@ -467,6 +471,25 @@ class GatewayDaemon:
                 out[name] = out.get(name, 0.0) + s
         return out
 
+    def _egress_edges(self) -> Dict[str, Dict[tuple, float]]:
+        """{metric: {(src, dst): bytes}} for the edge-labeled provider. The
+        multi-process pump keeps its wire work in worker processes, so pump
+        senders attribute their merged wire_bytes_sent to the operator's
+        current target — single-target-per-operator by construction."""
+        from skyplane_tpu.gateway.pump import is_pump_sender
+
+        edges: Dict[tuple, float] = {}
+        for op in self.operators:
+            if not isinstance(op, GatewaySenderOperator):
+                continue
+            per_edge = op.egress_by_edge()
+            if not per_edge and is_pump_sender(op):
+                per_edge = {op.target_gateway_id: op.wire_counters().get("wire_bytes_sent", 0)}
+            for dst, n in per_edge.items():
+                key = (self.gateway_id, dst)
+                edges[key] = edges.get(key, 0) + n
+        return {"bytes_total": edges}
+
     def _sender_socket_events(self) -> dict:
         """Per-window send profile events + the stable wire-counter schema
         from every sender operator (sender-side analog of the receiver
@@ -641,7 +664,9 @@ class GatewayDaemon:
         if op_type == "read_local":
             return GatewayReadLocalOperator(**common, n_workers=op.get("num_connections", 8))
         if op_type == "write_local":
-            return GatewayWriteLocalOperator(**common, n_workers=4)
+            # `path` re-anchors dest_key under a sink-local root (blast
+            # fan-out: many sinks land the same dest_key side by side)
+            return GatewayWriteLocalOperator(**common, n_workers=4, root=op.get("path"))
         if op_type == "gen_data":
             return GatewayRandomDataGenOperator(**common, n_workers=4)
         if op_type == "send":
@@ -684,6 +709,7 @@ class GatewayDaemon:
                 api_token=self.api_token,
                 control_tls=self.control_tls,
                 source_gateway_id=self.gateway_id,
+                peer_serve=op.get("peer_serve", False),
                 dedup_index=self._dedup_index_for(target_id) if dedup and not self.pump_procs else None,
                 scheduler=self.scheduler,
                 tenant_registry=self.tenants,

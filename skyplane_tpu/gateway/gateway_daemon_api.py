@@ -10,6 +10,9 @@ directly:
   POST /api/v1/servers                     new receiver data port -> {server_port}
   DELETE /api/v1/servers/<port>            stop a receiver port
   POST /api/v1/chunk_requests              register chunk batch (json list)
+  POST /api/v1/requeue_chunks              re-drive already-registered chunks
+                                           (json list of ids; registration
+                                           map untouched — blast healing)
   GET  /api/v1/chunk_requests              all chunk requests + states
   GET  /api/v1/incomplete_chunk_requests   pending only
   GET  /api/v1/chunk_status_log            aggregate chunk_id -> state map
@@ -157,6 +160,12 @@ class GatewayDaemonAPI:
         self.chunk_status_log: List[dict] = []
         self._status_log_dropped = 0
         self._terminal_done: Dict[str, Set[str]] = {}  # chunk_id -> completed terminal handles
+        # chunks currently being re-driven through the program (blast
+        # healing, POST /requeue_chunks): their terminal refcount was reset
+        # so GC waits for EVERY branch of the re-pass; a second requeue of
+        # the same id is refused until this pass lands (double-enqueueing
+        # would race one copy's GC against the other copy's file reads)
+        self._redriving: Set[str] = set()
         self._errors: List[str] = []
         self.shutdown_requested = threading.Event()
 
@@ -310,14 +319,25 @@ class GatewayDaemonAPI:
                         done.add(group)
                         if len(done) == len(terminals):
                             self.chunk_status[chunk_id] = "complete"
+                            self._redriving.discard(chunk_id)  # re-drive pass landed
                             self._gc_chunk(chunk_id)
-                        else:
+                        elif self.chunk_status.get(chunk_id) != "complete":
+                            # a re-driven chunk mid-pass stays 'complete':
+                            # the aggregate status NEVER regresses an acked
+                            # chunk (sink-measured truth, docs/blast.md)
                             self.chunk_status[chunk_id] = "partial"
                     # a NON-terminal complete (e.g. WaitReceiver before the
                     # write) must never set the aggregate to 'complete' — the
                     # tracker would read the destination mid-write
                 elif state == ChunkState.failed.to_short_str():
-                    self.chunk_status[chunk_id] = "failed"
+                    # a failed RE-drive pass never regresses a chunk whose
+                    # bytes landed durably on the first pass — and always
+                    # releases the re-drive guard so a later requeue may
+                    # retry (blast healing; docs/blast.md)
+                    redriving = chunk_id in self._redriving
+                    self._redriving.discard(chunk_id)
+                    if not (redriving and self.chunk_status.get(chunk_id) == "complete"):
+                        self.chunk_status[chunk_id] = "failed"
                 elif chunk_id not in self.chunk_status or self.chunk_status[chunk_id] not in ("complete", "partial"):
                     self.chunk_status[chunk_id] = state
         return n
@@ -724,6 +744,42 @@ class GatewayDaemonAPI:
                 str(new_id), str(host), int(port), old_target_gateway_id=body.get("old_target_gateway_id")
             )
             req._send(200, {"status": "ok", "retargeted": n})
+        elif path == "/api/v1/requeue_chunks":
+            # blast tree healing (docs/blast.md): re-DRIVE already-registered
+            # chunks through this gateway's program without touching the
+            # registration map — exactly-once registration is preserved (the
+            # zero-duplicate-registrations invariant), while the re-enqueued
+            # chunk re-reads/re-sends idempotently: receivers re-land the
+            # same bytes atomically, sinks re-register as a no-op, and
+            # write operators overwrite identical content. Body: a JSON list
+            # of chunk ids; unknown ids are reported, never invented.
+            body = req._read_json()
+            if not isinstance(body, list):
+                req._send(400, {"error": "expected a json list of chunk ids"})
+                return
+            requeued, pending, unknown = 0, 0, []
+            for cid in body:
+                cid = str(cid)
+                with self._lock:
+                    d = self.chunk_requests.get(cid)
+                    if d is None:
+                        unknown.append(cid)
+                        continue
+                    if self.chunk_status.get(cid) not in ("complete", "failed") or cid in self._redriving:
+                        # still in flight through the program (or already
+                        # being re-driven): the existing copy will finish —
+                        # a second enqueue would race its own GC. FAILED
+                        # chunks have NO in-flight copy and do re-drive.
+                        pending += 1
+                        continue
+                    # fresh terminal refcount: GC waits for EVERY branch of
+                    # the re-pass; the aggregate status stays 'complete'
+                    self._terminal_done.pop(cid, None)
+                    self._redriving.add(cid)
+                cr = ChunkRequest.from_dict(d)
+                self.chunk_store.add_chunk_request(cr, ChunkState.registered)
+                requeued += 1
+            req._send(200, {"status": "ok", "requeued": requeued, "pending": pending, "unknown": unknown})
         elif path == "/api/v1/chunk_requests":
             if self.draining_event is not None and self.draining_event.is_set():
                 # DRAINING: admission stopped. 503 (not 4xx) so dispatch/

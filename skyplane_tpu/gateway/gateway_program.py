@@ -48,6 +48,7 @@ class GatewaySend(GatewayOp):
         encrypt: bool = False,
         dedup: bool = False,
         private_ip: bool = False,
+        peer_serve: bool = False,
         handle: Optional[str] = None,
     ):
         super().__init__(handle)
@@ -58,6 +59,10 @@ class GatewaySend(GatewayOp):
         self.encrypt = encrypt
         self.dedup = dedup
         self.private_ip = private_ip
+        # blast relay tree (skyplane_tpu/blast, docs/blast.md): this send
+        # runs on a DESTINATION gateway serving already-landed chunks to a
+        # sibling sink; arms the relay.peer_serve fault point
+        self.peer_serve = peer_serve
 
     def to_dict(self) -> dict:
         d = super().to_dict()
@@ -69,6 +74,7 @@ class GatewaySend(GatewayOp):
             encrypt=self.encrypt,
             dedup=self.dedup,
             private_ip=self.private_ip,
+            peer_serve=self.peer_serve,
         )
         return d
 

@@ -30,6 +30,7 @@ import json
 
 from skyplane_tpu.chunk import DEFAULT_TENANT_ID, ChunkFlags, ChunkRequest, ChunkState, WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
+from skyplane_tpu.faults import get_injector
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, put_drop_oldest
 from skyplane_tpu.obs import NOOP_SPAN, get_registry, get_tracer
 from skyplane_tpu.gateway.operators.sender_wire import RECONNECT_POLICY, EngineCallbacks, env_int
@@ -245,10 +246,22 @@ class GatewayWriteLocalOperator(GatewayOperator):
 
     MAX_CACHED_FDS = 256
 
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, root: Optional[str] = None, **kwargs):
         super().__init__(*args, **kwargs)
+        # sink-local output root (blast fan-out, docs/blast.md): many sink
+        # gateways land the SAME dest_key — each re-anchors it under its own
+        # root so per-sink outputs stay byte-verifiable side by side
+        self.root = root
         self._fd_lock = threading.Lock()
         self._fds: "OrderedDict[str, list]" = OrderedDict()  # dest -> [fd, refcount]
+
+    def _dest_path(self, dest_key: str) -> Path:
+        if not self.root:
+            return Path(dest_key)
+        p = Path(dest_key)
+        if p.is_absolute():
+            p = p.relative_to(p.anchor)
+        return Path(self.root) / p
 
     def _acquire_fd(self, dest: Path) -> int:
         key = str(dest)
@@ -302,7 +315,7 @@ class GatewayWriteLocalOperator(GatewayOperator):
             "chunk.write_local", trace_id=chunk.chunk_id, cat="receiver", force=bool(chunk.traced), args=span_args
         ):
             data = self.chunk_store.chunk_path(chunk.chunk_id).read_bytes()
-            dest = Path(chunk.dest_key)
+            dest = self._dest_path(chunk.dest_key)
             offset = chunk.file_offset_bytes or 0
             fd = self._acquire_fd(dest)
             try:
@@ -549,6 +562,11 @@ class _SenderEngineOps(EngineCallbacks):
         self.op.error_queue.put(msg)
         self.op.error_event.set()
 
+    def on_wire_sent(self, nbytes: int) -> None:
+        # per-edge egress attribution: the engine reports frame bytes as they
+        # hit the socket; the operator keys them by its current target
+        self.op.note_egress(nbytes)
+
 
 class GatewaySenderOperator(GatewayOperator):
     """Pushes chunks to a remote gateway over framed TCP(+TLS).
@@ -596,6 +614,7 @@ class GatewaySenderOperator(GatewayOperator):
         dedup_index: Optional[SenderDedupIndex] = None,
         scheduler=None,
         tenant_registry=None,
+        peer_serve: bool = False,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -672,6 +691,15 @@ class GatewaySenderOperator(GatewayOperator):
         # bumped by retarget(); serial-path workers compare their cached
         # socket's generation against it and re-dial the (new) target
         self._target_gen = 0
+        # blast peer-serve (docs/blast.md): this sender runs on a destination
+        # gateway re-serving landed chunks to a sibling sink; arms the
+        # relay.peer_serve fault point (drop -> silent requeue -> re-serve)
+        self.peer_serve = bool(peer_serve)
+        # per-(src,dst)-edge egress bytes, keyed by target gateway id at the
+        # moment the bytes hit the socket (retargets start a new key) — the
+        # counter-measured source of skyplane_egress_bytes_total{src,dst}
+        self._egress_lock = threading.Lock()
+        self._egress_bytes: Dict[str, int] = {}
         from skyplane_tpu.gateway.control_auth import control_session
 
         self._session = control_session(api_token)
@@ -834,6 +862,21 @@ class GatewaySenderOperator(GatewayOperator):
         )
         SCHED_RELEASE_POLICY.call(lambda: self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1), log_errors=False)
 
+    def note_egress(self, nbytes: int) -> None:
+        """Account wire bytes against the CURRENT target edge (called from
+        the serial send loop and the engine's on_wire_sent callback)."""
+        if nbytes <= 0:
+            return
+        target = self.target_gateway_id
+        with self._egress_lock:
+            self._egress_bytes[target] = self._egress_bytes.get(target, 0) + nbytes
+
+    def egress_by_edge(self) -> Dict[str, int]:
+        """{target_gateway_id: wire bytes sent} — the daemon aggregates this
+        into skyplane_egress_bytes_total{src=<this gateway>,dst=<target>}."""
+        with self._egress_lock:
+            return dict(self._egress_bytes)
+
     def note_window_event(self, event: dict, seconds: float) -> None:
         """Emit one per-window profile event (bounded queue, counted drops)
         and feed the unified-registry window-latency histogram."""
@@ -978,7 +1021,15 @@ class GatewaySenderOperator(GatewayOperator):
         engine = self._engine(worker_id)
         engine.note_window()
         window = _WindowStats(self, worker_id, len(batch))
+        inj = get_injector()
         for req in batch:
+            if self.peer_serve and inj.enabled and inj.fire("relay.peer_serve"):
+                # injected drop of a peer-served chunk (docs/fault-injection
+                # .md relay.peer_serve): silent requeue — the chunk re-serves
+                # on a later pass, exactly like a transient stream break
+                self.input_queue.put_for_handle(self.handle, req)
+                window.note(acked=False)
+                continue
             # fair-share gate BEFORE framing: a tenant over its share parks
             # HERE (its tokens return as its own acks land), so its backlog
             # never occupies frame-ahead buffers or batch-runner windows that
@@ -1072,7 +1123,10 @@ class GatewaySenderOperator(GatewayOperator):
             # as they hit the socket, so worker memory holds ONE chunk at a
             # time (plus ack bookkeeping), not the whole window
             tracer = get_tracer()
+            inj = get_injector()
             for i, req in enumerate(batch):
+                if self.peer_serve and inj.enabled and inj.fire("relay.peer_serve"):
+                    continue  # injected peer-serve drop: result stays False -> requeue
                 if not self.sched_acquire(req):
                     break  # shutdown mid-window: un-sent chunks re-queue below
                 acquired.append(req)
@@ -1107,19 +1161,22 @@ class GatewaySenderOperator(GatewayOperator):
                     header.to_socket(sock)
                     sock.sendall(wire)
                 window_wire += len(wire)
+                self.note_egress(len(wire))
                 del wire
                 if payload is not None:
                     # only the fingerprint lists are needed for ack
                     # bookkeeping — keeping wire_bytes alive in `sent` would
                     # pin up to window_bytes per worker until acks complete
                     payload.wire_bytes = b""
-                sent.append((req, payload))
+                # carry the BATCH index: a peer-serve drop skips mid-batch,
+                # so enumerate(sent) would misattribute later acks
+                sent.append((i, req, payload))
             # cumulative ack collection: acks arrive in frame order (the
             # receiver's per-connection loop is sequential). sendall only
             # proves bytes reached the local TCP buffer; the ack means the
             # chunk (and its dedup literals) is durably landed, so the
             # fingerprint commits below are truthful.
-            for i, (req, payload) in enumerate(sent):
+            for i, req, payload in sent:
                 ack = sock.recv(1)
                 if ack == ACK_BYTE:
                     if self.dedup_index is not None and payload is not None:

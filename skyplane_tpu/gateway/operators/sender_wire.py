@@ -157,6 +157,12 @@ class EngineCallbacks:
     def on_fatal(self, msg: str) -> None:  # escalate to the daemon error machinery
         ...
 
+    def on_wire_sent(self, nbytes: int) -> None:  # frame bytes hit the socket
+        # per-(src,dst)-edge egress attribution (skyplane_egress_bytes_total,
+        # docs/blast.md): the operator keys the bytes by its CURRENT target,
+        # which only the callback owner knows — the engine stays edge-blind
+        ...
+
 
 class _Stream:
     """One striped connection: frame-ahead queue, in-flight window, pending
@@ -626,6 +632,7 @@ class SenderWireEngine:
                 stream.inflight_bytes += frame.wire_len
             self._bump("frames_sent")
             self._bump("wire_bytes_sent", frame.wire_len)
+            self.callbacks.on_wire_sent(frame.wire_len)
             if pipelined:
                 self._bump("frames_pipelined")
             self._drain_acks(stream, block=False)

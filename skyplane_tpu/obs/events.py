@@ -56,6 +56,17 @@ EV_REPLACEMENT_REQUESTED = "replacement.requested"
 EV_REPLACEMENT_READY = "replacement.ready"
 EV_REPLACEMENT_FAILED = "replacement.failed"
 EV_FAULT_FIRED = "fault.fired"
+# planner fallback visibility (docs/blast.md): an overlay/blast planner that
+# could not (or chose not to) build its preferred topology records WHY —
+# paired with the skyplane_planner_downgrades_total counter so a blast job
+# can assert it really got a relay tree instead of a silent direct fan-out
+EV_PLANNER_DOWNGRADE = "planner.downgrade"
+# checkpoint-blast fan-out (skyplane_tpu/blast, docs/blast.md): per-sink
+# completion + tree-healing lifecycle
+EV_BLAST_SINK_COMPLETE = "blast.sink_complete"
+EV_BLAST_RELAY_DEAD = "blast.relay_dead"
+EV_BLAST_RETARGETED = "blast.retargeted"
+EV_BLAST_REQUEUED = "blast.requeued"
 EV_PUMP_WORKER_DEATH = "pump.worker_death"  # multi-process pump worker died (respawn follows)
 EV_STREAM_RESET = "stream.reset"
 EV_STREAM_BREAK = "stream.break"

@@ -200,11 +200,16 @@ class MetricsRegistry:
         with self._lock:
             self._providers.append((prefix, fn))
 
-    def register_labeled_provider(self, prefix: str, fn: Callable[[], dict], label: str = "tenant") -> None:
+    def register_labeled_provider(self, prefix: str, fn: Callable[[], dict], label="tenant") -> None:
         """Absorb a nested dict source ``{metric: {label_value: number}}``:
         each metric renders as ``skyplane_<prefix>_<metric>{<label>="v"} n``.
         This is how per-tenant accounting (TenantRegistry, scheduler, the
-        persistent dedup index) reaches /api/v1/metrics."""
+        persistent dedup index) reaches /api/v1/metrics.
+
+        ``label`` may also be a tuple of label names, with the provider's
+        inner keys being same-length tuples of values — the per-edge surface
+        (``skyplane_egress_bytes_total{src="...",dst="..."}``) the blast
+        fan-out accounting measures source egress from (docs/blast.md)."""
         with self._lock:
             self._labeled_providers.append((prefix, label, fn))
 
@@ -271,13 +276,20 @@ class MetricsRegistry:
                     if name in seen:
                         continue
                     seen.add(name)
-                    lines.append(f"# HELP {name} per-{label} value from the {prefix} provider")
+                    label_names = (label,) if isinstance(label, str) else tuple(label)
+                    lines.append(f"# HELP {name} per-{','.join(label_names)} value from the {prefix} provider")
                     lines.append(f"# TYPE {name} gauge")
                     for label_value in sorted(by_label):
                         v = by_label[label_value]
                         if not isinstance(v, (int, float)) or isinstance(v, bool):
                             continue
-                        lines.append(f'{name}{{{label}="{_escape_label(str(label_value))}"}} {_fmt(v)}')
+                        values = (label_value,) if not isinstance(label_value, tuple) else label_value
+                        if len(values) != len(label_names):
+                            continue  # malformed key: skip the sample, never the scrape
+                        pairs = ",".join(
+                            f'{n}="{_escape_label(str(v_))}"' for n, v_ in zip(label_names, values)
+                        )
+                        lines.append(f"{name}{{{pairs}}} {_fmt(v)}")
         return "\n".join(lines) + "\n"
 
     def _chain(self) -> List["MetricsRegistry"]:

@@ -28,6 +28,21 @@ from skyplane_tpu.gateway.gateway_program import (
 from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
 from skyplane_tpu.planner.topology import TopologyPlan
 
+def record_planner_downgrade(requested: str, chosen: str, reason: str, **fields) -> None:
+    """A planner fell down its fallback ladder: record a flight-recorder
+    event and bump ``skyplane_planner_downgrades_total`` so the fallback is
+    queryable, not a log line someone greps for after the fact. The blast
+    path additionally asserts ``plan.planner_name`` (docs/blast.md) — this
+    accounting is how a fleet operator notices topology intent being lost."""
+    from skyplane_tpu.obs import get_recorder, get_registry
+    from skyplane_tpu.obs.events import EV_PLANNER_DOWNGRADE
+
+    get_registry().counter(
+        "planner_downgrades_total", help_="plans that fell back from their requested planner/topology"
+    ).inc()
+    get_recorder().record(EV_PLANNER_DOWNGRADE, requested=requested, chosen=chosen, reason=reason, **fields)
+
+
 # vCPU counts per instance class, smallest-last fallback ladder
 # (reference: data/vcpu_info.csv + planner.py:114-159)
 VCPU_INFO: Dict[str, List[Tuple[str, int]]] = {
@@ -275,6 +290,7 @@ class MulticastDirectPlanner(Planner):
         # multicast pays egress once per destination region)
         plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
         plan.codec_decisions = dict(getattr(self, "codec_decisions", {}))  # plan log (north-star decision)
+        plan.planner_name = "multicast_direct"
         return plan
 
 
@@ -312,6 +328,7 @@ class DirectPlannerSourceOneSided(MulticastDirectPlanner):
                     )
             gw.vm_type = vm_types.get(src_region)
         plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
+        plan.planner_name = "src_one_sided"
         return plan
 
 
@@ -344,6 +361,7 @@ class DirectPlannerDestOneSided(MulticastDirectPlanner):
                     )
                 gw.vm_type = vm_types.get(region)
         plan.cost_per_gb = sum(get_egress_cost_per_gb(src_region, r) for r in dst_regions if r != src_region)
+        plan.planner_name = "dst_one_sided"
         return plan
 
 
@@ -396,9 +414,23 @@ class OverlayPlanner(Planner):
         direct = MulticastDirectPlanner(
             self.transfer_config, quota_limits_file=self.quota_limits_file, n_instances=self.n_instances
         )
+        requested = f"overlay_{self.solver_name}"
+
+        def _downgrade(reason: str) -> TopologyPlan:
+            # accounted, never silent: the flight-recorder event + counter
+            # make the fallback queryable, and the plan's metadata lets the
+            # caller (e.g. the blast path) ASSERT which planner it really got
+            logger.fs.warning(f"overlay planner downgrade ({reason}); using direct multicast plan")
+            record_planner_downgrade(requested, "multicast_direct", reason, n_destinations=len(dst_regions))
+            plan = direct.plan(jobs)
+            plan.metadata["downgraded_from"] = requested
+            plan.metadata["downgrade_reason"] = reason
+            return plan
+
         if len(dst_regions) != 1:
-            logger.fs.warning("overlay planner supports a single destination; using direct multicast plan")
-            return direct.plan(jobs)
+            # multi-destination fan-out belongs to the blast planner
+            # (skyplane_tpu/blast); the overlay solvers model one sink
+            return _downgrade("multi_destination")
         solver_cls = {"ron": ThroughputSolverRON, "ilp": ThroughputSolverILP}[self.solver_name]
         solver = solver_cls(self.profile_path)
         candidates = self.candidate_regions
@@ -406,8 +438,7 @@ class OverlayPlanner(Planner):
             candidates = sorted({r for pair in solver.grid for r in pair})
         candidates = [c for c in candidates if c not in (src_region, dst_regions[0])]
         if not candidates:
-            logger.fs.warning("no candidate relay regions (no throughput profile?); using direct plan")
-            return direct.plan(jobs)
+            return _downgrade("no_candidate_regions")
         required = self.required_gbps
         if required is None:
             # demand the best achievable single-path throughput, not merely
@@ -438,27 +469,38 @@ class OverlayPlanner(Planner):
         else:
             sol = solver.solve_min_cost(problem, candidates)
         if not sol.is_feasible:
-            logger.fs.warning("overlay solver found no feasible topology; using direct plan")
-            return direct.plan(jobs)
+            return _downgrade("solver_infeasible")
         if sol.path == [src_region, dst_regions[0]] or set(sol.edge_flow_gbits) == {(src_region, dst_regions[0])}:
-            return direct.plan(jobs)  # solver chose the direct path: simpler program
+            # the solver CHOSE direct: simpler program, not a downgrade
+            plan = direct.plan(jobs)
+            plan.metadata["overlay_considered"] = True
+            return plan
         logger.fs.info(
             f"overlay plan via {self.solver_name}: "
             f"{sol.path or sorted(sol.edge_flow_gbits)} at {sol.throughput_achieved_gbits:.1f} Gbps"
         )
-        return solution_to_topology(sol, jobs, self.transfer_config, planner=self)
+        plan = solution_to_topology(sol, jobs, self.transfer_config, planner=self)
+        plan.planner_name = requested
+        return plan
 
 
 def get_planner(name: str, transfer_config: TransferConfig, **kw) -> Planner:
     """Planner selection by name (reference: api/pipeline.py:63-71; 'ron' and
-    'ilp' route through the overlay solvers)."""
+    'ilp' route through the overlay solvers, 'blast' through the multicast
+    relay-tree planner in skyplane_tpu/blast)."""
     if name in ("ron", "ilp"):
         return OverlayPlanner(transfer_config, solver=name, **kw)
+    if name == "blast":
+        from skyplane_tpu.blast.planner import BlastPlanner
+
+        return BlastPlanner(transfer_config, **kw)
     planners = {
         "direct": MulticastDirectPlanner,
         "src_one_sided": DirectPlannerSourceOneSided,
         "dst_one_sided": DirectPlannerDestOneSided,
     }
     if name not in planners:
-        raise SkyplaneTpuException(f"unknown planner {name!r}; available: {sorted(planners) + ['ron', 'ilp']}")
+        raise SkyplaneTpuException(
+            f"unknown planner {name!r}; available: {sorted(planners) + ['ron', 'ilp', 'blast']}"
+        )
     return planners[name](transfer_config, **kw)

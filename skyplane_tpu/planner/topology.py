@@ -50,6 +50,13 @@ class TopologyPlan:
         self.cost_per_gb = cost_per_gb
         self.gateways: Dict[str, TopologyPlanGateway] = {}
         self._counter = 0
+        # provenance: which planner actually produced this plan (a fallback
+        # ladder may end somewhere other than where it started — the blast
+        # path asserts planner_name so a silent direct downgrade can't pose
+        # as a relay tree), plus free-form planner metadata (tree edges,
+        # downgrade reasons, solver identity; docs/blast.md)
+        self.planner_name: str = ""
+        self.metadata: Dict[str, object] = {}
 
     def add_gateway(self, region_tag: str, program: Optional[GatewayProgram] = None) -> TopologyPlanGateway:
         gateway_id = f"gateway_{self._counter}"

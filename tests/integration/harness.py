@@ -157,14 +157,15 @@ def make_pair(
     return src, dst
 
 
-def dispatch_file(
-    src: LocalGateway,
+def build_chunk_requests(
     src_path: Path,
-    dst_path: Path,
+    dst_path,
     chunk_bytes: int = 4 << 20,
     tenant_id: Optional[str] = None,
-) -> List[str]:
-    """Split a file into chunk requests and POST them to the source gateway."""
+) -> List[ChunkRequest]:
+    """Split a local file into local-region chunk requests — the one
+    canonical builder for every loopback driver (dispatch_file, the blast
+    soak/bench/controller tests)."""
     size = src_path.stat().st_size
     reqs = []
     offset = 0
@@ -182,9 +183,76 @@ def dispatch_file(
         offset += length
         if size == 0:
             break
+    return reqs
+
+
+def dispatch_file(
+    src: LocalGateway,
+    src_path: Path,
+    dst_path: Path,
+    chunk_bytes: int = 4 << 20,
+    tenant_id: Optional[str] = None,
+) -> List[str]:
+    """Split a file into chunk requests and POST them to the source gateway."""
+    reqs = build_chunk_requests(src_path, dst_path, chunk_bytes, tenant_id=tenant_id)
     resp = src.post("chunk_requests", json=[r.as_dict() for r in reqs], timeout=30)
     resp.raise_for_status()
     return [r.chunk.chunk_id for r in reqs]
+
+
+def hard_kill(gw: LocalGateway) -> None:
+    """Emulate SIGKILL for an in-process daemon: operators abandon their
+    queues mid-chunk, data sockets close, and the control API vanishes —
+    no drain, no flush, unlike the graceful ``stop()``. Liveness pollers see
+    connection failures immediately (the blast/chaos relay-death drills)."""
+    daemon = gw.daemon
+    for op in daemon.operators:
+        op.exit_flag.set()
+    try:
+        daemon.receiver.stop_all()
+    except OSError:
+        pass
+    daemon.api.stop()  # idempotent: the run loop's shutdown re-stop is a no-op
+    daemon.stop()
+    gw.thread.join(timeout=10)
+
+
+# ---- blast fan-out fleet (skyplane_tpu/blast, docs/blast.md) ----
+
+
+def start_blast_fleet(
+    tmp: Path,
+    tree,
+    compress: str = "none",
+    dedup: bool = False,
+    encrypt: bool = False,
+    num_connections: int = 2,
+    out_roots: Optional[Dict[str, str]] = None,
+):
+    """Start a loopback blast fleet for ``tree`` (leaves first, so every
+    parent knows its children's control ports). Returns
+    ``(source, sinks, out_roots)`` — sinks keyed by tree node id, each sink
+    writing under its own out_roots[node]."""
+    from skyplane_tpu.blast import build_local_blast_programs, start_order
+    from skyplane_tpu.gateway.crypto import generate_key
+
+    key = generate_key() if encrypt else None
+    if out_roots is None:
+        out_roots = {node: str(tmp / "out" / node) for node in tree.sinks()}
+    programs = build_local_blast_programs(
+        tree, out_roots, num_connections=num_connections, compress=compress, dedup=dedup, encrypt=encrypt
+    )
+    gateways: Dict[str, LocalGateway] = {}
+    ports: Dict[str, int] = {}
+    for node in start_order(tree):
+        # leaves-first start order guarantees every child's port is known
+        info = {c: {"public_ip": "127.0.0.1", "control_port": ports[c]} for c in tree.children(node)}
+        gateways[node] = start_gateway(
+            programs[node], info, node, str(tmp / f"{node}_chunks"), e2ee_key=key, use_tls=False
+        )
+        ports[node] = gateways[node].control_port
+    source = gateways.pop(tree.root)
+    return source, gateways, out_roots
 
 
 # ---- control-plane harness: drive the REAL TransferProgressTracker over

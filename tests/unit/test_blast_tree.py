@@ -1,0 +1,275 @@
+"""Blast tree placement + planner fan-out shapes (docs/blast.md).
+
+Pins, in the spirit of test_pricing_grid.py: the degree-constrained tree
+solver's structural invariants (one inbound edge per sink, acyclic, degree
+bounds), per-edge costs priced off the REAL egress grid, the tree-vs-direct
+cost crossover, and the planner-downgrade accounting satellite (flight
+recorder event + skyplane_planner_downgrades_total + plan metadata).
+"""
+
+from __future__ import annotations
+
+import uuid
+from types import SimpleNamespace
+
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.blast import (
+    BlastPlanner,
+    BlastTree,
+    build_local_blast_programs,
+    parse_egress_edges,
+    solve_blast_tree,
+    solve_blast_tree_greedy,
+    solve_blast_tree_milp,
+    start_order,
+    tree_cost_per_gb,
+    validate_tree,
+)
+from skyplane_tpu.obs import get_registry
+from skyplane_tpu.obs.events import EV_PLANNER_DOWNGRADE, configure_recorder
+from skyplane_tpu.planner.planner import OverlayPlanner, get_planner
+from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+
+
+def _iface(region, bucket="b"):
+    return SimpleNamespace(region_tag=lambda: region, bucket=lambda: bucket)
+
+
+def _job(src_region, dst_regions):
+    return SimpleNamespace(
+        uuid=uuid.uuid4().hex,
+        src_iface=_iface(src_region, "srcb"),
+        dst_ifaces=[_iface(r, f"dst{i}") for i, r in enumerate(dst_regions)],
+    )
+
+
+def _cfg(**kw):
+    defaults = dict(compress="none", dedup=False, encrypt_e2e=False, auto_codec_decision=False)
+    defaults.update(kw)
+    return TransferConfig(**defaults)
+
+
+SINKS8 = {f"s{i}": "local:local" for i in range(8)}
+
+
+# ---- solver structural invariants ----
+
+
+@pytest.mark.parametrize("solver", ["greedy", "milp"])
+def test_tree_shape_invariants(solver):
+    tree = solve_blast_tree(
+        "src", SINKS8, "local:local", cost_fn=lambda a, b: 0.0, fanout=2, source_degree=1, solver=solver
+    )
+    validate_tree(tree)
+    # exactly one inbound edge per sink, none at the root
+    assert sorted(tree.parent) == sorted(SINKS8)
+    assert "src" not in tree.parent
+    # degree bounds: source 1, interior <= 2
+    assert len(tree.children("src")) == 1
+    assert all(len(tree.children(s)) <= 2 for s in tree.sinks())
+    # acyclic + fully reachable: every sink has a root path
+    for s in tree.sinks():
+        assert tree.path_from_root(s)[0] == "src"
+    # both solvers reach the optimal depth sum for 8 sinks @ fanout 2
+    assert sum(tree.depth(s) for s in tree.sinks()) == 21
+
+
+def test_tree_deterministic():
+    a = solve_blast_tree_greedy("src", SINKS8, "local:local", cost_fn=lambda x, y: 0.0, fanout=3)
+    b = solve_blast_tree_greedy("src", SINKS8, "local:local", cost_fn=lambda x, y: 0.0, fanout=3)
+    assert a.edges() == b.edges()
+
+
+def test_validate_tree_rejects_bad_shapes():
+    regions = {"src": "r", "a": "r", "b": "r"}
+    with pytest.raises(ValueError, match="cycle"):
+        validate_tree(BlastTree(root="src", parent={"a": "b", "b": "a"}, regions=regions))
+    with pytest.raises(ValueError, match="out-degree"):
+        validate_tree(
+            BlastTree(root="src", parent={"a": "src", "b": "src"}, regions=regions, source_degree=1, fanout=2)
+        )
+    with pytest.raises(ValueError, match="unknown node"):
+        validate_tree(BlastTree(root="src", parent={"a": "ghost"}, regions={"src": "r", "a": "r"}))
+
+
+def test_replace_node_rewires_parent_and_children():
+    tree = solve_blast_tree_greedy("src", SINKS8, "local:local", cost_fn=lambda x, y: 0.0, fanout=2)
+    victim = tree.children("src")[0]
+    kids = tree.children(victim)
+    tree.replace_node(victim, "repl")
+    validate_tree(tree)
+    assert tree.parent["repl"] == "src"
+    assert all(tree.parent[k] == "repl" for k in kids)
+    assert victim not in tree.parent and victim not in tree.regions
+
+
+# ---- grid-priced costs + the tree-vs-direct crossover ----
+
+WAN_SINKS = {
+    "a": "gcp:us-central1",
+    "b": "gcp:europe-west1",
+    "c": "gcp:asia-east1",
+    "d": "aws:us-west-2",
+}
+
+
+def test_edge_costs_match_grid():
+    tree = solve_blast_tree("src", WAN_SINKS, "aws:us-east-1", fanout=3, source_degree=1)
+    validate_tree(tree)
+    expect = sum(get_egress_cost_per_gb(tree.regions[p], tree.regions[c]) for p, c in tree.edges())
+    assert tree.cost_per_gb == pytest.approx(expect)
+    assert tree.cost_per_gb == pytest.approx(tree_cost_per_gb(tree.edges(), tree.regions, get_egress_cost_per_gb))
+
+
+def test_milp_vs_direct_cost_crossover():
+    """The pin: at real grid prices a peered tree beats direct multicast
+    whenever sink-to-sink egress undercuts repeated source egress — and
+    degenerates to the direct star when it doesn't."""
+    # multi-continent fan-out from AWS us-east-1: intra-GCP forwarding is
+    # cheaper than repeated AWS internet egress -> the tree must relay
+    tree = solve_blast_tree("src", WAN_SINKS, "aws:us-east-1", fanout=3, source_degree=3)
+    direct = sum(get_egress_cost_per_gb("aws:us-east-1", r) for r in WAN_SINKS.values())
+    assert tree.cost_per_gb < direct
+    # the margin is real money at checkpoint scale: > $10 per TB blasted
+    assert (direct - tree.cost_per_gb) * 1000 > 10.0
+    # crossover: when every peer edge costs MORE than the source edges, the
+    # optimal tree IS the direct star (same cost, no relaying)
+    def star_costs(a, b):
+        return 0.01 if a == "aws:us-east-1" else 1.0
+
+    star = solve_blast_tree("src", WAN_SINKS, "aws:us-east-1", cost_fn=star_costs, fanout=3, source_degree=4)
+    assert all(p == "src" for p, _ in star.edges())
+    assert star.cost_per_gb == pytest.approx(0.04)
+
+
+def test_milp_matches_or_beats_greedy_on_grid():
+    milp = solve_blast_tree_milp("src", WAN_SINKS, "aws:us-east-1", fanout=2, source_degree=1)
+    greedy = solve_blast_tree_greedy("src", WAN_SINKS, "aws:us-east-1", fanout=2, source_degree=1)
+    if milp is None:
+        pytest.skip("scipy.optimize.milp unavailable")
+    assert milp.cost_per_gb <= greedy.cost_per_gb + 1e-9
+
+
+# ---- planner program shapes ----
+
+
+def test_blast_plan_fanout_shapes():
+    regions = [f"test:r{i}" for i in range(8)]
+    job = _job("test:src", regions)
+    planner = BlastPlanner(_cfg(), fanout=2, source_degree=1, quota_limits_file="")
+    plan = planner.plan([job])
+    assert plan.planner_name == "blast_tree"
+    assert plan.metadata["tree"]["solver"] in ("milp", "greedy")
+    sinks = {g.gateway_id for g in plan.sink_gateways()}
+    assert len(sinks) == 8
+    # exactly one inbound send edge per sink, and no edge targets the source
+    inbound: dict = {}
+    for gid in plan.gateways:
+        for tgt in plan.get_outgoing_paths(gid):
+            inbound.setdefault(tgt, []).append(gid)
+    assert sorted(inbound) == sorted(sinks)
+    assert all(len(v) == 1 for v in inbound.values())
+    # acyclic: walking out-edges from the source visits every sink once
+    seen, frontier = set(), [plan.source_gateways()[0].gateway_id]
+    while frontier:
+        gid = frontier.pop()
+        for tgt in plan.get_outgoing_paths(gid):
+            assert tgt not in seen, "cycle or double-visit in the blast program graph"
+            seen.add(tgt)
+            frontier.append(tgt)
+    assert seen == sinks
+    # source degree bound holds in the PROGRAM, not just the tree
+    assert len(plan.get_outgoing_paths(plan.source_gateways()[0].gateway_id)) == 1
+    # plan cost is the tree's grid cost
+    assert plan.cost_per_gb == pytest.approx(plan.metadata["tree"]["cost_per_gb"], abs=1e-6)
+    # peer-serve marking: sink sends carry it, source sends do not
+    src_id = plan.source_gateways()[0].gateway_id
+    for gid, gw in plan.gateways.items():
+        def walk(ops):
+            for op in ops:
+                if op["op_type"] == "send":
+                    assert op["peer_serve"] == (gid != src_id), (gid, op)
+                walk(op.get("children", []))
+        walk(gw.program_ops())
+    # every sink writes
+    for gid in sinks:
+        assert plan.gateways[gid]._has_op("write_object_store")
+
+
+def test_local_program_builder_shapes():
+    tree = solve_blast_tree("src", SINKS8, "local:local", cost_fn=lambda a, b: 0.0, fanout=2, source_degree=1)
+    roots = {s: f"/tmp/out/{s}" for s in SINKS8}
+    programs = build_local_blast_programs(tree, roots)
+    assert sorted(programs) == sorted(["src"] + list(SINKS8))
+    # children start before parents
+    order = start_order(tree)
+    for node in tree.sinks():
+        assert order.index(node) < order.index(tree.parent[node])
+    # interior sinks: receive -> mux_and -> [write, peer sends]
+    for node in tree.interior_nodes():
+        recv = programs[node]["plan"][0]["value"][0]
+        assert recv["op_type"] == "receive"
+        mux = recv["children"][0]
+        assert mux["op_type"] == "mux_and"
+        kinds = sorted(c["op_type"] for c in mux["children"])
+        assert kinds == sorted(["write_local"] + ["send"] * len(tree.children(node)))
+        assert all(c.get("peer_serve") for c in mux["children"] if c["op_type"] == "send")
+
+
+# ---- downgrade accounting (satellite) ----
+
+
+def _downgrade_counter():
+    return get_registry().counter("planner_downgrades_total").value()
+
+
+def test_overlay_multi_destination_downgrade_accounted():
+    rec = configure_recorder(capacity=64)
+    before = _downgrade_counter()
+    planner = OverlayPlanner(_cfg(), solver="ron", candidate_regions=["test:c"], quota_limits_file="")
+    plan = planner.plan([_job("test:src", ["test:r1", "test:r2"])])
+    assert plan.planner_name == "multicast_direct"
+    assert plan.metadata["downgraded_from"] == "overlay_ron"
+    assert plan.metadata["downgrade_reason"] == "multi_destination"
+    assert _downgrade_counter() == before + 1
+    events = [e for e in rec.events_since(0) if e["kind"] == EV_PLANNER_DOWNGRADE]
+    assert events and events[-1]["reason"] == "multi_destination"
+    assert events[-1]["requested"] == "overlay_ron"
+    configure_recorder()
+
+
+def test_blast_single_destination_downgrade_accounted():
+    rec = configure_recorder(capacity=64)
+    before = _downgrade_counter()
+    planner = get_planner("blast", _cfg(), quota_limits_file="")
+    plan = planner.plan([_job("test:src", ["test:r1"])])
+    assert plan.planner_name == "multicast_direct"
+    assert plan.metadata["downgrade_reason"] == "single_destination"
+    assert _downgrade_counter() == before + 1
+    assert any(e["kind"] == EV_PLANNER_DOWNGRADE for e in rec.events_since(0))
+    configure_recorder()
+
+
+def test_overlay_no_candidates_downgrade_accounted():
+    before = _downgrade_counter()
+    planner = OverlayPlanner(_cfg(), solver="ron", candidate_regions=[], quota_limits_file="")
+    plan = planner.plan([_job("test:src", ["test:r1"])])
+    assert plan.planner_name == "multicast_direct"
+    assert plan.metadata["downgrade_reason"] == "no_candidate_regions"
+    assert _downgrade_counter() == before + 1
+
+
+# ---- per-edge egress exposition parsing ----
+
+
+def test_parse_egress_edges():
+    text = (
+        "# HELP skyplane_egress_bytes_total per-src,dst value from the egress provider\n"
+        "# TYPE skyplane_egress_bytes_total gauge\n"
+        'skyplane_egress_bytes_total{src="gw_a",dst="gw_b"} 1048576\n'
+        'skyplane_egress_bytes_total{src="gw_a",dst="gw_c"} 42\n'
+        'skyplane_other_metric{src="x",dst="y"} 7\n'
+    )
+    assert parse_egress_edges(text) == {("gw_a", "gw_b"): 1048576, ("gw_a", "gw_c"): 42}
