@@ -18,11 +18,15 @@ ACQ_TIMEOUT=${ACQ_TIMEOUT:-300}   # how long an attempt may wait for acquisition
 SLEEP_BETWEEN=${SLEEP_BETWEEN:-120}
 SUCCESS=$LOGDIR/device_profile.success
 
-# Static-analysis gate (CPU-only, cheap): same pass tier-1 runs in
-# tests/unit/test_static_analysis.py. Emits the machine-readable findings
-# report for BENCH/soak tooling; failures are logged LOUDLY but do not block
-# device profiling — the pytest gate is what blocks a merge.
+# Static-analysis gate (CPU-only, cheap — content-hash cached, so an
+# unchanged tree costs milliseconds): same pass tier-1 runs in
+# tests/unit/test_static_analysis.py. --check-suppressions makes a stale
+# `# sklint: disable` fail this step loudly instead of rotting in place.
+# Emits the machine-readable findings report for BENCH/soak tooling;
+# failures are logged LOUDLY but do not block device profiling — the
+# pytest gate is what blocks a merge.
 JAX_PLATFORMS=cpu python -m skyplane_tpu.analysis skyplane_tpu \
+  --check-suppressions \
   --json "$LOGDIR/lint_findings.json" >"$LOGDIR/lint.out" 2>&1
 LINT_RC=$?
 if [ "$LINT_RC" -ne 0 ]; then

@@ -31,6 +31,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="also report stale suppressions (disables whose rule no longer fires on that line)",
     )
     parser.add_argument("--list-rules", action="store_true", help="list every rule with severity and exit")
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore and do not update the content-hash cache (.sklint-cache.json)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -45,7 +50,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if bad:
             parser.error(f"unknown rule(s): {', '.join(sorted(bad))} (see --list-rules)")
     try:
-        report = run_paths(args.paths or ["skyplane_tpu"], rules=rules, check_suppressions=args.check_suppressions)
+        report = run_paths(
+            args.paths or ["skyplane_tpu"],
+            rules=rules,
+            check_suppressions=args.check_suppressions,
+            use_cache=not args.no_cache,
+        )
     except FileNotFoundError as e:
         # exit 2 (usage error), distinct from exit 1 (findings): a typo'd
         # path or wrong cwd must fail loudly, never read as a clean gate
@@ -56,9 +66,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     for finding in shown:
         print(finding.render())
     n_sup = sum(1 for f in report.findings if f.suppressed)
+    cached = " (cached)" if report.cache_info.get("full_hit") else ""
     print(
-        f"checked {report.files_checked} files: {len(report.unsuppressed)} finding(s), "
-        f"{n_sup} suppressed",
+        f"checked {report.files_checked} files in {report.wall_time_s:.2f}s{cached}: "
+        f"{len(report.unsuppressed)} finding(s), {n_sup} suppressed",
         file=sys.stderr,
     )
     if args.json:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import ast
 import io
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -153,8 +154,9 @@ def all_checkers() -> List[Checker]:
 
 def all_project_checkers() -> List[ProjectChecker]:
     from skyplane_tpu.analysis.lockgraph import LOCKGRAPH_PROJECT_CHECKERS
+    from skyplane_tpu.analysis.resources import RESOURCE_PROJECT_CHECKERS
 
-    return [cls() for cls in LOCKGRAPH_PROJECT_CHECKERS]
+    return [cls() for cls in (*LOCKGRAPH_PROJECT_CHECKERS, *RESOURCE_PROJECT_CHECKERS)]
 
 
 def iter_rules() -> List[RuleSpec]:
@@ -175,6 +177,8 @@ def known_rule_names() -> Set[str]:
 class AnalysisReport:
     findings: List[Finding] = field(default_factory=list)
     files_checked: int = 0
+    wall_time_s: float = 0.0
+    cache_info: dict = field(default_factory=dict)  # empty when caching is off
 
     @property
     def unsuppressed(self) -> List[Finding]:
@@ -187,12 +191,27 @@ class AnalysisReport:
     def ok(self) -> bool:
         return not self.unsuppressed
 
+    def rule_counts(self) -> dict:
+        """{rule: {"total", "unsuppressed"}} over EVERY known rule — zero
+        entries included so the JSON keys are stable run-to-run (dashboards
+        and diffs track 'rule X went 3 -> 0' without special-casing absence)."""
+        counts = {name: {"total": 0, "unsuppressed": 0} for name in sorted(known_rule_names())}
+        for f in self.findings:
+            c = counts.setdefault(f.rule, {"total": 0, "unsuppressed": 0})
+            c["total"] += 1
+            if not f.suppressed:
+                c["unsuppressed"] += 1
+        return counts
+
     def as_dict(self) -> dict:
         return {
             "files_checked": self.files_checked,
             "n_findings": len(self.findings),
             "n_unsuppressed": len(self.unsuppressed),
             "ok": self.ok(),
+            "wall_time_s": round(self.wall_time_s, 3),
+            "rule_counts": self.rule_counts(),
+            "cache": self.cache_info,
             "findings": [f.as_dict() for f in self.findings],
         }
 
@@ -384,25 +403,63 @@ def run_paths(
     paths: Sequence[str],
     rules: Optional[Set[str]] = None,
     check_suppressions: bool = False,
+    use_cache: bool = False,
+    cache_path=None,
 ) -> AnalysisReport:
+    """Analyze files/directories. With ``use_cache`` the content-hash cache
+    (:mod:`skyplane_tpu.analysis.cache`) makes an unchanged tree a full hit
+    (no parsing) and a one-file edit re-run only that file's per-module
+    checkers plus the whole-program passes. Cached findings are always
+    unfiltered; the ``rules`` filter applies after, so a filtered run never
+    poisons the cache."""
+    t0 = time.perf_counter()
     report = AnalysisReport()
-    checkers = all_checkers()
     known = known_rule_names()
-    modules: List[ModuleInfo] = []
-    findings: List[Finding] = []
-    for fs_path, display in _iter_py_files(paths):
-        module, load_findings = load_module(fs_path, display, known=known)
-        report.files_checked += 1
-        findings.extend(load_findings)  # framework findings obey --rule like any other
-        if module is not None:
+    entries = [
+        (display, Path(fs_path).read_text(encoding="utf-8", errors="replace"))
+        for fs_path, display in _iter_py_files(paths)
+    ]
+    cache = None
+    run_key = ""
+    findings: Optional[List[Finding]] = None
+    if use_cache:
+        from skyplane_tpu.analysis.cache import AnalysisCache, content_digest
+
+        cache = AnalysisCache(cache_path)
+        digests = [(display, content_digest(source)) for display, source in entries]
+        run_key = cache.run_key(digests, check_suppressions)
+        findings = cache.get_run(run_key)
+    if findings is None:
+        checkers = all_checkers()
+        modules: List[ModuleInfo] = []
+        findings = []
+        for i, (display, source) in enumerate(entries):
+            module, load_findings = load_module_source(source, display, known=known)
+            findings.extend(load_findings)  # framework findings obey --rule like any other
+            if module is None:
+                continue
             modules.append(module)
-            findings.extend(run_module(module, checkers))
-    findings.extend(run_project(modules))
-    if check_suppressions:
-        # over the UNFILTERED findings — see audit_suppressions
-        findings.extend(audit_suppressions(modules, findings))
+            cached_mod = cache.get_module(display, digests[i][1]) if cache is not None else None
+            if cached_mod is not None:
+                findings.extend(cached_mod)
+            else:
+                mod_findings = run_module(module, checkers)
+                if cache is not None:
+                    cache.put_module(display, digests[i][1], mod_findings)
+                findings.extend(mod_findings)
+        findings.extend(run_project(modules))
+        if check_suppressions:
+            # over the UNFILTERED findings — see audit_suppressions
+            findings.extend(audit_suppressions(modules, findings))
+        if cache is not None:
+            cache.put_run(run_key, findings)
+    if cache is not None:
+        cache.save()
+        report.cache_info = cache.info()
+    report.files_checked = len(entries)
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
     report.findings = findings
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.wall_time_s = time.perf_counter() - t0
     return report

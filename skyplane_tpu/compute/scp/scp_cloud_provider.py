@@ -126,8 +126,16 @@ class SCPClient:
             # O_APPEND + explicit 0600 (mode on os.open only applies at
             # creation; fchmod also tightens a pre-existing loose file)
             fd = os.open(path_out, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600)
-            os.fchmod(fd, 0o600)
-            with os.fdopen(fd, "a") as f:
+            try:
+                os.fchmod(fd, 0o600)
+                f = os.fdopen(fd, "a")  # owns fd from here on
+            except BaseException:
+                # the enclosing `except Exception: pass` would swallow the
+                # error AND strand the descriptor — every failed trace write
+                # leaking one fd until the process hits its rlimit
+                os.close(fd)
+                raise
+            with f:
                 f.write(json.dumps(record, default=str) + "\n")
         except Exception:  # noqa: BLE001 — tracing must never break a live call
             pass

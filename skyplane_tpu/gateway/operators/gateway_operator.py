@@ -121,6 +121,7 @@ class GatewayOperator:
                     continue
                 if self.log_in_progress:
                     for chunk_req in batch:
+                        # sklint: disable=resource-leak-on-path -- ownership transfer: when process_batch returns None the batch moved into a streaming pipeline (pipelined sender) whose ack path performs the terminal complete/requeue/failed accounting
                         self.chunk_store.log_chunk_state(chunk_req, ChunkState.in_progress, self.handle, worker_id)
                 try:
                     results = self.process_batch(batch, worker_id)
@@ -730,12 +731,19 @@ class GatewaySenderOperator(GatewayOperator):
         port = info["server_port"]
         self._apply_dedup_budget(info)
         sock = socket.create_connection((self.target_host, port), timeout=30)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        if self.use_tls:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE  # self-signed receiver certs
-            sock = ctx.wrap_socket(sock)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.use_tls:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE  # self-signed receiver certs
+                sock = ctx.wrap_socket(sock)
+        except BaseException:
+            # a failed TLS handshake (or setsockopt on a dying connection)
+            # must not strand the TCP socket: retarget()/redial loops call
+            # this repeatedly and would bleed one fd per failed attempt
+            sock.close()
+            raise
         self._local.port = port
         return sock
 
@@ -842,7 +850,15 @@ class GatewaySenderOperator(GatewayOperator):
         abort = lambda: self.exit_flag.is_set() or self.error_event.is_set()  # noqa: E731
         if not self.scheduler.acquire(tenant, RES_CHUNK_SLOTS, 1, abort_check=abort):
             return False
-        if not self.scheduler.acquire(tenant, RES_WIRE_BYTES, req.chunk.chunk_length_bytes, abort_check=abort):
+        try:
+            granted = self.scheduler.acquire(tenant, RES_WIRE_BYTES, req.chunk.chunk_length_bytes, abort_check=abort)
+        except BaseException:
+            # SchedulerTimeout (or an abort raced with the grant) on the wire
+            # tokens must hand back the chunk slot: it is this tenant's OWN
+            # budget, and nothing downstream knows a slot was taken
+            SCHED_RELEASE_POLICY.call(lambda: self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1), log_errors=False)
+            raise
+        if not granted:
             SCHED_RELEASE_POLICY.call(lambda: self.scheduler.release(tenant, RES_CHUNK_SLOTS, 1), log_errors=False)
             return False
         return True
@@ -1034,6 +1050,7 @@ class GatewaySenderOperator(GatewayOperator):
             # HERE (its tokens return as its own acks land), so its backlog
             # never occupies frame-ahead buffers or batch-runner windows that
             # other tenants' chunks could be using
+            # sklint: disable=resource-leak-on-path -- ownership transfer: the granted tokens ride the frame submitted to the engine below; sched_release fires from the engine's ack/requeue/reaper paths once the frame resolves
             if not self.sched_acquire(req):
                 # shutdown: silent-requeue contract, tokens never granted
                 self.input_queue.put_for_handle(self.handle, req)

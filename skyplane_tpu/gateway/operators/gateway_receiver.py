@@ -405,10 +405,16 @@ class GatewayReceiver:
     def start_server(self) -> int:
         """Bind a new ephemeral data port; returns the port (reference :69-114)."""
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((self.bind_host, 0))
-        sock.listen(64)
-        port = sock.getsockname()[1]
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.bind_host, 0))
+            sock.listen(64)
+            port = sock.getsockname()[1]
+        except BaseException:
+            # bind/listen can fail under fd pressure or address exhaustion;
+            # the control plane retries /servers, so the leak would compound
+            sock.close()
+            raise
         with self._lock:
             self._servers[port] = sock
         t = threading.Thread(target=self._accept_loop, args=(sock, port), name=f"receiver-accept-{port}", daemon=True)

@@ -286,7 +286,6 @@ class PumpPool:
         logger.fs.info(f"[pump:{self.gateway_id}] {self.role} pool up: {self.procs} worker process(es)")
 
     def _spawn_locked(self, idx: int, gen: int) -> _WorkerHandle:
-        parent_sock, child_sock = socket.socketpair()
         name = f"pump-{self.role}{idx}.g{gen}"
         cfg = dict(self.cfg)
         cfg["worker_idx"] = idx
@@ -296,12 +295,21 @@ class PumpPool:
         # a respawned replacement re-reading the same env plan would fire the
         # same deterministic schedule again and crash-loop the pool
         cfg["crash_armed"] = gen == 0
-        proc = SPAWN_CTX.Process(
-            target=_pump_worker_main, args=(cfg, child_sock), name=f"{self.gateway_id}-{name}", daemon=True
-        )
-        proc.start()
+        parent_sock, child_sock = socket.socketpair()
+        try:
+            proc = SPAWN_CTX.Process(
+                target=_pump_worker_main, args=(cfg, child_sock), name=f"{self.gateway_id}-{name}", daemon=True
+            )
+            proc.start()
+        except BaseException:
+            # spawn failure (fork/exec EAGAIN, unpicklable cfg) strands BOTH
+            # halves of the pair — and the supervisor will retry the spawn
+            parent_sock.close()
+            child_sock.close()
+            raise
+        chan = CtrlChannel(parent_sock)  # owns the parent half from here on
         child_sock.close()  # the child holds its own copy now
-        w = _WorkerHandle(idx, gen, name, proc, CtrlChannel(parent_sock))
+        w = _WorkerHandle(idx, gen, name, proc, chan)
         w.reader = threading.Thread(target=self._read_loop, args=(w,), name=f"pump-reader-{name}", daemon=True)
         self._workers.append(w)
         self._spawns += 1

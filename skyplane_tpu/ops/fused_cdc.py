@@ -315,30 +315,39 @@ class FusedCDCFP:
         fallback: List[Optional[Tuple[np.ndarray, List[bytes]]]] = []
         if self.pool is not None:
             ends_scratch = self.pool.acquire_scratch((b, n_slots), np.int32)
-            ends_scratch.fill(bucket)
         else:
             ends_scratch = None
-        ends_slots = ends_scratch if ends_scratch is not None else np.full((b, n_slots), bucket, np.int32)
-        for i in range(b):
-            n = int(lens[i])
-            n_cand = int(packed[i, cap])
-            if n_cand > cap:  # overflow: device compaction truncated the list
-                fallback.append(_host_exact(np.asarray(host_rows[i][:n]), self.params))
-                ends_rows.append(None)
-                continue
-            fallback.append(None)
-            cands = packed[i, :n_cand].astype(np.int64)
-            ends = select_boundaries(cands, n, self.params)
-            ends_rows.append(ends)
-            ends_slots[i, : len(ends)] = ends
-            if n < bucket:  # one garbage end covering the zero padding
-                ends_slots[i, len(ends)] = bucket
-        if self.donate and owned and self.mesh is None:
-            lanes_dev = _fp_impl_donated(dev_batch, jnp.asarray(ends_slots), n_slots=n_slots)
-            with self._stats_lock:
-                self._donated_batches += 1
-        else:
-            lanes_dev = fp_fn(dev_batch, jnp.asarray(ends_slots))  # enqueued; readback deferred
+        try:
+            if ends_scratch is not None:
+                ends_scratch.fill(bucket)
+            ends_slots = ends_scratch if ends_scratch is not None else np.full((b, n_slots), bucket, np.int32)
+            for i in range(b):
+                n = int(lens[i])
+                n_cand = int(packed[i, cap])
+                if n_cand > cap:  # overflow: device compaction truncated the list
+                    fallback.append(_host_exact(np.asarray(host_rows[i][:n]), self.params))
+                    ends_rows.append(None)
+                    continue
+                fallback.append(None)
+                cands = packed[i, :n_cand].astype(np.int64)
+                ends = select_boundaries(cands, n, self.params)
+                ends_rows.append(ends)
+                ends_slots[i, : len(ends)] = ends
+                if n < bucket:  # one garbage end covering the zero padding
+                    ends_slots[i, len(ends)] = bucket
+            if self.donate and owned and self.mesh is None:
+                lanes_dev = _fp_impl_donated(dev_batch, jnp.asarray(ends_slots), n_slots=n_slots)
+                with self._stats_lock:
+                    self._donated_batches += 1
+            else:
+                lanes_dev = fp_fn(dev_batch, jnp.asarray(ends_slots))  # enqueued; readback deferred
+        except BaseException:
+            if ends_scratch is not None:
+                # an overflow-row host recompute or a failed device dispatch
+                # must not strand the pooled scratch: only PendingBatch
+                # (constructed below) knows to release it
+                self.pool.release_scratch(ends_scratch)
+            raise
         return PendingBatch(self, b, ends_rows, fallback, lanes_dev, ends_scratch)
 
     def __call__(

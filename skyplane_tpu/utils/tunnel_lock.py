@@ -31,18 +31,25 @@ def acquire_tunnel_lock(timeout_s: float | None = None) -> bool:
     global _held_fd
     if _held_fd is not None:
         return True
+    # sklint: disable=resource-leak-on-path -- ownership transfer: the fd is parked in module-global _held_fd for the whole process lifetime by design (the flock guards jax backend state until exit; the OS releases it on process death)
     fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
     deadline = None if timeout_s is None else time.monotonic() + timeout_s
-    while True:
-        try:
-            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            _held_fd = fd
-            return True
-        except BlockingIOError:
-            if deadline is not None and time.monotonic() >= deadline:
-                os.close(fd)
-                return False
-            time.sleep(1.0)
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                _held_fd = fd
+                return True
+            except BlockingIOError:
+                if deadline is not None and time.monotonic() >= deadline:
+                    os.close(fd)
+                    return False
+                time.sleep(1.0)
+    except BaseException:
+        # anything other than "lock is busy" (ENOLCK, a signal mid-sleep)
+        # must not strand the descriptor on its way out
+        os.close(fd)
+        raise
 
 
 def held() -> bool:

@@ -25,8 +25,10 @@ def rules_of(src: str, path: str = "fixture.py"):
 
 @pytest.fixture(scope="module")
 def repo_report():
-    # one parse+check of the full package, shared by both gate tests
-    return run_paths([str(REPO_ROOT / "skyplane_tpu")])
+    # one pass over the full package, shared by the gate tests; use_cache
+    # exercises the content-hash cache on the same path devloop takes (keys
+    # bake in file digests + the analysis sources, so a hit cannot go stale)
+    return run_paths([str(REPO_ROOT / "skyplane_tpu")], use_cache=True)
 
 
 def test_repo_has_zero_unsuppressed_findings(repo_report):
@@ -36,6 +38,26 @@ def test_repo_has_zero_unsuppressed_findings(repo_report):
     assert repo_report.files_checked > 100  # the walk actually covered the package
     rendered = "\n".join(f.render() for f in repo_report.unsuppressed)
     assert repo_report.ok(), f"unsuppressed lint findings:\n{rendered}"
+
+
+def test_repo_pass_stays_fast(repo_report):
+    """devloop runs the full pass on every loop, so it has to stay
+    interactive: even a cold (cache-miss) run must clear 30s with head-room;
+    a warm run is a sub-second full hit."""
+    assert repo_report.wall_time_s < 30.0, f"whole-repo lint took {repo_report.wall_time_s:.1f}s"
+
+
+def test_report_rule_counts_are_stable(repo_report):
+    """The --json schema contract: every registered rule appears in
+    rule_counts even at zero, so dashboards diffing two reports never see
+    keys appear/disappear as findings come and go."""
+    d = repo_report.as_dict()
+    assert set(d["rule_counts"]) == {r.name for r in iter_rules()}
+    for counts in d["rule_counts"].values():
+        assert set(counts) == {"total", "unsuppressed"}
+        assert counts["unsuppressed"] <= counts["total"]
+    assert isinstance(d["wall_time_s"], float) and d["wall_time_s"] >= 0.0
+    assert set(d["cache"]) >= {"full_hit", "files_reused", "files_recomputed"}
 
 
 def test_repo_suppressions_all_carry_reasons(repo_report):
