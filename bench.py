@@ -1060,6 +1060,85 @@ def bench_blast() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_raw_forward() -> dict:
+    """Raw-forward fast path on the blast-interior-edge shape
+    (docs/datapath-performance.md "Raw-forward fast path"): one peer-serving
+    sender re-serves the SAME staged chunks to ``fanout`` tree children over
+    a loopback wire — once with raw forwarding ON (first pass seals, every
+    later pass splices the staged bytes kernel-side via sendfile) and once
+    forced through the codec path (every pass re-reads + re-frames +
+    re-fingerprints, the pre-raw behavior). Identical workload, identical
+    cores; ``relay_gbps_raw`` vs ``relay_gbps_codec`` is the banked ratio
+    check_bench_json.py gates (>= 3x at >= 2 cores, presence-only on
+    single-vCPU runners where the consuming receiver shares the core)."""
+    import shutil
+    import sys as sys_mod
+    import tempfile
+    from pathlib import Path
+
+    sys_mod.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from unit.test_sender_pipeline import AckServer, drain_n, make_sender, stage_chunks
+
+    from skyplane_tpu.gateway.operators.sender_wire import RAW_FORWARD_ENV
+
+    n_chunks = int(os.environ.get("SKYPLANE_BENCH_RAW_CHUNKS", "16"))
+    fanout = int(os.environ.get("SKYPLANE_BENCH_RAW_FANOUT", "4"))
+    corpus_rng = np.random.default_rng(17)
+    datas = [corpus_rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes() for _ in range(n_chunks)]
+    total_bytes = sum(len(d) for d in datas) * fanout
+    # the interior edge runs the edge's real codec: lz4 when the system
+    # library is present (the seal amortizes the compression), else
+    # passthrough (the seal amortizes only the fingerprint)
+    from skyplane_tpu.utils import lz4ref
+
+    codec_name = "lz4" if lz4ref.available() else "none"
+
+    def leg(raw_on: bool):
+        saved = os.environ.get(RAW_FORWARD_ENV)
+        os.environ[RAW_FORWARD_ENV] = "1" if raw_on else "0"
+        tmp = Path(tempfile.mkdtemp(prefix=f"skyplane_raw_bench_{int(raw_on)}_"))
+        server = AckServer()
+        op = None
+        try:
+            op, in_q, out_q, _, store = make_sender(
+                tmp, server.port, dedup=False, raw_forward=raw_on, peer_serve=True,
+                max_streams=1, codec_name=codec_name,
+            )
+            reqs = stage_chunks(store, datas)
+            op.start_workers()
+            t0 = time.perf_counter()
+            for _ in range(fanout):  # one pass per tree child
+                for req in reqs:
+                    in_q.put(req)
+                done = drain_n(out_q, n_chunks, timeout=120)
+                assert len(done) == n_chunks, f"raw bench leg(raw={raw_on}) incomplete: {len(done)}/{n_chunks}"
+            dt = time.perf_counter() - t0
+            return dt, op.wire_counters()
+        finally:
+            if op is not None:
+                op.stop_workers()
+            server.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+            if saved is None:
+                os.environ.pop(RAW_FORWARD_ENV, None)
+            else:
+                os.environ[RAW_FORWARD_ENV] = saved
+
+    codec_dt, codec_counters = leg(False)
+    raw_dt, raw_counters = leg(True)
+    return {
+        "relay_gbps_raw": round(total_bytes * 8 / 1e9 / raw_dt, 3),
+        "relay_gbps_codec": round(total_bytes * 8 / 1e9 / codec_dt, 3),
+        "wire_raw_frames": raw_counters["wire_raw_frames"],
+        "wire_raw_bytes": raw_counters["wire_raw_bytes"],
+        "wire_raw_fallbacks": raw_counters["wire_raw_fallbacks"] + codec_counters["wire_raw_fallbacks"],
+        "raw_chunks": n_chunks,
+        "raw_fanout": fanout,
+        "raw_codec": codec_name,
+        "raw_cores_available": os.cpu_count() or 1,
+    }
+
+
 def _bench_codec(chunks, one) -> dict:
     """Time a per-chunk codec with full core-level worker parallelism.
 
@@ -1287,6 +1366,15 @@ def main() -> None:
         f"source egress {blast['blast_egress_ratio']}x corpus"
     )
 
+    # raw-forward fast path: sendfile re-serve vs codec re-framing over the
+    # identical blast-interior-edge workload (docs/datapath-performance.md
+    # "Raw-forward fast path") — the banked ratio check_bench_json.py gates
+    raw_fwd = bench_raw_forward()
+    log(
+        f"raw-forward bench done: raw {raw_fwd['relay_gbps_raw']} Gbps vs codec "
+        f"{raw_fwd['relay_gbps_codec']} Gbps ({raw_fwd['wire_raw_frames']} raw frames)"
+    )
+
     ours_gbps = gbits / ours["seconds"]
     base_gbps = base["raw_bytes"] * 8 / 1e9 / base["seconds"]
     from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
@@ -1375,6 +1463,11 @@ def main() -> None:
         # egress over corpus size on a kill-free loopback blast — gated
         # <= 1.5x by check_bench_json.py (a degraded tree reads ~n_sinks)
         **blast,
+        # raw-forward fast path (docs/datapath-performance.md): kernel-spliced
+        # re-serve vs codec re-framing on the interior-edge workload; the
+        # ratio gate (raw >= 3x codec, downgraded on single-vCPU runners)
+        # and the wire_raw_frames floor live in check_bench_json.py
+        **raw_fwd,
     }
     if base_lz4:
         # the honest reference-codec bar (BASELINE.json names LZ4, not zstd)

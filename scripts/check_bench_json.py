@@ -63,10 +63,26 @@ REQUIRED_TOP = (
     # egress over corpus size on a small loopback blast, banked per round
     "blast_egress_ratio",
     "blast_sinks",
+    # raw-forward fast path (docs/datapath-performance.md): kernel-spliced
+    # re-serve vs codec re-framing on the interior-edge workload
+    "relay_gbps_raw",
+    "relay_gbps_codec",
+    "wire_raw_frames",
+    "wire_raw_fallbacks",
+    "raw_chunks",
+    "raw_fanout",
+    "raw_cores_available",
 )
 #: bench/soak acceptance bound: source egress may exceed 1x the corpus only
 #: by healing re-sends and in-flight re-frames (docs/blast.md)
 MAX_BLAST_EGRESS_RATIO = 1.5
+#: raw-forward acceptance ratio: kernel-spliced re-serve vs codec re-framing
+#: over the identical interior-edge workload, at equal cores. Demonstrable
+#: only when the consuming receiver can move off the sender's core, so the
+#: ratio gate arms at >= MIN_RAW_CORES cores; single-vCPU runners downgrade
+#: to schema + raw-beats-codec sanity (docs/datapath-performance.md).
+MIN_RAW_RELAY_RATIO = 3.0
+MIN_RAW_CORES = 2
 #: the acceptance floor for the blast soak's fan-out scale
 MIN_BLAST_SINKS = 8
 # trace-derived per-stage latency breakdown (bench.py TRACE_STAGES /
@@ -1026,6 +1042,51 @@ def main(argv) -> int:
             file=sys.stderr,
         )
         return 1
+    # raw-forward fast path gates (docs/datapath-performance.md "Raw-forward
+    # fast path"): the identical interior-edge workload must actually splice
+    # (wire_raw_frames covers every re-serve pass) with zero fallbacks, and
+    # on runners with a core for the consuming receiver the spliced legs
+    # must beat codec re-framing by MIN_RAW_RELAY_RATIO. Single-vCPU
+    # runners can only show the copy win diluted by the shared core, so
+    # they downgrade to raw > codec.
+    raw_g, codec_g = result["relay_gbps_raw"], result["relay_gbps_codec"]
+    for key, val in (("relay_gbps_raw", raw_g), ("relay_gbps_codec", codec_g)):
+        if not isinstance(val, (int, float)) or val <= 0:
+            print(f"bench-smoke: implausible raw-forward throughput {key}={val!r}", file=sys.stderr)
+            return 1
+    min_raw_frames = result["raw_chunks"] * (result["raw_fanout"] - 1)
+    if result["wire_raw_frames"] < min_raw_frames:
+        print(
+            f"bench-smoke: raw-forward leg spliced only {result['wire_raw_frames']} frames "
+            f"(every re-serve pass must go raw: floor {min_raw_frames})",
+            file=sys.stderr,
+        )
+        return 1
+    if result["wire_raw_fallbacks"]:
+        print(
+            f"bench-smoke: {result['wire_raw_fallbacks']} raw->codec fallbacks on a healthy loopback",
+            file=sys.stderr,
+        )
+        return 1
+    raw_cores = result["raw_cores_available"]
+    if isinstance(raw_cores, (int, float)) and raw_cores >= MIN_RAW_CORES:
+        if raw_g < MIN_RAW_RELAY_RATIO * codec_g:
+            print(
+                f"bench-smoke: raw-forward re-serve at {raw_g} Gbps does not clear "
+                f"{MIN_RAW_RELAY_RATIO}x the codec path ({codec_g} Gbps) on a {raw_cores}-core runner",
+                file=sys.stderr,
+            )
+            return 1
+        raw_note = f"({round(raw_g / codec_g, 2)}x codec at {raw_cores} cores)"
+    else:
+        if raw_g <= codec_g:
+            print(
+                f"bench-smoke: raw-forward re-serve ({raw_g} Gbps) did not beat the codec path "
+                f"({codec_g} Gbps) even on a shared core",
+                file=sys.stderr,
+            )
+            return 1
+        raw_note = f"(cores_available={raw_cores}: ratio gate downgraded, {round(raw_g / codec_g, 2)}x codec)"
     print(
         f"bench-smoke OK: {result['value']} {result['unit']} encode, "
         f"{result['decode_gbps']} {result['unit']} decode on {result['platform']} "
@@ -1034,7 +1095,8 @@ def main(argv) -> int:
         f"trace overhead {overhead}%; cpu profile: {cpu['profile_samples']} samples, "
         f"{cores} cores effective, GIL wait {round(100.0 * gil, 1)}%, sampler overhead {p_overhead}%; "
         f"pump: {pump_g} Gbps by procs {pump_note}; "
-        f"blast: {blast_ratio}x source egress over {result['blast_sinks']} sinks"
+        f"blast: {blast_ratio}x source egress over {result['blast_sinks']} sinks; "
+        f"raw-forward: {raw_g} vs {codec_g} Gbps, {result['wire_raw_frames']} frames spliced {raw_note}"
     )
     return 0
 

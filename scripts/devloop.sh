@@ -283,6 +283,30 @@ else
   echo "[devloop] pump-smoke clean; report at $LOGDIR/pump_tests.out" >>"$LOGDIR/devloop.log"
 fi
 
+# Raw-smoke gate (CPU-only, ~1 min): the raw-forward fast path
+# (docs/datapath-performance.md "Raw-forward fast path"). Two halves:
+# (a) the raw-forward unit suite — byte-identical sendfile-vs-codec wire
+# output, the RawSendError fallback truth table, sealed-cache refcount/GC,
+# and the copy-free vectored send; (b) the integration suite rerun with the
+# SKYPLANE_TPU_RAW_FORWARD=0 kill switch — the codec path must stand alone
+# when raw forwarding is disabled in the field, with nothing keyed on the
+# sealed cache. (The default-ON raw path already rides every other smoke
+# and tier-1.) Like the other smokes: failures are logged LOUDLY but do
+# not block device profiling.
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+  tests/unit/test_raw_forward.py >"$LOGDIR/raw_tests.out" 2>&1
+RAW_RC=$?
+if [ "$RAW_RC" -eq 0 ]; then
+  JAX_PLATFORMS=cpu SKYPLANE_TPU_RAW_FORWARD=0 python -m pytest -q -m 'not slow' -p no:cacheprovider \
+    tests/integration >"$LOGDIR/raw_killswitch_tests.out" 2>&1
+  RAW_RC=$?
+fi
+if [ "$RAW_RC" -ne 0 ]; then
+  echo "[devloop] RAW-SMOKE FAILURE (rc=$RAW_RC) — raw-forward unit suite or the RAW_FORWARD=0 kill-switch rerun regressed; see $LOGDIR/raw_tests.out / $LOGDIR/raw_killswitch_tests.out" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] raw-smoke clean; reports at $LOGDIR/raw_tests.out, $LOGDIR/raw_killswitch_tests.out" >>"$LOGDIR/devloop.log"
+fi
+
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only
   # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
   # acquired but crashed mid-profile must be retried, not recorded

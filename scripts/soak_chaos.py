@@ -887,6 +887,69 @@ def run_pump_scenario(base: Path, seed: int) -> dict:
     return out
 
 
+def run_raw_forward_scenario(base: Path, seed: int) -> dict:
+    """Raw-forward torn-send chaos (docs/datapath-performance.md "Raw-forward
+    fast path"): a compress=none, dedup-off loopback transfer — the raw
+    eligibility sweet spot, so frames splice kernel-side via sendfile — with
+    ``sender.raw_send`` armed (p=1, after=2, max_fires=1: the third raw send
+    tears mid-payload). The engine must disable raw on the wounded stream for
+    its lifetime, requeue the un-acked frames UNCOUNTED, and resend through
+    the codec path; the run passes only when the destination corpus is
+    byte-identical, at least one raw frame shipped AND at least one fallback
+    was taken, and every chunk reads complete exactly once."""
+    plan = FaultPlan.from_dict(
+        {"seed": seed, "points": {"sender.raw_send": {"p": 1.0, "after": 2, "max_fires": 1}}}
+    )
+    chunk_bytes = 256 << 10
+    n_chunks = 16
+    payload = np.random.default_rng(seed + 6).integers(0, 256, chunk_bytes * n_chunks, dtype=np.uint8).tobytes()
+    tmp = base / "rawfwd"
+    tmp.mkdir()
+    src_file = tmp / "corpus.bin"
+    src_file.write_bytes(payload)
+    out_file = tmp / "out" / "corpus.bin"
+    out = {
+        "raw_forward_ok": False,
+        "raw_forward_faults_fired": 0,
+        "raw_forward_frames": 0,
+        "raw_forward_fallbacks": 0,
+        "raw_forward_byte_identical": False,
+        "raw_forward_chunks_lost": -1,
+        "raw_forward_seconds": None,
+    }
+    src = dst = None
+    inj = configure_injector(plan)
+    try:
+        src, dst = make_pair(tmp, compress="none", dedup=False, encrypt=False, use_tls=False, num_connections=2)
+        t0 = time.monotonic()
+        ids = dispatch_with_retry(src, src_file, out_file, chunk_bytes, tenant_id=None)
+        wait_complete(src, ids, timeout=180)
+        wait_complete(dst, ids, timeout=180)
+        out["raw_forward_seconds"] = round(time.monotonic() - t0, 3)
+        wire = src.daemon._sender_wire_counters()
+        out["raw_forward_frames"] = wire.get("wire_raw_frames", 0)
+        out["raw_forward_fallbacks"] = wire.get("wire_raw_fallbacks", 0)
+        out["raw_forward_faults_fired"] = inj.counters().get("sender.raw_send", 0)
+        out["raw_forward_byte_identical"] = out_file.read_bytes() == payload
+        status = dst.get("chunk_status_log", timeout=30).json()["chunk_status"]
+        out["raw_forward_chunks_lost"] = sum(1 for cid in ids if status.get(cid) != "complete")
+        out["raw_forward_ok"] = bool(
+            out["raw_forward_byte_identical"]
+            and out["raw_forward_faults_fired"] >= 1
+            and out["raw_forward_frames"] >= 1
+            and out["raw_forward_fallbacks"] >= 1
+            and out["raw_forward_chunks_lost"] == 0
+        )
+    except (RuntimeError, TimeoutError, requests.RequestException) as e:
+        out["raw_forward_error"] = str(e)[:500]
+    finally:
+        for gw in (src, dst):
+            if gw is not None:
+                gw.stop()
+        configure_injector(None)
+    return out
+
+
 def _probe_per_acquire_ns() -> float:
     """Per-acquire cost delta of a witness-wrapped lock vs a plain lock.
 
@@ -1057,6 +1120,10 @@ def main() -> int:
     # multi-process pump: worker crash -> respawn + uncounted requeue with a
     # byte-identical corpus (docs/datapath-performance.md "Multi-process pump")
     pump = run_pump_scenario(base, args.seed)
+    # raw-forward torn send -> per-stream raw disable + uncounted requeue +
+    # codec resend, byte-identical (docs/datapath-performance.md
+    # "Raw-forward fast path")
+    rawfwd = run_raw_forward_scenario(base, args.seed)
 
     # the repair/drain/replan scenarios above also ran under the witness:
     # fold their observed edges into the final acyclicity verdict
@@ -1105,6 +1172,7 @@ def main() -> int:
         **drain,
         **replan,
         **pump,
+        **rawfwd,
     }
     print(json.dumps(result))
     return 0

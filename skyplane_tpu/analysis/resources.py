@@ -146,6 +146,9 @@ PROTOCOLS: Tuple[Protocol, ...] = (
             Site(names=("socket", "create_connection"), recv_any=("socket",), bind="result"),
             Site(names=("socketpair",), recv_any=("socket",), bind="result"),
             Site(names=("pipe", "dup", "openpty", "open"), recv_any=("os",), bind="result"),
+            # staged-file fd adopted off the pump ctrl channel: popping it
+            # moves ownership to the caller (raw-forward fast path)
+            Site(names=("take_raw_fd",), bind="result"),
         ),
         releases=(
             Site(names=("close", "shutdown_and_close"), bind="receiver"),
@@ -160,8 +163,22 @@ PROTOCOLS: Tuple[Protocol, ...] = (
             # os.fdopen(fd) wraps the raw fd in a file object that now owns
             # the close (closing the file closes the descriptor)
             Site(names=("fdopen",), recv_any=("os",), bind="args", to_status="escaped"),
+            # raw-forward: the chunk store adopts the staged-file fd (its
+            # take_raw_fd consumer closes it); os.sendfile is deliberately
+            # NOT listed anywhere — the splice BORROWS the fd, the frame
+            # that carries it still owns the close
+            Site(names=("adopt_raw_fd",), bind="args", to_status="transferred"),
         ),
         leak_hint=" — leaked descriptors exhaust the process rlimit",
+    ),
+    Protocol(
+        name="sealed",
+        what="sealed-frame borrow",
+        acquires=(Site(names=("sealed_open",), bind="result"),),
+        releases=(
+            Site(names=("close", "release"), recv_any=("ref", "sealed"), bind="receiver"),
+        ),
+        leak_hint=" — an unreleased borrow pins the sealed frame past its chunk's terminal GC",
     ),
     Protocol(
         name="chunk",
@@ -766,13 +783,18 @@ class _FunctionAnalysis:
                         state = df.set_facts(state, key, frozenset({(_ESCAPED, line)}))
             return state
         # 5) constructor heuristic: the object owns what it was built from
-        #    (private classes like `_Entry` count: look past the underscores)
+        #    (private classes like `_Entry` count: look past the underscores).
+        #    An ATTRIBUTE operand moves its base object too — passing
+        #    `ref.fd` / `release_fn=ref.close` into RawFrameSource(...) hands
+        #    the borrow's lifetime to the constructed frame (bias toward
+        #    silence, per the module contract)
         if terminal.lstrip("_")[:1].isupper():
             for name in _flat_operand_names(call):
-                for prefix in _KEY_PREFIXES:
-                    key = f"{prefix}:{name}"
-                    if _OPEN in df.statuses(state, key):
-                        state = df.set_facts(state, key, frozenset({(_ESCAPED, line)}))
+                for cand in {name, name.split(".", 1)[0]}:
+                    for prefix in _KEY_PREFIXES:
+                        key = f"{prefix}:{cand}"
+                        if _OPEN in df.statuses(state, key):
+                            state = df.set_facts(state, key, frozenset({(_ESCAPED, line)}))
             return state
         # 6) queue/IPC boundary with an owned operand: escape-without-transfer
         if terminal in _BOUNDARY_NAMES:

@@ -187,6 +187,10 @@ class BlastPlanner(Planner):
                     encrypt=cfg.encrypt_e2e,
                     dedup=dedup,
                     peer_serve=peer_serve,
+                    # interior edges re-serve landed chunks: raw-forward the
+                    # sealed frames unless the edge deduplicates (recipes
+                    # depend on per-edge index state, never raw-eligible)
+                    raw_eligible=(not dedup) if peer_serve else None,
                     private_ip=(from_region.split(":")[0] == child.region_tag.split(":")[0] == "gcp"),
                 ),
                 parent_handle=send_parent,
@@ -223,6 +227,7 @@ def build_local_blast_programs(
             "encrypt": encrypt,
             "dedup": dedup,
             "peer_serve": peer,
+            "raw_eligible": (not dedup) if peer else None,
             "children": [],
         }
 

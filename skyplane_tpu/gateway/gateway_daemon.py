@@ -710,6 +710,7 @@ class GatewayDaemon:
                 control_tls=self.control_tls,
                 source_gateway_id=self.gateway_id,
                 peer_serve=op.get("peer_serve", False),
+                raw_forward=op.get("raw_eligible"),
                 dedup_index=self._dedup_index_for(target_id) if dedup and not self.pump_procs else None,
                 scheduler=self.scheduler,
                 tenant_registry=self.tenants,

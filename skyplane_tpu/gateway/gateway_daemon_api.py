@@ -350,6 +350,10 @@ class GatewayDaemonAPI:
                     p.unlink()
                 except OSError:
                     pass
+        # sealed-frame cache entries (raw-forward) go through the
+        # refcount-aware discard: an in-flight sendfile borrow defers the
+        # unlink to its last close instead of tearing the frame mid-splice
+        self.chunk_store.sealed_discard(chunk_id)
 
     def record_error(self, tb: str) -> None:
         with self._lock:

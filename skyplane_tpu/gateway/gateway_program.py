@@ -49,6 +49,7 @@ class GatewaySend(GatewayOp):
         dedup: bool = False,
         private_ip: bool = False,
         peer_serve: bool = False,
+        raw_eligible: Optional[bool] = None,
         handle: Optional[str] = None,
     ):
         super().__init__(handle)
@@ -63,6 +64,10 @@ class GatewaySend(GatewayOp):
         # runs on a DESTINATION gateway serving already-landed chunks to a
         # sibling sink; arms the relay.peer_serve fault point
         self.peer_serve = peer_serve
+        # raw-forward planner hint (docs/datapath-performance.md): True/False
+        # force the sendfile fast path on/off for this edge; None defers to
+        # the operator default (on, modulo SKYPLANE_TPU_RAW_FORWARD)
+        self.raw_eligible = raw_eligible
 
     def to_dict(self) -> dict:
         d = super().to_dict()
@@ -75,6 +80,7 @@ class GatewaySend(GatewayOp):
             dedup=self.dedup,
             private_ip=self.private_ip,
             peer_serve=self.peer_serve,
+            raw_eligible=self.raw_eligible,
         )
         return d
 

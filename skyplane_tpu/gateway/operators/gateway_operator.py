@@ -28,12 +28,23 @@ import requests
 
 import json
 
-from skyplane_tpu.chunk import DEFAULT_TENANT_ID, ChunkFlags, ChunkRequest, ChunkState, WireProtocolHeader
+import hashlib
+
+from skyplane_tpu.chunk import DEFAULT_TENANT_ID, ChunkFlags, ChunkRequest, ChunkState, Codec, WireProtocolHeader
 from skyplane_tpu.exceptions import SkyplaneTpuException
 from skyplane_tpu.faults import get_injector
 from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE, NACK_UNRESOLVED, put_drop_oldest
 from skyplane_tpu.obs import NOOP_SPAN, get_registry, get_tracer
-from skyplane_tpu.gateway.operators.sender_wire import RECONNECT_POLICY, EngineCallbacks, env_int
+from skyplane_tpu.gateway.operators.sender_wire import (
+    RECONNECT_POLICY,
+    EngineCallbacks,
+    RawForwardEngine,
+    RawFrameSource,
+    RawSendError,
+    env_int,
+    raw_forward_enabled,
+    send_vectored,
+)
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.crypto import ChunkCipher
 from skyplane_tpu.gateway.gateway_queue import GatewayQueue
@@ -616,6 +627,7 @@ class GatewaySenderOperator(GatewayOperator):
         scheduler=None,
         tenant_registry=None,
         peer_serve: bool = False,
+        raw_forward: Optional[bool] = None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -696,6 +708,24 @@ class GatewaySenderOperator(GatewayOperator):
         # gateway re-serving landed chunks to a sibling sink; arms the
         # relay.peer_serve fault point (drop -> silent requeue -> re-serve)
         self.peer_serve = bool(peer_serve)
+        # raw-forward fast path (docs/datapath-performance.md): splice
+        # already-sealed staged files kernel-side instead of re-framing.
+        # Constructor False (or planner raw_eligible=False) disables for this
+        # edge; the SKYPLANE_TPU_RAW_FORWARD knob master-gates everything.
+        self.raw_forward = (raw_forward if raw_forward is not None else True) and raw_forward_enabled()
+        self._dedup = bool(dedup)
+        # passthrough eligibility: wire bytes == staged chunk bytes exactly
+        # (identity codec, no recipe, no seal) — only then can the payload
+        # skip userspace entirely; the header's blake2b fingerprint is
+        # computed once and cached as sealed meta
+        self._raw_passthrough = (
+            self.processor.codec.codec_id == Codec.NONE and not self._dedup and self.cipher is None
+        )
+        # one stateless raw engine serves the serial path (pipelined workers
+        # use their wire engine's); serial raw counters merge in wire_counters
+        self._raw_serial = RawForwardEngine()
+        self._serial_raw_lock = threading.Lock()
+        self._serial_raw = {"wire_raw_frames": 0, "wire_raw_bytes": 0, "wire_raw_fallbacks": 0}
         # per-(src,dst)-edge egress bytes, keyed by target gateway id at the
         # moment the bytes hit the socket (retargets start a new key) — the
         # counter-measured source of skyplane_egress_bytes_total{src,dst}
@@ -919,6 +949,9 @@ class GatewaySenderOperator(GatewayOperator):
             counters = engine.counters()
             for k in out:
                 out[k] += counters.get(k, 0)
+        with self._serial_raw_lock:
+            for k, v in self._serial_raw.items():
+                out[k] += v
         with self._events_dropped_lock:
             out["profile_events_dropped"] += self._events_dropped
         return out
@@ -941,10 +974,152 @@ class GatewaySenderOperator(GatewayOperator):
             total += req.chunk.chunk_length_bytes
         return batch
 
+    def _header_from_meta(self, chunk, meta: dict, length: int, n_left: int) -> WireProtocolHeader:
+        """Rebuild the per-send wire header from cached send-invariant meta
+        (relay ``.hdr`` sidecars and sealed-frame cache entries share the
+        field schema); only data_len and n_chunks_left vary per send."""
+        return WireProtocolHeader(
+            chunk_id=chunk.chunk_id,
+            data_len=length,
+            raw_data_len=meta["raw_data_len"],
+            codec=meta["codec"],
+            flags=meta["flags"],
+            fingerprint=meta["fingerprint"],
+            n_chunks_left_on_socket=n_left,
+            tenant_id=meta.get("tenant") or DEFAULT_TENANT_ID,
+        )
+
+    def _raw_frame_chunk(self, chunk_req: ChunkRequest, n_left: int):
+        """Raw-forward eligibility (docs/datapath-performance.md): build
+        ``(RawFrameSource, header, relay)`` when this chunk's wire bytes
+        already exist as a staged file and need no re-framing — else None and
+        the codec path decides. The ladder, most- to least-sealed:
+
+          (a) relay re-send — a ``.hdr`` sidecar means the staged bytes ARE
+              the wire payload (any codec/dedup/cipher: they're opaque here);
+          (b) sealed-frame cache — this chunk was framed once by the codec
+              path and its wire bytes staged (dedup off: recipes depend on
+              per-edge index state and are never cacheable);
+          (c) compress=none passthrough — wire bytes == chunk file bytes;
+              the blake2b fingerprint the receiver verifies is computed once
+              (streamed, no full materialization) and sealed as meta.
+
+        Every failure degrades silently to the codec path — eligibility is
+        an optimization decision, never a correctness gate."""
+        if not self.raw_forward:
+            return None
+        chunk = chunk_req.chunk
+        store = self.chunk_store
+        fpath = store.chunk_path(chunk.chunk_id)
+        hdr_sidecar = fpath.with_suffix(".hdr")
+        if hdr_sidecar.exists():
+            try:
+                meta = json.loads(hdr_sidecar.read_text())
+            except (OSError, ValueError):
+                return None  # sidecar raced GC: let the codec path decide
+            fd = store.take_raw_fd(chunk.chunk_id)
+            if fd is None:
+                try:
+                    fd = os.open(fpath, os.O_RDONLY)
+                except OSError:
+                    return None
+            try:
+                length = os.fstat(fd).st_size
+                header = self._header_from_meta(chunk, meta, length, n_left)
+            except Exception:
+                os.close(fd)
+                return None  # torn sidecar/stat: the codec path decides
+            except BaseException:
+                os.close(fd)
+                raise
+            return RawFrameSource(fd, length), header, True
+        if self._dedup:
+            return None
+        ref = store.sealed_open(chunk.chunk_id)
+        if ref is not None:
+            try:
+                chunk.fingerprint = ref.meta["fingerprint"]
+                header = self._header_from_meta(chunk, ref.meta, ref.length, n_left)
+            except BaseException:
+                ref.close()
+                raise
+            return RawFrameSource(ref.fd, ref.length, release_fn=ref.close), header, False
+        if not self._raw_passthrough:
+            return None
+        try:
+            fd = os.open(fpath, os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            length = os.fstat(fd).st_size
+            h = hashlib.blake2b(digest_size=16)
+            off = 0
+            while off < length:
+                b = os.pread(fd, min(1 << 20, length - off), off)
+                if not b:
+                    raise OSError(f"staged chunk truncated at {off}/{length}")
+                h.update(b)
+                off += len(b)
+            meta = {
+                "codec": int(Codec.NONE),
+                "flags": 0,
+                "fingerprint": h.hexdigest(),
+                "raw_data_len": length,
+                "tenant": chunk.tenant_id or DEFAULT_TENANT_ID,
+            }
+            # meta-only seal: the .chunk file stays the payload; siblings
+            # (blast tree children, pump re-sends) skip even the one hash pass
+            try:
+                store.seal_frame(chunk.chunk_id, meta)
+            except OSError as e:
+                logger.fs.warning(f"[{self.handle}] sealed-meta staging failed for {chunk.chunk_id}: {e}")
+            chunk.fingerprint = meta["fingerprint"]
+            header = self._header_from_meta(chunk, meta, length, n_left)
+        except OSError:
+            os.close(fd)
+            return None
+        except BaseException:
+            os.close(fd)
+            raise
+        return RawFrameSource(fd, length), header, False
+
+    def _maybe_seal(self, chunk, payload, wire: bytes, header: WireProtocolHeader) -> None:
+        """Stage this codec-framed chunk's wire bytes for raw re-serves.
+        Gated on peer_serve: sealing costs one disk write per chunk and only
+        pays when the SAME chunk frames again (N blast tree children) — a
+        plain source edge frames each chunk exactly once."""
+        if not (self.raw_forward and self.peer_serve) or self._dedup or payload is None or payload.is_recipe:
+            return
+        meta = {
+            "codec": header.codec,
+            # TRACED is a per-send sampling decision, never cached
+            "flags": header.flags & ~int(ChunkFlags.TRACED),
+            "fingerprint": header.fingerprint,
+            "raw_data_len": header.raw_data_len,
+            "tenant": header.tenant_id,
+        }
+        try:
+            self.chunk_store.seal_frame(chunk.chunk_id, meta, None if self._raw_passthrough else wire)
+        except OSError as e:
+            logger.fs.warning(f"[{self.handle}] sealed-frame staging failed for {chunk.chunk_id}: {e}")
+
+    def _bump_serial_raw(self, key: str, n: int = 1) -> None:
+        with self._serial_raw_lock:
+            self._serial_raw[key] += n
+
     def _frame_chunk(self, chunk_req: ChunkRequest, view: Optional[_WindowFpView], n_left: int):
         """Build (payload, wire, header) for one chunk. payload is None on the
         relay path (opaque staged bytes re-framed with their original header)."""
         chunk = chunk_req.chunk
+        # a staged-file fd the pump parent passed for raw forwarding that the
+        # raw path did not consume (ineligible/disabled): close it here so
+        # codec-path re-frames never accumulate descriptors
+        adopted = self.chunk_store.take_raw_fd(chunk.chunk_id)
+        if adopted is not None:
+            try:
+                os.close(adopted)
+            except OSError:
+                pass
         fpath = self.chunk_store.chunk_path(chunk.chunk_id)
         hdr_sidecar = fpath.with_suffix(".hdr")
         if hdr_sidecar.exists():
@@ -978,6 +1153,7 @@ class GatewaySenderOperator(GatewayOperator):
             is_encrypted=self.cipher is not None,
             is_recipe=payload.is_recipe,
         )
+        self._maybe_seal(chunk, payload, wire, header)
         return payload, wire, header
 
     def _register_batch(self, batch: List[ChunkRequest]) -> None:
@@ -1109,6 +1285,15 @@ class GatewaySenderOperator(GatewayOperator):
         # continuous stream (receivers ignore it; docs/wire_protocol.md) —
         # the one header field where serial and pipelined frames differ
         with span:
+            raw = self._raw_frame_chunk(req, n_left=0)
+            if raw is not None:
+                # raw-forward: the staged file IS the wire payload; the pump
+                # thread splices it kernel-side (or materializes it on a
+                # raw-disabled stream — byte-identical either way)
+                source, header, relay = raw
+                if traced and not relay:
+                    header.flags |= ChunkFlags.TRACED
+                return WireFrame(req, header, b"", relay=relay, window=window, traced=traced, raw=source)
             payload, wire, header = self._frame_chunk(req, view, n_left=0)
         if traced and payload is not None:
             # stamp the sampling decision into the wire header so the
@@ -1159,9 +1344,21 @@ class GatewaySenderOperator(GatewayOperator):
                     if traced
                     else NOOP_SPAN
                 )
+                raw = None
+                payload = wire = None
                 with span:
-                    payload, wire, header = self._frame_chunk(req, view, n_left=len(batch) - i - 1)
-                if traced and payload is not None:
+                    # serial raw-forward: per-worker eligibility mirrors the
+                    # engine's per-stream raw_ok — one raw-send error flips
+                    # this worker to the codec path for its lifetime
+                    if getattr(self._local, "raw_ok", True):
+                        raw = self._raw_frame_chunk(req, n_left=len(batch) - i - 1)
+                    if raw is None:
+                        payload, wire, header = self._frame_chunk(req, view, n_left=len(batch) - i - 1)
+                if raw is not None:
+                    source, header, relay = raw
+                    if traced and not relay:
+                        header.flags |= ChunkFlags.TRACED
+                elif traced and payload is not None:
                     header.flags |= ChunkFlags.TRACED  # receiver spans follow the sender's sample
                 send_span = (
                     tracer.span(
@@ -1175,10 +1372,29 @@ class GatewaySenderOperator(GatewayOperator):
                     else NOOP_SPAN
                 )
                 with send_span:
-                    header.to_socket(sock)
-                    sock.sendall(wire)
-                window_wire += len(wire)
-                self.note_egress(len(wire))
+                    if raw is not None:
+                        try:
+                            self._raw_serial.send(sock, header.to_bytes(), source)
+                        except RawSendError:
+                            # mid-stream fallback, serial flavor: the frame
+                            # may be torn mid-payload, so fall through to the
+                            # socket-error handler (reset + requeue unacked)
+                            # with raw disabled for this worker from now on
+                            self._local.raw_ok = False
+                            self._bump_serial_raw("wire_raw_fallbacks")
+                            raise
+                        finally:
+                            source.release()
+                        self._bump_serial_raw("wire_raw_frames")
+                        self._bump_serial_raw("wire_raw_bytes", source.length)
+                        sent_len = source.length
+                    else:
+                        # vectored codec send: header as the iovec prefix,
+                        # one sendmsg, no concatenation copy
+                        send_vectored(sock, header.to_bytes(), wire)
+                        sent_len = len(wire)
+                window_wire += sent_len
+                self.note_egress(sent_len)
                 del wire
                 if payload is not None:
                     # only the fingerprint lists are needed for ack

@@ -44,6 +44,7 @@ frame-ahead queue full, i.e. the wire is the bottleneck and acks lag.
 
 from __future__ import annotations
 
+import mmap
 import os
 import selectors
 import socket
@@ -75,6 +76,157 @@ def env_int(var: str, default: int, minimum: int = 1) -> int:
         logger.fs.warning(f"ignoring malformed {var}; using {default}")
         return default
 
+#: master knob for the raw-forward fast path (docs/datapath-performance.md
+#: "Raw-forward fast path"): 0/false/off disables kernel-side splicing
+#: everywhere; eligibility is still decided per chunk and per stream
+RAW_FORWARD_ENV = "SKYPLANE_TPU_RAW_FORWARD"
+
+
+def raw_forward_enabled() -> bool:
+    return os.environ.get(RAW_FORWARD_ENV, "1").strip().lower() not in ("0", "false", "off")
+
+
+def send_vectored(sock, header: bytes, payload) -> None:
+    """One vectored ``sendmsg([header, payload])`` — header and payload leave
+    in a single syscall with NO concatenation copy — with a sendall-style
+    resume loop for partial sends. TLS sockets (no sendmsg: OpenSSL owns the
+    record layer) and test fakes without sendmsg fall back to two sendalls,
+    which is the old behavior exactly."""
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None or isinstance(sock, ssl.SSLSocket):
+        sock.sendall(header)
+        if len(payload):
+            sock.sendall(payload)
+        return
+    iov = [memoryview(header), memoryview(payload)]
+    iov = [v for v in iov if len(v)]
+    while iov:
+        sent = sendmsg(iov)
+        while iov and sent >= len(iov[0]):
+            sent -= len(iov[0])
+            iov.pop(0)
+        if iov and sent:
+            iov[0] = iov[0][sent:]
+
+
+class RawSendError(OSError):
+    """A raw (sendfile/mmap) send failed mid-frame. Distinguished from plain
+    socket death so the pump can fall the STREAM back to the codec path
+    (requeueing un-acked frames uncounted) instead of burning the circuit
+    breaker's reset budget on a mechanism failure."""
+
+
+class RawFrameSource:
+    """The payload of a raw-forwarded frame: a staged file the kernel splices
+    to the socket, never materialized as Python bytes on the happy path.
+
+    The frame OWNS the source (the fd rides inside ``os.sendfile`` as a
+    borrow, analysis/resources.py) until it resolves — delivered, requeued,
+    or failed — when the engine calls :meth:`release` exactly once."""
+
+    __slots__ = ("fd", "length", "_release_fn", "_released")
+
+    def __init__(self, fd: int, length: int, release_fn: Optional[Callable[[], None]] = None):
+        self.fd = fd
+        self.length = length
+        self._release_fn = release_fn
+        self._released = False
+
+    def read_all(self) -> bytes:
+        """Materialize the payload (codec-path fallback / TLS pread path)."""
+        out = bytearray()
+        off = 0
+        while off < self.length:
+            b = os.pread(self.fd, min(1 << 20, self.length - off), off)
+            if not b:
+                raise OSError(f"staged frame truncated at {off}/{self.length} bytes")
+            out += b
+            off += len(b)
+        return bytes(out)
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        if self._release_fn is not None:
+            self._release_fn()
+        else:
+            try:
+                os.close(self.fd)
+            except OSError:
+                pass
+
+
+class RawForwardEngine:
+    """Kernel-assisted raw sends beside the framer/pump/reaper pipeline.
+
+    Plaintext TCP: the 86-byte wire header goes out as an iovec prefix via
+    ``socket.sendmsg`` (MSG_MORE where available, so it coalesces with the
+    first payload bytes instead of riding its own segment), then the staged
+    payload splices kernel-side with ``os.sendfile`` — zero userspace copies.
+    TLS: OpenSSL must see the plaintext, so the payload is written from an
+    ``mmap`` view in bounded slices — still no read() copy into Python bytes.
+    Any failure raises :class:`RawSendError`; ack/NACK reaping, chunk
+    accounting, and egress attribution stay with the caller unchanged."""
+
+    MMAP_SLICE = 4 << 20  # TLS path: bound each SSL_write's plaintext slice
+
+    def send(self, sock, header_bytes: bytes, source: RawFrameSource) -> None:
+        inj = get_injector()
+        tear_at = -1
+        if inj.enabled and inj.fire("sender.raw_send"):
+            # docs/fault-injection.md sender.raw_send: tear the splice
+            # mid-payload — the receiver sees a truncated frame (connection
+            # drop), the sender stream falls back to the codec path
+            tear_at = source.length // 2
+        try:
+            if isinstance(sock, ssl.SSLSocket):
+                self._send_mmap(sock, header_bytes, source, tear_at)
+            else:
+                self._send_sendfile(sock, header_bytes, source, tear_at)
+        except RawSendError:
+            raise
+        except (OSError, ssl.SSLError, ValueError) as e:
+            raise RawSendError(f"raw send failed: {e}") from e
+
+    def _send_sendfile(self, sock, header_bytes: bytes, source: RawFrameSource, tear_at: int) -> None:
+        flags = getattr(socket, "MSG_MORE", 0) if source.length else 0
+        iov = [memoryview(header_bytes)]
+        while iov:
+            sent = sock.sendmsg(iov, [], flags)
+            if sent >= len(iov[0]):
+                break
+            iov[0] = iov[0][sent:]
+        offset = 0
+        out_fd = sock.fileno()
+        while offset < source.length:
+            if 0 <= tear_at <= offset:
+                raise RawSendError(f"injected raw splice failure mid-payload at {offset}/{source.length}")
+            count = source.length - offset
+            if tear_at > offset:
+                count = tear_at - offset
+            sent = os.sendfile(out_fd, source.fd, offset, count)
+            if sent == 0:
+                raise RawSendError(f"sendfile stalled at {offset}/{source.length} (staged file truncated?)")
+            offset += sent
+
+    def _send_mmap(self, sock, header_bytes: bytes, source: RawFrameSource, tear_at: int) -> None:
+        sock.sendall(header_bytes)
+        if source.length == 0:
+            return
+        with mmap.mmap(source.fd, source.length, prot=mmap.PROT_READ) as m:
+            with memoryview(m) as view:
+                offset = 0
+                while offset < source.length:
+                    if 0 <= tear_at <= offset:
+                        raise RawSendError(f"injected raw splice failure mid-payload at {offset}/{source.length}")
+                    end = min(offset + self.MMAP_SLICE, source.length)
+                    if tear_at > offset:
+                        end = min(end, tear_at)
+                    sock.sendall(view[offset:end])
+                    offset = end
+
+
 # stable sender wire-counter schema (the sender mirror of DECODE_COUNTER_ZERO):
 # every key always present — zeros when the pipelined engine is off — so
 # /profile/socket/sender, bench.py's wire section, and check_bench_json.py can
@@ -95,6 +247,13 @@ SENDER_WIRE_COUNTER_ZERO = {
     "stream_retargets": 0,  # replan cutovers: streams reset onto a new next hop
     "windows": 0,  # submit batches (the _drain_batch granularity)
     "profile_events_dropped": 0,  # per-window profile events lost to the bounded queue
+    # raw-forward fast path (docs/datapath-performance.md): frames whose
+    # payload was spliced kernel-side (sendfile) or streamed from an mmap
+    # view (TLS), the payload bytes so moved, and raw-send errors that fell
+    # a stream back to the codec path
+    "wire_raw_frames": 0,
+    "wire_raw_bytes": 0,
+    "wire_raw_fallbacks": 0,
 }
 
 
@@ -109,6 +268,7 @@ class WireFrame:
         "new_fps",
         "ref_fps",
         "relay",
+        "raw",
         "sent_ns",
         "sent_wall_ns",
         "window",
@@ -116,11 +276,24 @@ class WireFrame:
         "counted_retry",
     )
 
-    def __init__(self, req, header, wire: bytes, new_fps=(), ref_fps=(), relay: bool = False, window=None, traced: bool = False):
+    def __init__(
+        self,
+        req,
+        header,
+        wire: bytes,
+        new_fps=(),
+        ref_fps=(),
+        relay: bool = False,
+        window=None,
+        traced: bool = False,
+        raw: Optional[RawFrameSource] = None,
+    ):
         self.req = req
         self.header = header
         self.wire = wire
-        self.wire_len = len(wire)
+        # raw frames carry no in-memory payload: the staged file is the wire
+        self.raw = raw
+        self.wire_len = raw.length if raw is not None else len(wire)
         self.new_fps = list(new_fps)  # (fp, size) committed to the durable index on ack
         self.ref_fps = list(ref_fps)  # fps discarded on an unresolvable-REF nack
         self.relay = relay  # opaque re-framed bytes: a NACK is unrecoverable
@@ -132,6 +305,14 @@ class WireFrame:
         # requeue contract, not failures — only real retries (socket death,
         # NACK resend) count against the chunk's retry budget
         self.counted_retry = True
+
+    def release_raw(self) -> None:
+        """Release the staged-file borrow (idempotent, no-op on codec
+        frames). The engine calls this at every frame resolution —
+        delivered, requeued, failed — a requeued chunk re-frames from
+        scratch and re-acquires its own source."""
+        if self.raw is not None:
+            self.raw.release()
 
 
 class EngineCallbacks:
@@ -186,6 +367,7 @@ class _Stream:
         "consec_resets",
         "broken",
         "retarget",
+        "raw_ok",
     )
 
     def __init__(self, idx: int):
@@ -217,6 +399,11 @@ class _Stream:
         # pump thread — which performs the actual reset, preserving the
         # single-thread socket-ownership invariant
         self.retarget = False
+        # per-stream raw-forward eligibility: a raw-send error flips this
+        # False for the stream's lifetime and every later frame (including
+        # requeued ones) ships through the codec path — the mid-stream
+        # fallback ladder of docs/datapath-performance.md. Pump thread only.
+        self.raw_ok = True
 
     def wake(self) -> None:
         try:
@@ -303,6 +490,9 @@ class SenderWireEngine:
         self._completion_cond = threading.Condition(lockcheck.wrap(threading.RLock(), "SenderWireEngine._completion_cond"))
         self._counters = dict(SENDER_WIRE_COUNTER_ZERO)
         self._counters_lock = lockcheck.wrap(threading.Lock(), "SenderWireEngine._counters_lock")
+        # raw-forward stream mode: kernel-side payload splicing for frames
+        # that carry a RawFrameSource (per-stream opt-out via _Stream.raw_ok)
+        self.raw_engine = RawForwardEngine()
         self._closed = False
         self._reaper = threading.Thread(target=self._reap, name=f"{name}-reaper", daemon=True)
         self._reaper.start()
@@ -329,6 +519,7 @@ class SenderWireEngine:
                     # not a counted retry — the chunk did not fail, it never
                     # got a live stream
                     frame.counted_retry = False
+                    frame.release_raw()
                     self.callbacks.on_requeue(frame)
                     return frame
                 if len(stream.frames) < self.frame_ahead:
@@ -347,10 +538,12 @@ class SenderWireEngine:
                     with stream.lock:
                         stream.pending_fps.difference_update(fp for fp, _ in frame.new_fps)
                     stream = new
+                    frame.release_raw()  # the re-frame acquires its own source
                     frame = frame_fn(stream.pending_fps)
                     continue
             if self.abort_check is not None and self.abort_check():
                 frame.counted_retry = False  # shutdown, not a failure
+                frame.release_raw()
                 self.callbacks.on_requeue(frame)
                 return frame
             with stream.lock:
@@ -420,6 +613,7 @@ class SenderWireEngine:
             s.wake()
         for frame in leftovers:
             frame.counted_retry = False  # drained shutdown, not a failure
+            frame.release_raw()
             self.callbacks.on_requeue(frame)
         with self._completion_cond:
             self._completion_cond.notify_all()
@@ -488,6 +682,8 @@ class SenderWireEngine:
                     continue
                 try:
                     self._pump_once(stream)
+                except RawSendError as e:
+                    self._raw_fallback(stream, str(e))
                 except (OSError, ssl.SSLError) as e:
                     self._stream_error(stream, str(e))
         except Exception:  # noqa: BLE001 — unexpected pump error is daemon-fatal
@@ -529,6 +725,18 @@ class SenderWireEngine:
             self._break_stream(stream, why)
             return
         time.sleep(RECONNECT_POLICY.backoff_s(stream.consec_resets - 1))
+
+    def _raw_fallback(self, stream: _Stream, why: str) -> None:
+        """Mid-stream fallback to the codec path: a raw (sendfile/mmap) send
+        failed, possibly leaving a torn frame on the wire. Disable raw mode
+        for this stream's lifetime, then reset it like any stream break —
+        un-acked frames requeue UNCOUNTED (the mechanism failed, not the
+        chunk) and the circuit breaker is NOT charged (a mechanism bug must
+        not kill a healthy link)."""
+        stream.raw_ok = False
+        self._bump("wire_raw_fallbacks")
+        logger.fs.warning(f"[{self.name}:stream{stream.idx}] raw-forward disabled, falling back to codec path: {why}")
+        self._reset_stream(stream, f"raw-send fallback: {why}", counted=False)
 
     def _break_stream(self, stream: _Stream, why: str) -> None:
         """Circuit breaker: declare this stream dead. Its frames already
@@ -610,11 +818,28 @@ class SenderWireEngine:
                         # error mid-send; sender.corrupt_payload flips one wire
                         # byte (detectable only on sealed/recipe payloads —
                         # the receiver's auth/structure checks turn it into a
-                        # payload error and the chunk resends)
+                        # payload error and the chunk resends). Raw frames
+                        # have no in-memory payload to corrupt; their torn-
+                        # send fault point is sender.raw_send (raw_engine).
                         inj.check("sender.send", OSError, "injected socket error before send")
                         frame.wire = inj.corrupt("sender.corrupt_payload", frame.wire)
-                    frame.header.to_socket(stream.sock)
-                    stream.sock.sendall(frame.wire)
+                    if frame.raw is not None and not (stream.raw_ok and raw_forward_enabled()):
+                        # raw-eligible frame on a raw-disabled stream (or the
+                        # knob flipped off): materialize the sealed bytes and
+                        # ship them through the codec send — byte-identical
+                        # by construction, just a userspace copy slower
+                        frame.wire = frame.raw.read_all()
+                        frame.release_raw()
+                        frame.raw = None
+                    if frame.raw is not None:
+                        self.raw_engine.send(stream.sock, frame.header.to_bytes(), frame.raw)
+                        self._bump("wire_raw_frames")
+                        self._bump("wire_raw_bytes", frame.wire_len)
+                    else:
+                        # codec path: one vectored sendmsg, header as the
+                        # iovec prefix — no header-only TCP segment, no
+                        # header+payload concatenation copy
+                        send_vectored(stream.sock, frame.header.to_bytes(), frame.wire)
             except (OSError, ssl.SSLError):
                 # the frame is in-hand (already popped): put it back so the
                 # reset path requeues its chunk — otherwise a socket death
@@ -758,6 +983,7 @@ class SenderWireEngine:
         for frame in doomed:
             if not counted:
                 frame.counted_retry = False
+            frame.release_raw()
             self.callbacks.on_requeue(frame)
 
     # ---- ack reaper (one per engine; never touches a socket) ----
@@ -775,6 +1001,7 @@ class SenderWireEngine:
                     stream, frame, b = self._completion_q.popleft()
                 if b == ACK_BYTE:
                     self._bump("acks_reaped")
+                    frame.release_raw()
                     # commit to the durable index FIRST, then retire the fps
                     # from the stream view — membership (pending ∪ durable)
                     # never has a gap a concurrent framer could fall through
@@ -784,6 +1011,7 @@ class SenderWireEngine:
                             stream.pending_fps.difference_update(fp for fp, _ in frame.new_fps)
                 else:  # NACK_UNRESOLVED
                     self._bump("nacks_reaped")
+                    frame.release_raw()
                     if frame.relay:
                         # opaque staged bytes: the recipe cannot be rebuilt and a
                         # re-queue would replay the identical unresolvable frame
@@ -841,10 +1069,12 @@ class SenderWireEngine:
         for _stream, f, b in leftovers:
             if b == ACK_BYTE:
                 self._bump("acks_reaped")
+                f.release_raw()
                 self.callbacks.on_delivered(f)
             else:
                 doomed.append(f)
         for f in doomed:
+            f.release_raw()
             self.callbacks.on_failed(f)
         self.callbacks.on_fatal(msg)
 

@@ -774,6 +774,7 @@ def _sender_pump_class():
                 "api_token": self.api_token,
                 "control_tls": self.control_tls,
                 "source_gateway_id": self.source_gateway_id,
+                "raw_forward": self.raw_forward,
                 "push_s": _env_float(PUMP_PUSH_S_ENV, 0.25),
             }
 
@@ -826,6 +827,41 @@ def _sender_pump_class():
         def _ship(self, reqs) -> bool:
             payload = {"type": "batch", "reqs": [r.as_dict() for r in reqs]}
             ids = [r.chunk.chunk_id for r in reqs]
+            # raw-forward fd crossing: for relay chunks (.hdr sidecar = staged
+            # bytes ARE the wire payload) the parent opens the staged file and
+            # SCM_RIGHTS-moves the fd with the batch, so the worker's sendfile
+            # is immune to a terminal-sweep GC racing the ship. Capped at 16
+            # fds per message (CtrlChannel.recv's ancillary bound); overflow
+            # chunks just open by path worker-side.
+            raw_fds: List[int] = []
+            raw_ids: List[str] = []
+            if self.raw_forward:
+                for r in reqs:
+                    if len(raw_fds) >= 16:
+                        break
+                    cpath = self.chunk_store.chunk_path(r.chunk.chunk_id)
+                    if not cpath.with_suffix(".hdr").exists():
+                        continue
+                    try:
+                        raw_fds.append(os.open(cpath, os.O_RDONLY))
+                    except OSError:
+                        continue
+                    raw_ids.append(r.chunk.chunk_id)
+            if raw_fds:
+                payload["n_fds"] = len(raw_fds)
+                payload["raw_fd_chunks"] = raw_ids
+            try:
+                return self._ship_locked(payload, ids, reqs, raw_fds)
+            finally:
+                # send_fds dups descriptors into the message; the parent's
+                # copies close here whether the ship landed or not
+                for fd in raw_fds:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+
+        def _ship_locked(self, payload: dict, ids, reqs, raw_fds) -> bool:
             while not self.exit_flag.is_set() and not self.error_event.is_set():
                 w = self.pool.least_loaded(self._outstanding_cap)
                 if w is None:
@@ -839,7 +875,7 @@ def _sender_pump_class():
                         self._outstanding[r.chunk.chunk_id] = r
                     w.outstanding.update(ids)
                     self._batches_shipped += 1
-                if w.chan.send(payload):
+                if w.chan.send(payload, fds=tuple(raw_fds)):
                     return True
                 # send raced the worker's death: roll back; the reader's
                 # death path may also be requeueing — _take_outstanding is
@@ -1234,6 +1270,7 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
         source_gateway_id=cfg.get("source_gateway_id"),
         scheduler=None,  # fair-share tokens are held by the parent
         tenant_registry=None,
+        raw_forward=bool(cfg.get("raw_forward")),
     )
     op.start_workers()
     stop_evt = threading.Event()
@@ -1298,10 +1335,23 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
         got = chan.recv()
         if got is None:
             break
-        msg, _fds = got
+        msg, fds = got
         kind = msg.get("type")
         if kind == "batch":
             _maybe_crash(cfg)
+            if fds:
+                # staged-file fds the parent opened ride the batch message;
+                # the store adopts them (ownership moves) so the raw frame
+                # built later splices the parent's still-open descriptor
+                raw_ids = msg.get("raw_fd_chunks") or []
+                for cid, fd in zip(raw_ids, fds):
+                    store.adopt_raw_fd(cid, fd)
+                for fd in fds[len(raw_ids):]:  # malformed surplus: don't leak
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                fds.clear()  # adopted: the reader must not close them
             for d in msg.get("reqs") or []:
                 inbox.put(ChunkRequest.from_dict(d))
         elif kind == "retarget":

@@ -1,0 +1,398 @@
+"""Kernel-assisted raw-forward wire path (docs/datapath-performance.md
+"Raw-forward fast path"): byte-identical wire output raw vs codec, the
+mid-stream RawSendError -> codec fallback with the acked-chunks-stay-complete
+truth table, sealed-frame cache framed-once-serves-N, ChunkStore sealed
+staging/refcount/GC semantics, and the vectored send_vectored resume loop
+asserted copy-free."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.faults import FaultPlan, configure_injector
+from skyplane_tpu.gateway.chunk_store import ChunkStore
+from skyplane_tpu.gateway.operators.sender_wire import (
+    RAW_FORWARD_ENV,
+    RawForwardEngine,
+    RawFrameSource,
+    RawSendError,
+    raw_forward_enabled,
+    send_vectored,
+)
+from tests.unit.test_sender_pipeline import AckServer, drain_n, make_sender, stage_chunks
+
+rng = np.random.default_rng(86)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    yield
+    configure_injector(None)
+
+
+def run_transfer(tmp_path, datas, *, raw_forward, capture_headers=None, server=None, **kw):
+    """One loopback transfer; returns (frame_log, wire_counters)."""
+    own_server = server is None
+    if own_server:
+        script = None
+        if capture_headers is not None:
+            from skyplane_tpu.gateway.operators.gateway_receiver import ACK_BYTE
+
+            def script(i, header, payload):
+                capture_headers.append(header)
+                return ACK_BYTE
+
+        server = AckServer(script=script, ack_delay_s=0.002)
+    op, in_q, out_q, _, store = make_sender(
+        tmp_path, server.port, dedup=False, raw_forward=raw_forward, max_streams=1, **kw
+    )
+    try:
+        for req in stage_chunks(store, datas):
+            in_q.put(req)
+        op.start_workers()
+        done = drain_n(out_q, len(datas))
+        assert len(done) == len(datas), "transfer incomplete"
+        counters = op.wire_counters()
+    finally:
+        op.stop_workers()
+        if own_server:
+            server.close()
+    return server.frame_log(), counters
+
+
+# ------------------------------------------------------- raw/codec equivalence
+
+
+@pytest.mark.parametrize("pipelined", [True, False], ids=["pipelined", "serial"])
+def test_raw_vs_codec_byte_identical(tmp_path, pipelined):
+    """compress=none passthrough: the sendfile path must put the exact bytes
+    (and the exact header fingerprint the receiver verifies) on the wire that
+    the codec path would — per stream mode."""
+    datas = [rng.integers(0, 256, n, dtype=np.uint8).tobytes() for n in (64_000, 8_192, 130_000, 1)]
+
+    codec_headers, raw_headers = [], []
+    codec_frames, codec_counters = run_transfer(
+        tmp_path, datas, raw_forward=False, capture_headers=codec_headers, pipelined=pipelined
+    )
+    raw_frames, raw_counters = run_transfer(
+        tmp_path, datas, raw_forward=True, capture_headers=raw_headers, pipelined=pipelined
+    )
+
+    assert codec_counters["wire_raw_frames"] == 0
+    assert raw_counters["wire_raw_frames"] == len(datas)
+    assert raw_counters["wire_raw_bytes"] == sum(len(d) for d in datas)
+    assert raw_counters["wire_raw_fallbacks"] == 0
+
+    by_id_codec = dict(codec_frames)
+    by_id_raw = dict(raw_frames)
+    assert by_id_codec.keys() == by_id_raw.keys()
+    for cid in by_id_codec:
+        assert by_id_codec[cid] == by_id_raw[cid], f"wire payload diverged for {cid}"
+    hdr_codec = {h.chunk_id: h for h in codec_headers}
+    hdr_raw = {h.chunk_id: h for h in raw_headers}
+    for cid, h in hdr_codec.items():
+        r = hdr_raw[cid]
+        # the fingerprint is what the receiver VERIFIES; codec/flags/lengths
+        # are what it decodes by — all must match the codec framing exactly
+        assert (r.fingerprint, r.codec, r.flags, r.data_len, r.raw_data_len) == (
+            h.fingerprint, h.codec, h.flags, h.data_len, h.raw_data_len
+        )
+
+
+def test_raw_forward_env_kill_switch(tmp_path, monkeypatch):
+    monkeypatch.setenv(RAW_FORWARD_ENV, "0")
+    assert not raw_forward_enabled()
+    datas = [rng.integers(0, 256, 16_000, dtype=np.uint8).tobytes()]
+    _, counters = run_transfer(tmp_path, datas, raw_forward=True)
+    assert counters["wire_raw_frames"] == 0
+
+
+# ------------------------------------------- mid-stream fallback (truth table)
+
+
+def test_raw_send_error_falls_back_and_acked_chunks_stay_complete(tmp_path):
+    """sender.raw_send tears the splice mid-payload on the 3rd raw frame.
+    Truth table: chunks acked before the tear stay complete and are NOT
+    re-sent; the torn + trailing chunks requeue (uncounted) and land via the
+    fallback; every chunk completes exactly once; >=1 fallback is counted."""
+    configure_injector(FaultPlan.from_dict({"seed": 7, "points": {"sender.raw_send": {"p": 1.0, "after": 2, "max_fires": 1}}}))
+    datas = [rng.integers(0, 256, 40_000 + i, dtype=np.uint8).tobytes() for i in range(5)]
+
+    server = AckServer(ack_delay_s=0.002)
+    op, in_q, out_q, _, store = make_sender(
+        tmp_path, server.port, dedup=False, raw_forward=True, max_streams=1, pipelined=True, window=5
+    )
+    try:
+        reqs = stage_chunks(store, datas)
+        for req in reqs:
+            in_q.put(req)
+        op.start_workers()
+        done = drain_n(out_q, len(datas), timeout=30.0)
+        assert len(done) == len(datas), "fallback did not redeliver the torn window"
+        # exactly once: an acked chunk must never resurface via the requeue
+        done_ids = sorted(r.chunk.chunk_id for r in done)
+        assert done_ids == sorted(r.chunk.chunk_id for r in reqs)
+        counters = op.wire_counters()
+        assert counters["wire_raw_fallbacks"] >= 1
+        # the tear itself surfaced as a stream break, not a counted retry
+        assert counters["wire_raw_frames"] >= 2  # the pre-tear raw sends
+    finally:
+        op.stop_workers()
+        server.close()
+    # every delivered payload byte-identical to its staged source
+    by_id = dict(server.frame_log())
+    for req, data in zip(reqs, datas):
+        assert by_id[req.chunk.chunk_id] == data
+
+
+# --------------------------------------------------- sealed-frame cache
+
+
+def test_sealed_cache_frames_once_serves_n(tmp_path):
+    """peer-serve re-send of an lz4-framed chunk: first send runs the codec
+    and seals the wire bytes; the second send of the SAME chunk raw-forwards
+    the sealed file — byte-identical, codec ran once."""
+    data = bytes(range(256)) * 400  # compressible: the seal must hold WIRE bytes
+    server = AckServer(ack_delay_s=0.002)
+    op, in_q, out_q, _, store = make_sender(
+        tmp_path,
+        server.port,
+        dedup=False,
+        raw_forward=True,
+        peer_serve=True,
+        codec_name="lz4",
+        pipelined=True,
+        max_streams=1,
+    )
+    try:
+        (req,) = stage_chunks(store, [data])
+        in_q.put(req)
+        op.start_workers()
+        assert len(drain_n(out_q, 1)) == 1
+        assert store.sealed_path(req.chunk.chunk_id).exists(), "codec framing did not seal"
+        meta = json.loads(store.sealed_meta_path(req.chunk.chunk_id).read_text())
+        assert meta["payload"] == "sealed"
+        in_q.put(req)  # the tree's next child asks for the same chunk
+        assert len(drain_n(out_q, 1)) == 1
+        counters = op.wire_counters()
+        assert counters["wire_raw_frames"] == 1, "second send must skip the codec"
+    finally:
+        op.stop_workers()
+        server.close()
+    frames = server.frame_log()
+    assert len(frames) == 2
+    assert frames[0][1] == frames[1][1], "sealed re-serve diverged from the codec framing"
+    assert len(frames[0][1]) < len(data), "lz4 framing expected to compress this corpus"
+
+
+def test_passthrough_seals_meta_only_when_peer_serving(tmp_path):
+    """compress=none + peer_serve: the .chunk file IS the payload, so sealing
+    stages only the meta sidecar (fingerprint cached for siblings)."""
+    data = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+    server = AckServer(ack_delay_s=0.002)
+    op, in_q, out_q, _, store = make_sender(
+        tmp_path, server.port, dedup=False, raw_forward=True, peer_serve=True, pipelined=True, max_streams=1
+    )
+    try:
+        (req,) = stage_chunks(store, [data])
+        in_q.put(req)
+        op.start_workers()
+        assert len(drain_n(out_q, 1)) == 1
+        cid = req.chunk.chunk_id
+        assert not store.sealed_path(cid).exists(), "passthrough must not copy the payload"
+        meta = json.loads(store.sealed_meta_path(cid).read_text())
+        assert meta["payload"] == "chunk"
+        assert len(meta["fingerprint"]) == 32
+    finally:
+        op.stop_workers()
+        server.close()
+
+
+# --------------------------------------------------- ChunkStore sealed staging
+
+
+def test_chunk_store_sealed_refcount_and_deferred_gc(tmp_path):
+    store = ChunkStore(str(tmp_path / "cs"))
+    meta = {"codec": 0, "flags": 0, "fingerprint": "ab" * 16, "raw_data_len": 9, "tenant": "t"}
+    store.seal_frame("c1", meta, b"wirebytes")
+    assert store.sealed_path("c1").read_bytes() == b"wirebytes"
+
+    r1 = store.sealed_open("c1")
+    r2 = store.sealed_open("c1")
+    assert r1 is not None and r2 is not None
+    assert r1.length == 9 and r2.meta["fingerprint"] == meta["fingerprint"]
+    assert store.sealed_stats() == {"sealed_entries": 1, "sealed_refs": 2}
+
+    store.sealed_discard("c1")  # chunk went terminal with borrows in flight
+    assert store.sealed_path("c1").exists(), "unlink must defer to the last close"
+    assert store.sealed_open("c1") is None, "doomed entries refuse new borrows"
+    assert os.pread(r1.fd, 9, 0) == b"wirebytes", "in-flight borrow keeps streaming"
+
+    r1.close()
+    assert store.sealed_path("c1").exists()
+    r2.close()
+    r2.close()  # idempotent
+    assert not store.sealed_path("c1").exists()
+    assert not store.sealed_meta_path("c1").exists()
+    assert store.sealed_stats() == {"sealed_entries": 0, "sealed_refs": 0}
+
+
+def test_chunk_store_meta_only_seal_serves_chunk_file(tmp_path):
+    store = ChunkStore(str(tmp_path / "cs"))
+    store.chunk_path("c2").write_bytes(b"payload==wire")
+    meta = {"codec": 0, "flags": 0, "fingerprint": "0" * 32, "raw_data_len": 13, "tenant": "t"}
+    store.seal_frame("c2", meta)  # wire=None: compress=none passthrough
+    assert not store.sealed_path("c2").exists()
+    ref = store.sealed_open("c2")
+    assert ref is not None
+    assert os.pread(ref.fd, ref.length, 0) == b"payload==wire"
+    ref.close()
+    # cross-process adoption: a fresh store over the same dir (pump worker)
+    # finds the on-disk meta sidecar
+    sibling = ChunkStore(str(tmp_path / "cs"), clean_stale=False)
+    ref2 = sibling.sealed_open("c2")
+    assert ref2 is not None and ref2.meta["payload"] == "chunk"
+    ref2.close()
+
+
+def test_chunk_store_adopted_fd_move_semantics(tmp_path):
+    store = ChunkStore(str(tmp_path / "cs"))
+    p = tmp_path / "staged"
+    p.write_bytes(b"x" * 8)
+    fd1 = os.open(p, os.O_RDONLY)
+    store.adopt_raw_fd("c3", fd1)
+    fd2 = os.open(p, os.O_RDONLY)
+    store.adopt_raw_fd("c3", fd2)  # replaces: fd1 must be closed by the store
+    with pytest.raises(OSError):
+        os.fstat(fd1)
+    got = store.take_raw_fd("c3")
+    assert got == fd2
+    assert store.take_raw_fd("c3") is None  # popped: ownership moved out
+    os.close(fd2)
+
+
+# ------------------------------------------------------- vectored codec send
+
+
+class RecordingSock:
+    """sendmsg-capable fake that forces partial sends and records every iovec
+    it was handed (object identity preserved for the copy-free assertion)."""
+
+    def __init__(self, partials):
+        self.partials = list(partials)  # byte counts to accept per call
+        self.calls = []  # list of tuples of bytes actually accepted
+        self.stream = bytearray()
+
+    def sendmsg(self, buffers):
+        bufs = [bytes(b) for b in buffers]
+        budget = self.partials.pop(0) if self.partials else sum(len(b) for b in bufs)
+        self.calls.append(tuple(len(b) for b in buffers))
+        taken = 0
+        for b in bufs:
+            take = min(len(b), budget - taken)
+            self.stream += b[:take]
+            taken += take
+            if taken >= budget:
+                break
+        return taken
+
+
+def test_send_vectored_resume_loop_is_copy_free():
+    header = bytes(range(86))
+    payload = rng.integers(0, 256, 10_000, dtype=np.uint8).tobytes()
+    sock = RecordingSock(partials=[3, 90, 4_000])  # tear mid-header, mid-payload
+    send_vectored(sock, header, payload)
+    assert bytes(sock.stream) == header + payload
+    # copy-free: the first syscall got BOTH buffers as separate iovec entries
+    # at their full lengths — never one concatenated header+payload buffer
+    assert sock.calls[0] == (86, 10_000)
+    assert all(len(c) <= 2 for c in sock.calls)
+    assert not any(c == (86 + 10_000,) for c in sock.calls)
+    assert len(sock.calls) == 4  # 3 partials + the final flush
+
+
+def test_send_vectored_falls_back_to_sendall_without_sendmsg():
+    class PlainSock:
+        def __init__(self):
+            self.sent = bytearray()
+
+        def sendall(self, b):
+            self.sent += bytes(b)
+
+    sock = PlainSock()
+    send_vectored(sock, b"HDR", b"PAYLOAD")
+    assert bytes(sock.sent) == b"HDRPAYLOAD"
+
+
+# --------------------------------------------------------- RawForwardEngine
+
+
+def _staged_source(tmp_path, data: bytes) -> RawFrameSource:
+    p = tmp_path / "frame.bin"
+    p.write_bytes(data)
+    fd = os.open(p, os.O_RDONLY)
+    return RawFrameSource(fd, len(data))
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        got = sock.recv(min(1 << 20, n - len(out)))
+        if not got:
+            break
+        out += got
+    return out
+
+
+def test_raw_engine_sendfile_and_mmap_paths_byte_identical(tmp_path):
+    data = rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    header = bytes(range(86))
+    for path in ("sendfile", "mmap"):
+        a, b = socket.socketpair()
+        got = {}
+
+        def reader():
+            got["bytes"] = _recv_exact(b, 86 + len(data))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        source = _staged_source(tmp_path, data)
+        try:
+            eng = RawForwardEngine()
+            if path == "sendfile":
+                eng._send_sendfile(a, header, source, -1)
+            else:
+                eng._send_mmap(a, header, source, -1)
+        finally:
+            source.release()
+            a.close()
+        t.join(timeout=10)
+        b.close()
+        assert got["bytes"] == header + data, f"{path} path corrupted the frame"
+
+
+def test_raw_source_read_all_matches_file_and_release_is_idempotent(tmp_path):
+    data = rng.integers(0, 256, 70_000, dtype=np.uint8).tobytes()
+    source = _staged_source(tmp_path, data)
+    assert source.read_all() == data
+    source.release()
+    source.release()  # idempotent
+
+
+def test_raw_engine_wraps_socket_death_in_raw_send_error(tmp_path):
+    a, b = socket.socketpair()
+    b.close()  # peer gone: sendmsg/sendfile must surface as RawSendError
+    source = _staged_source(tmp_path, b"x" * 4096)
+    try:
+        with pytest.raises(RawSendError):
+            RawForwardEngine().send(a, bytes(86), source)
+    finally:
+        source.release()
+        a.close()

@@ -101,7 +101,7 @@ class AckServer:
             pass
 
 
-def make_sender(tmp_path, port, *, dedup=True, n_workers=1, **kw):
+def make_sender(tmp_path, port, *, dedup=True, n_workers=1, codec_name="none", **kw):
     """A GatewaySenderOperator wired straight at an AckServer: the control
     plane (/servers + chunk pre-registration) is stubbed out, the data
     socket connects directly."""
@@ -123,7 +123,7 @@ def make_sender(tmp_path, port, *, dedup=True, n_workers=1, **kw):
         target_gateway_id="gw_test",
         target_host="127.0.0.1",
         target_control_port=0,
-        codec_name="none",
+        codec_name=codec_name,
         dedup=dedup,
         use_tls=False,
         **kw,
