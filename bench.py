@@ -1010,6 +1010,134 @@ def bench_pump_scaling() -> dict:
     }
 
 
+SPMD_DEVICE_COUNTS = (1, 2, 4, 8)
+SPMD_CHUNK_MB = int(os.environ.get("SKYPLANE_BENCH_SPMD_MB", "1"))
+
+# child body for one spmd sweep point: forced-host devices are armed through
+# the ENV (before any jax import — the whole reason this is a subprocess);
+# argv = [n_devices, chunk_bytes, reps]. Prints one JSON line.
+_SPMD_CHILD = """\
+import json, sys, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
+from skyplane_tpu.ops.cdc import CDCParams, cdc_and_fps_host
+from skyplane_tpu.parallel.datapath_spmd import default_mesh
+
+n = int(sys.argv[1])
+chunk_bytes = int(sys.argv[2])
+reps = int(sys.argv[3])
+assert len(jax.devices()) >= n, f"forced-host arming failed: {len(jax.devices())} < {n}"
+mesh = default_mesh(jax.devices()[:n]) if n > 1 else None
+params = CDCParams()
+runner = DeviceBatchRunner(cdc_params=params, max_batch=8, mesh=mesh)
+rng = np.random.default_rng(3)
+chunks = [rng.integers(0, 256, chunk_bytes, dtype=np.uint8) for _ in range(runner.max_batch)]
+
+def one_round():
+    results = [None] * len(chunks)
+    def sub(i):
+        h = runner.submit(chunks[i])
+        results[i] = (h.ends(), h.fps())
+    ts = [threading.Thread(target=sub, args=(i,)) for i in range(len(chunks))]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    return results
+
+results = one_round()  # warm-up: compiles the (sharded) kernels
+identical = all(
+    np.array_equal(np.asarray(e), np.asarray(re)) and list(f) == list(rf)
+    for (e, f), (re, rf) in zip(results, (cdc_and_fps_host(c, params) for c in chunks))
+)
+t0 = time.perf_counter()
+for _ in range(reps):
+    one_round()
+dt = time.perf_counter() - t0
+total = reps * sum(len(c) for c in chunks)
+print(json.dumps({
+    "n": n,
+    "gbps": round(total * 8 / 1e9 / dt, 3),
+    "mesh": "x".join(str(s) for s in mesh.shape.values()) if mesh is not None else "1x1",
+    "identical": bool(identical),
+}))
+"""
+
+
+def _main_mesh_label() -> str:
+    """The (data x seq) mesh label for THIS process's jax client ("1x1" when
+    sharding is not viable) — the required ``mesh`` artifact field."""
+    from skyplane_tpu.parallel.datapath_spmd import maybe_default_mesh
+
+    mesh = maybe_default_mesh()
+    return "x".join(str(s) for s in mesh.shape.values()) if mesh is not None else "1x1"
+
+
+def bench_spmd_scaling() -> dict:
+    """Mesh-sharded batch runner Gbps vs device count (ROADMAP item 1's
+    multi-chip scaling curve): the batched CDC+fingerprint path at 1/2/4/8
+    forced-host devices (``--xla_force_host_platform_device_count``, one
+    subprocess per point — the flag must land before any jax import), each
+    window submitted from max_batch concurrent threads exactly like gateway
+    sender workers. Each child verifies byte-identity against the host
+    kernels (``spmd_identical``) before the timed reps.
+
+    Device counts are capped at the runner's core count — forcing 8 "devices"
+    onto 1 core measures scheduler noise, not scaling — and the
+    check_bench_json gate arms only at ``spmd_devices_available >= 2``
+    (graceful small-runner downgrade, same pattern as the pump core gates).
+    Intra-op threads are pinned to 1 in EVERY child so the 1-device run
+    cannot silently spread across all cores and erase the curve. On real
+    TPU slices the same mesh path runs live in the gateway
+    (SKYPLANE_TPU_SPMD); the silicon row lands via scripts/device_profile.py.
+    """
+    from skyplane_tpu.parallel.datapath_spmd import force_host_devices_env
+
+    cores = os.cpu_count() or 1
+    avail = max(1, min(8, cores))
+    counts = [n for n in SPMD_DEVICE_COUNTS if n <= avail]
+    chunk_bytes = SPMD_CHUNK_MB << 20
+    reps = 3
+    by_devices = {}
+    mesh_label = "1x1"
+    identical = True
+    for n in counts:
+        env = force_host_devices_env(n)
+        # uniform intra-op pinning (see docstring): one compute thread per
+        # device in every child
+        env["XLA_FLAGS"] += " --xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1"
+        # per-batch host recompute would pollute the timed reps; the child
+        # does its own identity pass before timing
+        env.pop("SKYPLANE_TPU_SPMD_CHECK", None)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _SPMD_CHILD, str(n), str(chunk_bytes), str(reps)],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            log(f"WARN: spmd bench child for {n} device(s) hung; skipping")
+            continue
+        if proc.returncode != 0:
+            log(f"WARN: spmd bench child for {n} device(s) failed: {proc.stderr[-300:]}")
+            continue
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        by_devices[str(n)] = row["gbps"]
+        identical = identical and bool(row["identical"])
+        if n == counts[-1]:
+            mesh_label = row["mesh"]
+        log(f"spmd bench: {n} device(s) -> {row['gbps']} Gbps (mesh {row['mesh']})")
+    return {
+        "spmd_gbps_by_devices": by_devices,
+        "spmd_mesh": mesh_label,
+        "spmd_devices_available": avail,
+        "spmd_identical": identical,
+    }
+
+
 def bench_blast() -> dict:
     """Small loopback checkpoint blast (docs/blast.md): 1 source ->
     ``SKYPLANE_BENCH_BLAST_SINKS`` peered sink daemons over a planner-placed
@@ -1357,6 +1485,16 @@ def main() -> None:
         f"merged cores effective {pump['pump_cores_effective']}"
     )
 
+    # SPMD device scaling: the mesh-sharded batch runner at 1/2/4/8 forced-
+    # host devices (parallel/datapath_spmd.py) — ROADMAP item 1's multi-chip
+    # scaling curve; byte-identity verified in every child, monotonic device
+    # scaling gated by scripts/check_bench_json.py where cores allow
+    spmd = bench_spmd_scaling()
+    log(
+        f"spmd bench done: {spmd['spmd_gbps_by_devices']} Gbps by devices "
+        f"(mesh {spmd['spmd_mesh']}, {spmd['spmd_devices_available']} device(s) viable)"
+    )
+
     # checkpoint blast: source egress vs fan-out over a peered relay tree
     # (docs/blast.md) — the ratio must sit at ~1x regardless of sink count;
     # banked per round so the fan-out-vs-egress curve rides the trajectory
@@ -1392,6 +1530,12 @@ def main() -> None:
         "codec_ours": _effective_codec(ours_codec),
         "codec_baseline": base_label,
         "platform": dev_platform,
+        # device-count context (required on every artifact row since PR 18:
+        # check_bench_json refuses rows without it): how many devices THIS
+        # process's jax client saw, and the (data x seq) mesh the live batch
+        # runner would shard over ("1x1" = single-device)
+        "n_devices": len(jax.devices()),
+        "mesh": _main_mesh_label(),
         # device provenance: the live jax platform, or "cpu-fallback" when
         # the device probe/supervisor gave up (bounded busy-wait) — fallback
         # numbers are labeled, never silently compared against device rounds
@@ -1468,6 +1612,12 @@ def main() -> None:
         # ratio gate (raw >= 3x codec, downgraded on single-vCPU runners)
         # and the wire_raw_frames floor live in check_bench_json.py
         **raw_fwd,
+        # SPMD device scaling (parallel/datapath_spmd.py, docs/datapath-
+        # performance.md "SPMD device data path"): batched CDC+fingerprint
+        # Gbps at 1/2/4/8 forced-host devices, byte-identity verified per
+        # child; check_bench_json gates monotonic scaling (0.85 tolerance)
+        # and >=1.6x at 4 devices when spmd_devices_available allows
+        **spmd,
     }
     if base_lz4:
         # the honest reference-codec bar (BASELINE.json names LZ4, not zstd)

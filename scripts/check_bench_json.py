@@ -72,6 +72,17 @@ REQUIRED_TOP = (
     "raw_chunks",
     "raw_fanout",
     "raw_cores_available",
+    # device-count context: every bench/MULTICHIP artifact row carries the
+    # attached device count and (data x seq) mesh label since PR 18
+    "n_devices",
+    "mesh",
+    # SPMD device scaling (parallel/datapath_spmd.py, docs/datapath-
+    # performance.md "SPMD device data path"): mesh-sharded batch-runner Gbps
+    # by forced-host device count, byte-identity verified in every child
+    "spmd_gbps_by_devices",
+    "spmd_mesh",
+    "spmd_devices_available",
+    "spmd_identical",
 )
 #: bench/soak acceptance bound: source egress may exceed 1x the corpus only
 #: by healing re-sends and in-flight re-frames (docs/blast.md)
@@ -128,6 +139,25 @@ PUMP_PROC_KEYS = ("1", "2", "4")
 PUMP_MONOTONIC_TOLERANCE = 0.85
 MIN_PUMP_GBPS_AT_4 = 2.0
 MIN_PUMP_CORES_EFFECTIVE = 1.5
+# SPMD device scaling (parallel/datapath_spmd.py, docs/datapath-performance.md
+# "SPMD device data path"): the mesh-sharded batch runner swept at 1/2/4/8
+# forced-host devices must scale — monotonic within measurement tolerance,
+# and >= 1.6x at 4 devices vs 1 on runners with the cores to force them.
+# Small runners (spmd_devices_available < 2) downgrade gracefully to the
+# schema + byte-identity checks, same pattern as the pump core gates: a
+# 1-core container cannot demonstrate device scaling.
+SPMD_MONOTONIC_TOLERANCE = 0.85
+MIN_SPMD_SPEEDUP_AT_4 = 1.6
+# MULTICHIP dryrun artifact row (__graft_entry__.dryrun_multichip)
+REQUIRED_MULTICHIP = (
+    "metric",
+    "n_devices",
+    "mesh",
+    "prod_chunk_mb",
+    "prod_batch",
+    "ref_segments",
+    "bit_identical",
+)
 REQUIRED_COUNTERS = (
     "pool_hit_rate",
     "pool_hits",
@@ -853,6 +883,129 @@ def check_multijob(result: dict) -> int:
     return 0
 
 
+def _gate_spmd(result, tag: str):
+    """SPMD device-scaling gate, shared by the full bench artifact and the
+    standalone ``spmd_scaling`` row (devloop spmd-smoke). Returns the
+    human-readable note for the OK line on pass, or None after printing the
+    failure (caller returns 1). Gates arm progressively with
+    spmd_devices_available — the pump-gate downgrade pattern."""
+    spmd_g = result.get("spmd_gbps_by_devices")
+    if not isinstance(spmd_g, dict) or "1" not in spmd_g:
+        print(f"{tag}: spmd_gbps_by_devices must be a dict holding the 1-device point, got {spmd_g!r}", file=sys.stderr)
+        return None
+    bad = {k: v for k, v in spmd_g.items() if not isinstance(v, (int, float)) or v <= 0}
+    if bad:
+        print(f"{tag}: implausible spmd throughput(s): {bad}", file=sys.stderr)
+        return None
+    if result.get("spmd_identical") is not True:
+        print(f"{tag}: spmd sweep is not byte-identical to the host kernels (spmd_identical={result.get('spmd_identical')!r})", file=sys.stderr)
+        return None
+    avail = result.get("spmd_devices_available")
+    if not isinstance(avail, (int, float)) or avail < 1:
+        print(f"{tag}: implausible spmd_devices_available {avail!r}", file=sys.stderr)
+        return None
+    note = f"(devices_available={avail}: scaling gates downgraded)"
+    if avail >= 2:
+        if "2" not in spmd_g:
+            print(f"{tag}: spmd sweep missing the 2-device point on a {avail}-device runner", file=sys.stderr)
+            return None
+        if spmd_g["2"] < SPMD_MONOTONIC_TOLERANCE * spmd_g["1"]:
+            print(
+                f"{tag}: spmd throughput regressed 1->2 devices ({spmd_g['1']} -> {spmd_g['2']} Gbps) "
+                f"on a {avail}-device runner",
+                file=sys.stderr,
+            )
+            return None
+        note = f"(devices_available={avail}: 4-device gates downgraded)"
+    if avail >= 4:
+        if "4" not in spmd_g:
+            print(f"{tag}: spmd sweep missing the 4-device point on a {avail}-device runner", file=sys.stderr)
+            return None
+        if spmd_g["4"] < SPMD_MONOTONIC_TOLERANCE * spmd_g["2"]:
+            print(
+                f"{tag}: spmd throughput regressed 2->4 devices ({spmd_g['2']} -> {spmd_g['4']} Gbps) "
+                f"on a {avail}-device runner",
+                file=sys.stderr,
+            )
+            return None
+        speedup = spmd_g["4"] / spmd_g["1"]
+        if speedup < MIN_SPMD_SPEEDUP_AT_4:
+            print(
+                f"{tag}: spmd speedup at 4 devices is {round(speedup, 2)}x vs 1 device "
+                f"({spmd_g['1']} -> {spmd_g['4']} Gbps), below the {MIN_SPMD_SPEEDUP_AT_4}x acceptance floor",
+                file=sys.stderr,
+            )
+            return None
+        note = f"(mesh {result.get('spmd_mesh')}, {round(speedup, 2)}x at 4 devices)"
+    return note
+
+
+def _mesh_label_ok(mesh, n_devices) -> bool:
+    """A mesh label is "<data>x<seq>" whose product equals the device count."""
+    if not isinstance(mesh, str):
+        return False
+    parts = mesh.split("x")
+    if len(parts) != 2 or not all(p.isdigit() for p in parts):
+        return False
+    return int(parts[0]) * int(parts[1]) == n_devices
+
+
+def check_spmd(result) -> int:
+    """Standalone SPMD scaling row (devloop spmd-smoke: bench_spmd_scaling()
+    exported as one ``{"metric": "spmd_scaling", ...}`` line)."""
+    missing = [
+        k
+        for k in ("spmd_gbps_by_devices", "spmd_mesh", "spmd_devices_available", "spmd_identical")
+        if k not in result
+    ]
+    if missing:
+        print(f"spmd-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    note = _gate_spmd(result, "spmd-smoke")
+    if note is None:
+        return 1
+    print(f"spmd-smoke OK: {result['spmd_gbps_by_devices']} Gbps by devices {note}")
+    return 0
+
+
+def check_multichip(result) -> int:
+    """MULTICHIP dryrun artifact row (__graft_entry__.dryrun_multichip):
+    every row must carry the device-count context (n_devices + mesh — on
+    every bench/MULTICHIP artifact row since PR 18) and prove the
+    production-shape mesh run bit-identical to the host pipeline."""
+    missing = [k for k in REQUIRED_MULTICHIP if k not in result]
+    if missing:
+        print(f"multichip-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    n = result["n_devices"]
+    if not isinstance(n, int) or n < 1:
+        print(f"multichip-smoke: implausible n_devices {n!r}", file=sys.stderr)
+        return 1
+    if not _mesh_label_ok(result["mesh"], n):
+        print(
+            f"multichip-smoke: mesh label {result['mesh']!r} is not a (data x seq) factorization of "
+            f"{n} device(s)",
+            file=sys.stderr,
+        )
+        return 1
+    if result["bit_identical"] is not True:
+        print("multichip-smoke: mesh data path is not bit-identical to the host pipeline", file=sys.stderr)
+        return 1
+    if not isinstance(result["ref_segments"], int) or result["ref_segments"] <= 0:
+        print(
+            f"multichip-smoke: near-duplicate produced {result['ref_segments']!r} REF segments "
+            "(dedup inactive on the mesh path?)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"multichip-smoke OK: mesh {result['mesh']} over {n} device(s), "
+        f"{result['prod_batch']}x{result['prod_chunk_mb']}MiB production batch bit-identical, "
+        f"{result['ref_segments']} REF segments on the near-dup"
+    )
+    return 0
+
+
 def main(argv) -> int:
     if len(argv) != 2:
         print("usage: check_bench_json.py <bench-output-file>", file=sys.stderr)
@@ -888,6 +1041,10 @@ def main(argv) -> int:
         return check_service(result)
     if result.get("metric") == "blast_soak":
         return check_blast(result)
+    if result.get("metric") == "spmd_scaling":
+        return check_spmd(result)
+    if result.get("metric") == "multichip":
+        return check_multichip(result)
     missing = [k for k in REQUIRED_TOP if k not in result]
     counters = result.get("datapath_counters")
     if not isinstance(counters, dict):
@@ -1087,6 +1244,26 @@ def main(argv) -> int:
             )
             return 1
         raw_note = f"(cores_available={raw_cores}: ratio gate downgraded, {round(raw_g / codec_g, 2)}x codec)"
+    # device-count context (PR 18): every bench row names its device count
+    # and (data x seq) mesh; "1x1" is the unsharded single-device label
+    n_dev = result["n_devices"]
+    if not isinstance(n_dev, int) or n_dev < 1:
+        print(f"bench-smoke: implausible n_devices {n_dev!r}", file=sys.stderr)
+        return 1
+    if not _mesh_label_ok(result["mesh"], n_dev) and result["mesh"] != "1x1":
+        print(
+            f"bench-smoke: mesh label {result['mesh']!r} is not a (data x seq) factorization of "
+            f"{n_dev} device(s)",
+            file=sys.stderr,
+        )
+        return 1
+    # SPMD device-scaling gates (ISSUE 18, docs/datapath-performance.md
+    # "SPMD device data path"): positive Gbps at every swept device count,
+    # byte-identity vs the host kernels, monotonic scaling within tolerance
+    # where cores allow, and the 1.6x floor at 4 devices
+    spmd_note = _gate_spmd(result, "bench-smoke")
+    if spmd_note is None:
+        return 1
     print(
         f"bench-smoke OK: {result['value']} {result['unit']} encode, "
         f"{result['decode_gbps']} {result['unit']} decode on {result['platform']} "
@@ -1096,7 +1273,9 @@ def main(argv) -> int:
         f"{cores} cores effective, GIL wait {round(100.0 * gil, 1)}%, sampler overhead {p_overhead}%; "
         f"pump: {pump_g} Gbps by procs {pump_note}; "
         f"blast: {blast_ratio}x source egress over {result['blast_sinks']} sinks; "
-        f"raw-forward: {raw_g} vs {codec_g} Gbps, {result['wire_raw_frames']} frames spliced {raw_note}"
+        f"raw-forward: {raw_g} vs {codec_g} Gbps, {result['wire_raw_frames']} frames spliced {raw_note}; "
+        f"devices: {n_dev} (mesh {result['mesh']}); "
+        f"spmd: {result['spmd_gbps_by_devices']} Gbps by devices {spmd_note}"
     )
     return 0
 

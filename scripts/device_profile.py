@@ -52,7 +52,7 @@ def main() -> None:
     if marker:  # tell the wrapper we now hold the device (must not be killed)
         with open(marker, "w") as f:
             f.write(f"{devs[0].platform} {time.time()}\n")
-    emit("acquire", platform=devs[0].platform, seconds=round(time.time() - t0, 1))
+    emit("acquire", platform=devs[0].platform, seconds=round(time.time() - t0, 1), n_devices=len(devs))
     if devs[0].platform == "cpu":
         log("no accelerator; exiting")
         return
@@ -117,6 +117,29 @@ def main() -> None:
         f"steady single-chunk {run_s * 1e3:.0f} ms -> {gbps:.2f} Gbps/chunk")
     emit("runner", bucket_mb=bench_mod.CHUNK_MB, window=runner.max_batch,
          first_s=round(compile_s, 1), steady_ms=round(run_s * 1e3, 1), gbps_single=round(gbps, 2))
+
+    # stage 3b: the silicon row — ONE full FusedCDCFP batch at the production
+    # window, banked at first tunnel acquisition: bytes-hashed/s and the
+    # roofline fraction against the documented 400 GB/s HBM bandwidth, with
+    # the device-count context every artifact row carries since PR 18
+    mesh = runner.mesh
+    mesh_label = "x".join(str(s) for s in mesh.shape.values()) if mesh is not None else "1x1"
+    batch = np.stack([row] * runner.max_batch)
+    lens = [bucket] * runner.max_batch
+    runner._fused.dispatch(batch, lens).lanes()  # warm (the full-window program)
+    n_rep = 3
+    t = time.perf_counter()
+    for _ in range(n_rep):
+        runner._fused.dispatch(batch, lens).lanes()
+    batch_s = (time.perf_counter() - t) / n_rep
+    hashed_per_s = runner.max_batch * bucket / batch_s
+    log(f"silicon row: {runner.max_batch}x{bench_mod.CHUNK_MB}MiB batch {batch_s * 1e3:.0f} ms -> "
+        f"{hashed_per_s / 1e9:.2f} GB/s hashed ({100 * hashed_per_s / 400e9:.1f}% of 400 GB/s roofline), "
+        f"mesh {mesh_label}")
+    emit("silicon", platform=devs[0].platform, n_devices=len(devs), mesh=mesh_label,
+         bytes_hashed_per_s=round(hashed_per_s, 1),
+         roofline_fraction_400gbps=round(hashed_per_s / 400e9, 4),
+         batch_rows=runner.max_batch, bucket_mb=bench_mod.CHUNK_MB)
 
     # stage 4: pallas gear kernel standalone timing on device
     if pallas.get("gear"):

@@ -307,6 +307,30 @@ else
   echo "[devloop] raw-smoke clean; reports at $LOGDIR/raw_tests.out, $LOGDIR/raw_killswitch_tests.out" >>"$LOGDIR/devloop.log"
 fi
 
+# SPMD-smoke gate (CPU-only, ~1 min): the mesh-sharded device data path
+# (parallel/datapath_spmd.py, docs/datapath-performance.md "SPMD device data
+# path") — bench_spmd_scaling() sweeps the batched CDC+fingerprint runner at
+# 1/2/4/8 forced-host devices (capped at the runner's core count), each child
+# byte-identity-checked against the host kernels before its timed reps. The
+# spmd_scaling branch of check_bench_json.py gates monotonic device scaling
+# (0.85 tolerance) and the 1.6x floor at 4 devices, auto-armed at
+# spmd_devices_available >= 2 and gracefully downgraded on 1-device runners.
+# Like the other smokes: failures are logged LOUDLY but do not block device
+# profiling.
+JAX_PLATFORMS=cpu SKYPLANE_BENCH_SPMD_MB=1 python -c \
+  'import json, bench; print(json.dumps({"metric": "spmd_scaling", **bench.bench_spmd_scaling()}))' \
+  >"$LOGDIR/spmd_smoke.out" 2>"$LOGDIR/spmd_smoke.err"
+SPMD_RC=$?
+if [ "$SPMD_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/spmd_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  SPMD_RC=$?
+fi
+if [ "$SPMD_RC" -ne 0 ]; then
+  echo "[devloop] SPMD-SMOKE FAILURE (rc=$SPMD_RC) — mesh scaling, byte-identity, or schema gates regressed; see $LOGDIR/spmd_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] spmd-smoke clean; result at $LOGDIR/spmd_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 check_success() { # $1 = attempt number, $2 = attempt rc; records success only
   # for a CLEAN (rc=0) run that proves a TPU acquisition — an attempt that
   # acquired but crashed mid-profile must be retried, not recorded

@@ -180,14 +180,19 @@ class GatewayDaemon:
         except ValueError:
             logger.fs.warning("ignoring malformed SKYPLANE_TPU_BATCH_CHUNKS; using 8")
             tpu_batch = 8
-        if on_accelerator() and tpu_batch > 1:
+        from skyplane_tpu.parallel.datapath_spmd import maybe_default_mesh, spmd_mode
+
+        # SKYPLANE_TPU_SPMD=on forces the mesh-backed runner even off-
+        # accelerator (forced-host CPU devices); =off never builds a mesh
+        # (maybe_default_mesh returns None); auto shards when a viable mesh
+        # exists on an accelerator gateway.
+        mode = spmd_mode()
+        if tpu_batch > 1 and mode != "off" and (on_accelerator() or mode == "on"):
             from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
 
             # TPU-slice gateways: shard the batched kernels over ALL chips via
             # a (data, seq) mesh — the same SPMD path dryrun_multichip
             # validates — instead of running everything on chip 0
-            from skyplane_tpu.parallel.datapath_spmd import maybe_default_mesh
-
             mesh = maybe_default_mesh()
             self.batch_runner = DeviceBatchRunner(cdc_params=self.cdc_params, max_batch=tpu_batch, mesh=mesh)
             if mesh is not None:

@@ -95,6 +95,8 @@ PUMP_COUNTER_ZERO = {
     "chunks_outstanding": 0,  # gauge: shipped, no terminal outcome yet
     "chunks_requeued_on_death": 0,
     "ctrl_messages": 0,  # messages received from workers
+    "batch_rpcs_served": 0,  # codec batches workers shipped to the parent's device runner
+    "batch_rpc_errors": 0,  # parent-side batch RPC failures (worker fell back to host)
 }
 
 
@@ -146,10 +148,18 @@ class CtrlChannel:
         self._fds: List[int] = []
         self._closed = False
 
-    def send(self, msg: dict, fds: Tuple[int, ...] = ()) -> bool:
+    MAX_RAW = 1 << 30  # bound on a message's binary trailer (one chunk's bytes)
+
+    def send(self, msg: dict, fds: Tuple[int, ...] = (), raw=None) -> bool:
         """Serialize + send one message (thread-safe). Returns False when the
         peer is gone — callers treat that as worker/parent death, never an
-        exception on a hot path."""
+        exception on a hot path. ``raw`` (bytes-like) rides AFTER the JSON
+        frame under the same lock — the batch-RPC payload path: chunk bytes
+        and fingerprint digests cross without a base64/JSON copy. The frame
+        declares ``raw_len`` so recv() reunites them by construction."""
+        if raw is not None:
+            msg = dict(msg)
+            msg["raw_len"] = memoryview(raw).nbytes
         payload = json.dumps(msg, separators=(",", ":")).encode()
         data = struct.pack("!I", len(payload)) + payload
         with self._send_lock:
@@ -165,6 +175,9 @@ class CtrlChannel:
                 if sent < len(data):
                     # sklint: disable=socket-io-under-lock -- remainder of the same locally-drained frame
                     self.sock.sendall(data[sent:])
+                if raw is not None and memoryview(raw).nbytes:
+                    # sklint: disable=socket-io-under-lock,blocking-under-lock -- the declared binary trailer of the frame above; must stay atomic with it
+                    self.sock.sendall(raw)
                 return True
             except OSError:
                 return False
@@ -185,6 +198,21 @@ class CtrlChannel:
                         return None
                     n_fds = int(msg.get("n_fds", 0) or 0)
                     fds, self._fds = self._fds[:n_fds], self._fds[n_fds:]
+                    n_raw = int(msg.get("raw_len", 0) or 0)
+                    if n_raw:
+                        if n_raw > self.MAX_RAW:
+                            return None  # corrupt stream: treat as death
+                        while len(self._buf) < n_raw:
+                            try:
+                                data, more_fds, _flags, _addr = socket.recv_fds(self.sock, 1 << 20, 16)
+                            except OSError:
+                                return None
+                            if not data and not more_fds:
+                                return None
+                            self._buf += data
+                            self._fds.extend(more_fds)
+                        msg["_raw"] = bytes(self._buf[:n_raw])
+                        del self._buf[:n_raw]
                     return msg, fds
             try:
                 data, fds, _flags, _addr = socket.recv_fds(self.sock, 1 << 20, 16)
@@ -506,6 +534,114 @@ def merge_numeric_counters(base: dict, snaps: List[dict], rates: Tuple[str, ...]
     return out
 
 
+# --------------------------------------------------- parent-routed batches
+
+
+class _RemoteBatchHandle:
+    """Worker-side handle for one batch RPC in flight to the parent's device
+    runner. Blocking with the same 600 s backstop as BatchHandle; ``wait_ns``
+    accumulates actual blocked time for the datapath stall accounting."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._ends = None
+        self._fps: Optional[List[bytes]] = None
+        self._error: Optional[str] = None
+        self.wait_ns = 0
+
+    def _wait(self) -> None:
+        if not self._event.is_set():
+            t0 = time.perf_counter_ns()
+            self._event.wait(timeout=600)
+            self.wait_ns += time.perf_counter_ns() - t0
+        if not self._event.is_set():
+            raise TimeoutError("parent batch runner stalled")
+        if self._error is not None:
+            raise RuntimeError(f"parent batch runner failed: {self._error}")
+
+    def ends(self):
+        self._wait()
+        return self._ends
+
+    def fps(self) -> List[bytes]:
+        self._wait()
+        return self._fps
+
+
+class RemoteBatchRunner:
+    """Worker-side proxy for the PARENT daemon's DeviceBatchRunner: pump
+    workers pin a CPU jax platform (the device belongs to the parent), so
+    codec batches ship over the CtrlChannel as raw-trailer RPCs instead of
+    running on a private cold backend. N framing workers submitting
+    concurrently land in the parent runner's leader-batching window, which
+    shards the stacked batch over the mesh — cores multiply chips instead of
+    competing with them. Duck-types the DeviceBatchRunner surface
+    DataPathProcessor uses: ``remote``/``cdc_params``/``pool``/``counters``/
+    ``submit``. Parent death degrades to the exact host kernels, never an
+    error on the data path."""
+
+    remote = True
+
+    def __init__(self, chan: CtrlChannel, cdc_params):
+        from skyplane_tpu.ops.bufpool import BufferPool
+
+        self.chan = chan
+        self.cdc_params = cdc_params
+        self.pool = BufferPool()
+        self._lock = lockcheck.wrap(threading.Lock(), "RemoteBatchRunner._lock")
+        self._next_id = 0
+        self._pending: Dict[int, _RemoteBatchHandle] = {}
+        self._counters = {"batch_rpcs_sent": 0, "batch_rpc_fallbacks": 0}
+
+    def counters(self) -> dict:
+        with self._lock:
+            c = dict(self._counters)
+        c.update(self.pool.counters())
+        return c
+
+    def submit(self, arr) -> _RemoteBatchHandle:
+        import numpy as np
+
+        arr = np.ascontiguousarray(np.frombuffer(arr, np.uint8) if not isinstance(arr, np.ndarray) else arr)
+        handle = _RemoteBatchHandle()
+        with self._lock:
+            rpc_id = self._next_id
+            self._next_id += 1
+            self._pending[rpc_id] = handle
+            self._counters["batch_rpcs_sent"] += 1
+        if not self.chan.send({"type": "batch_rpc", "rpc_id": rpc_id}, raw=memoryview(arr)):
+            # parent gone (shutdown race): same bytes through the exact host
+            # kernels — bit-identical by the CDC determinism contract
+            from skyplane_tpu.ops.cdc import cdc_and_fps_host
+
+            with self._lock:
+                self._pending.pop(rpc_id, None)
+                self._counters["batch_rpc_fallbacks"] += 1
+            handle._ends, handle._fps = cdc_and_fps_host(arr, self.cdc_params)
+            handle._event.set()
+        return handle
+
+    def cdc_and_fps(self, arr, padded=None):
+        handle = self.submit(arr)
+        return handle.ends(), handle.fps()
+
+    def resolve(self, msg: dict) -> None:
+        """Apply one ``batch_result`` from the parent (recv-loop thread)."""
+        import numpy as np
+
+        with self._lock:
+            handle = self._pending.pop(msg.get("rpc_id"), None)
+        if handle is None:
+            return  # duplicate / post-fallback straggler
+        if msg.get("error"):
+            handle._error = str(msg["error"])
+        else:
+            handle._ends = np.asarray(msg.get("ends") or [], dtype=np.int64)
+            raw = msg.get("_raw") or b""
+            handle._fps = [bytes(raw[i * 16 : (i + 1) * 16]) for i in range(len(raw) // 16)]
+        handle._event.set()
+
+
 # ---------------------------------------------------------- receiver pump
 
 
@@ -750,6 +886,12 @@ def _sender_pump_class():
             self._retired_wire: List[dict] = []
             self._retired_datapath: List[dict] = []
             self.pool: Optional[PumpPool] = None
+            # parent-routed codec batches: workers RPC their chunk bytes to
+            # THIS process's (possibly mesh-sharded) device runner instead of
+            # running cold private CPU backends (built lazily on first RPC)
+            self._batch_rpc_pool = None
+            self._batch_rpcs_served = 0
+            self._batch_rpc_errors = 0
 
         # ---- lifecycle ----
 
@@ -776,6 +918,9 @@ def _sender_pump_class():
                 "source_gateway_id": self.source_gateway_id,
                 "raw_forward": self.raw_forward,
                 "push_s": _env_float(PUMP_PUSH_S_ENV, 0.25),
+                # the parent owns a device batch runner: workers proxy codec
+                # batches to it instead of pinning private CPU backends
+                "parent_batch": self.processor.batch_runner is not None,
             }
 
         def start_workers(self) -> None:
@@ -793,6 +938,8 @@ def _sender_pump_class():
 
         def stop_workers(self, timeout: float = 5.0) -> None:
             super().stop_workers(timeout)
+            if self._batch_rpc_pool is not None:
+                self._batch_rpc_pool.shutdown(wait=False)
             if self.pool is not None:
                 self.pool.stop(timeout_s=min(timeout, 5.0))
                 # whatever never reached a terminal outcome goes back to the
@@ -914,6 +1061,8 @@ def _sender_pump_class():
             kind = msg.get("type")
             if kind == "status":
                 self._on_terminal(w, msg)
+            elif kind == "batch_rpc":
+                self._serve_batch_rpc(w, msg)
             elif kind == "counters":
                 _absorb_counters(w, msg)
                 for ev in msg.get("window_events") or []:
@@ -943,6 +1092,42 @@ def _sender_pump_class():
                 self.chunk_store.log_chunk_state(req, ChunkState.failed, self.handle, w.idx)
             self.sched_release(req)
             self.pool.slot_event.set()
+
+        def _serve_batch_rpc(self, w: _WorkerHandle, msg: dict) -> None:
+            """Dispatch one worker codec batch onto the parent's device
+            runner. Runs the device call on an executor, NOT the pool reader
+            thread: concurrent RPCs from N workers must overlap so they land
+            in the same runner window and fill the mesh-sharded batch."""
+            rpc_id = msg.get("rpc_id")
+            raw = msg.pop("_raw", b"") or b""
+            with self._acct_lock:
+                if self._batch_rpc_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    runner = self.processor.batch_runner
+                    self._batch_rpc_pool = ThreadPoolExecutor(
+                        max_workers=max(2, getattr(runner, "max_batch", 8)),
+                        thread_name_prefix=f"{self.handle}-batch-rpc",
+                    )
+                pool = self._batch_rpc_pool
+            try:
+                pool.submit(self._run_batch_rpc, w, rpc_id, raw)
+            except RuntimeError:  # executor shut down: stopping — drop; the
+                pass  # worker's 600s backstop / parent-death fallback covers it
+
+        def _run_batch_rpc(self, w: _WorkerHandle, rpc_id, raw: bytes) -> None:
+            import numpy as np
+
+            try:
+                ends, fps = self.processor.batch_runner.cdc_and_fps(np.frombuffer(raw, np.uint8))
+                with self._acct_lock:
+                    self._batch_rpcs_served += 1
+                reply = {"type": "batch_result", "rpc_id": rpc_id, "ends": np.asarray(ends).tolist()}
+                w.chan.send(reply, raw=b"".join(fps))  # False = worker died; its pending RPC died with it
+            except Exception as err:  # noqa: BLE001 — the worker must unblock and fall back
+                with self._acct_lock:
+                    self._batch_rpc_errors += 1
+                w.chan.send({"type": "batch_result", "rpc_id": rpc_id, "error": repr(err)})
 
         def _on_worker_death(self, w: _WorkerHandle) -> None:
             # the shard-accounting truth table (docs/datapath-performance.md
@@ -1002,6 +1187,8 @@ def _sender_pump_class():
                 out["batches_shipped"] = self._batches_shipped
                 out["chunks_requeued_on_death"] = self._requeued_on_death
                 out["chunks_outstanding"] = len(self._outstanding)
+                out["batch_rpcs_served"] = self._batch_rpcs_served
+                out["batch_rpc_errors"] = self._batch_rpc_errors
             return out
 
         def profile_summaries(self) -> List[dict]:
@@ -1244,6 +1431,15 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
     cmin, cavg, cmax = cfg.get("cdc") or (4 * 1024, 16 * 1024, 64 * 1024)
     key = bytes(cfg["e2ee_key"]) if cfg.get("e2ee_key") else None
     store = ChunkStore(cfg["chunk_dir"], clean_stale=False)
+    # parent-routed batches: when the parent daemon owns a device batch
+    # runner, this worker's codec batches proxy to it over the CtrlChannel —
+    # N framing cores feed ONE (mesh-sharded) accelerator instead of N cold
+    # private CPU backends. Otherwise host kernels (see _pump_worker_main).
+    batch_runner = (
+        RemoteBatchRunner(chan, CDCParams(min_bytes=cmin, avg_bytes=cavg, max_bytes=cmax))
+        if cfg.get("parent_batch")
+        else None
+    )
     op = GatewaySenderOperator(
         handle=cfg["handle"],
         region=cfg.get("region", "local:local"),
@@ -1262,7 +1458,7 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
         cdc_params=CDCParams(min_bytes=cmin, avg_bytes=cavg, max_bytes=cmax),
         e2ee_key=key,
         use_tls=bool(cfg.get("use_tls")),
-        batch_runner=None,  # pump workers run host kernels (see _pump_worker_main)
+        batch_runner=batch_runner,
         window=int(cfg.get("window", 16)),
         window_bytes=int(cfg.get("window_bytes", 256 << 20)),
         api_token=cfg.get("api_token"),
@@ -1354,6 +1550,9 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
                 fds.clear()  # adopted: the reader must not close them
             for d in msg.get("reqs") or []:
                 inbox.put(ChunkRequest.from_dict(d))
+        elif kind == "batch_result":
+            if batch_runner is not None:
+                batch_runner.resolve(msg)
         elif kind == "retarget":
             op.retarget(msg["new_target_gateway_id"], msg["host"], int(msg["control_port"]))
         elif kind == "stop":

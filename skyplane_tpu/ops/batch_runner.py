@@ -44,6 +44,7 @@ numpy/native host path.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -128,8 +129,6 @@ class DeviceBatchRunner:
             # window-formation wait. 3 ms suits a locally attached chip;
             # behind a high-latency dispatch link (tunnel) a longer wait fills
             # windows better than it delays them — tune without code changes
-            import os
-
             try:
                 max_wait_ms = float(os.environ.get("SKYPLANE_TPU_BATCH_WAIT_MS", "3"))
             except ValueError:
@@ -166,7 +165,13 @@ class DeviceBatchRunner:
         # shared padded-buffer pool: submissions without a caller-provided
         # padded buffer draw from here and recycle after the batch dispatch
         self.pool = pool if pool is not None else BufferPool()
-        self._counters = {"batch_windows": 0, "batch_rows": 0, "batch_padded_rows": 0}
+        self._counters = {
+            "batch_windows": 0,
+            "batch_rows": 0,
+            "batch_padded_rows": 0,
+            "spmd_batches": 0,
+            "spmd_check_batches": 0,
+        }
         self._stage_failures: Dict[int, int] = {}  # bucket -> count (first occurrence logged)
         self._zero_rows: Dict[int, np.ndarray] = {}  # bucket -> shared READ-ONLY zero pad row
         self._dev_zero_rows: Dict[int, object] = {}  # bucket -> staged device zero row
@@ -207,6 +212,10 @@ class DeviceBatchRunner:
                 self._warn(f"rounding max_batch {self.max_batch} -> {new_batch} to divide {divisor} mesh shards")
                 self.max_batch = new_batch
         self._fused = FusedCDCFP(cdc_params, mesh=self.mesh, shard_axes=self.shard_axes, pool=self.pool)
+        # structural bit-identity assertion for the mesh path: every sharded
+        # batch is checked against the host recompute before any result
+        # leaves the runner (tests, dryruns, paranoid deployments)
+        self._spmd_check = os.environ.get("SKYPLANE_TPU_SPMD_CHECK", "0").strip().lower() in ("1", "on", "true", "yes")
 
     @staticmethod
     def _warn(msg: str) -> None:
@@ -234,6 +243,8 @@ class DeviceBatchRunner:
             c["stage_failures"] = sum(self._stage_failures.values())
         cap = c["batch_windows"] * self.max_batch
         c["batch_occupancy"] = round(c["batch_rows"] / cap, 4) if cap else 0.0
+        # numeric only: merge_numeric_counters sums these across pump workers
+        c["spmd_devices"] = int(np.prod(list(self.mesh.shape.values()))) if self.mesh is not None else 1
         c.update(self.pool.counters())
         c.update(self._fused.counters())
         return c
@@ -381,6 +392,11 @@ class DeviceBatchRunner:
                     rows = rows + [self._zero_row(bucket)] * n_pad_rows
                     lens = lens + [0] * n_pad_rows
                 pending = self._fused.dispatch(np.stack(rows), lens)
+                if self._spmd_check:
+                    # gate BEFORE ends leave the runner: a diverging shard
+                    # must surface as this window's error, not as corrupt
+                    # recipes three stages later
+                    self._check_mesh_identity(entries, pending)
             else:
                 # host-upload fallback for rows whose async staging failed:
                 # passing the numpy row lets jnp.stack do the transfer inside
@@ -419,10 +435,34 @@ class DeviceBatchRunner:
                 self._counters["batch_windows"] += 1
                 self._counters["batch_rows"] += len(entries)
                 self._counters["batch_padded_rows"] += n_pad_rows
+                if self.mesh is not None:
+                    self._counters["spmd_batches"] += 1
                 self._cond.notify_all()  # deferring leaders: this bucket drained
             for e in entries:
                 e.ends_ready.set()
                 e.done.set()
+
+    def _check_mesh_identity(self, entries: List[_Entry], pending) -> None:
+        """SKYPLANE_TPU_SPMD_CHECK: assert the mesh-sharded batch is
+        bit-identical to the host recompute. ``lanes()`` is cached, so the
+        eager readback here makes the later phase-2 call free; verified rows
+        get ``fps`` set directly, skipping lazy finalize."""
+        from skyplane_tpu.ops.cdc import cdc_and_fps_host
+
+        lanes = pending.lanes()
+        for i, e in enumerate(entries):
+            if pending.fallback[i] is not None:
+                continue  # overflow rows already ARE the exact host recompute
+            ends = pending.ends_rows[i]
+            fps = finalize_row(lanes[i], ends)
+            ref_ends, ref_fps = cdc_and_fps_host(e.arr[: e.n], self.cdc_params)
+            if not np.array_equal(np.asarray(ends), np.asarray(ref_ends)) or list(fps) != list(ref_fps):
+                raise AssertionError(
+                    f"SPMD mesh batch diverged from host recompute (bucket {len(e.arr)}, row {i}, n={e.n})"
+                )
+            e.fps = fps
+        with self._lock:
+            self._counters["spmd_check_batches"] += 1
 
     def _release_pooled(self, entries: List[_Entry]) -> None:
         for e in entries:

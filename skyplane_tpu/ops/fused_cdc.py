@@ -367,11 +367,14 @@ def make_sharded_kernels(mesh, params: CDCParams, bucket: int, pallas: bool = Fa
     """
     from jax.sharding import PartitionSpec as P
 
+    from skyplane_tpu.parallel.datapath_spmd import shard_map_compat
+
+    shard_map = shard_map_compat()
     cap = candidate_cap(bucket, params)
     n_slots = slots_cap(bucket, params)
     axes = tuple(shard_axes) if shard_axes else tuple(mesh.shape.keys())
     cand = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda b, l: _candidates_impl(b, l, mask_bits=params.mask_bits, cap=cap, _pallas=pallas),
             mesh=mesh,
             in_specs=(P(axes, None), P(axes)),
@@ -379,7 +382,7 @@ def make_sharded_kernels(mesh, params: CDCParams, bucket: int, pallas: bool = Fa
         )
     )
     fp = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda b, e: _fp_body(b, e, n_slots=n_slots),
             mesh=mesh,
             in_specs=(P(axes, None), P(axes, None)),

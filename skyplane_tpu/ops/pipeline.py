@@ -316,6 +316,15 @@ class DataPathProcessor:
         between the two so host work overlaps the in-flight device batch.
         Host and unbatched paths degenerate to both-ready-now.
         """
+        if self.batch_runner is not None and getattr(self.batch_runner, "remote", False):
+            # pump worker with parent-routed batches: the proxy ships the
+            # chunk to the parent daemon's (possibly mesh-sharded) runner
+            # over the CtrlChannel. Checked BEFORE on_accelerator(): the
+            # worker itself pins a CPU backend precisely because the parent
+            # owns the device.
+            assert self.batch_runner.cdc_params == self.cdc_params, "batch runner CDC params diverge from processor"
+            handle = self.batch_runner.submit(arr)
+            return _PhasedCDC(handle.ends(), handle.fps, wait_ns_fn=lambda: handle.wait_ns)
         if not self._on_accelerator():
             from skyplane_tpu.ops.cdc import cdc_and_fps_host
 

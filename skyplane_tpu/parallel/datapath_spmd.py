@@ -19,6 +19,8 @@ tags/fingerprints/literal compaction are fully local after the halo exchange
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Optional
 
 import jax
@@ -31,19 +33,77 @@ from skyplane_tpu.ops.fingerprint import segment_fingerprint_device
 from skyplane_tpu.ops.gear import GEAR_TABLE, GEAR_WINDOW, boundary_candidate_mask
 
 
+def shard_map_compat():
+    """``shard_map`` across the jax versions this repo runs on: top-level
+    ``jax.shard_map`` (>= 0.5) when present, else the ``jax.experimental``
+    form (0.4.x). One resolver so every kernel builder agrees."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
+
+
+def spmd_mode() -> str:
+    """Parse SKYPLANE_TPU_SPMD into one of "off" / "auto" / "on".
+
+    "off" disables mesh sharding entirely; "on" forces the mesh-backed runner
+    even off-accelerator (forced-host CPU devices — bench/CI); anything else
+    (including unset) is "auto": shard when maybe_default_mesh() finds a
+    viable mesh, single-device otherwise.
+    """
+    v = os.environ.get("SKYPLANE_TPU_SPMD", "auto").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v in ("1", "on", "true", "yes", "force"):
+        return "on"
+    return "auto"
+
+
+_warned_mesh_unavailable = False
+
+
 def maybe_default_mesh() -> Optional[Mesh]:
     """A (data, seq) mesh over the attached devices when sharding is viable
     (more than one device, power-of-two count), else None. Never raises —
-    a mesh is an optimization, not a requirement."""
+    a mesh is an optimization, not a requirement. Honors SKYPLANE_TPU_SPMD=off."""
+    global _warned_mesh_unavailable
+    if spmd_mode() == "off":
+        return None
     try:
         n = len(jax.devices())
         if n > 1 and (n & (n - 1)) == 0:
             return default_mesh()
     except Exception as e:  # noqa: BLE001 — no usable backend => unsharded
-        from skyplane_tpu.utils.logger import logger
+        if not _warned_mesh_unavailable:
+            _warned_mesh_unavailable = True
+            from skyplane_tpu.utils.logger import logger
 
-        logger.fs.warning(f"multi-device mesh unavailable ({e}); running single-device")
+            logger.fs.warning(f"multi-device mesh unavailable ({e}); running single-device")
     return None
+
+
+_FORCE_HOST_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
+
+
+def force_host_devices_env(n: int, base_env: Optional[dict] = None) -> dict:
+    """Environment for a child process that should see ``n`` forced-host CPU
+    devices. Spawn-safe: the returned dict must reach the child before any
+    JAX import (pass it to subprocess/spawn env=), because XLA reads
+    XLA_FLAGS exactly once at backend init. Existing force-host flags in the
+    inherited XLA_FLAGS are replaced, other flags preserved; JAX_PLATFORMS is
+    pinned to cpu so a TPU tunnel plugin never claims the child."""
+    env = dict(os.environ if base_env is None else base_env)
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = env.get("XLA_FLAGS", "")
+    if _FORCE_HOST_RE.search(flags):
+        flags = _FORCE_HOST_RE.sub(flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    env["XLA_FLAGS"] = flags
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
 
 
 def default_mesh(devices=None, data_parallel: Optional[int] = None) -> Mesh:
@@ -58,17 +118,19 @@ def default_mesh(devices=None, data_parallel: Optional[int] = None) -> Mesh:
     return Mesh(arr, axis_names=("data", "seq"))
 
 
-def _gear_hash_halo(chunk: jax.Array, axis_name: str) -> jax.Array:
+def _gear_hash_halo(chunk: jax.Array, axis_name: str, n_dev: int) -> jax.Array:
     """Per-shard gear hash with left-neighbor halo over ``axis_name``.
 
     chunk: [n_local] uint8 (this device's contiguous byte range).
+    ``n_dev`` is the static axis size, threaded from the mesh: ppermute's
+    perm list must be a Python value, and jax.lax.axis_size does not exist
+    on every jax this repo runs (0.4.x).
     Matches the unsharded ops.gear.gear_hash exactly: device 0's halo is
     zeros (ppermute leaves unmatched targets zero), which reproduces the
     zero-prefix semantics of the sequential recurrence.
     """
     table = jnp.asarray(GEAR_TABLE)
     g = table[chunk.astype(jnp.int32)]  # [n_local] uint32
-    n_dev = jax.lax.axis_size(axis_name)
     halo = jax.lax.ppermute(
         g[-(GEAR_WINDOW - 1) :],
         axis_name,
@@ -119,7 +181,7 @@ def make_spmd_datapath(
     def per_shard(batch_local: jax.Array):
         # batch_local: [B/data, n_local] uint8
         def one(chunk_local):
-            h = _gear_hash_halo(chunk_local, "seq")
+            h = _gear_hash_halo(chunk_local, "seq", seq)
             candidates = boundary_candidate_mask(h, mask_bits)
             tags, literals, n_lit = blockpack.encode_device(chunk_local, block_bytes=block_bytes)
             fp = fixed_stride_lanes(chunk_local, fp_seg_bytes, pallas=pallas)
@@ -127,7 +189,7 @@ def make_spmd_datapath(
 
         return jax.vmap(one)(batch_local)
 
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map_compat()(
         per_shard,
         mesh=mesh,
         in_specs=P("data", "seq"),

@@ -157,6 +157,71 @@ def test_pump_worker_kill_truth_table(tmp_path, pump_env):
         dst.stop()
 
 
+@pytest.mark.slow
+def test_pump_batch_work_routes_to_parent_mesh_runner(tmp_path, pump_env, monkeypatch):
+    """ISSUE 18 acceptance: SKYPLANE_TPU_SPMD=on + 2 pump procs + a 4-device
+    (2x2) mesh — CPU-pinned sender workers ship their codec batch work to
+    the PARENT's mesh-sharded device runner over the control channel instead
+    of pinning cold private backends. The corpus lands byte-identical, the
+    batch rows are counted on the parent runner (with the structural
+    SPMD_CHECK armed), and a mid-transfer worker SIGKILL requeues its
+    in-flight work uncounted — no chunk ever consumes retry budget."""
+    import jax
+
+    from skyplane_tpu.parallel import datapath_spmd
+
+    monkeypatch.setenv("SKYPLANE_TPU_SPMD", "on")
+    monkeypatch.setenv("SKYPLANE_TPU_BATCH_CHUNKS", "4")
+    monkeypatch.setenv("SKYPLANE_TPU_SPMD_CHECK", "1")
+    monkeypatch.setattr(
+        datapath_spmd,
+        "maybe_default_mesh",
+        lambda: datapath_spmd.default_mesh(jax.devices()[:4], data_parallel=2),
+    )
+    src_file = _corpus(tmp_path, 8, seed=31)
+    dst_file = tmp_path / "out" / "dst.bin"
+    src, dst = make_pair(tmp_path, compress="none", dedup=True, encrypt=False, use_tls=False, num_connections=2)
+    try:
+        runner = src.daemon.batch_runner
+        assert runner is not None, "SKYPLANE_TPU_SPMD=on must build the parent device runner"
+        assert runner.mesh is not None and dict(runner.mesh.shape) == {"data": 2, "seq": 2}
+        ids = dispatch_file(src, src_file, dst_file, chunk_bytes=256 << 10)
+        sender_ops = [op for op in src.daemon.operators if hasattr(op, "pool") and op.pool is not None]
+        assert sender_ops, "pump sender operator missing"
+        # let batch RPCs flow (the first one pays the mesh compile), then
+        # SIGKILL a sender worker with work in flight
+        deadline = time.time() + 180
+        while time.time() < deadline and sender_ops[0]._batch_rpcs_served == 0:
+            time.sleep(0.05)
+        assert sender_ops[0]._batch_rpcs_served > 0, "no codec batch reached the parent runner"
+        os.kill(sender_ops[0].pool.live_workers()[0].proc.pid, signal.SIGKILL)
+        wait_complete(src, ids, timeout=300)
+        wait_complete(dst, ids, timeout=300)
+        deadline = time.time() + 10
+        while time.time() < deadline and dst_file.read_bytes() != src_file.read_bytes():
+            time.sleep(0.2)
+        assert dst_file.read_bytes() == src_file.read_bytes()
+        pump_src = src.daemon._pump_counters()
+        assert pump_src["batch_rpcs_served"] >= 1
+        assert pump_src["worker_deaths"] >= 1 and pump_src["worker_respawns"] >= 1
+        # the batch work is counter-asserted on the PARENT's runner: every
+        # served RPC became a row in its (mesh-sharded, identity-checked)
+        # windows
+        c = runner.counters()
+        assert c["batch_rows"] >= pump_src["batch_rpcs_served"]
+        assert c["spmd_batches"] >= 1 and c["spmd_check_batches"] >= 1
+        assert c["spmd_devices"] == 4
+        # the death-requeue went through the uncounted path: retry budgets
+        # untouched, nothing reads 'failed', and the sink holds exactly one
+        # registration per chunk id
+        final = src.get("chunk_status_log", timeout=30).json()["chunk_status"]
+        assert not any(state == "failed" for state in final.values())
+        assert _unique_sink_registrations(dst) == 0
+    finally:
+        src.stop()
+        dst.stop()
+
+
 def test_pump_matches_inprocess_output(tmp_path, pump_env, monkeypatch):
     """The same corpus through the pump (2 procs) and through the default
     in-process plane (SKYPLANE_TPU_PUMP_PROCS=0) lands byte-identical files

@@ -132,8 +132,12 @@ def test_mesh_axis_selection_bounds_window_inflation():
     import numpy as np
     from jax.sharding import Mesh
 
-    if not hasattr(jax, "shard_map"):
-        pytest.skip("jax.shard_map unavailable in this jax version (environment-caused)")
+    try:
+        from skyplane_tpu.parallel.datapath_spmd import shard_map_compat
+
+        shard_map_compat()
+    except ImportError:
+        pytest.skip("shard_map unavailable in this jax version (environment-caused)")
 
     devs = np.asarray(jax.devices()[:8])
     mesh = Mesh(devs.reshape(2, 4), axis_names=("data", "seq"))

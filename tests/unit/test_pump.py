@@ -85,6 +85,138 @@ def test_ctrl_channel_corrupt_length_is_death_not_oom():
     rx.close()
 
 
+def test_ctrl_channel_raw_trailer_roundtrip():
+    """The batch-RPC payload path: a binary trailer rides AFTER the JSON
+    frame, reunited by the declared raw_len — neighbors unaffected, fds
+    still aligned to their declaring message."""
+    tx, rx = _channel_pair()
+    r1, w1 = socket.socketpair()
+    blob = os.urandom(200_000)  # multi-recv trailer
+    try:
+        tx.send({"type": "plain1"})
+        tx.send({"type": "batch_rpc", "rpc_id": 7}, raw=blob)
+        tx.send({"type": "conn", "n_fds": 1}, fds=(w1.fileno(),), raw=b"xy")
+        tx.send({"type": "plain2"})
+        m1, f1 = rx.recv()
+        m2, f2 = rx.recv()
+        m3, f3 = rx.recv()
+        m4, f4 = rx.recv()
+        assert (m1["type"], f1) == ("plain1", []) and "_raw" not in m1
+        assert m2["rpc_id"] == 7 and m2["raw_len"] == len(blob) and m2["_raw"] == blob
+        assert m3["_raw"] == b"xy" and len(f3) == 1
+        assert (m4["type"], f4) == ("plain2", [])
+        os.close(f3[0])
+    finally:
+        for s in (r1, w1):
+            try:
+                s.close()
+            except OSError:
+                pass
+        tx.close()
+        rx.close()
+
+
+def test_ctrl_channel_oversized_raw_is_death_not_oom():
+    import json as _json
+    import struct as _struct
+
+    a, b = socket.socketpair()
+    rx = CtrlChannel(b)
+    payload = _json.dumps({"type": "batch_rpc", "raw_len": CtrlChannel.MAX_RAW + 1}).encode()
+    a.sendall(_struct.pack("!I", len(payload)) + payload)
+    assert rx.recv() is None  # corrupt stream: treated as peer death
+    a.close()
+    rx.close()
+
+
+# ------------------------------------------------- parent-routed batches
+
+
+def _remote_runner_params():
+    from skyplane_tpu.ops.cdc import CDCParams
+
+    return CDCParams(min_bytes=1024, avg_bytes=4096, max_bytes=16384)
+
+
+def test_remote_batch_runner_matches_host_kernels():
+    """Worker-side proxy end to end over a real socketpair: a parent thread
+    serves batch RPCs with the exact host kernels; the proxy's results must
+    be bit-identical and its duck-typed runner surface intact."""
+    import numpy as np
+
+    from skyplane_tpu.gateway.pump import RemoteBatchRunner
+    from skyplane_tpu.ops.cdc import cdc_and_fps_host
+
+    params = _remote_runner_params()
+    wchan, pchan = _channel_pair()
+    runner = RemoteBatchRunner(wchan, params)
+    assert runner.remote is True and runner.cdc_params == params
+
+    def parent():  # the parent's _serve_batch_rpc, minus the executor
+        while True:
+            got = pchan.recv()
+            if got is None:
+                return
+            msg, _fds = got
+            arr = np.frombuffer(msg["_raw"], np.uint8)
+            ends, fps = cdc_and_fps_host(arr, params)
+            pchan.send(
+                {"type": "batch_result", "rpc_id": msg["rpc_id"], "ends": np.asarray(ends).tolist()},
+                raw=b"".join(fps),
+            )
+
+    def resolver():  # the worker recv loop's batch_result branch
+        while True:
+            got = wchan.recv()
+            if got is None:
+                return
+            msg, _fds = got
+            if msg.get("type") == "batch_result":
+                runner.resolve(msg)
+
+    threads = [threading.Thread(target=parent, daemon=True), threading.Thread(target=resolver, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        rng = np.random.default_rng(33)
+        chunks = [rng.integers(0, 256, 50_000, dtype=np.uint8) for _ in range(3)]
+        chunks.append(np.zeros(20_000, np.uint8))  # zero-extent row
+        results = [runner.cdc_and_fps(c) for c in chunks]
+        for chunk, (ends, fps) in zip(chunks, results):
+            want_ends, want_fps = cdc_and_fps_host(chunk, params)
+            np.testing.assert_array_equal(ends, want_ends)
+            assert fps == want_fps
+        c = runner.counters()
+        assert c["batch_rpcs_sent"] == len(chunks)
+        assert c["batch_rpc_fallbacks"] == 0
+    finally:
+        wchan.close()
+        pchan.close()
+        for t in threads:
+            t.join(timeout=10)
+
+
+def test_remote_batch_runner_dead_parent_falls_back():
+    """Parent gone mid-shutdown: submit() must complete via the exact host
+    kernels (bit-identical by CDC determinism) instead of hanging a worker."""
+    import numpy as np
+
+    from skyplane_tpu.gateway.pump import RemoteBatchRunner
+    from skyplane_tpu.ops.cdc import cdc_and_fps_host
+
+    params = _remote_runner_params()
+    wchan, pchan = _channel_pair()
+    pchan.close()  # peer death before the RPC
+    runner = RemoteBatchRunner(wchan, params)
+    chunk = np.random.default_rng(34).integers(0, 256, 30_000, dtype=np.uint8)
+    ends, fps = runner.cdc_and_fps(chunk)
+    want_ends, want_fps = cdc_and_fps_host(chunk, params)
+    np.testing.assert_array_equal(ends, want_ends)
+    assert fps == want_fps
+    assert runner.counters()["batch_rpc_fallbacks"] == 1
+    wchan.close()
+
+
 # ----------------------------------------------------------------- merging
 
 

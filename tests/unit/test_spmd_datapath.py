@@ -9,8 +9,18 @@ import jax.numpy as jnp
 from skyplane_tpu.ops.pipeline import datapath_step
 from skyplane_tpu.parallel.datapath_spmd import default_mesh, make_spmd_datapath
 
+def _have_shard_map() -> bool:
+    try:
+        from skyplane_tpu.parallel.datapath_spmd import shard_map_compat
+
+        shard_map_compat()
+        return True
+    except ImportError:
+        return False
+
+
 requires_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"), reason="jax.shard_map unavailable in this jax version (environment-caused)"
+    not _have_shard_map(), reason="shard_map unavailable in this jax version (environment-caused)"
 )
 
 rng = np.random.default_rng(11)
@@ -108,6 +118,104 @@ def test_meshed_batch_runner_matches_host_path(mesh):
         want_fps = segment_fingerprints_host_batch(chunk, want_ends)
         np.testing.assert_array_equal(ends, want_ends)
         assert fps == want_fps
+
+
+@requires_shard_map
+@pytest.mark.parametrize("n_devices,data_parallel", [(2, 1), (4, 2), (8, 2)], ids=["1x2", "2x2", "2x4"])
+def test_meshed_runner_bit_identity_across_meshes(n_devices, data_parallel, monkeypatch):
+    """ISSUE 18: the mesh-backed runner must be bit-identical to the host
+    kernels on every viable mesh shape — 1x2, 2x2 and 2x4 — including a
+    window that needs batch-dim padding (3 submissions into a 4-row window)
+    and a near-duplicate corpus (the dedup REF workload). The structural
+    assertion itself (SKYPLANE_TPU_SPMD_CHECK) is armed, so a diverging
+    shard fails inside the runner, not in this test's comparisons."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from skyplane_tpu.ops.batch_runner import DeviceBatchRunner
+    from skyplane_tpu.ops.cdc import CDCParams, cdc_segment_ends
+    from skyplane_tpu.ops.fingerprint import segment_fingerprints_host_batch
+    from skyplane_tpu.parallel.datapath_spmd import default_mesh
+
+    monkeypatch.setenv("SKYPLANE_TPU_SPMD_CHECK", "1")
+    cdc = CDCParams(min_bytes=1024, avg_bytes=4096, max_bytes=16384)
+    mesh = default_mesh(jax.devices()[:n_devices], data_parallel=data_parallel)
+    assert dict(mesh.shape) == {"data": data_parallel, "seq": n_devices // data_parallel}
+    runner = DeviceBatchRunner(cdc_params=cdc, max_batch=4, max_wait_ms=50.0, mesh=mesh)
+    local = np.random.default_rng(21)
+    base = local.integers(0, 256, size=48_000, dtype=np.uint8)  # non-power-of-two -> padded bucket
+    near_dup = base.copy()
+    near_dup[1000:1100] = local.integers(0, 256, 100, dtype=np.uint8)
+    zeros_head = base.copy()
+    zeros_head[: len(base) // 3] = 0
+    corpus = [base, near_dup, zeros_head]  # 3 rows -> one zero pad row in the 4-row window
+    with ThreadPoolExecutor(max_workers=len(corpus)) as pool:
+        results = list(pool.map(lambda c: runner.cdc_and_fps(c), corpus))
+    for chunk, (ends, fps) in zip(corpus, results):
+        want_ends = cdc_segment_ends(chunk, cdc)
+        np.testing.assert_array_equal(ends, want_ends)
+        assert fps == segment_fingerprints_host_batch(chunk, want_ends)
+    # the near-dup shares almost every segment digest with its base — the
+    # property the dedup index turns into REF spans downstream
+    base_fps, dup_fps = set(results[0][1]), set(results[1][1])
+    assert len(base_fps & dup_fps) > len(base_fps) // 2
+    c = runner.counters()
+    assert c["spmd_devices"] == n_devices
+    assert c["spmd_batches"] >= 1
+    assert c["spmd_check_batches"] >= 1, "the structural bit-identity assertion must have run"
+    assert c["batch_padded_rows"] >= 1, "3 rows into a 4-row mesh window must pad"
+
+
+def test_spmd_mode_parsing(monkeypatch):
+    from skyplane_tpu.parallel.datapath_spmd import spmd_mode
+
+    monkeypatch.delenv("SKYPLANE_TPU_SPMD", raising=False)
+    assert spmd_mode() == "auto"
+    for raw, want in (("0", "off"), ("off", "off"), ("no", "off"), ("1", "on"),
+                      ("ON", "on"), ("force", "on"), ("auto", "auto"), ("bogus", "auto")):
+        monkeypatch.setenv("SKYPLANE_TPU_SPMD", raw)
+        assert spmd_mode() == want, raw
+
+
+def test_maybe_default_mesh_off_and_memoized_warning(monkeypatch):
+    """SKYPLANE_TPU_SPMD=off always yields None; a broken backend warns ONCE
+    per process (the warning is memoized), then stays silent."""
+    from skyplane_tpu.parallel import datapath_spmd
+
+    monkeypatch.setenv("SKYPLANE_TPU_SPMD", "off")
+    assert datapath_spmd.maybe_default_mesh() is None
+    monkeypatch.delenv("SKYPLANE_TPU_SPMD", raising=False)
+
+    warnings = []
+    monkeypatch.setattr(datapath_spmd, "_warned_mesh_unavailable", False)
+    monkeypatch.setattr(
+        datapath_spmd.jax, "devices", lambda: (_ for _ in ()).throw(RuntimeError("no backend"))
+    )
+    from skyplane_tpu.utils.logger import logger
+
+    monkeypatch.setattr(logger.fs, "warning", lambda msg, *a, **k: warnings.append(msg))
+    assert datapath_spmd.maybe_default_mesh() is None
+    assert datapath_spmd.maybe_default_mesh() is None
+    assert len(warnings) == 1, f"mesh-unavailable warning must be memoized per process, got {warnings}"
+
+
+def test_force_host_devices_env(monkeypatch):
+    """The spawn-safe harness helper: XLA_FLAGS gains (or replaces) the
+    forced-host device count, other flags survive, JAX_PLATFORMS pins cpu,
+    and the caller's env dict is never mutated."""
+    from skyplane_tpu.parallel.datapath_spmd import force_host_devices_env
+
+    base = {"XLA_FLAGS": "--xla_cpu_foo=1 --xla_force_host_platform_device_count=8", "PATH": "/bin"}
+    env = force_host_devices_env(4, base_env=base)
+    assert env["XLA_FLAGS"] == "--xla_cpu_foo=1 --xla_force_host_platform_device_count=4"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["PATH"] == "/bin"
+    assert base["XLA_FLAGS"].endswith("count=8"), "base env must not be mutated"
+    env2 = force_host_devices_env(2, base_env={"PATH": "/bin"})
+    assert env2["XLA_FLAGS"] == "--xla_force_host_platform_device_count=2"
+    # default base: the process environment (conftest pins 8 virtual devices)
+    env3 = force_host_devices_env(4)
+    assert "--xla_force_host_platform_device_count=4" in env3["XLA_FLAGS"]
+    assert env3["XLA_FLAGS"].count("xla_force_host_platform_device_count") == 1
 
 
 @requires_shard_map
