@@ -131,8 +131,22 @@ def probe_device() -> str:
             )
             out = proc.stdout.strip()
             if proc.returncode == 0 and out == "busy":
-                log(f"probe {i}: tunnel lock contended; waiting...")
-                time.sleep(10)
+                # the child lost the lock race after the parent's tunnel_busy()
+                # check said free — SAME busy budget as the branch above, or a
+                # run contending with a wedged holder alternates between the
+                # two branches and spins past every deadline (BENCH_r05 rc=124:
+                # busy_waited never accrued here, so the cap never fired)
+                if busy_waited >= busy_budget_s:
+                    log(
+                        f"probe {i}: tunnel lock still contended after {busy_waited:.0f}s of waiting; "
+                        "falling back to the CPU backend (device: cpu-fallback)"
+                    )
+                    PROBE_FALLBACK = True
+                    return "cpu"
+                log(f"probe {i}: tunnel lock contended; waiting (bounded)...")
+                wait = min(10.0, busy_budget_s - busy_waited)
+                time.sleep(wait)
+                busy_waited += wait
                 continue
             if proc.returncode == 0 and out:
                 log(f"device probe ok on attempt {i}: platform={out}")

@@ -30,7 +30,13 @@ Three result shapes are recognized, dispatched on the ``metric`` field:
     relay tree, one relay hard-killed mid-blast and healed (replacement +
     retarget + re-drive), every sink byte-identical, source egress
     counter-measured at <= 1.5x the corpus, zero acked-chunk loss, zero
-    duplicate sink registrations (docs/blast.md).
+    duplicate sink registrations (docs/blast.md);
+  * scripts/soak_dedup_fabric.py results (``metric: fabric_soak``): the
+    dedup-fabric soak — two gateway pairs sync overlapping corpora through
+    one consistent-hash ring; the warm re-send probe must hit >=90%
+    cross-gateway REFs with >=1 peer fetch served, a cross-shard NACK rate
+    under the PR-13 literal-resend tolerance, byte-identical outputs, and
+    bounded fd growth (docs/dedup-fabric.md).
 
 Exit 0 iff the result parses and every required key is present; used by the
 bench-smoke, multijob-smoke, and chaos-smoke steps in scripts/devloop.sh so a
@@ -211,6 +217,35 @@ REQUIRED_MULTIJOB = (
 # every tenant's accounting entry must carry these keys
 REQUIRED_TENANT_KEYS = ("chunks_registered", "bytes_registered", "bytes_delivered")
 
+# dedup-fabric soak result (scripts/soak_dedup_fabric.py / docs/dedup-fabric.md)
+REQUIRED_FABRIC = (
+    "metric",
+    "value",
+    "unit",
+    "fabric_members",
+    "fabric_gossip_fps",
+    "fabric_overlap_segments",
+    "fabric_overlap_refs",
+    "fabric_overlap_ref_rate",
+    "fabric_warm_segments",
+    "fabric_warm_refs",
+    "fabric_warm_hit_rate",
+    "fabric_warm_hit_floor",
+    "fabric_source_literals_warm",
+    "fabric_peer_fetch_hits",
+    "fabric_peer_fetch_timeouts",
+    "fabric_pushes_sent",
+    "fabric_lands",
+    "fabric_land_rejects",
+    "fabric_cross_shard_nacks",
+    "fabric_cross_shard_nack_rate",
+    "fabric_nack_rate_bound",
+    "fabric_byte_identical",
+    "fabric_warm_seconds",
+    "process_open_fds_start",
+    "process_open_fds_end",
+)
+
 # chaos soak result (scripts/soak_chaos.py / docs/fault-injection.md)
 REQUIRED_CHAOS = (
     "metric",
@@ -279,6 +314,15 @@ REQUIRED_CHAOS = (
     "pump_acked_chunks_lost",
     "pump_duplicate_registrations",
     "pump_seconds",
+    # dedup-fabric scenario (docs/dedup-fabric.md): with fabric.peer_fetch
+    # dropping every fetch, the warm cross-gateway re-send must heal through
+    # NACK -> literal resend, byte-identical, with zero peer-fetch hits
+    "fabric_ok",
+    "fabric_faults_fired",
+    "fabric_nacks",
+    "fabric_peer_fetch_hits",
+    "fabric_byte_identical",
+    "fabric_seconds",
 )
 #: post-recovery completion rate must reach this fraction of the pre-kill
 #: rate once the replacement joins ("within 20%" of pre-kill throughput)
@@ -696,6 +740,24 @@ def check_chaos(result: dict) -> int:
             file=sys.stderr,
         )
         return 1
+    if result["fabric_ok"] is not True:
+        print(
+            "chaos-smoke: dedup-fabric scenario failed — "
+            f"faults_fired={result.get('fabric_faults_fired')} "
+            f"nacks={result.get('fabric_nacks')} "
+            f"peer_fetch_hits={result.get('fabric_peer_fetch_hits')} "
+            f"byte_identical={result.get('fabric_byte_identical')} "
+            f"error={result.get('fabric_error')}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["fabric_faults_fired"] < 1 or result["fabric_nacks"] < 1:
+        print(
+            f"chaos-smoke: fabric scenario was vacuous — {result['fabric_faults_fired']} fault(s) "
+            f"fired, {result['fabric_nacks']} NACK(s); the drop never forced the heal path",
+            file=sys.stderr,
+        )
+        return 1
     overhead = result["lockcheck_overhead_pct"]
     if not isinstance(overhead, (int, float)) or overhead < 0 or overhead >= MAX_LOCKCHECK_OVERHEAD_PCT:
         print(
@@ -737,7 +799,9 @@ def check_chaos(result: dict) -> int:
         f"drain {result['drain_seconds']}s/{result['drain_deadline_s']}s with 0 acked chunks lost, "
         f"{result['replan_applied_events']} replan(s) applied over {result['replan_stream_retargets']} stream cutover(s); "
         f"pump: {result['pump_worker_deaths']} worker crash(es) absorbed in {result['pump_seconds']}s "
-        f"({result['pump_respawns']} respawn(s), {result['pump_requeued_chunks']} chunk(s) requeued, byte-identical)"
+        f"({result['pump_respawns']} respawn(s), {result['pump_requeued_chunks']} chunk(s) requeued, byte-identical); "
+        f"fabric: {result['fabric_faults_fired']} dropped peer fetch(es) healed via "
+        f"{result['fabric_nacks']} NACK(s), byte-identical"
         + (
             f"; lockcheck: {result['lockcheck_acquisitions']} acquisitions over "
             f"{result['lockcheck_locks']} locks, {result['lockcheck_edges']} order edge(s) acyclic, "
@@ -879,6 +943,69 @@ def check_multijob(result: dict) -> int:
         f"multijob-smoke OK: {result['n_jobs']} jobs, {result['value']} {result['unit']} aggregate, "
         f"per-tenant max/min {ratio} (bound {bound}), index RSS {result['index_rss_bytes']:.0f}B, "
         f"fd growth {fd_growth}"
+    )
+    return 0
+
+
+def check_fabric(result: dict) -> int:
+    missing = [k for k in REQUIRED_FABRIC if k not in result]
+    if missing:
+        print(f"fabric-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    if result["fabric_byte_identical"] is not True:
+        print("fabric-smoke: a phase output was NOT byte-identical to its corpus", file=sys.stderr)
+        return 1
+    # vacuous-run guards: the probe must have actually exercised the fabric
+    if result["fabric_warm_segments"] < 1 or result["fabric_gossip_fps"] < 1:
+        print(
+            f"fabric-smoke: vacuous run — warm_segments={result['fabric_warm_segments']}, "
+            f"gossip_fps={result['fabric_gossip_fps']}",
+            file=sys.stderr,
+        )
+        return 1
+    if result["fabric_peer_fetch_hits"] < 1:
+        print(
+            "fabric-smoke: zero peer fetches served — the ring never resolved a REF miss "
+            f"(lands={result['fabric_lands']}, pushes={result['fabric_pushes_sent']})",
+            file=sys.stderr,
+        )
+        return 1
+    # acceptance gate (ISSUE 19): cross-gateway warm-hit rate >= 90%
+    rate = result["fabric_warm_hit_rate"]
+    floor = result["fabric_warm_hit_floor"]
+    if not isinstance(rate, (int, float)) or rate < floor:
+        print(
+            f"fabric-smoke: warm-hit rate {rate!r} under the {floor} floor "
+            f"({result['fabric_source_literals_warm']} source literal(s) on the warm probe)",
+            file=sys.stderr,
+        )
+        return 1
+    # acceptance gate: cross-shard NACK rate under the PR-13 tolerance
+    nack_rate = result["fabric_cross_shard_nack_rate"]
+    bound = result["fabric_nack_rate_bound"]
+    if not isinstance(nack_rate, (int, float)) or nack_rate > bound:
+        print(
+            f"fabric-smoke: cross-shard NACK rate {nack_rate!r} over the {bound} bound "
+            f"({result['fabric_cross_shard_nacks']} NACK(s) / {result['fabric_warm_refs']} warm REF(s))",
+            file=sys.stderr,
+        )
+        return 1
+    if result["fabric_land_rejects"] > 0:
+        print(
+            f"fabric-smoke: {result['fabric_land_rejects']} pushed segment(s) failed content "
+            "verification at the ring owner",
+            file=sys.stderr,
+        )
+        return 1
+    fd_growth = result["process_open_fds_end"] - result["process_open_fds_start"]
+    if fd_growth > 64:
+        print(f"fabric-smoke: fd count grew by {fd_growth} across the soak (descriptor leak)", file=sys.stderr)
+        return 1
+    print(
+        f"fabric-smoke OK: warm-hit {rate} (floor {floor}, {result['fabric_warm_refs']}/"
+        f"{result['fabric_warm_segments']} REFs), {result['fabric_peer_fetch_hits']} peer fetch(es) served, "
+        f"overlap REF rate {result['fabric_overlap_ref_rate']}, NACK rate {nack_rate} (bound {bound}), "
+        f"byte-identical, {result['value']} {result['unit']} warm, fd growth {fd_growth}"
     )
     return 0
 
@@ -1041,6 +1168,8 @@ def main(argv) -> int:
         return check_service(result)
     if result.get("metric") == "blast_soak":
         return check_blast(result)
+    if result.get("metric") == "fabric_soak":
+        return check_fabric(result)
     if result.get("metric") == "spmd_scaling":
         return check_spmd(result)
     if result.get("metric") == "multichip":

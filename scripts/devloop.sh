@@ -205,6 +205,29 @@ else
   echo "[devloop] blast-smoke clean; result at $LOGDIR/blast_smoke.out" >>"$LOGDIR/devloop.log"
 fi
 
+# Fabric-smoke gate (CPU-only, ~1 min): the fleet-wide dedup fabric
+# (skyplane_tpu/dedup_fabric/, docs/dedup-fabric.md) — two src->dst pairs
+# whose receivers form one consistent-hash ring sync overlapping corpora:
+# write-through placement, one gossip round, then the warm probe (corpus A
+# re-sent through pair B) which must hit >= 90% cross-gateway REFs with >= 1
+# peer fetch actually served, a cross-shard NACK rate under the PR-13
+# literal-resend tolerance, byte-identical outputs, and bounded fd growth
+# (fabric branch of check_bench_json.py). The fabric.peer_fetch fault rung
+# rides the chaos smoke below. Like the other smokes: failures are logged
+# LOUDLY but do not block device profiling.
+JAX_PLATFORMS=cpu SKYPLANE_FABRIC_MB=4 SKYPLANE_FABRIC_UNIQUE_MB=1 \
+  python scripts/soak_dedup_fabric.py >"$LOGDIR/fabric_smoke.out" 2>"$LOGDIR/fabric_smoke.err"
+FABRIC_RC=$?
+if [ "$FABRIC_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/fabric_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  FABRIC_RC=$?
+fi
+if [ "$FABRIC_RC" -ne 0 ]; then
+  echo "[devloop] FABRIC-SMOKE FAILURE (rc=$FABRIC_RC) — warm-hit, peer-fetch, NACK-rate, or integrity gates regressed; see $LOGDIR/fabric_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] fabric-smoke clean; result at $LOGDIR/fabric_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 # Chaos-smoke gate (CPU-only, ~1-2 min): the deterministic fault-injection soak
 # plus the capacity-repair scenarios (docs/provisioning.md "Repair & drain"):
 # gateway death -> requeue-to-survivor, kill-one-of-two -> replacement

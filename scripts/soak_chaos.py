@@ -54,6 +54,7 @@ from integration.harness import (  # noqa: E402
     LocalGateway,
     StubDataplane,
     bind_gateway,
+    dispatch_file,
     make_pair,
     start_gateway,
     wait_complete,
@@ -806,6 +807,154 @@ def run_replan_scenario(base: Path, seed: int) -> dict:
     return out
 
 
+def run_fabric_scenario(base: Path, seed: int) -> dict:
+    """Dedup-fabric peer-fetch chaos (docs/dedup-fabric.md): a corpus enters
+    the fleet through gateway pair A, one gossip round warms pair B's sender
+    index, then the SAME corpus re-sends through pair B with the
+    ``fabric.peer_fetch`` fault dropping EVERY fetch. The fabric is strictly
+    an optimization rung, so the armed run must heal through the established
+    NACK -> literal-resend ladder: byte-identical output, >= 1 receiver NACK
+    (the heal actually ran), zero peer-fetch hits (the fault actually held)."""
+    from skyplane_tpu.dedup_fabric import run_summary_exchange
+
+    chunk_bytes = 256 << 10
+    payload = np.random.default_rng(seed + 7).integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    tmp = base / "fabric"
+    tmp.mkdir()
+    src_file = tmp / "corpus.bin"
+    src_file.write_bytes(payload)
+    outA = tmp / "out" / "a.bin"
+    outB = tmp / "out" / "b.bin"
+    out = {
+        "fabric_ok": False,
+        "fabric_faults_fired": 0,
+        "fabric_nacks": 0,
+        "fabric_peer_fetch_hits": -1,
+        "fabric_byte_identical": False,
+        "fabric_seconds": None,
+    }
+
+    def recv_program():
+        return {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "receive",
+                            "handle": "recv",
+                            "decrypt": False,
+                            "dedup": True,
+                            "children": [{"op_type": "write_local", "handle": "write", "children": []}],
+                        }
+                    ],
+                }
+            ]
+        }
+
+    def send_program(target):
+        return {
+            "plan": [
+                {
+                    "partitions": ["default"],
+                    "value": [
+                        {
+                            "op_type": "read_local",
+                            "handle": "read",
+                            "num_connections": 2,
+                            "children": [
+                                {
+                                    "op_type": "send",
+                                    "handle": "send",
+                                    "target_gateway_id": target,
+                                    "region": "local:local",
+                                    "num_connections": 2,
+                                    "compress": "none",
+                                    "encrypt": False,
+                                    "dedup": True,
+                                    "children": [],
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+
+    gws: list = []
+    try:
+        dstA = start_gateway(recv_program(), {}, "gw_dstA", str(tmp / "dstA_chunks"), use_tls=False)
+        gws.append(dstA)
+        dstB = start_gateway(recv_program(), {}, "gw_dstB", str(tmp / "dstB_chunks"), use_tls=False)
+        gws.append(dstB)
+        srcA = start_gateway(
+            send_program("gw_dstA"),
+            {"gw_dstA": {"public_ip": "127.0.0.1", "control_port": dstA.control_port}},
+            "gw_srcA",
+            str(tmp / "srcA_chunks"),
+            use_tls=False,
+        )
+        gws.append(srcA)
+        srcB = start_gateway(
+            send_program("gw_dstB"),
+            {"gw_dstB": {"public_ip": "127.0.0.1", "control_port": dstB.control_port}},
+            "gw_srcB",
+            str(tmp / "srcB_chunks"),
+            use_tls=False,
+        )
+        gws.append(srcB)
+        membership = {
+            "members": [
+                {"id": "gw_dstA", "url": f"http://127.0.0.1:{dstA.control_port}", "seat": "gw_dstA"},
+                {"id": "gw_dstB", "url": f"http://127.0.0.1:{dstB.control_port}", "seat": "gw_dstB"},
+            ],
+            "draining": [],
+        }
+        for gw in (dstA, dstB):
+            gw.post("fabric/membership", json=membership, timeout=10).raise_for_status()
+        # forced NACKs must not stall for the full production ref-wait
+        dstB.daemon.receiver.ref_wait_timeout = 0.5
+
+        t0 = time.monotonic()
+        ids = dispatch_file(srcA, src_file, outA, chunk_bytes=chunk_bytes)
+        wait_complete(srcA, ids, timeout=120)
+        wait_complete(dstA, ids, timeout=120)
+        deadline = time.time() + 30
+        while time.time() < deadline and dstA.daemon.fabric.counters()["fabric_push_queue_depth"]:
+            time.sleep(0.2)
+        run_summary_exchange(
+            [(f"http://127.0.0.1:{gw.control_port}/api/v1", gw.session()) for gw in (dstA, dstB, srcB)]
+        )
+
+        inj = configure_injector(
+            FaultPlan.from_dict({"seed": seed, "points": {"fabric.peer_fetch": {"p": 1.0}}})
+        )
+        ids2 = dispatch_file(srcB, src_file, outB, chunk_bytes=chunk_bytes)
+        wait_complete(srcB, ids2, timeout=180)
+        wait_complete(dstB, ids2, timeout=180)
+        out["fabric_seconds"] = round(time.monotonic() - t0, 3)
+        out["fabric_faults_fired"] = inj.counters().get("fabric.peer_fetch", 0)
+        out["fabric_nacks"] = dstB.daemon.receiver.nacks_total
+        out["fabric_peer_fetch_hits"] = dstB.daemon.fabric.counters()["fabric_peer_fetch_hits"]
+        out["fabric_byte_identical"] = outB.read_bytes() == payload
+        out["fabric_ok"] = bool(
+            out["fabric_byte_identical"]
+            and out["fabric_faults_fired"] >= 1
+            and out["fabric_nacks"] >= 1
+            and out["fabric_peer_fetch_hits"] == 0
+        )
+    except (RuntimeError, TimeoutError, requests.RequestException) as e:
+        out["fabric_error"] = str(e)[:500]
+    finally:
+        configure_injector(None)
+        for gw in gws:
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+    return out
+
+
 _PER_ACQUIRE_NS: list = []
 
 
@@ -1124,6 +1273,9 @@ def main() -> int:
     # codec resend, byte-identical (docs/datapath-performance.md
     # "Raw-forward fast path")
     rawfwd = run_raw_forward_scenario(base, args.seed)
+    # dedup-fabric peer fetch dropped wholesale -> NACK -> literal resend
+    # heals byte-identically (docs/dedup-fabric.md "Failure semantics")
+    fabric = run_fabric_scenario(base, args.seed)
 
     # the repair/drain/replan scenarios above also ran under the witness:
     # fold their observed edges into the final acyclicity verdict
@@ -1173,6 +1325,7 @@ def main() -> int:
         **replan,
         **pump,
         **rawfwd,
+        **fabric,
     }
     print(json.dumps(result))
     return 0

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Dedup-fabric soak: two gateway pairs sync overlapping corpora through the
+fleet-wide content-addressed fabric (docs/dedup-fabric.md).
+
+Topology: two disjoint src->dst pairs whose receivers form one consistent-hash
+ring. Three phases:
+
+  1. corpus A (shared blob + A-unique tail) enters through pair A; the
+     write-through placement pushes each segment to its ring owner.
+  2. corpus B (SAME shared blob + B-unique tail) enters through pair B after
+     one gossip round — the overlap dedups cross-gateway (informational
+     `fabric_overlap_ref_rate`).
+  3. the warm probe: corpus A re-sent through pair B. Every segment is
+     fleet-proved by now, so the sender must emit (almost) pure REFs and the
+     receiver must resolve its misses via peer fetch, not source NACKs.
+
+Reports a single JSON result line:
+
+  metric                      fabric_soak (warm-probe effective Gbps)
+  fabric_warm_hit_rate        REF fraction of the warm probe's segments,
+                              gated >= fabric_warm_hit_floor (0.90)
+  fabric_source_literals_warm segments the warm probe shipped as literals
+  fabric_cross_shard_nack_rate  receiver NACKs per warm REF, gated <=
+                              fabric_nack_rate_bound (the PR-13 chaos-soak
+                              literal-resend tolerance, 0.05)
+  fabric_peer_fetch_hits      must be >= 1 (the ring actually served)
+  fabric_byte_identical       every phase output byte-identical
+  process_open_fds_start/end  descriptor-leak signal
+
+scripts/check_bench_json.py validates the schema and gates the rates
+(fabric branch); scripts/devloop.sh runs this as the fabric-smoke step.
+
+Env knobs: SKYPLANE_FABRIC_MB (shared-blob MiB, default 4),
+SKYPLANE_FABRIC_UNIQUE_MB (per-pair unique tail MiB, default 1),
+SKYPLANE_FABRIC_CHUNK_KB (default 256).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import numpy as np  # noqa: E402
+
+from integration.harness import dispatch_file, start_gateway, wait_complete  # noqa: E402
+from skyplane_tpu.dedup_fabric import run_summary_exchange  # noqa: E402
+from skyplane_tpu.obs.metrics import open_fd_count  # noqa: E402
+
+WARM_HIT_FLOOR = 0.90  # acceptance: cross-gateway warm-hit rate (ISSUE 19)
+# PR-13 chaos-soak tolerance for literal resends on a healthy (fault-free)
+# path: warm REFs that bounce back as NACKs must stay under this rate
+NACK_RATE_BOUND = 0.05
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+def _recv_program() -> dict:
+    return {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "receive",
+                        "handle": "recv",
+                        "decrypt": False,
+                        "dedup": True,
+                        "children": [{"op_type": "write_local", "handle": "write", "children": []}],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def _send_program(target_gateway_id: str) -> dict:
+    return {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "num_connections": 2,
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "send",
+                                "target_gateway_id": target_gateway_id,
+                                "region": "local:local",
+                                "num_connections": 2,
+                                "compress": "none",
+                                "encrypt": False,
+                                "dedup": True,
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def _drain_pushes(dst, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if dst.daemon.fabric.counters()["fabric_push_queue_depth"] == 0:
+            time.sleep(0.3)  # let an in-flight POST finish landing
+            return
+        time.sleep(0.2)
+    raise TimeoutError("fabric push queue did not drain")
+
+
+def _sender_op(src):
+    return next(op for op in src.daemon.operators if getattr(op, "dedup_index", None) is not None)
+
+
+def main() -> int:
+    shared_mb = _env_int("SKYPLANE_FABRIC_MB", 4)
+    unique_mb = _env_int("SKYPLANE_FABRIC_UNIQUE_MB", 1)
+    chunk_bytes = _env_int("SKYPLANE_FABRIC_CHUNK_KB", 256) << 10
+
+    fds_start = open_fd_count()
+    tmp = Path(tempfile.mkdtemp(prefix="skyplane_fabric_"))
+    rng = np.random.default_rng(19)
+    shared = rng.integers(0, 256, shared_mb << 20, dtype=np.uint8).tobytes()
+    corpus_a = shared + rng.integers(0, 256, unique_mb << 20, dtype=np.uint8).tobytes()
+    corpus_b = shared + rng.integers(0, 256, unique_mb << 20, dtype=np.uint8).tobytes()
+    file_a = tmp / "corpus_a.bin"
+    file_b = tmp / "corpus_b.bin"
+    file_a.write_bytes(corpus_a)
+    file_b.write_bytes(corpus_b)
+
+    gws = []
+    try:
+        dstA = start_gateway(_recv_program(), {}, "gw_dstA", str(tmp / "dstA_chunks"), use_tls=False)
+        gws.append(dstA)
+        dstB = start_gateway(_recv_program(), {}, "gw_dstB", str(tmp / "dstB_chunks"), use_tls=False)
+        gws.append(dstB)
+        srcA = start_gateway(
+            _send_program("gw_dstA"),
+            {"gw_dstA": {"public_ip": "127.0.0.1", "control_port": dstA.control_port}},
+            "gw_srcA",
+            str(tmp / "srcA_chunks"),
+            use_tls=False,
+        )
+        gws.append(srcA)
+        srcB = start_gateway(
+            _send_program("gw_dstB"),
+            {"gw_dstB": {"public_ip": "127.0.0.1", "control_port": dstB.control_port}},
+            "gw_srcB",
+            str(tmp / "srcB_chunks"),
+            use_tls=False,
+        )
+        gws.append(srcB)
+
+        # the two receivers form the ring BEFORE any data moves (note_put is
+        # inert on an unconfigured fabric)
+        membership = {
+            "members": [
+                {"id": "gw_dstA", "url": f"http://127.0.0.1:{dstA.control_port}", "seat": "gw_dstA"},
+                {"id": "gw_dstB", "url": f"http://127.0.0.1:{dstB.control_port}", "seat": "gw_dstB"},
+            ],
+            "draining": [],
+        }
+        for gw in (dstA, dstB):
+            resp = gw.post("fabric/membership", json=membership, timeout=10)
+            resp.raise_for_status()
+
+        legs = [
+            (f"http://127.0.0.1:{gw.control_port}/api/v1", gw.session())
+            for gw in (dstA, dstB, srcB)
+        ]
+
+        # phase 1: corpus A through pair A, then placement + gossip converge
+        ids = dispatch_file(srcA, file_a, tmp / "out" / "a_via_a.bin", chunk_bytes=chunk_bytes)
+        wait_complete(srcA, ids, timeout=300)
+        wait_complete(dstA, ids, timeout=300)
+        ok_a = (tmp / "out" / "a_via_a.bin").read_bytes() == corpus_a
+        _drain_pushes(dstA)
+        gossip1 = run_summary_exchange(legs)
+
+        sender = _sender_op(srcB)
+        before_overlap = sender.processor.stats.as_dict()
+
+        # phase 2: overlapping corpus B through pair B — the shared blob must
+        # dedup against fleet warmth pair B never produced locally
+        ids = dispatch_file(srcB, file_b, tmp / "out" / "b_via_b.bin", chunk_bytes=chunk_bytes)
+        wait_complete(srcB, ids, timeout=300)
+        wait_complete(dstB, ids, timeout=300)
+        ok_b = (tmp / "out" / "b_via_b.bin").read_bytes() == corpus_b
+        after_overlap = sender.processor.stats.as_dict()
+        overlap_segments = after_overlap["segments"] - before_overlap["segments"]
+        overlap_refs = after_overlap["ref_segments"] - before_overlap["ref_segments"]
+        _drain_pushes(dstB)
+        gossip2 = run_summary_exchange(legs)
+
+        # phase 3 (the gated probe): corpus A re-sent through pair B — every
+        # segment is fleet-proved, so REFs only + peer fetch at the receiver
+        t0 = time.monotonic()
+        ids = dispatch_file(srcB, file_a, tmp / "out" / "a_via_b.bin", chunk_bytes=chunk_bytes)
+        wait_complete(srcB, ids, timeout=300)
+        wait_complete(dstB, ids, timeout=300)
+        warm_seconds = time.monotonic() - t0
+        ok_warm = (tmp / "out" / "a_via_b.bin").read_bytes() == corpus_a
+        after_warm = sender.processor.stats.as_dict()
+        warm_segments = after_warm["segments"] - after_overlap["segments"]
+        warm_refs = after_warm["ref_segments"] - after_overlap["ref_segments"]
+
+        fab_a = dstA.daemon.fabric.counters()
+        fab_b = dstB.daemon.fabric.counters()
+        peer_fetch_hits = fab_a["fabric_peer_fetch_hits"] + fab_b["fabric_peer_fetch_hits"]
+        peer_fetch_timeouts = fab_a["fabric_peer_fetch_timeouts"] + fab_b["fabric_peer_fetch_timeouts"]
+        nacks = dstB.daemon.receiver.nacks_total
+    except (RuntimeError, TimeoutError, OSError) as e:
+        print(json.dumps({"error": f"fabric soak failed: {e}"}), file=sys.stderr)
+        return 1
+    finally:
+        for gw in gws:
+            try:
+                gw.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+    fds_end = open_fd_count()
+
+    warm_hit_rate = warm_refs / max(warm_segments, 1)
+    nack_rate = nacks / max(warm_refs, 1)
+    result = {
+        "metric": "fabric_soak",
+        "value": round(len(corpus_a) * 8 / warm_seconds / 1e9, 4),
+        "unit": "Gbps",
+        "fabric_members": 2,
+        "fabric_shared_mb": shared_mb,
+        "fabric_unique_mb": unique_mb,
+        "fabric_gossip_fps": gossip1["fps"] + gossip2["fps"],
+        "fabric_overlap_segments": overlap_segments,
+        "fabric_overlap_refs": overlap_refs,
+        "fabric_overlap_ref_rate": round(overlap_refs / max(overlap_segments, 1), 4),
+        "fabric_warm_segments": warm_segments,
+        "fabric_warm_refs": warm_refs,
+        "fabric_warm_hit_rate": round(warm_hit_rate, 4),
+        "fabric_warm_hit_floor": WARM_HIT_FLOOR,
+        "fabric_source_literals_warm": warm_segments - warm_refs,
+        "fabric_peer_fetch_hits": peer_fetch_hits,
+        "fabric_peer_fetch_timeouts": peer_fetch_timeouts,
+        "fabric_pushes_sent": fab_a["fabric_pushes_sent"] + fab_b["fabric_pushes_sent"],
+        "fabric_lands": fab_a["fabric_lands"] + fab_b["fabric_lands"],
+        "fabric_land_rejects": fab_a["fabric_land_rejects"] + fab_b["fabric_land_rejects"],
+        "fabric_cross_shard_nacks": nacks,
+        "fabric_cross_shard_nack_rate": round(nack_rate, 4),
+        "fabric_nack_rate_bound": NACK_RATE_BOUND,
+        "fabric_byte_identical": bool(ok_a and ok_b and ok_warm),
+        "fabric_warm_seconds": round(warm_seconds, 3),
+        "process_open_fds_start": fds_start,
+        "process_open_fds_end": fds_end,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,7 +174,9 @@ PROTOCOLS: Tuple[Protocol, ...] = (
     Protocol(
         name="sealed",
         what="sealed-frame borrow",
-        acquires=(Site(names=("sealed_open",), bind="result"),),
+        # sealed_open_by_fp: the dedup fabric's fingerprint-keyed borrow
+        # (gateway_daemon segment serve) — same obligation as sealed_open
+        acquires=(Site(names=("sealed_open", "sealed_open_by_fp"), bind="result"),),
         releases=(
             Site(names=("close", "release"), recv_any=("ref", "sealed"), bind="receiver"),
         ),

@@ -158,6 +158,17 @@ class BlastPlanner(Planner):
         plan.codec_decisions = dict(self.codec_decisions)
         plan.planner_name = "blast_tree"
         plan.metadata["tree"] = tree.as_dict()
+        # fleet dedup-fabric seed (docs/dedup-fabric.md): when any tree edge
+        # deduplicates, every gateway in the plan is a candidate segment
+        # owner on the consistent-hash ring. The provisioner resolves member
+        # urls once IPs exist and renders this into each VM's
+        # SKYPLANE_TPU_FABRIC env; seats start as the gateway ids so a
+        # replacement VM can adopt its predecessor's ring position.
+        if any(d.get("dedup") for d in self.codec_decisions.values()):
+            plan.metadata["fabric"] = {
+                "members": [{"id": gid, "seat": gid} for gid in sorted(gw_by_id)],
+                "draining": [],
+            }
         return plan
 
     def _sink_dedup(self, tree: BlastTree, gw, estimate) -> bool:

@@ -205,6 +205,21 @@ class ChunkStore:
             return None
         return SealedFrameRef(chunk_id, fd, length, meta, self)
 
+    def sealed_open_by_fp(self, fp_hex: str) -> Optional[SealedFrameRef]:
+        """Borrow a sealed frame by its content fingerprint instead of its
+        chunk id — the dedup fabric's segment route serves peers by
+        fingerprint (``GET /api/v1/segment/<fp>``), and a sealed frame whose
+        payload hashes to the requested fp is the PR-17 raw path: no decode,
+        no recompress, one fd splice. Same borrow/release contract as
+        ``sealed_open`` (the caller must ``close()`` the ref on every path)."""
+        with self._lock:
+            matches = [cid for cid, ent in self._sealed.items() if not ent["doomed"] and ent["meta"].get("fingerprint") == fp_hex]
+        for chunk_id in matches:
+            ref = self.sealed_open(chunk_id)
+            if ref is not None:
+                return ref
+        return None
+
     def _sealed_unref(self, chunk_id: str) -> None:
         with self._lock:
             ent = self._sealed.get(chunk_id)

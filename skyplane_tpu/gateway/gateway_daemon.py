@@ -219,6 +219,28 @@ class GatewayDaemon:
             # a pure source/relay-origin gateway must not pay idle workers
             self.receiver.enable_pump(self.pump_procs, persist_dedup=self.persist_dedup)
 
+        # ---- fleet-wide dedup fabric (skyplane_tpu/dedup_fabric) ----
+        # Consistent-hash segment placement + peer fetch: membership comes
+        # from SKYPLANE_TPU_FABRIC (pump workers inherit the env and build
+        # their own instance) or arrives later via POST /fabric/membership.
+        # Unconfigured, every hook below is inert.
+        from skyplane_tpu.dedup_fabric import fabric_from_env
+
+        self.fabric = fabric_from_env(gateway_id, serve_spill_roots=[Path(chunk_dir) / "segments"])
+        self.fabric.local_store = self.receiver.segment_store
+        self.fabric.chunk_store = self.chunk_store
+        if self.receiver.segment_store is not None:
+            # receiver-side REF miss -> peer fetch before the NACK ladder;
+            # landed literals feed write-through placement + gossip summary
+            self.receiver.segment_store.fabric = self.fabric
+        # absorbed peer summaries warm every sender index partition
+        self.fabric.add_absorb_sink(self._absorb_fleet_fps)
+        # dynamic membership pushes fan out to pump worker processes
+        self.fabric.configure_listeners.append(self._broadcast_fabric_membership)
+        # stale cross-shard warmth observed as NACKs (gossip said a fleet
+        # member proved the fp; the receiver disagreed at send time)
+        self._cross_shard_nacks = 0
+
         self.upload_id_map: Dict[str, str] = {}
         self.operators: List[GatewayOperator] = []
         self.terminal_operators: Dict[str, List[str]] = {}  # partition -> terminal group names
@@ -290,6 +312,21 @@ class GatewayDaemon:
         # keyed by (src, dst) gateway so fan-out-vs-egress curves come from
         # counters, not arithmetic — skyplane_egress_bytes_total{src,dst}
         self.metrics.register_labeled_provider("egress", self._egress_edges, label=("src", "dst"))
+        # dedup-fabric health (docs/dedup-fabric.md): peer-fetch outcomes
+        # (worker-process counters ride the decode snapshots), fetch latency,
+        # cross-shard NACKs, and the raw fabric counter schema
+        self.metrics.register_labeled_provider("peer_fetch", self._peer_fetch_results, label="result")
+        self.fabric.fetch_observe = self.metrics.histogram(
+            "peer_fetch_seconds",
+            help_="peer segment fetch latency (ring-owner GET round trip)",
+            buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0),
+        ).observe
+        self.metrics.gauge(
+            "cross_shard_nacks_total",
+            help_="NACKs on fingerprints warmed only by fleet gossip (stale cross-shard warmth)",
+            fn=self._cross_shard_nacks_total,
+        )
+        self.metrics.register_provider("fabric", self._fabric_counters)
         self.api = GatewayDaemonAPI(
             chunk_store=self.chunk_store,
             receiver=self.receiver,
@@ -305,6 +342,7 @@ class GatewayDaemon:
             sender_profile_fn=self._sender_socket_events,
             metrics_fn=self.metrics.render_prometheus,
             trace_fn=self._merged_trace_export,
+            fabric=self.fabric,
             api_token=self.api_token,
             ssl_ctx=ssl_ctx,
             tenant_registry=self.tenants,
@@ -363,12 +401,86 @@ class GatewayDaemon:
                 default_tenant_quota_bytes=self._tenant_index_quota or None,
             )
             self._dedup_indexes[target_gateway_id] = idx
+            self._wire_index_to_fabric(idx)
             if idx.counters()["index_recovered_entries"]:
                 logger.fs.info(
                     f"[daemon {self.gateway_id}] recovered {idx.counters()['index_recovered_entries']} "
                     f"warm fingerprints for target {target_gateway_id}"
                 )
         return idx
+
+    # ---- fleet dedup fabric plumbing (docs/dedup-fabric.md) ----
+
+    def _wire_index_to_fabric(self, idx) -> None:
+        """Attach one sender dedup index to the fabric: discarding a
+        gossip-warmed fp counts a cross-shard NACK, and fps already absorbed
+        from peer summaries seed the remote tier so indexes created after the
+        gossip round still skip the literal."""
+        idx.on_cross_shard_nack = self._note_cross_shard_nack
+        seeded = self.fabric.absorbed_fps()
+        if seeded:
+            idx.add_remote(seeded, origin="fabric")
+
+    def _note_cross_shard_nack(self, fp: bytes) -> None:
+        self._cross_shard_nacks += 1  # plain int bump (GIL-atomic)
+
+    def _cross_shard_nacks_total(self) -> float:
+        """Parent-side discards (indexes wired above) plus pump sender
+        workers' counts, which ride the merged wire-counter snapshots."""
+        total = float(self._cross_shard_nacks)
+        for op in self.operators:
+            if isinstance(op, GatewaySenderOperator):
+                total += op.wire_counters().get("cross_shard_nacks", 0)
+        return total
+
+    def _absorb_fleet_fps(self, batch, origin: str) -> None:
+        """Fan one absorbed peer summary out to every sender dedup index
+        partition: the daemon-shared persistent indexes, operator-private
+        ephemeral indexes, and (over the ctrl channel) the pump sender
+        workers' private partitions."""
+        seen = set()
+        for idx in self._dedup_indexes.values():
+            if id(idx) not in seen:
+                seen.add(id(idx))
+                idx.add_remote(batch, origin=origin)
+        for op in self.operators:
+            idx = getattr(op, "dedup_index", None)
+            if idx is not None and id(idx) not in seen and hasattr(idx, "add_remote"):
+                seen.add(id(idx))
+                idx.add_remote(batch, origin=origin)
+        from skyplane_tpu.gateway.pump import is_pump_sender
+
+        msg = {"type": "fabric_fps", "fps": [[fp.hex(), size] for fp, size in batch], "origin": origin}
+        for op in self.operators:
+            if is_pump_sender(op) and getattr(op, "pool", None) is not None:
+                op.pool.broadcast(msg)
+
+    def _broadcast_fabric_membership(self, membership: dict) -> None:
+        """Membership pushed to this daemon reaches pump worker processes
+        (each runs its own DedupFabric bootstrapped from the inherited env)."""
+        msg = {"type": "fabric", "membership": membership}
+        for owner in self._pump_pools():
+            pool = getattr(owner, "pool", None)
+            if pool is not None:
+                pool.broadcast(msg)
+
+    def _peer_fetch_results(self) -> Dict[str, Dict[str, float]]:
+        """skyplane_peer_fetch_total{result=hit|miss|timeout}: parent fabric
+        counters plus receiver pump workers' (merged into decode snapshots)."""
+        c = self.fabric.counters()
+        dec = self.receiver.decode_counters()
+        return {
+            "total": {
+                "hit": c["fabric_peer_fetch_hits"] + dec.get("fabric_peer_fetch_hits", 0),
+                "miss": c["fabric_peer_fetch_misses"] + dec.get("fabric_peer_fetch_misses", 0),
+                "timeout": c["fabric_peer_fetch_timeouts"] + dec.get("fabric_peer_fetch_timeouts", 0),
+            }
+        }
+
+    def _fabric_counters(self) -> dict:
+        # keys already carry the fabric_ prefix; strip it so the provider
+        # renders skyplane_fabric_<key> instead of skyplane_fabric_fabric_*
+        return {k[len("fabric_"):]: v for k, v in self.fabric.counters().items()}
 
     def apply_tenant_policy(self, tenant_id: str, weight: float = 1.0, quotas: Optional[Dict[str, int]] = None) -> str:
         """Admission-time policy push: registry + scheduler weights/caps, and
@@ -693,7 +805,7 @@ class GatewayDaemon:
 
                 sender_cls = make_sender_pump_operator
                 sender_extra = {"pump_procs": self.pump_procs}
-            return sender_cls(
+            sender = sender_cls(
                 **common,
                 **sender_extra,
                 n_workers=op.get("num_connections", 16),
@@ -720,6 +832,12 @@ class GatewayDaemon:
                 scheduler=self.scheduler,
                 tenant_registry=self.tenants,
             )
+            # operator-private ephemeral indexes (persistence off) still join
+            # the fabric: gossip warmth in, cross-shard NACK accounting out
+            idx = getattr(sender, "dedup_index", None)
+            if idx is not None and getattr(idx, "on_cross_shard_nack", False) is None:
+                self._wire_index_to_fabric(idx)
+            return sender
         raise ValueError(f"unknown operator type {op_type!r}")
 
     # ---- graceful drain + applied replans (docs/provisioning.md) ----
@@ -871,6 +989,7 @@ class GatewayDaemon:
             for op in self.operators:
                 op.stop_workers(timeout=2.0)
             self.receiver.stop_all()
+            self.fabric.close()
             # flush persistent dedup journals so the next daemon recovers a
             # clean (untorn) tail even after a prompt process exit
             for idx in self._dedup_indexes.values():

@@ -54,6 +54,15 @@ directly:
                                            combined collector scrape: metrics
                                            + trace + events (+ cpu + profile
                                            summary) in ONE round trip
+  GET  /api/v1/segment/<fp>                dedup-fabric peer fetch: serve one
+                                           segment by fingerprint (binary;
+                                           404 = not resident)
+  POST /api/v1/segment/<fp>                write-through push landing (raw
+                                           body, fingerprint-verified)
+  GET  /api/v1/fabric/summary              gossip pull: recently-proved fps +
+                                           membership + fabric counters
+  POST /api/v1/fabric/summary              gossip push: absorb a peer summary
+  POST /api/v1/fabric/membership           replace fleet membership document
 
 Completion accounting (the reference's most bug-prone logic, SURVEY §7 #6):
 an explicit per-chunk refcount of terminal-operator completions — a chunk is
@@ -74,6 +83,15 @@ from skyplane_tpu.faults import get_injector
 from skyplane_tpu.gateway.chunk_store import ChunkStore
 from skyplane_tpu.gateway.operators.gateway_receiver import GatewayReceiver
 from skyplane_tpu.utils.logger import logger
+
+
+def _parse_fp(hexfp: str) -> Optional[bytes]:
+    """16-byte fingerprint from its hex route segment; None when malformed."""
+    try:
+        fp = bytes.fromhex(hexfp)
+    except ValueError:
+        return None
+    return fp if len(fp) == 16 else None
 
 
 class GatewayDaemonAPI:
@@ -104,6 +122,7 @@ class GatewayDaemonAPI:
         retarget_fn=None,
         profile_summary_fn=None,
         pump_cpu_fn=None,
+        fabric=None,
     ):
         self.chunk_store = chunk_store
         self.receiver = receiver
@@ -148,6 +167,11 @@ class GatewayDaemonAPI:
 
         self.profile_summary_fn = profile_summary_fn or (lambda: get_profiler().summary())
         self.pump_cpu_fn = pump_cpu_fn
+        # fleet dedup fabric (skyplane_tpu/dedup_fabric, docs/dedup-fabric.md):
+        # serves GET/POST /api/v1/segment/<fp> (peer fetch + write-through
+        # landing) and the /api/v1/fabric/* membership + gossip routes. None
+        # keeps the bare single-gateway surface (all fabric routes 404/503).
+        self.fabric = fabric
 
         self._lock = threading.Lock()
         self._dedup_sources: set = set()  # distinct source gateway ids seen on /servers
@@ -189,6 +213,14 @@ class GatewayDaemonAPI:
                 body = text.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_bytes(self, code: int, body: bytes) -> None:
+                # binary route (segment serving): no JSON round trip
+                self.send_response(code)
+                self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -582,6 +614,31 @@ class GatewayDaemonAPI:
             # Prometheus text exposition: the unified MetricsRegistry view of
             # the DATAPATH/DECODE/SENDER_WIRE schemas + native gauges/histograms
             req._send_text(200, self.metrics_fn(), "text/plain; version=0.0.4; charset=utf-8")
+        elif path.startswith("/api/v1/segment/"):
+            # dedup-fabric peer fetch (docs/dedup-fabric.md): the ring owner
+            # serves one segment by fingerprint — SegmentStore peek, sealed
+            # raw path, or pump-shard spill file. Binary response; 404 = the
+            # owner is healthy but cold (the fetcher treats it as a plain
+            # miss, NOT a breaker strike).
+            fp = _parse_fp(path.rsplit("/", 1)[1])
+            if self.fabric is None or fp is None:
+                req._send(404, {"error": "no dedup fabric on this gateway" if self.fabric is None else "malformed fingerprint"})
+            else:
+                data = self.fabric.serve(fp)
+                if data is None:
+                    req._send(404, {"error": "segment not resident"})
+                else:
+                    req._send_bytes(200, data)
+        elif path == "/api/v1/fabric/summary":
+            # gossip pull: this gateway's recently-proved fingerprints plus
+            # the membership view (introspection for soaks and operators)
+            if self.fabric is None:
+                req._send(404, {"error": "no dedup fabric on this gateway"})
+            else:
+                out = self.fabric.summary()
+                out["membership"] = self.fabric.membership()
+                out["counters"] = self.fabric.counters()
+                req._send(200, out)
         elif path == "/api/v1/logs":
             # live daemon log tail (reference analog: the dozzle container log
             # viewer on :8888); ?bytes=N bounds the tail (default 64 KiB,
@@ -836,6 +893,38 @@ class GatewayDaemonAPI:
             body = req._read_json()
             self.upload_id_map_update(body)
             req._send(200, {"status": "ok", "entries": len(body)})
+        elif path.startswith("/api/v1/segment/"):
+            # dedup-fabric write-through landing: a peer whose literal's ring
+            # owner is THIS gateway pushes the segment here. Raw binary body;
+            # the fabric verifies content-vs-fingerprint before storing, so a
+            # corrupt (or hostile) push can never poison the store.
+            fp = _parse_fp(path.rsplit("/", 1)[1])
+            length = int(req.headers.get("Content-Length", 0) or 0)
+            data = req.rfile.read(length) if length else b""
+            if self.fabric is None or fp is None:
+                req._send(404, {"error": "no dedup fabric on this gateway" if self.fabric is None else "malformed fingerprint"})
+            elif self.fabric.land(fp, data):
+                req._send(200, {"status": "ok", "bytes": len(data)})
+            else:
+                req._send(422, {"error": "segment rejected (content/fingerprint mismatch or no store)"})
+        elif path == "/api/v1/fabric/summary":
+            # gossip push: absorb a peer's fingerprint summary into every
+            # sender dedup index partition on this gateway (live operators,
+            # pump workers, and indexes created later)
+            if self.fabric is None:
+                req._send(404, {"error": "no dedup fabric on this gateway"})
+            else:
+                body = req._read_json()
+                req._send(200, {"status": "ok", "absorbed": self.fabric.absorb(body)})
+        elif path == "/api/v1/fabric/membership":
+            # fleet membership update (service controller / operator): full
+            # document replace — ring rebuild, draining set, member table
+            if self.fabric is None:
+                req._send(404, {"error": "no dedup fabric on this gateway"})
+            else:
+                body = req._read_json()
+                self.fabric.configure(body)
+                req._send(200, {"status": "ok", "members": len(body.get("members") or [])})
         else:
             req._send(404, {"error": f"no route {req.path}"})
 

@@ -1338,6 +1338,18 @@ def _receiver_worker(cfg: dict, chan: CtrlChannel) -> None:
             spill_max_bytes=max(64 << 20, (_env_int("SKYPLANE_TPU_SEGSTORE_SPILL_MB", 32 << 10, minimum=1) << 20) // n),
             persistent_spill=bool(cfg.get("persist_dedup")),
         )
+    fabric = None
+    if segment_store is not None:
+        from skyplane_tpu.dedup_fabric import fabric_from_env
+
+        # worker-side dedup fabric: bootstrapped from the inherited
+        # SKYPLANE_TPU_FABRIC env (spawn-context workers re-read os.environ);
+        # dynamic membership arrives via the "fabric" ctrl message below. The
+        # PARENT gateway id keeps owner==self short-circuits correct for
+        # segments this gateway owns — unconfigured, fetch/note_put are inert.
+        fabric = fabric_from_env(str(cfg.get("gateway_id", "gateway")))
+        fabric.local_store = segment_store
+        segment_store.fabric = fabric
     cmin, cavg, cmax = cfg.get("cdc") or (4 * 1024, 16 * 1024, 64 * 1024)
     key = bytes(cfg["e2ee_key"]) if cfg.get("e2ee_key") else None
     tally = _TenantTally()  # per-tenant decode/nack attribution, replayed by the parent
@@ -1367,6 +1379,15 @@ def _receiver_worker(cfg: dict, chan: CtrlChannel) -> None:
     stride = _trace_stride(push_s)
     tick = [0]
 
+    def decode_snapshot() -> dict:
+        """Decode counters with this worker's fabric counters folded in —
+        merge_numeric_counters on the parent sums keys absent from the base
+        schema, so peer-fetch hits/misses/timeouts surface gateway-wide."""
+        out = dict(receiver.decode_counters())
+        if fabric is not None:
+            out.update(fabric.counters())
+        return out
+
     def pusher() -> None:
         while not stop_evt.is_set():
             _maybe_crash(cfg)
@@ -1374,7 +1395,7 @@ def _receiver_worker(cfg: dict, chan: CtrlChannel) -> None:
             if not chan.send(
                 _telemetry_snapshot(
                     cfg,
-                    {"decode": receiver.decode_counters(), "tenants": tally.snapshot()},
+                    {"decode": decode_snapshot(), "tenants": tally.snapshot()},
                     ev_cursor,
                     include_trace=tick[0] % stride == 0,
                 )
@@ -1403,14 +1424,18 @@ def _receiver_worker(cfg: dict, chan: CtrlChannel) -> None:
             conn = socket.socket(fileno=fds[0])
             receiver.adopt_connection(conn, int(msg.get("port") or 0))
             fds.clear()  # adopted: the reader must not close it
+        elif kind == "fabric":
+            # membership pushed to the parent daemon fans out here
+            if fabric is not None and isinstance(msg.get("membership"), dict):
+                fabric.configure(msg["membership"])
         elif kind == "stop":
             break
     stop_evt.set()
     # final snapshot so the parent's merged counters include everything this
     # worker landed, then let the decode pool wind down
-    chan.send(
-        _telemetry_snapshot(cfg, {"decode": receiver.decode_counters(), "tenants": tally.snapshot()}, ev_cursor)
-    )
+    chan.send(_telemetry_snapshot(cfg, {"decode": decode_snapshot(), "tenants": tally.snapshot()}, ev_cursor))
+    if fabric is not None:
+        fabric.close()
     receiver.stop_all()
 
 
@@ -1468,6 +1493,19 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
         tenant_registry=None,
         raw_forward=bool(cfg.get("raw_forward")),
     )
+    # cross-shard NACK attribution (docs/dedup-fabric.md): a discard of a
+    # fp this PRIVATE partition only knew via fleet gossip means stale
+    # cross-shard warmth — counted locally, summed by the parent's merged
+    # wire counters (merge_numeric_counters passes non-schema keys through)
+    cross_shard_nacks = [0]
+    if op.dedup_index is not None:
+        op.dedup_index.on_cross_shard_nack = lambda _fp: cross_shard_nacks.__setitem__(0, cross_shard_nacks[0] + 1)
+
+    def wire_snapshot() -> dict:
+        out = dict(op.wire_counters())
+        out["cross_shard_nacks"] = cross_shard_nacks[0]
+        return out
+
     op.start_workers()
     stop_evt = threading.Event()
     push_s = float(cfg.get("push_s", 0.25))
@@ -1505,7 +1543,7 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
             snap = _telemetry_snapshot(
                 cfg,
                 {
-                    "wire": op.wire_counters(),
+                    "wire": wire_snapshot(),
                     "datapath": op.processor.stats.as_dict(),
                     "window_events": window_events,
                 },
@@ -1555,6 +1593,21 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
                 batch_runner.resolve(msg)
         elif kind == "retarget":
             op.retarget(msg["new_target_gateway_id"], msg["host"], int(msg["control_port"]))
+        elif kind == "fabric_fps":
+            # gossip-absorbed fingerprints from the parent: warm this
+            # worker's PRIVATE dedup partition so the next send REFs instead
+            # of shipping the literal (stale entries heal via NACK)
+            if op.dedup_index is not None:
+                batch = []
+                for item in msg.get("fps") or ():
+                    try:
+                        fp = bytes.fromhex(item[0])
+                        if len(fp) == 16:
+                            batch.append((fp, int(item[1] or 0)))
+                    except (ValueError, TypeError, IndexError):
+                        continue
+                if batch:
+                    op.dedup_index.add_remote(batch, origin=str(msg.get("origin") or "?"))
         elif kind == "stop":
             break
     stop_evt.set()
@@ -1570,6 +1623,6 @@ def _sender_worker(cfg: dict, chan: CtrlChannel) -> None:
             chan.send({"type": "status", "chunk_id": rec["chunk_id"], "state": rec["state"]})
     chan.send(
         _telemetry_snapshot(
-            cfg, {"wire": op.wire_counters(), "datapath": op.processor.stats.as_dict(), "window_events": []}, ev_cursor
+            cfg, {"wire": wire_snapshot(), "datapath": op.processor.stats.as_dict(), "window_events": []}, ev_cursor
         )
     )

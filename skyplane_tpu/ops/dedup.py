@@ -100,6 +100,20 @@ class SenderDedupIndex:
         self._budget_lock = lockcheck.wrap(threading.Lock(), "SenderDedupIndex._budget_lock")  # guards the global byte total
         self._max_bytes = max_bytes
         self._bytes = 0
+        # fleet-gossiped warmth (dedup_fabric): fingerprints some OTHER
+        # gateway proved, learned from summary exchange. Kept apart from the
+        # LRU stripes — entry tuples there are (size, seq) and the
+        # persistent subclass's compactor iterates them — and bounded by
+        # COUNT, not bytes: remote fps consume no receiver capacity at this
+        # destination until a REF to one actually resolves (via peer fetch).
+        self._remote_lock = lockcheck.wrap(threading.Lock(), "SenderDedupIndex._remote_lock")
+        self._remote: "OrderedDict[bytes, int]" = OrderedDict()  # fp -> size
+        self._remote_cap = 65536
+        self._c_remote_hits = 0
+        # fired (fp) when a NACK kills a REF that was emitted on remote
+        # warmth — the cross-shard miss the fabric exists to shrink; the
+        # daemon binds this to skyplane_cross_shard_nacks_total
+        self.on_cross_shard_nack = None
 
     def _stripe(self, fp: bytes) -> _IndexStripe:
         return self._stripes[fp[0] & self._mask]
@@ -108,11 +122,19 @@ class SenderDedupIndex:
         s = self._stripe(fp)
         with s.lock:
             entry = s.lru.get(fp)
-            if entry is None:
-                return False
-            s.lru[fp] = (entry[0], next(self._seq))
-            s.lru.move_to_end(fp)
-            return True
+            if entry is not None:
+                s.lru[fp] = (entry[0], next(self._seq))
+                s.lru.move_to_end(fp)
+                return True
+        # fall through to fleet warmth: "any fleet member proved this fp"
+        # is REF-worthy — the receiver resolves it by peer fetch, and a
+        # stale entry heals through the ordinary NACK -> discard path
+        with self._remote_lock:
+            if fp in self._remote:
+                self._remote.move_to_end(fp)
+                self._c_remote_hits += 1
+                return True
+        return False
 
     def add(self, fp: bytes, size: int = 0, tenant: Optional[str] = None) -> None:
         """Insert/touch a fingerprint. ``tenant`` is accepted (and ignored)
@@ -127,6 +149,11 @@ class SenderDedupIndex:
                 return
             s.lru[fp] = (size, next(self._seq))
             s.bytes += size
+        with self._remote_lock:
+            # locally proved now: the entry graduates out of the gossip tier
+            # (double-membership would make discard() miscount a local NACK
+            # as a cross-shard one)
+            self._remote.pop(fp, None)
         with self._budget_lock:
             self._bytes += size
         self._evict_to_budget()
@@ -136,6 +163,16 @@ class SenderDedupIndex:
 
     def discard(self, fp: bytes) -> None:
         """Forget a fingerprint (receiver nacked an unresolvable REF to it)."""
+        with self._remote_lock:
+            was_remote = self._remote.pop(fp, None) is not None
+            hook = self.on_cross_shard_nack if was_remote else None
+        if hook is not None:
+            # a REF emitted on gossiped fleet warmth died at the destination
+            # — the cross-shard fragmentation signal (ROADMAP item 3)
+            try:
+                hook(fp)
+            except Exception:  # noqa: BLE001 — metrics hook must not break NACK recovery
+                pass
         s = self._stripe(fp)
         with s.lock:
             entry = s.lru.pop(fp, None)
@@ -144,6 +181,31 @@ class SenderDedupIndex:
             s.bytes -= entry[0]
         with self._budget_lock:
             self._bytes -= entry[0]
+
+    def add_remote(self, fps, origin: str = "?") -> int:
+        """Absorb gossiped fleet warmth: ``fps`` is ``[(fp, size), ...]``
+        proved by peer gateway ``origin``. Entries already proved locally are
+        skipped; the tier is count-bounded FIFO (stale entries cost one NACK
+        each, so over-retention is cheap here, unlike the local LRU)."""
+        added = 0
+        with self._remote_lock:
+            for fp, _size in fps:
+                if fp in self._remote:
+                    self._remote.move_to_end(fp)
+                    continue
+                s = self._stripe(fp)
+                with s.lock:
+                    if fp in s.lru:
+                        continue
+                self._remote[fp] = _size
+                added += 1
+            while len(self._remote) > self._remote_cap:
+                self._remote.popitem(last=False)
+        return added
+
+    def remote_counters(self) -> dict:
+        with self._remote_lock:
+            return {"index_remote_entries": len(self._remote), "index_remote_hits": self._c_remote_hits}
 
     def set_max_bytes(self, max_bytes: int) -> None:
         """Rebound the index (multi-source capacity split: each sender takes a
@@ -303,6 +365,12 @@ class SegmentStore:
         # not silently halve the dedup working set forever
         self._spill_fail_streak = 0
         self.max_spill_write_failures = 32
+        # fleet dedup fabric (dedup_fabric.DedupFabric), attached by the
+        # daemon after construction. When set, a REF miss tries ONE peer
+        # fetch from the ring owner before parking on the arrival event, and
+        # every landed literal feeds write-through placement via note_put.
+        self.fabric = None
+        self._c_fabric_hits = 0
 
     # ---- lock discipline ----
 
@@ -334,6 +402,11 @@ class SegmentStore:
     def put(self, fp: bytes, data: bytes) -> None:
         self._insert(fp, data)
         self._evict_to_budget()
+        if self.fabric is not None:
+            # landed literal: feed the gossip summary + write-through
+            # placement. Peer-fetched segments enter via _insert directly,
+            # so a fetch never push-loops back to the gateway it came from.
+            self.fabric.note_put(fp, data)
 
     def _insert(self, fp: bytes, data: bytes) -> None:
         """Insert into the striped in-memory map and wake any parked REFs."""
@@ -533,6 +606,7 @@ class SegmentStore:
         """
         deadline = time.monotonic() + wait_timeout
         s = self._stripe(fp)
+        tried_fabric = False
         while True:
             with self._hold(s.lock, s):
                 entry = s.mem.get(fp)
@@ -547,6 +621,23 @@ class SegmentStore:
                 self._evict_to_budget()
                 self._c_promotions += 1
                 return data
+            if self.fabric is not None and not tried_fabric:
+                # both local tiers missed: one peer fetch from the ring owner
+                # before parking. Strictly an optimization rung — fetch()
+                # returns None on any trouble and the miss proceeds to the
+                # arrival wait / NACK ladder unchanged. Once per get: a
+                # second attempt could not succeed where the first failed
+                # inside the same ref-wait window, it would only double the
+                # deadline burned before the NACK.
+                tried_fabric = True
+                data = self.fabric.fetch(fp)
+                if data is not None:
+                    # _insert (not put): peer-fetched bytes must not re-feed
+                    # note_put, or two gateways would ping-pong pushes
+                    self._insert(fp, data)
+                    self._evict_to_budget()
+                    self._c_fabric_hits += 1
+                    return data
             # miss: park on the per-fp arrival event. Re-check membership
             # AFTER registering (under the stripe lock) so a put() landing
             # between the lookups above and the registration cannot be lost.
@@ -592,6 +683,21 @@ class SegmentStore:
                 self._c_ref_timeouts += 1
                 raise DedupIntegrityException(f"unresolvable dedup ref {fp.hex()}")
             # the literal (or a spill transition) landed: retry the lookup
+
+    def peek(self, fp: bytes) -> Optional[bytes]:
+        """Non-blocking local-only resolve for the fabric's owner-side serve
+        path: memory or spill, no arrival wait, no peer fetch (a serving
+        gateway must never recurse into the fabric — two cold owners would
+        fetch from each other until both deadlines burn), no promotion and
+        no ref-timeout accounting (a peer's probe is not a datapath miss)."""
+        s = self._stripe(fp)
+        with self._hold(s.lock, s):
+            entry = s.mem.get(fp)
+            if entry is not None:
+                entry[1] = next(self._seq)
+                s.mem.move_to_end(fp)
+                return entry[0]
+        return self._spill_get(fp)
 
     def __contains__(self, fp: bytes) -> bool:
         # membership must be read under the owning locks: probing spill PATHS
@@ -667,6 +773,7 @@ class SegmentStore:
             "store_spill_bytes": spill_bytes,
             "store_spill_adopted": self._adopted_spill_count,
             "store_spill_write_failures": self._c_spill_write_failures,
+            "store_fabric_hits": self._c_fabric_hits,
         }
 
 

@@ -175,6 +175,8 @@ class ServiceController:
         self.c_heartbeats = 0
         self.c_watch_rounds = 0
         self.c_stall_reposts = 0
+        self.c_fabric_exchanges = 0
+        self.c_fabric_fps_exchanged = 0
         self._start_latencies: List[float] = []
         self.wal = ServiceWAL(wal_dir, journal_max_bytes=journal_max_bytes)
         self._load()
@@ -618,6 +620,28 @@ class ServiceController:
         self._last_heartbeat = time.time()
         return len(live)
 
+    def fabric_exchange_once(self) -> Dict[str, int]:
+        """One fleet fingerprint-summary gossip round between the bound
+        gateways, piggybacked on the heartbeat cadence (docs/dedup-fabric.md):
+        each gateway's recently-proved fps cross-post to the other, so sender
+        dedup indexes fleet-wide treat them as durable warmth. Free when no
+        fabric is configured (summaries come back empty, nothing posts)."""
+        from skyplane_tpu.dedup_fabric import run_summary_exchange
+
+        legs = []
+        seen = set()
+        for bg in (self.source, self.sink):
+            if bg is None or bg.gateway_id in seen:
+                continue
+            seen.add(bg.gateway_id)
+            legs.append((bg.control_url(), bg.control_session()))
+        if len(legs) < 2:
+            return {"pulled": 0, "posted": 0, "failed": 0, "fps": 0}
+        stats = run_summary_exchange(legs)
+        self.c_fabric_exchanges += 1
+        self.c_fabric_fps_exchanged += stats["fps"]
+        return stats
+
     # ---- continuous sync ----
 
     def run_watch_rounds(self) -> int:
@@ -684,6 +708,9 @@ class ServiceController:
         self.poll_once()
         if time.time() - self._last_heartbeat >= self.heartbeat_interval_s:
             self.heartbeat_once()
+            # gossip rides the same cadence: no extra timers, and a dead
+            # controller degrades gossip exactly as it degrades heartbeats
+            self.fabric_exchange_once()
         self.run_watch_rounds()
 
     def close(self) -> None:
@@ -713,6 +740,8 @@ class ServiceController:
             "heartbeats": self.c_heartbeats,
             "watch_rounds": self.c_watch_rounds,
             "stall_reposts": self.c_stall_reposts,
+            "fabric_exchanges": self.c_fabric_exchanges,
+            "fabric_fps_exchanged": self.c_fabric_fps_exchanged,
             "source_gateway": self.source.gateway_id if self.source else None,
             "sink_gateway": self.sink.gateway_id if self.sink else None,
         }

@@ -343,7 +343,8 @@ class PersistentDedupIndex(SenderDedupIndex):
             total = self._bytes
         with self._attr_lock:
             per_tenant = dict(self._tenant_bytes)
-        return {
+        out = self.remote_counters()  # fleet-gossip tier (dedup_fabric)
+        out.update({
             "index_bytes": total,
             "index_entries": len(self),
             "index_journal_appends": self._c_journal_appends,
@@ -354,4 +355,5 @@ class PersistentDedupIndex(SenderDedupIndex):
             "index_warm_fingerprint_hits": self._c_warm_hits,
             "index_tenant_quota_evictions": self._c_quota_evictions,
             "tenant_index_bytes": per_tenant,  # nested: labelled-provider food
-        }
+        })
+        return out

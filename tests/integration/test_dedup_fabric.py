@@ -1,0 +1,232 @@
+"""Fleet dedup-fabric integration: cross-gateway REF warmth via peer fetch.
+
+Two independent src->dst pairs share a segment namespace through the fabric
+(docs/dedup-fabric.md): a corpus uploaded through gateway pair A, followed by
+one gossip round, lets pair B re-send the SAME content as pure REFs — the
+receiver resolves every miss from the ring owner over
+``GET /api/v1/segment/<fp>`` instead of NACKing the source for literals.
+
+The second test arms the ``fabric.peer_fetch`` fault point and proves the
+fabric is strictly an optimization rung: with every peer fetch dropped, the
+pre-existing NACK -> literal-resend ladder completes the transfer
+byte-identically (docs/fault-injection.md).
+"""
+
+import time
+from pathlib import Path
+
+from integration.harness import dispatch_file, start_gateway, wait_complete
+from skyplane_tpu.dedup_fabric import run_summary_exchange
+from skyplane_tpu.faults import FaultPlan, configure_injector
+
+
+def _recv_program() -> dict:
+    return {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "receive",
+                        "handle": "recv",
+                        "decrypt": False,
+                        "dedup": True,
+                        "children": [{"op_type": "write_local", "handle": "write", "children": []}],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def _send_program(target_gateway_id: str) -> dict:
+    return {
+        "plan": [
+            {
+                "partitions": ["default"],
+                "value": [
+                    {
+                        "op_type": "read_local",
+                        "handle": "read",
+                        "num_connections": 2,
+                        "children": [
+                            {
+                                "op_type": "send",
+                                "handle": "send",
+                                "target_gateway_id": target_gateway_id,
+                                "region": "local:local",
+                                "num_connections": 2,
+                                "compress": "none",
+                                "encrypt": False,
+                                "dedup": True,
+                                "children": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def _start_fleet(tmp: Path):
+    """Two disjoint src->dst pairs with distinct gateway ids; both receivers
+    joined into one fabric ring BEFORE any data moves (note_put is inert on an
+    unconfigured fabric, so membership must precede the first landing)."""
+    dstA = start_gateway(_recv_program(), {}, "gw_dstA", str(tmp / "dstA_chunks"), use_tls=False)
+    dstB = start_gateway(_recv_program(), {}, "gw_dstB", str(tmp / "dstB_chunks"), use_tls=False)
+    srcA = start_gateway(
+        _send_program("gw_dstA"),
+        {"gw_dstA": {"public_ip": "127.0.0.1", "control_port": dstA.control_port}},
+        "gw_srcA",
+        str(tmp / "srcA_chunks"),
+        use_tls=False,
+    )
+    srcB = start_gateway(
+        _send_program("gw_dstB"),
+        {"gw_dstB": {"public_ip": "127.0.0.1", "control_port": dstB.control_port}},
+        "gw_srcB",
+        str(tmp / "srcB_chunks"),
+        use_tls=False,
+    )
+    membership = {
+        "members": [
+            {"id": "gw_dstA", "url": f"http://127.0.0.1:{dstA.control_port}", "seat": "gw_dstA"},
+            {"id": "gw_dstB", "url": f"http://127.0.0.1:{dstB.control_port}", "seat": "gw_dstB"},
+        ],
+        "draining": [],
+    }
+    for gw in (dstA, dstB):
+        resp = gw.post("fabric/membership", json=membership, timeout=10)
+        resp.raise_for_status()
+        assert resp.json()["members"] == 2
+    return srcA, dstA, srcB, dstB
+
+
+def _drain_pushes(dst, timeout: float = 30.0) -> None:
+    """Wait for the write-through push queue to empty (placement converged
+    enough that the warm-resend phase measures steady state, not a race)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if dst.daemon.fabric.counters()["fabric_push_queue_depth"] == 0:
+            time.sleep(0.3)  # let an in-flight POST finish landing
+            return
+        time.sleep(0.2)
+    raise TimeoutError("fabric push queue did not drain")
+
+
+def _gossip(*legs) -> dict:
+    return run_summary_exchange(
+        [(f"http://127.0.0.1:{gw.control_port}/api/v1", gw.session()) for gw in legs]
+    )
+
+
+def _sender_op(src):
+    return next(op for op in src.daemon.operators if getattr(op, "dedup_index", None) is not None)
+
+
+def _metric(gw, sample: str) -> float:
+    """Read one exact sample line (name or name{labels}) off /metrics."""
+    for line in gw.get("metrics", timeout=10).text.splitlines():
+        if line.startswith(f"{sample} "):
+            return float(line.split()[-1])
+    return 0.0
+
+
+def _corpus(seed: int, size: int) -> bytes:
+    import random
+
+    return random.Random(seed).randbytes(size)
+
+
+def test_cross_gateway_dedup_via_peer_fetch(tmp_path):
+    data = _corpus(7, 1536 << 10)
+    f = tmp_path / "corpus.bin"
+    f.write_bytes(data)
+    outA = tmp_path / "out" / "a.bin"
+    outB = tmp_path / "out" / "b.bin"
+
+    srcA, dstA, srcB, dstB = _start_fleet(tmp_path)
+    try:
+        # phase 1: the corpus enters the fleet through pair A
+        ids = dispatch_file(srcA, f, outA, chunk_bytes=256 << 10)
+        wait_complete(srcA, ids, timeout=120)
+        wait_complete(dstA, ids, timeout=120)
+        assert outA.read_bytes() == data
+        _drain_pushes(dstA)
+
+        # one gossip round: pair B's source learns the fleet proved these fps
+        stats = _gossip(dstA, dstB, srcB)
+        assert stats["failed"] == 0 and stats["fps"] > 0
+        sender = _sender_op(srcB)
+        assert sender.dedup_index.counters()["index_remote_entries"] > 0, (
+            "gossip round should have seeded srcB's sender index with remote warmth"
+        )
+
+        # phase 2: the SAME bytes through pair B — REFs only, no literals
+        ids2 = dispatch_file(srcB, f, outB, chunk_bytes=256 << 10)
+        wait_complete(srcB, ids2, timeout=180)
+        wait_complete(dstB, ids2, timeout=180)
+        assert outB.read_bytes() == data
+
+        s = sender.processor.stats.as_dict()
+        assert s["segments"] > 0
+        assert s["ref_segments"] == s["segments"], (
+            f"warm cross-gateway resend shipped {s['segments'] - s['ref_segments']} source literals"
+        )
+        # the REF misses at dstB resolved from the fleet, not the source
+        fab = dstB.daemon.fabric.counters()
+        assert fab["fabric_peer_fetch_hits"] > 0, f"expected peer fetches at dstB, counters: {fab}"
+        assert dstB.daemon.receiver.nacks_total == 0
+        assert fab["fabric_land_rejects"] == 0
+
+        # the new surfaces are live on /metrics
+        assert _metric(dstB, 'skyplane_peer_fetch_total{result="hit"}') > 0
+        assert _metric(dstB, "skyplane_peer_fetch_seconds_count") > 0
+        assert _metric(srcB, "skyplane_cross_shard_nacks_total") == 0
+        assert _metric(dstB, "skyplane_fabric_peer_fetch_hits") == fab["fabric_peer_fetch_hits"]
+    finally:
+        for gw in (srcA, srcB, dstA, dstB):
+            gw.stop()
+
+
+def test_peer_fetch_fault_heals_to_literal_resend(tmp_path):
+    data = _corpus(11, 1 << 20)
+    f = tmp_path / "corpus.bin"
+    f.write_bytes(data)
+    outA = tmp_path / "out" / "a.bin"
+    outB = tmp_path / "out" / "b.bin"
+
+    srcA, dstA, srcB, dstB = _start_fleet(tmp_path)
+    try:
+        # forced NACKs must not stall for the full production ref-wait
+        dstB.daemon.receiver.ref_wait_timeout = 0.5
+
+        ids = dispatch_file(srcA, f, outA, chunk_bytes=256 << 10)
+        wait_complete(srcA, ids, timeout=120)
+        wait_complete(dstA, ids, timeout=120)
+        _drain_pushes(dstA)
+        _gossip(dstA, dstB, srcB)
+
+        # every peer fetch now drops (docs/fault-injection.md fabric.peer_fetch):
+        # segments whose ring owner is dstA cannot be fetched, so their REFs
+        # must heal through NACK -> literal resend — byte-identical output
+        configure_injector(FaultPlan.from_dict({"seed": 3, "points": {"fabric.peer_fetch": {"p": 1.0}}}))
+        ids2 = dispatch_file(srcB, f, outB, chunk_bytes=256 << 10)
+        wait_complete(srcB, ids2, timeout=180)
+        wait_complete(dstB, ids2, timeout=180)
+        assert outB.read_bytes() == data
+
+        fab = dstB.daemon.fabric.counters()
+        assert fab["fabric_peer_fetch_hits"] == 0
+        assert fab["fabric_peer_fetch_timeouts"] + fab["fabric_breaker_skips"] > 0, (
+            f"armed fault never fired, counters: {fab}"
+        )
+        # the heal path actually ran: stale cross-shard warmth surfaced as
+        # NACKs at the receiver and as discards on the source's remote tier
+        assert dstB.daemon.receiver.nacks_total > 0
+        assert _metric(srcB, "skyplane_cross_shard_nacks_total") > 0
+    finally:
+        configure_injector(None)
+        for gw in (srcA, srcB, dstA, dstB):
+            gw.stop()

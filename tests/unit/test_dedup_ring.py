@@ -1,0 +1,382 @@
+"""Dedup-fabric placement + peer-fetch unit tests (docs/dedup-fabric.md).
+
+The ring contracts here are the ones the fleet's dedup ratio hangs off:
+determinism (no coordinator — every member computes the same owner), minimal
+remap on churn (~1/N per single join/leave), drain exclusion without remap,
+and replacement seat adoption. The fabric half covers the failure semantics
+peer fetch promises: every branch degrades to None (the caller's NACK ->
+literal-resend ladder), breaker windows bound dead-peer cost, and content
+verification keeps a corrupt peer out of the store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import pytest
+
+from skyplane_tpu.dedup_fabric import ConsistentHashRing, DedupFabric
+from skyplane_tpu.dedup_fabric.fabric import _content_matches
+from skyplane_tpu.ops.dedup import SegmentStore, SenderDedupIndex
+from skyplane_tpu.ops.fingerprint import segment_fingerprint_host
+
+
+def _fps(n: int):
+    return [hashlib.blake2b(str(i).encode(), digest_size=16).digest() for i in range(n)]
+
+
+# ---- ring placement ----
+
+
+def test_ring_placement_deterministic_across_instances():
+    fps = _fps(512)
+    a = ConsistentHashRing()
+    b = ConsistentHashRing()
+    for ring in (a, b):
+        for node in ("gw2", "gw0", "gw1"):  # insertion order must not matter
+            ring.add_node(node)
+    assert [a.owner(fp) for fp in fps] == [b.owner(fp) for fp in fps]
+    # every node owns a share (vnodes smooth the split)
+    owners = {a.owner(fp) for fp in fps}
+    assert owners == {"gw0", "gw1", "gw2"}
+
+
+def test_ring_single_join_remaps_about_one_over_n():
+    fps = _fps(2000)
+    ring = ConsistentHashRing()
+    for node in ("gw0", "gw1", "gw2"):
+        ring.add_node(node)
+    before = [ring.owner(fp) for fp in fps]
+    ring.add_node("gw3")
+    after = [ring.owner(fp) for fp in fps]
+    moved = sum(1 for x, y in zip(before, after) if x != y)
+    # ideal share for the 4th node is 1/4; allow slack for vnode variance
+    # but fail hard if a join reshuffles the keyspace wholesale
+    assert moved / len(fps) < 0.40, f"join remapped {moved}/{len(fps)} keys"
+    # everything that moved moved TO the joiner (consistent hashing invariant)
+    assert all(y == "gw3" for x, y in zip(before, after) if x != y)
+
+
+def test_ring_single_leave_remaps_only_the_departed_share():
+    fps = _fps(2000)
+    ring = ConsistentHashRing()
+    for node in ("gw0", "gw1", "gw2", "gw3"):
+        ring.add_node(node)
+    before = [ring.owner(fp) for fp in fps]
+    ring.remove_node("gw3")
+    after = [ring.owner(fp) for fp in fps]
+    for x, y in zip(before, after):
+        if x != "gw3":
+            assert y == x, "a leave must not move keys the departed node never owned"
+        else:
+            assert y in ("gw0", "gw1", "gw2")
+
+
+def test_ring_drain_excluded_at_lookup_without_remap():
+    fps = _fps(1000)
+    ring = ConsistentHashRing()
+    for node in ("gw0", "gw1", "gw2"):
+        ring.add_node(node)
+    before = [ring.owner(fp) for fp in fps]
+    drained = [ring.owner(fp, exclude=("gw1",)) for fp in fps]
+    # draining stays ON the ring: undrained keys keep their owner...
+    for x, y in zip(before, drained):
+        if x != "gw1":
+            assert y == x
+        else:
+            assert y in ("gw0", "gw2")
+    # ...and the transient state reverses cleanly
+    assert [ring.owner(fp) for fp in fps] == before
+    # fully-excluded ring has no owner
+    assert ring.owner(fps[0], exclude=("gw0", "gw1", "gw2")) is None
+
+
+def test_ring_replacement_adopts_dead_nodes_seat():
+    fps = _fps(1000)
+    ring = ConsistentHashRing()
+    for node in ("gw0", "gw1", "gw2"):
+        ring.add_node(node)
+    before = [ring.owner(fp) for fp in fps]
+    seat = ring.remove_node("gw1")
+    assert seat == "gw1"
+    ring.add_node("gw1_replacement", seat=seat)
+    after = [ring.owner(fp) for fp in fps]
+    # bit-for-bit position adoption: exactly the dead node's keys, no others
+    for x, y in zip(before, after):
+        assert y == ("gw1_replacement" if x == "gw1" else x)
+    assert ring.seat_of("gw1_replacement") == "gw1"
+
+
+def test_ring_owners_returns_distinct_successors():
+    ring = ConsistentHashRing()
+    for node in ("gw0", "gw1", "gw2"):
+        ring.add_node(node)
+    fp = _fps(1)[0]
+    owners = ring.owners(fp, count=3)
+    assert len(owners) == 3 and len(set(owners)) == 3
+    assert owners[0] == ring.owner(fp)
+    # primary excluded -> the old secondary is the new primary
+    assert ring.owner(fp, exclude=(owners[0],)) == owners[1]
+
+
+# ---- content verification (two fingerprint namespaces) ----
+
+
+def test_content_matches_accepts_both_fp_namespaces():
+    data = os.urandom(4096)
+    assert _content_matches(hashlib.blake2b(data, digest_size=16).digest(), data)
+    assert _content_matches(segment_fingerprint_host(data), data)
+    assert not _content_matches(b"\x00" * 16, data)
+
+
+# ---- fabric: membership + fetch failure semantics ----
+
+
+def _membership(self_id="gwA", peer_url="http://127.0.0.1:1"):
+    return {"members": [{"id": self_id, "url": ""}, {"id": "gwB", "url": peer_url}]}
+
+
+def test_fabric_unconfigured_is_inert():
+    f = DedupFabric("gwA")
+    assert not f.configured
+    assert f.owner_of(b"\x01" * 16) is None
+    assert f.fetch(b"\x01" * 16) is None
+    f.note_put(b"\x01" * 16, b"data")  # must not enqueue or throw
+    assert f.summary()["fps"] == []
+    assert f.counters()["fabric_push_queue_depth"] == 0
+    f.close()
+
+
+def test_fabric_fetch_owner_self_is_local_miss():
+    f = DedupFabric("gwA", membership={"members": [{"id": "gwA", "url": ""}]})
+    fp = b"\x02" * 16
+    assert f.owner_of(fp) == "gwA"
+    assert f.fetch(fp) is None  # never fetches from itself
+    assert f.counters()["fabric_peer_fetch_hits"] == 0
+    f.close()
+
+
+def test_fabric_fetch_transport_failure_trips_breaker(monkeypatch):
+    f = DedupFabric("gwA", membership=_membership(), fetch_deadline_s=0.2)
+    fp = next(p for p in _fps(64) if f.owner_of(p) == "gwB")
+
+    def boom(owner, member, q):
+        raise ConnectionError("peer down")
+
+    monkeypatch.setattr(f, "_http_get_segment", boom)
+    for _ in range(3):
+        assert f.fetch(fp) is None
+    c = f.counters()
+    assert c["fabric_peer_fetch_misses"] == 3
+    assert c["fabric_breaker_opens"] == 1
+    # breaker open: the next fetch skips without touching the peer
+    assert f.fetch(fp) is None
+    assert f.counters()["fabric_breaker_skips"] == 1
+    f.close()
+
+
+def test_fabric_fetch_404_is_clean_miss_not_breaker_strike(monkeypatch):
+    f = DedupFabric("gwA", membership=_membership())
+    fp = next(p for p in _fps(64) if f.owner_of(p) == "gwB")
+    monkeypatch.setattr(f, "_http_get_segment", lambda o, m, q: None)
+    for _ in range(10):
+        assert f.fetch(fp) is None
+    c = f.counters()
+    assert c["fabric_peer_fetch_misses"] == 10
+    assert c["fabric_breaker_opens"] == 0 and c["fabric_breaker_skips"] == 0
+    f.close()
+
+
+def test_fabric_fetch_verifies_content(monkeypatch):
+    f = DedupFabric("gwA", membership=_membership())
+    data = os.urandom(1024)
+    good_fp = hashlib.blake2b(data, digest_size=16).digest()
+    monkeypatch.setattr(f, "_http_get_segment", lambda o, m, q: data)
+    if f.owner_of(good_fp) == "gwB":
+        assert f.fetch(good_fp) == data
+        assert f.counters()["fabric_peer_fetch_hits"] == 1
+    # a fp the data does NOT hash to is rejected — corrupt peer, miss
+    bad_fp = next(p for p in _fps(64) if f.owner_of(p) == "gwB")
+    assert f.fetch(bad_fp) is None
+    assert f.counters()["fabric_peer_fetch_misses"] >= 1
+    f.close()
+
+
+def test_fabric_fault_point_drops_fetch(monkeypatch):
+    from skyplane_tpu.faults import FaultPlan, configure_injector, get_injector
+
+    f = DedupFabric("gwA", membership=_membership())
+    fp = next(p for p in _fps(64) if f.owner_of(p) == "gwB")
+    monkeypatch.setattr(f, "_http_get_segment", lambda o, m, q: b"never reached")
+    configure_injector(FaultPlan.from_dict({"seed": 7, "points": {"fabric.peer_fetch": {"p": 1.0}}}))
+    try:
+        assert f.fetch(fp) is None
+        assert f.counters()["fabric_peer_fetch_timeouts"] == 1
+        assert get_injector().counters().get("fabric.peer_fetch", 0) >= 1
+    finally:
+        configure_injector(None)
+        f.close()
+
+
+def test_fabric_note_put_routes_to_ring_owner():
+    f = DedupFabric("gwA", membership=_membership())
+    # landed literals owned by the PEER queue a write-through push; ours don't
+    mine = next(p for p in _fps(256) if f.owner_of(p) == "gwA")
+    theirs = next(p for p in _fps(256) if f.owner_of(p) == "gwB")
+    f.note_put(mine, b"m")
+    f.note_put(theirs, b"t")
+    # both are recorded for the gossip summary regardless of owner
+    assert {hexfp for hexfp, _ in f.summary()["fps"]} == {mine.hex(), theirs.hex()}
+    f.close()
+
+
+def test_fabric_summary_absorb_roundtrip_feeds_sinks():
+    a = DedupFabric("gwA", membership=_membership())
+    b = DedupFabric("gwB", membership=_membership(self_id="gwB"))
+    got = []
+    b.add_absorb_sink(lambda batch, origin: got.append((origin, list(batch))))
+    for fp in _fps(5):
+        a.note_put(fp, b"x" * 10)
+    n = b.absorb(a.summary())
+    assert n == 5
+    assert got and got[0][0] == "gwA" and len(got[0][1]) == 5
+    assert {fp for fp, _ in b.absorbed_fps()} == set(_fps(5))
+    # malformed summaries absorb nothing and don't throw
+    assert b.absorb({"gateway": "x", "fps": [["zz", 1], ["deadbeef", 2], 7]}) == 0
+    a.close()
+    b.close()
+
+
+def test_fabric_land_and_serve_through_segment_store(tmp_path):
+    f = DedupFabric("gwA", membership={"members": [{"id": "gwA", "url": ""}]})
+    store = SegmentStore(max_bytes=1 << 20, spill_dir=tmp_path / "spill", spill_max_bytes=1 << 20)
+    f.local_store = store
+    data = os.urandom(2048)
+    fp = segment_fingerprint_host(data)
+    # land verifies content before the store ever sees the bytes
+    assert not f.land(fp, b"corrupt" * 100)
+    assert f.counters()["fabric_land_rejects"] == 1
+    assert f.land(fp, data)
+    assert f.serve(fp) == data
+    assert f.serve(b"\x07" * 16) is None
+    c = f.counters()
+    assert c["fabric_lands"] == 1 and c["fabric_serves"] == 1 and c["fabric_serve_misses"] == 1
+    f.close()
+
+
+def test_fabric_serve_from_sealed_frame_cache(tmp_path):
+    from skyplane_tpu.gateway.chunk_store import ChunkStore
+
+    cs = ChunkStore(str(tmp_path / "chunks"))
+    wire = os.urandom(4096)
+    fp_hex = hashlib.blake2b(wire, digest_size=16).hexdigest()
+    cs.seal_frame("c1", {"codec": "none", "flags": 0, "fingerprint": fp_hex, "raw_data_len": len(wire)}, wire=wire)
+    f = DedupFabric("gwA", membership={"members": [{"id": "gwA", "url": ""}]})
+    f.chunk_store = cs
+    assert f.serve(bytes.fromhex(fp_hex)) == wire
+    c = f.counters()
+    assert c["fabric_serves_sealed"] == 1
+    # the borrow was released: GC can discard the entry immediately
+    assert cs.sealed_stats()["sealed_refs"] == 0
+    f.close()
+
+
+def test_fabric_serve_from_pump_spill_roots(tmp_path):
+    root = tmp_path / "segments"
+    (root / "pump0").mkdir(parents=True)
+    data = os.urandom(512)
+    fp = segment_fingerprint_host(data)
+    (root / "pump0" / f"{fp.hex()}.seg").write_bytes(data)
+    f = DedupFabric("gwA", membership={"members": [{"id": "gwA", "url": ""}]}, serve_spill_roots=[root])
+    assert f.serve(fp) == data
+    f.close()
+
+
+def test_fabric_configure_listeners_and_draining():
+    f = DedupFabric("gwA")
+    seen = []
+    f.configure_listeners.append(seen.append)
+    doc = _membership()
+    f.configure(doc)
+    assert seen == [doc]
+    fp = next(p for p in _fps(256) if f.owner_of(p) == "gwB")
+    f.set_draining(["gwB"])
+    assert f.owner_of(fp) == "gwA"  # drained peers excluded at lookup
+    assert "gwB" in f.membership()["draining"]
+    f.set_draining([])
+    assert f.owner_of(fp) == "gwB"
+    f.close()
+
+
+# ---- sender index remote-warmth tier ----
+
+
+def test_sender_index_remote_tier_and_cross_shard_nack_hook():
+    idx = SenderDedupIndex(max_bytes=1 << 20)
+    nacked = []
+    idx.on_cross_shard_nack = nacked.append
+    local_fp, remote_fp, cold_fp = _fps(3)
+    idx.add(local_fp, 100)
+    assert idx.add_remote([(remote_fp, 64)], origin="gwB") == 1
+    # already-local fps are not double-counted as remote
+    assert idx.add_remote([(local_fp, 100)], origin="gwB") == 0
+    assert local_fp in idx and remote_fp in idx and cold_fp not in idx
+    assert idx.remote_counters()["index_remote_hits"] >= 1
+    # graduation: proving the fp locally moves it out of the remote tier
+    idx.add(remote_fp, 64)
+    assert idx.remote_counters()["index_remote_entries"] == 0
+    # discarding a locally-proved fp is NOT a cross-shard nack...
+    idx.discard(local_fp)
+    assert nacked == []
+    # ...but discarding one only gossip vouched for is
+    other = _fps(4)[3]
+    idx.add_remote([(other, 32)], origin="gwC")
+    idx.discard(other)
+    assert nacked == [other]
+
+
+def test_segment_store_fabric_hook_fetches_on_miss(tmp_path):
+    class FakeFabric:
+        def __init__(self):
+            self.puts = []
+            self.payload = {}
+
+        def note_put(self, fp, data):
+            self.puts.append(fp)
+
+        def fetch(self, fp):
+            return self.payload.get(fp)
+
+    store = SegmentStore(max_bytes=1 << 20, spill_dir=tmp_path / "s", spill_max_bytes=1 << 20)
+    fab = FakeFabric()
+    store.fabric = fab
+    fp1, fp2, fp3 = _fps(3)
+    store.put(fp1, b"local")
+    assert fab.puts == [fp1]  # landed literals feed write-through placement
+    fab.payload[fp2] = b"from-peer"
+    assert store.get(fp2, wait_timeout=0.1) == b"from-peer"
+    assert store.counters()["store_fabric_hits"] == 1
+    # peer-fetched data is inserted WITHOUT re-notifying the fabric (no
+    # push ping-pong) and serves locally afterwards
+    assert fab.puts == [fp1]
+    assert store.peek(fp2) == b"from-peer"
+    # a fetch miss falls through to the ordinary ref-timeout path unchanged
+    from skyplane_tpu.ops.dedup import DedupIntegrityException
+
+    with pytest.raises(DedupIntegrityException):
+        store.get(fp3, wait_timeout=0.05)
+    assert store.peek(fp3) is None
+
+
+def test_persistent_index_counters_include_remote_tier(tmp_path):
+    from skyplane_tpu.tenancy import PersistentDedupIndex
+
+    idx = PersistentDedupIndex(tmp_path / "journal")
+    try:
+        assert idx.add_remote([(b"\x01" * 16, 10)], origin="gwB") == 1
+        c = idx.counters()
+        assert c["index_remote_entries"] == 1
+        assert b"\x01" * 16 in idx
+    finally:
+        idx.close()
