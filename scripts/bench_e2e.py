@@ -230,6 +230,25 @@ def timed_transfer(tmp: Path, tag: str, corpus: Path, gbps: float, rtt_ms: float
             p.close()
 
 
+def timeline_sweep(sizes_mb: str, chunk_kb: int) -> dict:
+    """The ISSUE-20 attribution sweep (scripts/report_overhead.py): >=3
+    loopback tracker transfers across corpus sizes, each fully sampled into a
+    fleet event log; banks ``e2e_fixed_overhead_s`` (the wall = overhead +
+    bytes/rate fit) and ``timeline_critical_path_s`` (largest run's solved
+    path) — the keys scripts/check_bench_json.py's timeline branch gates."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "report_overhead", Path(__file__).resolve().parent / "report_overhead.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sizes = [int(float(s) * (1 << 20)) for s in sizes_mb.split(",")]
+    result = mod.run_sweep(sizes, chunk_bytes=chunk_kb << 10)
+    print(result.pop("timeline_text"), file=sys.stderr)
+    return result
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     # default sweep stays in the WAN-bound regime for a 1-vCPU dev host (the
@@ -241,12 +260,28 @@ def main() -> int:
     ap.add_argument("--snapshots", type=int, default=3)
     ap.add_argument("--snap-chunks", type=int, default=2)
     ap.add_argument("--chunk-mb", type=int, default=8)
+    ap.add_argument("--timeline-sizes-mb", default="1,4,16", help=">=3 sizes for the overhead fit")
+    ap.add_argument("--timeline-chunk-kb", type=int, default=256)
+    ap.add_argument(
+        "--timeline-only", action="store_true",
+        help="skip the WAN matrix; emit just the timeline/overhead summary (devloop smoke)",
+    )
     ap.add_argument("--out", default=None, help="append the JSON summary to this file")
     args = ap.parse_args()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    if args.timeline_only:
+        summary = {"metric": "timeline_overhead", "unit": "seconds"}
+        summary.update(timeline_sweep(args.timeline_sizes_mb, args.timeline_chunk_kb))
+        line = json.dumps(summary)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(line + "\n")
+        return 0
 
     import tempfile
 
@@ -276,6 +311,9 @@ def main() -> int:
         "raw_bytes": raw,
         "rows": rows,
     }
+    # the attribution keys ride the full-bench artifact too, so one banked
+    # JSON answers both "how fast" and "where did the seconds go"
+    summary.update(timeline_sweep(args.timeline_sizes_mb, args.timeline_chunk_kb))
     line = json.dumps(summary)
     print(line, flush=True)
     if args.out:

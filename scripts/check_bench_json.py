@@ -371,6 +371,105 @@ MAX_FLEET_RECONCILE_PCT = 10.0
 MAX_COLLECTOR_OVERHEAD_PCT = 2.0
 
 
+# timeline / critical-path attribution result (scripts/bench_e2e.py
+# --timeline-only, scripts/report_overhead.py — docs/observability.md "Job
+# timelines & critical path"): a >=3-size loopback sweep, each run fully
+# sampled into a fleet event log, fitted to wall = overhead + bytes/rate
+REQUIRED_TIMELINE = (
+    "metric",
+    "unit",
+    "timeline_sizes_bytes",
+    "timeline_samples",
+    "e2e_fixed_overhead_s",
+    "e2e_fit_rate_bytes_per_s",
+    "e2e_fit_r2",
+    "timeline_critical_path_s",
+    "timeline_wall_s",
+    "timeline_coverage",
+    "timeline_fixed_s",
+    "timeline_scaled_s",
+    "timeline_largest_fixed_phase",
+    "timeline_phase_count",
+)
+#: the solved critical path must explain the timeline wall-clock to within
+#: 10% (the ISSUE-20 acceptance bound) — below this the DAG is dropping
+#: intervals; above 1.0 (plus float slack) it is double-counting overlap
+MIN_TIMELINE_COVERAGE = 0.90
+MAX_TIMELINE_COVERAGE = 1.001
+#: banked fixed-overhead baseline: the paper's ~2 s provisioned-path figure.
+#: The loopback sweep has no provisioning/TLS/WAN, so it must come in WELL
+#: under it — a loopback fit drifting past the bound means the client path
+#: itself regressed (dispatch serialization, drain poll, collector stalls)
+MAX_E2E_FIXED_OVERHEAD_S = 2.0
+MIN_TIMELINE_SIZES = 3
+
+
+def check_timeline(result: dict) -> int:
+    missing = [k for k in REQUIRED_TIMELINE if k not in result]
+    if missing:
+        print(f"timeline-smoke: result missing keys: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    sizes = result["timeline_sizes_bytes"]
+    if not isinstance(sizes, list) or len(sizes) < MIN_TIMELINE_SIZES or len(set(sizes)) < 2:
+        print(
+            f"timeline-smoke: fit needs >={MIN_TIMELINE_SIZES} sizes (>=2 distinct), got {sizes!r}",
+            file=sys.stderr,
+        )
+        return 1
+    overhead = result["e2e_fixed_overhead_s"]
+    if not isinstance(overhead, (int, float)) or overhead < 0:
+        print(f"timeline-smoke: e2e_fixed_overhead_s {overhead!r} is not a non-negative number", file=sys.stderr)
+        return 1
+    if overhead > MAX_E2E_FIXED_OVERHEAD_S:
+        print(
+            f"timeline-smoke: fixed overhead {overhead}s regressed past the banked "
+            f"{MAX_E2E_FIXED_OVERHEAD_S}s baseline — the loopback client path got slower",
+            file=sys.stderr,
+        )
+        return 1
+    cp, wall = result["timeline_critical_path_s"], result["timeline_wall_s"]
+    cov = result["timeline_coverage"]
+    if not all(isinstance(v, (int, float)) and v > 0 for v in (cp, wall, cov)):
+        print(f"timeline-smoke: non-positive path/wall/coverage: {cp!r}/{wall!r}/{cov!r}", file=sys.stderr)
+        return 1
+    if cov < MIN_TIMELINE_COVERAGE or cov > MAX_TIMELINE_COVERAGE:
+        print(
+            f"timeline-smoke: critical path {cp}s explains {100 * cov:.1f}% of wall {wall}s "
+            f"(required {100 * MIN_TIMELINE_COVERAGE:.0f}-{100 * MAX_TIMELINE_COVERAGE:.1f}%) — "
+            "the DAG is dropping intervals or double-counting overlap",
+            file=sys.stderr,
+        )
+        return 1
+    if not result["timeline_largest_fixed_phase"]:
+        print("timeline-smoke: no largest fixed-cost phase attributed (empty waterfall?)", file=sys.stderr)
+        return 1
+    if result["timeline_phase_count"] < 2:
+        print(
+            f"timeline-smoke: only {result['timeline_phase_count']} phase interval(s) sampled — "
+            "the lifecycle instrumentation did not fire",
+            file=sys.stderr,
+        )
+        return 1
+    fx, sc = result["timeline_fixed_s"], result["timeline_scaled_s"]
+    if not all(isinstance(v, (int, float)) and v >= 0 for v in (fx, sc)):
+        print(f"timeline-smoke: bad fixed/scaled split: {fx!r}/{sc!r}", file=sys.stderr)
+        return 1
+    if abs((fx + sc) - cp) > max(0.01, 0.01 * cp):
+        print(
+            f"timeline-smoke: fixed {fx}s + scaled {sc}s != critical path {cp}s — "
+            "the attribution split does not reconcile",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"timeline-smoke OK: {len(sizes)}-size sweep, fixed overhead {overhead}s "
+        f"(baseline {MAX_E2E_FIXED_OVERHEAD_S}s), critical path {cp}s = {100 * cov:.1f}% of wall "
+        f"{wall}s, largest fixed cost '{result['timeline_largest_fixed_phase']}' "
+        f"(fixed {fx}s | byte-scaled {sc}s)"
+    )
+    return 0
+
+
 # always-on service soak result (scripts/soak_service.py /
 # docs/service-mode.md): one standing fleet, >=50 sequential + >=8
 # concurrent warm jobs, a SIGKILLed controller recovered from the WAL
@@ -382,6 +481,8 @@ REQUIRED_SERVICE = (
     "service_concurrent_jobs",
     "service_job_start_p50_s",
     "service_job_start_p95_s",
+    "service_dispatch_hist_p50_s",
+    "service_dispatch_hist_p95_s",
     "service_start_bound_s",
     "service_dedup_hit_cold",
     "service_dedup_hit_warm",
@@ -438,6 +539,17 @@ def check_service(result: dict) -> int:
         print(
             f"service-smoke: warm-job start p50 {p50!r}s breaches the "
             f"{result['service_start_bound_s']}s bound — the standing fleet is not warm",
+            file=sys.stderr,
+        )
+        return 1
+    # the histogram-derived p50 (skyplane_service_dispatch_seconds) must agree:
+    # the soak gate and a production dashboard read the SAME series, so a
+    # dispatch-path latency regression cannot hide behind ad-hoc timing
+    hp50 = result["service_dispatch_hist_p50_s"]
+    if not isinstance(hp50, (int, float)) or hp50 <= 0 or hp50 >= result["service_start_bound_s"]:
+        print(
+            f"service-smoke: histogram-derived warm-dispatch p50 {hp50!r}s breaches the "
+            f"{result['service_start_bound_s']}s bound (service_dispatch_seconds series)",
             file=sys.stderr,
         )
         return 1
@@ -1166,6 +1278,8 @@ def main(argv) -> int:
         return check_fleet(result)
     if result.get("metric") == "service_jobs":
         return check_service(result)
+    if result.get("metric") == "timeline_overhead":
+        return check_timeline(result)
     if result.get("metric") == "blast_soak":
         return check_blast(result)
     if result.get("metric") == "fabric_soak":

@@ -140,6 +140,29 @@ else
   echo "[devloop] monitor-smoke clean; result at $LOGDIR/monitor_smoke.out, merged trace at $LOGDIR/monitor_trace.json" >>"$LOGDIR/devloop.log"
 fi
 
+# Timeline-smoke gate (CPU-only, ~1 min): the job-timeline / critical-path
+# attribution engine (obs/timeline.py, docs/observability.md "Job timelines
+# & critical path") — bench_e2e.py --timeline-only sweeps a loopback tracker
+# transfer across 3 corpus sizes, each fully sampled into a fleet event log,
+# and banks e2e_fixed_overhead_s (the wall = overhead + bytes/rate fit) plus
+# timeline_critical_path_s. The timeline branch of check_bench_json.py gates
+# the keys present, the critical path explaining 90-100% of the timeline
+# wall, a named largest fixed-cost phase, and the fixed overhead under the
+# banked 2.0 s baseline. Like the other smokes: failures are logged LOUDLY
+# but do not block device profiling.
+JAX_PLATFORMS=cpu python scripts/bench_e2e.py --timeline-only \
+  --timeline-sizes-mb 1,2,4 >"$LOGDIR/timeline_smoke.out" 2>"$LOGDIR/timeline_smoke.err"
+TIMELINE_RC=$?
+if [ "$TIMELINE_RC" -eq 0 ]; then
+  python scripts/check_bench_json.py "$LOGDIR/timeline_smoke.out" >>"$LOGDIR/devloop.log" 2>&1
+  TIMELINE_RC=$?
+fi
+if [ "$TIMELINE_RC" -ne 0 ]; then
+  echo "[devloop] TIMELINE-SMOKE FAILURE (rc=$TIMELINE_RC) — critical-path coverage, overhead fit, or attribution keys regressed; see $LOGDIR/timeline_smoke.err" >>"$LOGDIR/devloop.log"
+else
+  echo "[devloop] timeline-smoke clean; result at $LOGDIR/timeline_smoke.out" >>"$LOGDIR/devloop.log"
+fi
+
 # Multijob-smoke gate (CPU-only, ~1 min): >= 8 concurrent tenants over the
 # loopback stack (scripts/soak_multijob.py) — per-tenant Gbps split must stay
 # within the 2x fairness bound for equal weights, index RSS bounded, no fd

@@ -130,9 +130,17 @@ def main() -> int:
         if (tmp / "seq_out" / f"job{i}.bin").read_bytes() != corpus.read_bytes():
             print(json.dumps({"error": f"sequential job {i} output mismatch"}), file=sys.stderr)
             return 1
-    lat = sorted(c1.start_latencies()[1:])  # [0] is the cold first job
-    p50 = lat[len(lat) // 2]
-    p95 = lat[min(len(lat) - 1, int(0.95 * len(lat)))]
+    # warm-dispatch p50/p95 come from the controller's
+    # skyplane_service_dispatch_seconds histogram (the series /api/v1/metrics
+    # exports and operators alert on), not ad-hoc list sorting — so this gate
+    # and a production dashboard read the SAME number. The histogram includes
+    # the cold first job; with >=50 warm samples one cold outlier cannot move
+    # the quantiles.
+    p50 = c1.dispatch_hist.quantile(0.5)
+    p95 = c1.dispatch_hist.quantile(0.95)
+    if p50 is None or p95 is None:
+        print(json.dumps({"error": "service_dispatch_seconds histogram is empty"}), file=sys.stderr)
+        return 1
     dedup_cold = round(job_rates[0], 4)
     dedup_warm = round(sum(job_rates[1:]) / max(1, len(job_rates) - 1), 4)
 
@@ -381,6 +389,10 @@ def main() -> int:
         "service_concurrent_jobs": n_conc,
         "service_job_start_p50_s": round(p50, 4),
         "service_job_start_p95_s": round(p95, 4),
+        # explicit provenance keys: the quantiles above are histogram-derived
+        # (service_dispatch_seconds), gated as such by check_bench_json.py
+        "service_dispatch_hist_p50_s": round(p50, 4),
+        "service_dispatch_hist_p95_s": round(p95, 4),
         "service_start_bound_s": 1.0,
         "service_dedup_hit_cold": dedup_cold,
         "service_dedup_hit_warm": dedup_warm,

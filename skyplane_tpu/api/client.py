@@ -55,14 +55,24 @@ class SkyplaneClient:
 
     def copy(self, src: str, dst: str, recursive: bool = False, max_instances: int = 1) -> None:
         """Blocking convenience copy (reference: client.py:85-102)."""
+        self._mark_client_call("copy", src, dst)
         pipe = self.pipeline(max_instances=max_instances)
         pipe.queue_copy(src, dst, recursive=recursive)
         pipe.start(progress=False)
 
     def sync(self, src: str, dst: str, max_instances: int = 1) -> None:
+        self._mark_client_call("sync", src, dst)
         pipe = self.pipeline(max_instances=max_instances)
         pipe.queue_sync(src, dst)
         pipe.start(progress=False)
+
+    def _mark_client_call(self, verb: str, src: str, dst: str) -> None:
+        """Anchor the job timeline at the user-visible API call: everything
+        between this marker and phase.plan's start is pre-plan client setup
+        the waterfall would otherwise not see (obs/timeline.py)."""
+        from skyplane_tpu.obs.events import get_recorder
+
+        get_recorder().record("transfer.client_call", verb=verb, src=src, dst=dst, scope="client")
 
     def object_store(self):
         from skyplane_tpu.api.obj_store import ObjectStore

@@ -182,19 +182,27 @@ class Dataplane:
         """Reference: dataplane.py:129-230."""
         if self.provisioned:
             raise SkyplaneTpuException("dataplane already provisioned")
-        task_ids = {}
-        for gw in self.topology.gateways.values():
-            provider = gw.region_tag.split(":")[0]
-            task_ids[gw.gateway_id] = self.provisioner.add_task(provider, gw.region_tag, gw.vm_type)
-        self.provisioner.init_global()
-        servers = self.provisioner.provision()
-        for gateway_id, task_uuid in task_ids.items():
-            server = servers[task_uuid]
-            gw = self.topology.gateways[gateway_id]
-            gw.public_ip = server.public_ip()
-            gw.private_ip = server.private_ip()
-            gw.control_port = server.control_port
-            self.bound_gateways[gateway_id] = BoundGateway(gw, server)
+        # the fixed-overhead ledger (obs/timeline.py, ROADMAP item 4):
+        # provision / cred_stage / gateway_boot are journaled as DISJOINT
+        # phases so the waterfall attributes each second to exactly one row
+        from skyplane_tpu.obs.events import PH_CRED_STAGE, PH_GATEWAY_BOOT, PH_PROVISION
+        from skyplane_tpu.obs.timeline import PhaseClock
+
+        clock = PhaseClock(scope="client")
+        with clock.phase(PH_PROVISION, gateways=len(self.topology.gateways)):
+            task_ids = {}
+            for gw in self.topology.gateways.values():
+                provider = gw.region_tag.split(":")[0]
+                task_ids[gw.gateway_id] = self.provisioner.add_task(provider, gw.region_tag, gw.vm_type)
+            self.provisioner.init_global()
+            servers = self.provisioner.provision()
+            for gateway_id, task_uuid in task_ids.items():
+                server = servers[task_uuid]
+                gw = self.topology.gateways[gateway_id]
+                gw.public_ip = server.public_ip()
+                gw.private_ip = server.private_ip()
+                gw.control_port = server.control_port
+                self.bound_gateways[gateway_id] = BoundGateway(gw, server)
         if self.transfer_config.encrypt_e2e:
             self._e2ee_key = generate_key()
         gateway_info = self.topology.get_gateway_info_json()
@@ -215,7 +223,8 @@ class Dataplane:
                 "Use encrypt_socket_tls=True for any non-localhost transfer."
             )
 
-        credential_payloads = self._assemble_gateway_credentials()
+        with clock.phase(PH_CRED_STAGE):
+            credential_payloads = self._assemble_gateway_credentials()
         # kept for mid-job replacement provisioning (compute/repair.py): a
         # replacement gateway must boot with the same peer map and the same
         # credential material its predecessor held
@@ -225,7 +234,8 @@ class Dataplane:
         def start(bound: BoundGateway) -> None:
             self._start_bound_gateway(bound, credential_payloads.get(bound.gateway_id))
 
-        do_parallel(start, list(self.bound_gateways.values()), n=16, desc="starting gateways", spinner=spinner)
+        with clock.phase(PH_GATEWAY_BOOT, gateways=len(self.bound_gateways)):
+            do_parallel(start, list(self.bound_gateways.values()), n=16, desc="starting gateways", spinner=spinner)
         self.provisioned = True
 
     def _start_bound_gateway(self, bound: BoundGateway, credentials) -> None:
@@ -343,24 +353,29 @@ class Dataplane:
 
     def deprovision(self, max_jobs: int = 64) -> None:
         """Reference: dataplane.py:244-273 — wait for trackers, tear down."""
-        for t in self._trackers:
-            if t.is_alive():
-                t.join(timeout=5)
-        if self.repairer is not None:
-            # a repair mid-launch must finish (or fail) before teardown sweeps
-            # — deprovisioning under a half-provisioned replacement leaks it
-            self.repairer.close()
-        self.provisioner.deprovision()
-        self.provisioned = False
-        # gateways are down: now it is safe to abort incomplete multipart
-        # uploads from failed jobs (no UploadPart can still be in flight)
-        for t in self._trackers:
-            if t.error is not None:
-                for job in t.jobs:
-                    try:
-                        job.abort()
-                    except Exception as e:  # noqa: BLE001 - best effort
-                        logger.fs.warning(f"multipart abort for job failed: {e}")
+        from skyplane_tpu.obs.events import PH_TEARDOWN
+        from skyplane_tpu.obs.timeline import phase_span
+
+        with phase_span(PH_TEARDOWN, scope="client"):
+            for t in self._trackers:
+                if t.is_alive():
+                    t.join(timeout=5)
+            if self.repairer is not None:
+                # a repair mid-launch must finish (or fail) before teardown
+                # sweeps — deprovisioning under a half-provisioned replacement
+                # leaks it
+                self.repairer.close()
+            self.provisioner.deprovision()
+            self.provisioned = False
+            # gateways are down: now it is safe to abort incomplete multipart
+            # uploads from failed jobs (no UploadPart can still be in flight)
+            for t in self._trackers:
+                if t.error is not None:
+                    for job in t.jobs:
+                        try:
+                            job.abort()
+                        except Exception as e:  # noqa: BLE001 - best effort
+                            logger.fs.warning(f"multipart abort for job failed: {e}")
 
     @contextmanager
     def auto_deprovision(self):

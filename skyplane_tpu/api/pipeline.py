@@ -109,7 +109,16 @@ class Pipeline:
         Returns the transfer stats dict (effective Gbps, wire reduction,
         dedup counts) collected before deprovisioning, or None if stats
         collection failed."""
-        dp = self.create_dataplane(debug)
+        from skyplane_tpu.obs.events import PH_PLAN
+        from skyplane_tpu.obs.timeline import PhaseClock
+
+        # client-side lifecycle phases feed the job timeline (obs/timeline.py,
+        # docs/observability.md): plan here, provision/cred_stage/gateway_boot
+        # inside dataplane.provision, dispatch/drain in the tracker, teardown
+        # in dataplane.deprovision
+        clock = PhaseClock(scope="client")
+        with clock.phase(PH_PLAN, jobs=len(self.jobs_to_dispatch), algorithm=self.planning_algorithm):
+            dp = self.create_dataplane(debug)
         with dp.auto_deprovision():
             dp.provision(spinner=progress)
             if progress and hooks is None:

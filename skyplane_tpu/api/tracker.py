@@ -26,6 +26,8 @@ from skyplane_tpu.obs.events import (
     EV_REPLAN_APPLIED,
     EV_TRANSFER_COMPLETE,
     EV_TRANSFER_ERROR,
+    PH_DISPATCH,
+    PH_DRAIN,
     get_recorder,
 )
 from skyplane_tpu.utils.envcfg import env_float
@@ -246,6 +248,17 @@ class TransferProgressTracker(threading.Thread):
     def run(self) -> None:
         t0 = time.time()
         rec = get_recorder()
+        # one id names this transfer across the fleet log, the timeline CLI
+        # and the bench artifact: the first job's uuid (jobs already tag their
+        # chunks with it), else a fresh one for job-less harness runs
+        self.transfer_id = getattr(self.jobs[0], "uuid", "") if self.jobs else ""
+        if not self.transfer_id:
+            import uuid as _uuid
+
+            self.transfer_id = _uuid.uuid4().hex[:16]
+        from skyplane_tpu.obs.timeline import PhaseClock
+
+        clock = PhaseClock(job=self.transfer_id, scope="client", recorder=rec)
         if self.collect_enabled:
             self._start_collector()
         try:
@@ -260,21 +273,23 @@ class TransferProgressTracker(threading.Thread):
                 if first_run
                 else self._poll_profiles()
             )
-            rec.record(EV_DISPATCH_START, jobs=len(self.jobs))
-            for job in self.jobs:
-                self._dispatch_job(job)
+            rec.record(EV_DISPATCH_START, jobs=len(self.jobs), job=self.transfer_id)
+            with clock.phase(PH_DISPATCH, jobs=len(self.jobs)):
+                for job in self.jobs:
+                    self._dispatch_job(job)
             rec.record(
                 EV_DISPATCH_END, jobs=len(self.jobs), chunks=len(self.dispatched_chunk_ids),
-                bytes=self.query_bytes_dispatched(),
+                bytes=self.query_bytes_dispatched(), job=self.transfer_id,
             )
-            self._monitor_to_completion()
-            for job in self.jobs:
-                job.finalize()
-            for job in self.jobs:
-                job.verify()
-            for job in self.jobs:
-                if hasattr(job, "journal_complete"):
-                    job.journal_complete()  # verified: drop resumable state
+            with clock.phase(PH_DRAIN):
+                self._monitor_to_completion()
+                for job in self.jobs:
+                    job.finalize()
+                for job in self.jobs:
+                    job.verify()
+                for job in self.jobs:
+                    if hasattr(job, "journal_complete"):
+                        job.journal_complete()  # verified: drop resumable state
             try:
                 self.transfer_stats = self._collect_transfer_stats(time.time() - t0)
             except Exception as e:  # noqa: BLE001 - stats must never fail a delivered transfer
@@ -284,6 +299,7 @@ class TransferProgressTracker(threading.Thread):
                 seconds=round(time.time() - t0, 3),
                 chunks=len(self.complete_chunk_ids),
                 bytes=self.query_bytes_dispatched(),
+                job=self.transfer_id,
             )
             self.hooks.on_transfer_end()
             self._report_usage(time.time() - t0, error=None)

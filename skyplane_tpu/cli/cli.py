@@ -441,6 +441,91 @@ def flame(urls, trace_path, token, output, top):
 
 
 @main.command()
+@click.argument("transfer_id", default="latest")
+@click.option("--fleet-dir", default=None, help="fleet event-log directory (default: SKYPLANE_TPU_FLEET_DIR or /tmp/skyplane_tpu_fleet)")
+@click.option("--trace", "trace_path", default=None, help="optional (merged) Chrome trace JSON adding per-hop stage rows")
+@click.option("--url", default=None, help="service API base URL: fetch GET /api/v1/timeline from a live controller instead of a fleet log")
+@click.option("--token", default=None, help="bearer token for --url (defaults to none)")
+@click.option("--src-region", default=None, help="source region tag for the $/TB footer (default: inferred from events, else local)")
+@click.option("--dst-region", default=None, help="destination region tag for the $/TB footer")
+@click.option("--json", "as_json", is_flag=True, help="print the full report (timeline + critical path + fit) as JSON")
+@click.option("--perfetto", "perfetto_out", default=None, help="also write the timeline as a Perfetto/Chrome trace here")
+def timeline(transfer_id, fleet_dir, trace_path, url, token, src_region, dst_region, as_json, perfetto_out):
+    """Job waterfall + critical path: where did this transfer's wall-clock
+    go (docs/observability.md "Job timelines & critical path").
+
+    Reads the fleet event log a collected transfer banked
+    (SKYPLANE_TPU_COLLECT=1; TRANSFER_ID matches the job id or the log
+    filename, default `latest`), pairs the phase.plan/provision/.../drain
+    events into intervals, solves the longest weighted path through them,
+    and prints the waterfall with a fixed-vs-byte-scaled split. With --url
+    it asks a live service controller (GET /api/v1/timeline) instead."""
+    import json as json_mod
+
+    from skyplane_tpu.obs.timeline import (
+        load_fleet_log,
+        perfetto_export,
+        resolve_fleet_log,
+        timeline_report,
+    )
+
+    if url:
+        from skyplane_tpu.gateway.control_auth import control_session
+        from skyplane_tpu.obs.collector import api_base_of
+
+        params = {} if transfer_id == "latest" else {"job": transfer_id}
+        resp = control_session(token).get(f"{api_base_of(url)}/timeline", params=params, timeout=30)
+        resp.raise_for_status()
+        report = resp.json()
+        click.echo(json_mod.dumps(report, indent=2) if as_json else report.get("text", ""))
+        return
+
+    log_path = resolve_fleet_log(transfer_id, fleet_dir)
+    if log_path is None:
+        raise click.ClickException(
+            f"no fleet event log matches {transfer_id!r} — run the transfer with SKYPLANE_TPU_COLLECT=1 "
+            "(and optionally SKYPLANE_TPU_FLEET_DIR; docs/observability.md)"
+        )
+    events = load_fleet_log(log_path)
+    traces = None
+    if trace_path:
+        with open(trace_path) as f:
+            traces = json_mod.load(f)
+    job = None if transfer_id == "latest" else transfer_id
+    if job is not None:
+        # expand a git-style id prefix to the full job tag the events carry —
+        # the builder's job filter matches exactly
+        job = next(
+            (str(e["job"]) for e in events if isinstance(e.get("job"), str) and e["job"].startswith(job)),
+            job,
+        )
+    # $/TB footer: explicit region pair, else the regions the fleet events
+    # carry (loopback fleets tag local:local, which prices to $0)
+    regions = [str(e["region"]) for e in events if e.get("region")]
+    src = src_region or (regions[0] if regions else "local:local")
+    dst = dst_region or next((r for r in regions if r != src), src)
+    from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+
+    report = timeline_report(events, traces=traces, job=job, cost_per_gb=get_egress_cost_per_gb(src, dst))
+    if perfetto_out:
+        with open(perfetto_out, "w") as f:
+            json_mod.dump(perfetto_export(report["timeline"], report["critical_path"]), f)
+    if as_json:
+        report = dict(report)
+        report["fleet_log"] = str(log_path)
+        click.echo(json_mod.dumps(report, indent=2))
+    else:
+        click.echo(f"fleet log: {log_path}")
+        click.echo(report["text"])
+        if perfetto_out:
+            click.echo(f"wrote {perfetto_out}; open it in https://ui.perfetto.dev")
+    if not report["timeline"]["phases"]:
+        raise click.ClickException(
+            "the log holds no phase events — the transfer predates the timeline instrumentation?"
+        )
+
+
+@main.command()
 @click.option("--url", "urls", multiple=True, required=True, help="gateway control URL(s); repeatable")
 @click.option("--token", default=None, help="gateway API bearer token (defaults to none)")
 @click.option("--interval", default=2.0, type=float, help="refresh interval seconds")

@@ -243,6 +243,9 @@ class GatewayDaemon:
 
         self.upload_id_map: Dict[str, str] = {}
         self.operators: List[GatewayOperator] = []
+        # next-hop regions per target gateway, captured at operator
+        # instantiation — the egress-cost provider prices byte edges with them
+        self._target_regions: Dict[str, str] = {}
         self.terminal_operators: Dict[str, List[str]] = {}  # partition -> terminal group names
         self.handle_to_group: Dict[str, Dict[str, str]] = {}  # partition -> handle -> group
         self._or_counter = 0
@@ -312,6 +315,12 @@ class GatewayDaemon:
         # keyed by (src, dst) gateway so fan-out-vs-egress curves come from
         # counters, not arithmetic — skyplane_egress_bytes_total{src,dst}
         self.metrics.register_labeled_provider("egress", self._egress_edges, label=("src", "dst"))
+        # live egress dollars (docs/observability.md, ROADMAP item 3): the
+        # same per-edge byte counters priced through the region-pair grid
+        # (planner/pricing.py) at scrape time — same (src,dst) gateway-id
+        # labels as bytes_total, so $/TB joins are a one-line PromQL division.
+        # Next-hop regions were captured at operator instantiation above.
+        self.metrics.register_labeled_provider("egress", self._egress_cost_edges, label=("src", "dst"))
         # dedup-fabric health (docs/dedup-fabric.md): peer-fetch outcomes
         # (worker-process counters ride the decode snapshots), fetch latency,
         # cross-shard NACKs, and the raw fabric counter schema
@@ -607,6 +616,22 @@ class GatewayDaemon:
                 edges[key] = edges.get(key, 0) + n
         return {"bytes_total": edges}
 
+    def _egress_cost_edges(self) -> Dict[str, Dict[tuple, float]]:
+        """skyplane_egress_cost_dollars_total{src,dst}: per-edge wire bytes
+        priced through the region-pair egress grid at scrape time. Cumulative
+        like its byte counterpart (price x monotone bytes), so rate() and
+        increase() behave; an edge whose next-hop region was never learned
+        prices as same-provider intra-cloud ($0 on local/loopback fleets)."""
+        from skyplane_tpu.planner.pricing import get_egress_cost_per_gb
+
+        edges = self._egress_edges().get("bytes_total", {})
+        cost: Dict[tuple, float] = {}
+        for (src, dst), n in edges.items():
+            dst_region = self._target_regions.get(dst, self.region)
+            per_gb = get_egress_cost_per_gb(self.region, dst_region)
+            cost[(src, dst)] = (n / 1e9) * per_gb
+        return {"cost_dollars_total": cost}
+
     def _sender_socket_events(self) -> dict:
         """Per-window send profile events + the stable wire-counter schema
         from every sender operator (sender-side analog of the receiver
@@ -793,6 +818,11 @@ class GatewayDaemon:
             host = host or info.get("public_ip") or info.get("private_ip")
             if not host:
                 raise ValueError(f"no address for target gateway {target_id}")
+            # next-hop region for the egress-cost provider: the program's
+            # region tag first (planner truth), gateway_info as fallback
+            region_tag = op.get("region") or info.get("region")
+            if region_tag:
+                self._target_regions[target_id] = str(region_tag)
             dedup = op.get("dedup", False)
             sender_cls = GatewaySenderOperator
             sender_extra = {}

@@ -731,6 +731,11 @@ class GatewaySenderOperator(GatewayOperator):
         # counter-measured source of skyplane_egress_bytes_total{src,dst}
         self._egress_lock = threading.Lock()
         self._egress_bytes: Dict[str, int] = {}
+        # the first data-socket dial (port negotiation + connect + TLS
+        # handshake) is journaled as phase.pool_warm for the job waterfall
+        # (obs/timeline.py); flag race between workers is benign — duplicate
+        # phases merge into one envelope in the timeline builder
+        self._pool_warm_recorded = False
         from skyplane_tpu.gateway.control_auth import control_session
 
         self._session = control_session(api_token)
@@ -749,33 +754,49 @@ class GatewaySenderOperator(GatewayOperator):
         return {"gateway": self.source_gateway_id or self.gateway_id, "hop": req.chunk.hop or 0}
 
     def _make_socket(self) -> socket.socket:
-        # ask the remote gateway for an ephemeral data port (reference :225-246),
-        # identifying this source so the sink can count distinct sources
-        resp = self._session.post(
-            f"{self._control_base}/servers",
-            json={"source_gateway_id": self.source_gateway_id} if self.source_gateway_id else None,
-            timeout=30,
-        )
-        resp.raise_for_status()
-        info = resp.json()
-        port = info["server_port"]
-        self._apply_dedup_budget(info)
-        sock = socket.create_connection((self.target_host, port), timeout=30)
+        end_warm = None
+        if not self._pool_warm_recorded:
+            self._pool_warm_recorded = True
+            from skyplane_tpu.obs.events import PH_POOL_WARM
+            from skyplane_tpu.obs.timeline import phase_begin
+
+            end_warm = phase_begin(
+                PH_POOL_WARM,
+                gateway=self.source_gateway_id or self.gateway_id,
+                target=self.target_gateway_id,
+            )
         try:
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            if self.use_tls:
-                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-                ctx.check_hostname = False
-                ctx.verify_mode = ssl.CERT_NONE  # self-signed receiver certs
-                sock = ctx.wrap_socket(sock)
-        except BaseException:
-            # a failed TLS handshake (or setsockopt on a dying connection)
-            # must not strand the TCP socket: retarget()/redial loops call
-            # this repeatedly and would bleed one fd per failed attempt
-            sock.close()
-            raise
-        self._local.port = port
-        return sock
+            # ask the remote gateway for an ephemeral data port (reference
+            # :225-246), identifying this source so the sink can count
+            # distinct sources
+            resp = self._session.post(
+                f"{self._control_base}/servers",
+                json={"source_gateway_id": self.source_gateway_id} if self.source_gateway_id else None,
+                timeout=30,
+            )
+            resp.raise_for_status()
+            info = resp.json()
+            port = info["server_port"]
+            self._apply_dedup_budget(info)
+            sock = socket.create_connection((self.target_host, port), timeout=30)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if self.use_tls:
+                    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl.CERT_NONE  # self-signed receiver certs
+                    sock = ctx.wrap_socket(sock)
+            except BaseException:
+                # a failed TLS handshake (or setsockopt on a dying connection)
+                # must not strand the TCP socket: retarget()/redial loops call
+                # this repeatedly and would bleed one fd per failed attempt
+                sock.close()
+                raise
+            self._local.port = port
+            return sock
+        finally:
+            if end_warm is not None:
+                end_warm()
 
     def _apply_dedup_budget(self, server_info: dict) -> None:
         """Split the sink's advertised segment-store capacity fairly across

@@ -32,9 +32,11 @@ header, and the receiver honors that flag — so one chunk's sender spans
 one timeline keyed by the chunk id (docs/observability.md).
 """
 
-from skyplane_tpu.obs.events import FlightRecorder, configure_recorder, get_recorder
+from skyplane_tpu.obs.critical_path import critical_path, fit_fixed_overhead
+from skyplane_tpu.obs.events import FlightRecorder, configure_recorder, event_epoch, get_recorder
 from skyplane_tpu.obs.metrics import MetricsRegistry, get_registry
 from skyplane_tpu.obs.profiler import NOOP_PROFILER, StackProfiler, configure_profiler, get_profiler
+from skyplane_tpu.obs.timeline import PhaseClock, build_timeline, phase_span, render_waterfall, solve_timeline
 from skyplane_tpu.obs.tracer import NOOP_SPAN, Tracer, configure_tracer, get_tracer
 
 # NOTE: skyplane_tpu.obs.collector (the fleet TelemetryCollector) is imported
@@ -46,13 +48,21 @@ __all__ = [
     "MetricsRegistry",
     "NOOP_PROFILER",
     "NOOP_SPAN",
+    "PhaseClock",
     "StackProfiler",
     "Tracer",
+    "build_timeline",
     "configure_profiler",
     "configure_recorder",
     "configure_tracer",
+    "critical_path",
+    "event_epoch",
+    "fit_fixed_overhead",
     "get_profiler",
     "get_recorder",
     "get_registry",
     "get_tracer",
+    "phase_span",
+    "render_waterfall",
+    "solve_timeline",
 ]

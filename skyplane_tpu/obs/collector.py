@@ -41,6 +41,7 @@ import time
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from skyplane_tpu.obs.events import event_epoch
 from skyplane_tpu.utils.logger import logger
 
 #: stage -> span name, shared by bench.py's ``stage_latency_us`` and the
@@ -785,11 +786,17 @@ class TelemetryCollector:
         return render_fleet_metrics(per_gateway)
 
     def fleet_events(self) -> List[dict]:
-        """The merged fleet log, ordered by (ts, recorder seq) — one record of
-        everything that happened across the fleet, post-mortem ready."""
+        """The merged fleet log, ordered by event time then (recorder, seq) —
+        one record of everything that happened across the fleet, post-mortem
+        ready. Events that carry a monotonic epoch anchor (``anchor + mono``,
+        stamped by every FlightRecorder since the timeline PR) sort by the
+        anchored monotonic timestamp instead of raw ``ts``: a wall-clock step
+        (NTP slew, suspend/restore) mid-transfer shifts ``ts`` but not the
+        anchored stream, so one recorder's events can never reorder against
+        their own sequence numbers."""
         with self._lock:
             events = list(self._events)
-        events.sort(key=lambda e: (e.get("ts", 0.0), e.get("recorder", ""), e.get("seq", 0)))
+        events.sort(key=lambda e: (event_epoch(e), e.get("recorder", ""), e.get("seq", 0)))
         return events
 
     def cpu_profiles(self) -> Dict[str, dict]:

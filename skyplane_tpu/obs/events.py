@@ -72,6 +72,46 @@ EV_STREAM_RESET = "stream.reset"
 EV_STREAM_BREAK = "stream.break"
 EV_STREAM_REVIVE = "stream.revive"
 EV_SPILL_DEGRADED = "spill.degraded"
+# job lifecycle phases (obs/timeline.py PhaseClock, docs/observability.md
+# "Job timelines & critical path"): each phase is a pair of
+# ``phase.<name>`` events with ``edge="start"`` / ``edge="end"`` plus a
+# shared ``phase_id`` so the timeline builder can pair them even when several
+# recorders interleave. The phase names mirror the fixed-overhead ledger the
+# 2.5× campaign (ROADMAP item 4) is chasing.
+PH_PLAN = "phase.plan"
+PH_PROVISION = "phase.provision"
+PH_CRED_STAGE = "phase.cred_stage"
+PH_GATEWAY_BOOT = "phase.gateway_boot"
+PH_FIRST_COMPILE = "phase.first_compile"
+PH_POOL_WARM = "phase.pool_warm"
+PH_DISPATCH = "phase.dispatch"
+PH_DRAIN = "phase.drain"
+PH_TEARDOWN = "phase.teardown"
+ALL_PHASES = (
+    PH_PLAN,
+    PH_PROVISION,
+    PH_CRED_STAGE,
+    PH_GATEWAY_BOOT,
+    PH_FIRST_COMPILE,
+    PH_POOL_WARM,
+    PH_DISPATCH,
+    PH_DRAIN,
+    PH_TEARDOWN,
+)
+
+
+def event_epoch(ev: dict) -> float:
+    """Best epoch timestamp for one recorded event: the anchored monotonic
+    reading (``anchor + mono``) when both fields are numeric, else the raw
+    wall-clock ``ts``. The anchored form keeps one recorder's events ordered
+    even when that host's wall clock steps mid-run — the collector merge and
+    the timeline builder both key on it."""
+    mono = ev.get("mono")
+    anchor = ev.get("anchor")
+    if isinstance(mono, (int, float)) and isinstance(anchor, (int, float)):
+        return float(anchor) + float(mono)
+    ts = ev.get("ts", 0.0)
+    return float(ts) if isinstance(ts, (int, float)) else 0.0
 
 
 class FlightRecorder:
@@ -82,6 +122,13 @@ class FlightRecorder:
         # identifies THIS journal across scrapes: several gateway APIs in one
         # process share one recorder, several processes never share an id
         self.recorder_id = recorder_id or uuid.uuid4().hex[:16]
+        # monotonic epoch anchor: wall-clock epoch at recorder birth minus the
+        # monotonic reading at the same instant. ``anchor + mono`` reconstructs
+        # an epoch timestamp that is immune to wall-clock steps (NTP slews,
+        # VM suspend/restore) WITHIN one recorder — the collector's merge
+        # prefers it over ``ts`` so cross-process timelines don't reorder when
+        # a host's wall clock drifts mid-transfer (docs/observability.md).
+        self.mono_anchor = time.time() - time.monotonic()
         self._lock = threading.Lock()
         self._events: "deque[dict]" = deque(maxlen=self.capacity)
         self._seq = 0
@@ -94,7 +141,13 @@ class FlightRecorder:
             seq = self._seq
             if len(self._events) >= self.capacity:
                 self._dropped += 1  # deque(maxlen) evicts the oldest below
-            event = {"seq": seq, "ts": time.time(), "kind": kind}
+            event = {
+                "seq": seq,
+                "ts": time.time(),
+                "mono": time.monotonic(),
+                "anchor": self.mono_anchor,
+                "kind": kind,
+            }
             event.update(fields)
             self._events.append(event)
         return seq

@@ -124,6 +124,30 @@ class Histogram:
             cum.append(acc)
         return cum, total, n
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0 < q <= 1) with Prometheus
+        ``histogram_quantile`` semantics: linear interpolation inside the
+        bucket the rank lands in, lower edge 0 for the first bucket. Returns
+        None when empty; a rank in the +Inf bucket returns the largest finite
+        bound (the honest answer — the histogram cannot see past it). The
+        SLO gates (soak_service, check_bench_json) read p50/p95 from here
+        instead of keeping their own ad-hoc latency lists."""
+        cum, _total, n = self.snapshot()
+        if n <= 0:
+            return None
+        q = min(max(q, 0.0), 1.0)
+        rank = q * n
+        prev_cum, prev_edge = 0, 0.0
+        for edge, c in zip(self.buckets, cum):
+            if c >= rank:
+                in_bucket = c - prev_cum
+                if in_bucket <= 0:
+                    return float(edge)
+                frac = (rank - prev_cum) / in_bucket
+                return prev_edge + (edge - prev_edge) * frac
+            prev_cum, prev_edge = c, float(edge)
+        return float(self.buckets[-1]) if self.buckets else None
+
 
 class LabeledFamily:
     """A family of counters/gauges keyed by one label (e.g. ``tenant``):

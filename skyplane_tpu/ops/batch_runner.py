@@ -173,6 +173,10 @@ class DeviceBatchRunner:
             "spmd_check_batches": 0,
         }
         self._stage_failures: Dict[int, int] = {}  # bucket -> count (first occurrence logged)
+        # the first window pays the fresh XLA compile (often the single
+        # largest fixed cost of a small transfer): journal it as
+        # phase.first_compile so the job waterfall can name it (obs/timeline.py)
+        self._saw_first_window = False
         self._zero_rows: Dict[int, np.ndarray] = {}  # bucket -> shared READ-ONLY zero pad row
         self._dev_zero_rows: Dict[int, object] = {}  # bucket -> staged device zero row
         # multi-device gateway (TPU slice): run the fused kernels sharded over
@@ -370,6 +374,16 @@ class DeviceBatchRunner:
         bucket = len(entries[0].arr)
         with self._lock:
             self._in_flight[bucket] = self._in_flight.get(bucket, 0) + 1
+            first_window = not self._saw_first_window
+            self._saw_first_window = True
+        end_first_compile = None
+        if first_window:
+            # imperative begin/end (not `with`) keeps the large body below
+            # un-reindented; end fires in the finally either way
+            from skyplane_tpu.obs.events import PH_FIRST_COMPILE
+            from skyplane_tpu.obs.timeline import phase_begin
+
+            end_first_compile = phase_begin(PH_FIRST_COMPILE, bucket=bucket, rows=len(entries))
         n_pad_rows = 0
         try:
             # pad the batch dimension to max_batch with zero rows so XLA sees
@@ -430,6 +444,8 @@ class DeviceBatchRunner:
                 e.error = err
             self._release_pooled(entries)
         finally:
+            if end_first_compile is not None:
+                end_first_compile()
             with self._lock:
                 self._in_flight[bucket] -= 1
                 self._counters["batch_windows"] += 1

@@ -1,0 +1,113 @@
+"""Service introspection API: status, Prometheus metrics, job timelines.
+
+The batch path surfaces its telemetry on every gateway's control API, but
+the standing service (docs/service-mode.md) only wrote an advisory
+status.json — its SLO histograms and warm-dispatch phase events were
+trapped in-process. This module is the missing read surface: a tiny
+threaded HTTP server over a live :class:`ServiceController` exposing
+
+  * ``GET /api/v1/status``   — the controller status snapshot (includes the
+    histogram-derived dispatch/e2e percentiles);
+  * ``GET /api/v1/metrics``  — the process metrics registry in Prometheus
+    text format (``skyplane_service_dispatch_seconds`` /
+    ``skyplane_service_e2e_seconds`` live here);
+  * ``GET /api/v1/timeline`` — per-job timeline + critical-path report
+    (``?job=<id>`` filters; omit for the newest job seen), the service
+    analog of ``skyplane-tpu timeline`` (docs/observability.md).
+
+Read-only by construction — every route is a snapshot, no route mutates
+controller state — and bound to localhost by default. When the worker was
+started with a gateway bearer token the same token is required here
+(``Authorization: Bearer ...``), mirroring the gateway control-plane rule
+that one credential gates one fleet's surfaces.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from skyplane_tpu.utils.logger import logger
+
+#: env knob (docs/configuration.md): port for the service API; unset or
+#: empty disables the server, 0 binds an ephemeral port
+SERVICE_API_PORT_ENV = "SKYPLANE_TPU_SERVICE_API_PORT"
+
+
+class ServiceAPI:
+    """Threaded HTTP server over one live ServiceController (see module doc)."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0, token: Optional[str] = None):
+        self.controller = controller
+        self.token = token
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            daemon_threads = True
+
+            def log_message(self, fmt, *args):  # noqa: A003 — quiet: the worker log is the log
+                logger.fs.debug(f"[service-api] {fmt % args}")
+
+            def _deny(self, code: int, msg: str) -> None:
+                body = json.dumps({"error": msg}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                if api.token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth != f"Bearer {api.token}":
+                        return self._deny(401, "missing or bad bearer token")
+                parsed = urlparse(self.path)
+                try:
+                    if parsed.path == "/api/v1/status":
+                        return self._json(api.controller.status())
+                    if parsed.path == "/api/v1/metrics":
+                        from skyplane_tpu.obs.metrics import get_registry
+
+                        text = get_registry().render_prometheus()
+                        body = text.encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain; version=0.0.4")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return None
+                    if parsed.path == "/api/v1/timeline":
+                        q = parse_qs(parsed.query)
+                        job = (q.get("job") or [None])[0]
+                        return self._json(api.controller.timeline(job_id=job))
+                except Exception as e:  # noqa: BLE001 — introspection must never kill the service loop
+                    return self._deny(500, f"{type(e).__name__}: {e}")
+                return self._deny(404, f"no route {parsed.path}")
+
+            def _json(self, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[0], self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, name="service-api", daemon=True)
+
+    def start(self) -> "ServiceAPI":
+        self._thread.start()
+        logger.fs.info(f"[service-api] listening on http://{self.host}:{self.port}/api/v1")
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

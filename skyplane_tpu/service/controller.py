@@ -178,6 +178,24 @@ class ServiceController:
         self.c_fabric_exchanges = 0
         self.c_fabric_fps_exchanged = 0
         self._start_latencies: List[float] = []
+        # SLO histograms on the process registry (rendered by /metrics and
+        # the service API): dispatch = admission->chunk-POST done (the ~7 ms
+        # warm-dispatch claim, so fine sub-10ms buckets), e2e = submit->done.
+        # Registry dedupe means controllers recovered over the same WAL keep
+        # accumulating into one histogram — exactly what a standing service
+        # wants its SLO record to do (docs/service-mode.md).
+        from skyplane_tpu.obs.metrics import get_registry
+
+        self.dispatch_hist = get_registry().histogram(
+            "service_dispatch_seconds",
+            help_="warm dispatch latency: admission to chunk POST acknowledged",
+            buckets=(0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        self.e2e_hist = get_registry().histogram(
+            "service_e2e_seconds",
+            help_="job end-to-end latency: submission to verified completion",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+        )
         self.wal = ServiceWAL(wal_dir, journal_max_bytes=journal_max_bytes)
         self._load()
 
@@ -444,7 +462,19 @@ class ServiceController:
         """Warm dispatch: admission + WAL dispatch record + chunk POST. The
         WAL record lands BEFORE the POST (write-ahead): a crash between the
         two requeues exactly these chunk ids at recovery, and the sink's
-        idempotent re-register makes the retry side-effect free."""
+        idempotent re-register makes the retry side-effect free.
+
+        Journaled as phase.dispatch with scope="service" so the warm path
+        lands on the same waterfall as batch-mode transfers — service-vs-
+        batch overhead is one report, not two instruments
+        (docs/observability.md)."""
+        from skyplane_tpu.obs.events import PH_DISPATCH
+        from skyplane_tpu.obs.timeline import PhaseClock
+
+        with PhaseClock(job=job.job_id, scope="service").phase(PH_DISPATCH):
+            self._dispatch_inner(job)
+
+    def _dispatch_inner(self, job: ServiceJob) -> None:
         if self.source is None:
             self.attach()
         t0 = time.monotonic()
@@ -478,6 +508,7 @@ class ServiceController:
     MAX_LATENCY_SAMPLES = 4096
 
     def _note_latency(self, seconds: float) -> None:
+        self.dispatch_hist.observe(seconds)
         with self._lock:
             self._start_latencies.append(seconds)
             if len(self._start_latencies) > self.MAX_LATENCY_SAMPLES:
@@ -539,6 +570,8 @@ class ServiceController:
         rec = {"type": REC_FINALIZE, "job_id": job.job_id, "status": status}
         if error:
             rec["error"] = error
+        if status == "done":
+            self.e2e_hist.observe(max(0.0, time.time() - job.submitted_at))
         with self._lock:  # memory first — see _append_or_compact
             job.state = ST_DONE if status == "done" else ST_FAILED
             job.error = error
@@ -748,5 +781,33 @@ class ServiceController:
         if lat:
             out["job_start_p50_s"] = round(lat[len(lat) // 2], 4)
             out["job_start_p95_s"] = round(lat[min(len(lat) - 1, int(0.95 * len(lat)))], 4)
+        # histogram-derived SLO percentiles: what the soak gate asserts (the
+        # ad-hoc list above stays for continuity, the histogram is the truth)
+        for key, hist, q in (
+            ("dispatch_hist_p50_s", self.dispatch_hist, 0.5),
+            ("dispatch_hist_p95_s", self.dispatch_hist, 0.95),
+            ("e2e_hist_p50_s", self.e2e_hist, 0.5),
+            ("e2e_hist_p95_s", self.e2e_hist, 0.95),
+        ):
+            v = hist.quantile(q)
+            if v is not None:
+                out[key] = round(v, 4)
         out.update(self.wal.counters())
         return out
+
+    def timeline(self, job_id: Optional[str] = None) -> dict:
+        """Per-job timeline + critical path from this process's flight
+        recorder — the payload behind ``GET /api/v1/timeline`` on the
+        service API (docs/observability.md "Job timelines & critical
+        path"). Service-scope phase.dispatch events land here live, so a
+        warm dispatch is inspectable without any fleet log on disk."""
+        from skyplane_tpu.obs.events import get_recorder
+        from skyplane_tpu.obs.timeline import timeline_report
+
+        rec = get_recorder()
+        events = rec.events_since(0)
+        for ev in events:
+            ev.setdefault("recorder", rec.recorder_id)
+        report = timeline_report(events, job=job_id)
+        report["job_id"] = job_id or report["timeline"].get("job") or ""
+        return report

@@ -87,9 +87,14 @@ def run_service(
     poll_interval_s: float = 0.1,
     stop_event: Optional[threading.Event] = None,
     max_ticks: Optional[int] = None,
+    api_port: Optional[int] = None,
 ) -> ServiceController:
     """Attach, recover, loop. Returns the controller after the loop exits
-    (stop_event set, SIGTERM, or max_ticks — the last is for tests)."""
+    (stop_event set, SIGTERM, or max_ticks — the last is for tests).
+
+    ``api_port`` (or SKYPLANE_TPU_SERVICE_API_PORT) arms the read-only
+    introspection server (service/api.py): status, Prometheus metrics and
+    ``GET /api/v1/timeline``; 0 binds an ephemeral port."""
     spool = Path(spool_dir)
     spool.mkdir(parents=True, exist_ok=True)
     controller = ServiceController(
@@ -101,6 +106,21 @@ def run_service(
         chunk_bytes=chunk_bytes,
         heartbeat_interval_s=heartbeat_interval_s,
     )
+    if api_port is None:
+        env_port = os.environ.get("SKYPLANE_TPU_SERVICE_API_PORT", "").strip()
+        if env_port:
+            try:
+                api_port = int(env_port)
+            except ValueError:
+                logger.fs.warning(f"[service] ignoring non-integer SKYPLANE_TPU_SERVICE_API_PORT={env_port!r}")
+    api = None
+    if api_port is not None:
+        from skyplane_tpu.service.api import ServiceAPI
+
+        try:
+            api = ServiceAPI(controller, port=api_port, token=token).start()
+        except OSError as e:  # bind failure must not take down the service itself
+            logger.fs.warning(f"[service] API server failed to bind port {api_port}: {e}")
     stop = stop_event or threading.Event()
 
     def _sigterm(signum, frame):  # noqa: ARG001 — signal signature
@@ -124,6 +144,8 @@ def run_service(
         if max_ticks is not None and ticks >= max_ticks:
             break
         stop.wait(poll_interval_s)
+    if api is not None:
+        api.stop()
     controller.close()
     write_status(controller, status_path)
     return controller
@@ -140,6 +162,10 @@ def main(argv=None) -> int:
     ap.add_argument("--chunk-mb", type=float, default=4.0, help="default chunk size (MiB)")
     ap.add_argument("--heartbeat-s", type=float, default=5.0, help="TTL heartbeat interval")
     ap.add_argument("--poll-s", type=float, default=0.1, help="progress poll interval")
+    ap.add_argument(
+        "--api-port", type=int, default=None,
+        help="introspection API port (status/metrics/timeline; 0 = ephemeral; default: SKYPLANE_TPU_SERVICE_API_PORT or off)",
+    )
     args = ap.parse_args(argv)
     run_service(
         args.wal_dir,
@@ -151,6 +177,7 @@ def main(argv=None) -> int:
         chunk_bytes=int(args.chunk_mb * (1 << 20)),
         heartbeat_interval_s=args.heartbeat_s,
         poll_interval_s=args.poll_s,
+        api_port=args.api_port,
     )
     return 0
 

@@ -1,0 +1,88 @@
+"""Timeline acceptance slice (ISSUE 20): a loopback transfer fully sampled
+through the real TransferProgressTracker with the collector armed must yield
+a fleet event log from which ``timeline_report`` reconstructs a waterfall
+whose critical-path sum is within 10% of the timeline wall-clock, and which
+names the largest fixed-cost phase — the attribution contract the bench gate
+(scripts/check_bench_json.py) enforces on every banked run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+import numpy as np
+import pytest
+
+from skyplane_tpu.api.config import TransferConfig
+from skyplane_tpu.api.tracker import TransferProgressTracker
+from skyplane_tpu.obs import configure_recorder, configure_tracer
+from skyplane_tpu.obs.timeline import load_fleet_log, resolve_fleet_log, timeline_report
+from tests.integration.harness import HarnessCopyJob, StubDataplane, bind_gateway, make_pair
+
+rng = np.random.default_rng(41)
+
+
+@pytest.fixture(autouse=True)
+def _restore_obs():
+    yield
+    configure_tracer()
+    configure_recorder()
+
+
+def test_loopback_transfer_timeline_covers_wall_clock(tmp_path, monkeypatch):
+    fleet_dir = tmp_path / "fleet"
+    monkeypatch.setenv("SKYPLANE_TPU_COLLECT", "1")
+    monkeypatch.setenv("SKYPLANE_TPU_FLEET_DIR", str(fleet_dir))
+    configure_recorder()
+
+    (tmp_path / "src").mkdir()
+    (tmp_path / "out").mkdir()
+    src, dst = make_pair(tmp_path, compress="none", dedup=False, encrypt=False, use_tls=False)
+    try:
+        payload = rng.integers(0, 256, 768 << 10, dtype=np.uint8).tobytes() + bytes(256 << 10)
+        src_file = tmp_path / "src" / "corpus.bin"
+        dst_file = tmp_path / "out" / "corpus.bin"
+        src_file.write_bytes(payload)
+
+        dp = StubDataplane([bind_gateway(src, "local:srcA")], [bind_gateway(dst, "local:dstB")])
+        job = HarnessCopyJob(src_file, dst_file, chunk_bytes=128 << 10, batch_size=4)
+        tracker = TransferProgressTracker(dp, [job], TransferConfig())
+        t_start = time.time()
+        tracker.start()
+        tracker.join(timeout=120)
+        t_wall = time.time() - t_start
+        assert not tracker.is_alive() and tracker.error is None, f"transfer failed: {tracker.error}"
+        assert hashlib.md5(dst_file.read_bytes()).hexdigest() == hashlib.md5(payload).hexdigest()
+
+        # the tracker banked one fleet JSONL log; the CLI's resolver must find
+        # it both as "latest" and by the transfer id the tracker minted
+        log = resolve_fleet_log("latest", fleet_dir)
+        assert log is not None, "collector wrote no fleet event log"
+        assert resolve_fleet_log(tracker.transfer_id, fleet_dir) == log
+
+        events = load_fleet_log(log)
+        report = timeline_report(events, job=tracker.transfer_id)
+        tl, cp = report["timeline"], report["critical_path"]
+
+        # fully sampled: the client lifecycle phases are in the log
+        names = {p["name"] for p in tl["phases"]}
+        assert {"dispatch", "drain"} <= names, f"missing lifecycle phases: {names}"
+        assert tl["job"] == tracker.transfer_id
+        assert tl["bytes"] == len(payload)
+
+        # ---- the acceptance criterion: critical-path sum within 10% of wall ----
+        assert tl["wall_s"] > 0
+        assert cp["critical_path_s"] == pytest.approx(tl["wall_s"], rel=0.10)
+        assert cp["critical_path_s"] <= tl["wall_s"] * 1.001  # a path can never exceed wall
+        # and the timeline wall is itself within the measured process wall
+        assert tl["wall_s"] <= t_wall * 1.05
+
+        # attribution: the largest fixed-cost phase is named, and the report
+        # text carries it (what the CLI prints and the bench artifact banks)
+        assert cp["largest_fixed_phase"], f"no fixed phase attributed: {cp}"
+        assert f"largest fixed cost: {cp['largest_fixed_phase']}" in report["text"]
+        assert cp["fixed_s"] + cp["scaled_s"] == pytest.approx(cp["critical_path_s"], rel=1e-6)
+    finally:
+        src.stop()
+        dst.stop()

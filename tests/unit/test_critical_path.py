@@ -1,0 +1,102 @@
+"""Critical-path solver + fixed-overhead fit (obs/critical_path.py).
+
+The solver is pure arithmetic over plain dicts, so these tests pin exact
+paths, lengths and slacks: a known DAG must yield its known longest path,
+ties must break deterministically (lexically), and edges naming intervals
+that were never sampled must be tolerated, not fatal — a partially sampled
+job still gets its best-effort waterfall.
+"""
+
+import math
+
+import pytest
+
+from skyplane_tpu.obs.critical_path import critical_path, fit_fixed_overhead, largest_node
+
+
+def iv(name, start, end):
+    return {"name": name, "start": start, "end": end}
+
+
+class TestCriticalPath:
+    def test_known_dag_known_path_and_slack(self):
+        # a(2) -> b(3) -> d(1)
+        #   \--> c(1) ----^   : longest path a-b-d = 6
+        nodes = [iv("a", 0.0, 2.0), iv("b", 2.5, 5.5), iv("c", 2.0, 3.0), iv("d", 6.0, 7.0)]
+        edges = [("a", "b"), ("a", "c"), ("b", "d"), ("c", "d")]
+        r = critical_path(nodes, edges)
+        assert r["path"] == ["a", "b", "d"]
+        assert r["length_s"] == pytest.approx(6.0)
+        assert r["slack_s"]["a->b"] == pytest.approx(0.5)
+        assert r["slack_s"]["c->d"] == pytest.approx(3.0)
+        assert r["on_path"]["a->b"] and r["on_path"]["b->d"]
+        assert not r["on_path"]["a->c"] and not r["on_path"]["c->d"]
+
+    def test_tie_breaks_lexically(self):
+        # two equal-length parallel branches: the lexically first must win
+        nodes = [iv("a", 0, 1), iv("m1", 1, 3), iv("m2", 1, 3), iv("z", 3, 4)]
+        edges = [("a", "m1"), ("a", "m2"), ("m1", "z"), ("m2", "z")]
+        r = critical_path(nodes, edges)
+        assert r["path"] == ["a", "m1", "z"]
+        # and stays stable across repeated solves
+        assert critical_path(nodes, edges)["path"] == ["a", "m1", "z"]
+
+    def test_missing_interval_edges_dropped_not_fatal(self):
+        nodes = [iv("a", 0, 1), iv("b", 1, 4)]
+        edges = [("a", "b"), ("a", "ghost"), ("ghost", "b")]
+        r = critical_path(nodes, edges)
+        assert r["path"] == ["a", "b"]
+        assert r["length_s"] == pytest.approx(4.0)
+        assert sorted(r["dropped_edges"]) == ["a->ghost", "ghost->b"]
+
+    def test_empty_input(self):
+        r = critical_path([], [])
+        assert r["path"] == [] and r["length_s"] == 0.0
+
+    def test_duplicate_names_merge_to_envelope(self):
+        # two samples of the same phase (e.g. first_compile on two gateways)
+        # merge into one envelope interval
+        nodes = [iv("x", 0.0, 1.0), iv("x", 0.5, 2.0), iv("y", 2.0, 3.0)]
+        r = critical_path(nodes, [("x", "y")])
+        assert r["nodes"]["x"]["dur_s"] == pytest.approx(2.0)
+        assert r["length_s"] == pytest.approx(3.0)
+
+    def test_cycle_raises(self):
+        nodes = [iv("a", 0, 1), iv("b", 1, 2)]
+        with pytest.raises(ValueError, match="cycle"):
+            critical_path(nodes, [("a", "b"), ("b", "a")])
+
+    def test_largest_node(self):
+        nodes = [iv("a", 0, 1), iv("b", 1, 4), iv("c", 4, 5)]
+        edges = [("a", "b"), ("b", "c")]
+        r = critical_path(nodes, edges)
+        assert largest_node(r) == "b"
+        assert largest_node(r, names=["a", "c"]) in ("a", "c")
+
+
+class TestFixedOverheadFit:
+    def test_exact_linear_recovery(self):
+        # wall = 2.0 s + bytes / 1e8 exactly
+        samples = [(b, 2.0 + b / 1e8) for b in (1e6, 1e7, 1e8, 5e8)]
+        fit = fit_fixed_overhead(samples)
+        assert fit is not None
+        assert fit["overhead_s"] == pytest.approx(2.0, rel=1e-6)
+        assert fit["rate_bytes_per_s"] == pytest.approx(1e8, rel=1e-6)
+        assert fit["r2"] == pytest.approx(1.0, abs=1e-9)
+        assert fit["n"] == 4
+
+    def test_needs_three_samples_and_two_sizes(self):
+        assert fit_fixed_overhead([(1e6, 2.0), (1e7, 2.1)]) is None
+        assert fit_fixed_overhead([(1e6, 2.0), (1e6, 2.1), (1e6, 2.2)]) is None
+
+    def test_flat_wall_means_all_overhead(self):
+        fit = fit_fixed_overhead([(1e6, 2.0), (1e7, 2.0), (1e8, 2.0)])
+        assert fit is not None
+        assert math.isinf(fit["rate_bytes_per_s"])
+        assert fit["overhead_s"] == pytest.approx(2.0)
+
+    def test_negative_intercept_clamped(self):
+        # wall below the fit line at zero bytes: overhead reports 0, not < 0
+        fit = fit_fixed_overhead([(1e8, 1.0), (2e8, 2.5), (3e8, 4.0)])
+        assert fit is not None
+        assert fit["overhead_s"] == 0.0

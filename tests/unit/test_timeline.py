@@ -1,0 +1,288 @@
+"""Timeline engine (obs/timeline.py): PhaseClock pairing, the per-job
+builder, DAG construction, attribution, and — the bugfix this PR pins —
+monotonic-anchor timestamps surviving a wall-clock step mid-run.
+"""
+
+import json
+import time
+
+import pytest
+
+from skyplane_tpu.obs.events import (
+    PH_DISPATCH,
+    PH_DRAIN,
+    PH_PLAN,
+    PH_PROVISION,
+    FlightRecorder,
+    event_epoch,
+)
+from skyplane_tpu.obs.timeline import (
+    PhaseClock,
+    build_timeline,
+    classify,
+    perfetto_export,
+    phase_begin,
+    render_waterfall,
+    resolve_fleet_log,
+    solve_timeline,
+    timeline_dag,
+    timeline_report,
+)
+
+
+def ph(recorder, kind, edge, t, phase_id="p1", scope="client", job="j1", **fields):
+    """Handcrafted phase event with an anchored monotonic stamp (anchor 0 so
+    epoch == mono == t) — deterministic inputs for the builder/DAG tests."""
+    ev = {"seq": int(t * 1000), "ts": t, "mono": t, "anchor": 0.0, "kind": kind,
+          "edge": edge, "phase_id": phase_id, "recorder": recorder, "scope": scope, "job": job}
+    ev.update(fields)
+    return ev
+
+
+class TestPhaseClock:
+    def test_pairs_share_phase_id_and_end_fires_on_raise(self):
+        rec = FlightRecorder(capacity=64)
+        clock = PhaseClock(job="jX", scope="client", recorder=rec)
+        with pytest.raises(RuntimeError):
+            with clock.phase(PH_PLAN, jobs=3):
+                raise RuntimeError("boom")
+        evs = rec.events_since(0)
+        assert [e["edge"] for e in evs] == ["start", "end"]
+        assert evs[0]["phase_id"] == evs[1]["phase_id"]
+        assert all(e["kind"] == PH_PLAN and e["job"] == "jX" and e["jobs"] == 3 for e in evs)
+
+    def test_phase_begin_end_is_idempotent(self):
+        rec = FlightRecorder(capacity=64)
+        end = phase_begin(PH_PROVISION, recorder=rec, scope="gateway")
+        end()
+        end()  # double-fire from nested finally blocks must not duplicate
+        evs = rec.events_since(0)
+        assert [e["edge"] for e in evs] == ["start", "end"]
+
+    def test_live_recorder_round_trips_through_builder(self):
+        rec = FlightRecorder(capacity=64)
+        clock = PhaseClock(job="jY", recorder=rec)
+        with clock.phase(PH_PLAN):
+            time.sleep(0.01)
+        evs = rec.events_since(0)
+        for e in evs:
+            e.setdefault("recorder", rec.recorder_id)
+        tl = build_timeline(evs)
+        assert [p["name"] for p in tl["phases"]] == ["plan"]
+        assert tl["phases"][0]["dur_s"] >= 0.009
+        assert tl["job"] == "jY"
+        assert not tl["incomplete"]
+
+
+class TestBuildTimeline:
+    def test_unmatched_start_becomes_incomplete_interval(self):
+        events = [
+            ph("r1", PH_PLAN, "start", 10.0, "a"),
+            ph("r1", PH_PLAN, "end", 11.0, "a"),
+            ph("r1", PH_DISPATCH, "start", 11.0, "b"),  # crash mid-dispatch
+            ph("r1", PH_DRAIN, "start", 11.5, "c"),
+            ph("r1", PH_DRAIN, "end", 14.0, "c"),
+        ]
+        tl = build_timeline(events)
+        names = {p["name"]: p for p in tl["phases"]}
+        assert "dispatch" in tl["incomplete"]
+        assert not names["dispatch"]["complete"]
+        # stretches to the last timestamp seen, never negative
+        assert names["dispatch"]["end"] == pytest.approx(14.0)
+        assert tl["wall_s"] == pytest.approx(4.0)
+
+    def test_markers_extracted_and_job_inferred(self):
+        events = [
+            ph("r1", PH_PLAN, "start", 1.0, "a", job="t123"),
+            ph("r1", PH_PLAN, "end", 2.0, "a", job="t123"),
+            {"seq": 9, "ts": 2.5, "mono": 2.5, "anchor": 0.0, "kind": "transfer.complete",
+             "recorder": "r1", "job": "t123", "bytes": 1 << 20, "seconds": 0.5},
+        ]
+        tl = build_timeline(events)
+        assert tl["job"] == "t123"
+        assert tl["bytes"] == 1 << 20
+        assert tl["transfer_seconds"] == pytest.approx(0.5)
+        assert len(tl["markers"]) == 1
+
+    def test_same_phase_on_two_recorders_merges_to_envelope(self):
+        events = [
+            ph("g1", "phase.first_compile", "start", 5.0, "x", scope="gateway"),
+            ph("g1", "phase.first_compile", "end", 6.0, "x", scope="gateway"),
+            ph("g2", "phase.first_compile", "start", 5.5, "y", scope="gateway"),
+            ph("g2", "phase.first_compile", "end", 7.0, "y", scope="gateway"),
+        ]
+        tl = build_timeline(events)
+        assert len(tl["phases"]) == 1
+        env = tl["phases"][0]
+        assert env["name"] == "gateway.first_compile"
+        assert env["count"] == 2
+        assert env["dur_s"] == pytest.approx(2.0)  # envelope 5.0..7.0
+        assert env["busy_s"] == pytest.approx(2.5)  # 1.0 + 1.5 accumulated
+
+    def test_job_filter_drops_other_jobs(self):
+        events = [
+            ph("r1", PH_PLAN, "start", 1.0, "a", job="keep"),
+            ph("r1", PH_PLAN, "end", 2.0, "a", job="keep"),
+            ph("r1", PH_DISPATCH, "start", 1.0, "b", job="other"),
+            ph("r1", PH_DISPATCH, "end", 3.0, "b", job="other"),
+        ]
+        tl = build_timeline(events, job="keep")
+        assert [p["name"] for p in tl["phases"]] == ["plan"]
+
+    def test_hop_envelopes_from_chrome_trace(self):
+        trace = {"traceEvents": [
+            {"name": "wire.frame", "ph": "X", "ts": 1_000_000, "dur": 500_000, "pid": 1, "tid": 1},
+            {"name": "wire.frame", "ph": "X", "ts": 1_600_000, "dur": 400_000, "pid": 1, "tid": 1},
+            {"name": "decode", "ph": "b", "ts": 1_200_000, "args": {"dur_us": 300_000}},
+            {"name": "unrelated_span", "ph": "X", "ts": 0, "dur": 10},
+        ]}
+        tl = build_timeline([], traces=[({"gateway": "gw_src"}, trace)])
+        names = {h["name"]: h for h in tl["hops"]}
+        assert set(names) == {"hop:gw_src:frame", "hop:gw_src:decode"}
+        fr = names["hop:gw_src:frame"]
+        assert fr["start"] == pytest.approx(1.0) and fr["end"] == pytest.approx(2.0)
+        assert fr["busy_s"] == pytest.approx(0.9)
+        assert fr["count"] == 2
+
+
+class TestDagAndSolve:
+    def test_sequential_phases_chain_with_transitive_reduction(self):
+        events = [
+            ph("r1", PH_PLAN, "start", 0.0, "a"), ph("r1", PH_PLAN, "end", 1.0, "a"),
+            ph("r1", PH_DISPATCH, "start", 1.0, "b"), ph("r1", PH_DISPATCH, "end", 1.5, "b"),
+            ph("r1", PH_DRAIN, "start", 1.5, "c"), ph("r1", PH_DRAIN, "end", 4.0, "c"),
+        ]
+        nodes, edges = timeline_dag(build_timeline(events))
+        assert ("plan", "dispatch") in edges and ("dispatch", "drain") in edges
+        assert ("plan", "drain") not in edges  # transitively reduced
+
+    def test_overlapping_phases_are_parallel_branches(self):
+        # gateway-side compile runs UNDER the client drain: no edge either way,
+        # so the path cannot double-count the overlapped wall-clock
+        events = [
+            ph("r1", PH_DRAIN, "start", 0.0, "a"), ph("r1", PH_DRAIN, "end", 3.0, "a"),
+            ph("g1", "phase.first_compile", "start", 0.5, "b", scope="gateway"),
+            ph("g1", "phase.first_compile", "end", 1.5, "b", scope="gateway"),
+        ]
+        nodes, edges = timeline_dag(build_timeline(events))
+        assert edges == []
+
+    def test_solve_attribution_and_coverage(self):
+        events = [
+            ph("r1", PH_PLAN, "start", 0.0, "a"), ph("r1", PH_PLAN, "end", 1.0, "a"),
+            ph("r1", PH_DRAIN, "start", 1.0, "b"), ph("r1", PH_DRAIN, "end", 4.0, "b"),
+        ]
+        tl = build_timeline(events)
+        cp = solve_timeline(tl)
+        assert cp["path"] == ["plan", "drain"]
+        assert cp["critical_path_s"] == pytest.approx(4.0)
+        assert cp["fixed_s"] == pytest.approx(1.0)
+        assert cp["scaled_s"] == pytest.approx(3.0)  # drain is byte-scaled
+        assert cp["largest_fixed_phase"] == "plan"
+        assert cp["coverage"] == pytest.approx(1.0)
+
+    def test_classify(self):
+        assert classify("plan") == "fixed"
+        assert classify("gateway.first_compile") == "fixed"
+        assert classify("drain") == "scaled"
+        assert classify("hop:gw:frame") == "scaled"
+
+    def test_render_and_perfetto(self):
+        events = [
+            ph("r1", PH_PLAN, "start", 0.0, "a"), ph("r1", PH_PLAN, "end", 1.0, "a"),
+            ph("r1", PH_DRAIN, "start", 1.0, "b"), ph("r1", PH_DRAIN, "end", 4.0, "b"),
+        ]
+        report = timeline_report(events, fit_samples=[(1e6, 2.01), (1e7, 2.1), (1e8, 3.0)],
+                                 cost_per_gb=0.08)
+        text = report["text"]
+        assert "critical path" in text and "largest fixed cost: plan" in text
+        assert "fit (3 sizes)" in text and "egress cost" in text
+        trace = perfetto_export(report["timeline"], report["critical_path"])
+        assert {e["name"] for e in trace["traceEvents"] if e.get("cat") == "phase"} == {"plan", "drain"}
+        on_path = [e for e in trace["traceEvents"] if (e.get("args") or {}).get("on_critical_path")]
+        assert len(on_path) == 2
+        json.dumps(trace)  # must be serializable as-is
+
+
+class TestSkewedClock:
+    """The PR-9 collector merged on raw ``ts``; a wall-clock step (NTP slew,
+    VM suspend) mid-transfer reordered one recorder's events against their
+    own sequence numbers. Events now carry a per-recorder monotonic anchor
+    and every merge keys on event_epoch — pin it."""
+
+    def test_event_epoch_prefers_anchor_and_falls_back_to_ts(self):
+        assert event_epoch({"ts": 100.0, "mono": 7.0, "anchor": 50.0}) == pytest.approx(57.0)
+        assert event_epoch({"ts": 100.0}) == pytest.approx(100.0)  # legacy logs
+        assert event_epoch({"ts": 100.0, "mono": None, "anchor": 50.0}) == pytest.approx(100.0)
+
+    def test_recorder_survives_wall_clock_step_backwards(self, monkeypatch):
+        rec = FlightRecorder(capacity=64)
+        clock = PhaseClock(job="skew", recorder=rec)
+        with clock.phase(PH_PLAN):
+            pass
+        # the host's wall clock steps 300 s BACKWARDS mid-run; monotonic
+        # keeps advancing (that is its contract)
+        real_time = time.time
+        monkeypatch.setattr(time, "time", lambda: real_time() - 300.0)
+        with clock.phase(PH_DISPATCH):
+            pass
+        evs = rec.events_since(0)
+        for e in evs:
+            e.setdefault("recorder", rec.recorder_id)
+        # raw ts is now non-monotonic across the step...
+        assert evs[2]["ts"] < evs[1]["ts"]
+        # ...but the anchored epoch is not
+        epochs = [event_epoch(e) for e in evs]
+        assert epochs == sorted(epochs)
+        # and the builder places dispatch AFTER plan with sane durations
+        tl = build_timeline(evs)
+        names = {p["name"]: p for p in tl["phases"]}
+        assert names["dispatch"]["start"] >= names["plan"]["end"] - 1e-6
+        assert all(p["dur_s"] >= 0.0 for p in tl["phases"])
+
+    def test_collector_merge_orders_by_anchored_epoch(self):
+        from skyplane_tpu.obs.collector import TelemetryCollector
+
+        col = TelemetryCollector([], fleet_log_path=None)
+        # one recorder whose wall clock stepped back 300 s between seq 1 and 2:
+        # ts says B-before-A, anchor+mono says A-before-B (the truth)
+        a = {"seq": 1, "ts": 1000.0, "mono": 10.0, "anchor": 990.0, "kind": "phase.plan", "edge": "start"}
+        b = {"seq": 2, "ts": 701.0, "mono": 11.0, "anchor": 990.0, "kind": "phase.plan", "edge": "end"}
+        col._ingest_events("r1", "client", [a, b])
+        merged = col.fleet_events()
+        assert [e["seq"] for e in merged] == [1, 2]
+        # a naive ts sort would have flipped them — the regression this pins
+        assert sorted(merged, key=lambda e: e["ts"])[0]["seq"] == 2
+
+
+class TestFleetLogResolution:
+    def test_resolve_latest_substring_and_job_scan(self, tmp_path):
+        old = tmp_path / "transfer_100_1.events.jsonl"
+        new = tmp_path / "transfer_200_2.events.jsonl"
+        old.write_text(json.dumps({"kind": "phase.plan", "job": "jobA", "ts": 1.0}) + "\n")
+        new.write_text("not json\n" + json.dumps({"kind": "phase.plan", "job": "jobB", "ts": 2.0}) + "\n")
+        import os
+        os.utime(old, (100, 100))
+        os.utime(new, (200, 200))
+        assert resolve_fleet_log("latest", tmp_path) == new
+        assert resolve_fleet_log("100_1", tmp_path) == old
+        assert resolve_fleet_log("jobA", tmp_path) == old  # content scan past the malformed line
+        assert resolve_fleet_log("nope", tmp_path) is None
+        assert resolve_fleet_log("latest", tmp_path / "missing") is None
+
+
+class TestHistogramQuantile:
+    def test_quantile_interpolates_and_handles_edges(self):
+        from skyplane_tpu.obs.metrics import Histogram
+
+        h = Histogram("t_q", "", buckets=(0.01, 0.1, 1.0))
+        assert h.quantile(0.5) is None  # empty
+        for v in (0.005, 0.05, 0.05, 0.5):
+            h.observe(v)
+        # p50: rank 2 of 4 falls in the (0.01, 0.1] bucket (cum 1 -> 3)
+        q50 = h.quantile(0.5)
+        assert 0.01 <= q50 <= 0.1
+        # p100 of in-range data: the largest finite bound
+        assert h.quantile(1.0) == pytest.approx(1.0)
+        h.observe(50.0)  # lands in +Inf: quantiles clamp to largest finite bound
+        assert h.quantile(0.99) == pytest.approx(1.0)
